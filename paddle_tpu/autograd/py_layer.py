@@ -1,0 +1,99 @@
+"""PyLayer — user-defined differentiable ops.
+
+Parity: /root/reference/python/paddle/autograd/py_layer.py:280 (+ C++ side
+paddle/fluid/eager/pylayer/). TPU-native: the user's ``backward`` staticmethod
+becomes the vjp closure of a tape GradNode directly; inside jit traces it
+composes with jax transforms like any other node.
+"""
+from __future__ import annotations
+
+from typing import Any, List
+
+from .tape import GradNode, grad_enabled, no_grad
+
+__all__ = ["PyLayer", "PyLayerContext"]
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = []
+        self.materialize_grads = True
+        self._extra = {}
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    def saved_tensor(self):
+        return tuple(self._saved)
+
+    def mark_not_inplace(self, *args):
+        pass
+
+    def mark_non_differentiable(self, *args):
+        self._extra["non_diff"] = args
+
+    def set_materialize_grads(self, value: bool):
+        self.materialize_grads = bool(value)
+
+    def __getattr__(self, k):
+        extra = object.__getattribute__(self, "_extra")
+        if k in extra:
+            return extra[k]
+        raise AttributeError(k)
+
+    def __setattr__(self, k, v):
+        if k in ("_saved", "materialize_grads", "_extra"):
+            object.__setattr__(self, k, v)
+        else:
+            self._extra[k] = v
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..tensor.tensor import Tensor
+
+        ctx = PyLayerContext()
+        tensor_args: List[Any] = [a for a in args if isinstance(a, Tensor)]
+        needs = grad_enabled() and any(not t.stop_gradient for t in tensor_args)
+        with no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(outputs, (tuple, list))
+        outs = list(outputs) if multi else [outputs]
+
+        if needs:
+            def vjp_fn(cots):
+                cot_seq = cots if isinstance(cots, tuple) else (cots,)
+                cot_tensors = [Tensor(c, stop_gradient=True) for c in cot_seq]
+                with no_grad():
+                    grads = cls.backward(ctx, *cot_tensors)
+                if not isinstance(grads, (tuple, list)):
+                    grads = [grads]
+                if len(grads) != len(tensor_args):
+                    raise RuntimeError(
+                        f"{cls.__name__}.backward returned {len(grads)} grads "
+                        f"for {len(tensor_args)} tensor inputs"
+                    )
+                return tuple(g._value if isinstance(g, Tensor) else g for g in grads)
+
+            node = GradNode(vjp_fn, tensor_args, [o._value for o in outs], name=cls.__name__)
+            wrapped = []
+            for i, o in enumerate(outs):
+                t = Tensor(o._value, stop_gradient=False)
+                t._grad_node = node
+                t._out_index = i
+                wrapped.append(t)
+            outs = wrapped
+        return outs if multi else outs[0]

@@ -1,0 +1,303 @@
+"""Define-by-run autograd tape.
+
+Capability parity with the reference's eager autograd engine
+(/root/reference/paddle/fluid/eager/backward.cc:105 ``RunBackward`` — queue
+driven traversal over ``GradNodeBase`` with an in-degree map;
+grad_node_info.h:197).
+
+TPU-native design: instead of hand-written per-op GradNode classes generated
+from YAML, every eager op application calls ``jax.vjp`` on its pure JAX
+function; the returned vjp closure *is* the grad node. Nodes carry monotonic
+creation ids, and reverse-creation order is a valid topological order for a
+define-by-run graph, so backward is a single max-heap sweep — no in-degree
+counting needed. Inside ``jax.jit`` traces the same machinery runs on tracers,
+so compiled training steps reuse the eager tape unchanged.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "GradNode",
+    "grad_enabled",
+    "no_grad",
+    "enable_grad",
+    "set_grad_enabled",
+    "is_grad_enabled",
+    "run_backward",
+    "grad",
+]
+
+_node_counter = itertools.count(1)
+
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_state = _GradState()
+
+
+def grad_enabled() -> bool:
+    return _state.enabled
+
+
+def is_grad_enabled() -> bool:
+    return _state.enabled
+
+
+class set_grad_enabled:
+    """Context manager / callable mirroring paddle.set_grad_enabled."""
+
+    def __init__(self, mode: bool):
+        self.prev = _state.enabled
+        _state.enabled = bool(mode)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        _state.enabled = self.prev
+        return False
+
+
+class no_grad:
+    """paddle.no_grad parity: context manager and decorator."""
+
+    def __enter__(self):
+        self.prev = _state.enabled
+        _state.enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _state.enabled = self.prev
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with no_grad():
+                return fn(*a, **k)
+
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self.prev = _state.enabled
+        _state.enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _state.enabled = self.prev
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with enable_grad():
+                return fn(*a, **k)
+
+        return wrapper
+
+
+class GradNode:
+    """One taped op application.
+
+    ``vjp_fn`` maps a tuple of output cotangents to input cotangents.
+    ``inputs`` are the Tensor operands (kept alive until backward, like the
+    reference's TensorWrapper saves). ``out_metas`` are ShapeDtypeStructs used
+    to materialize zero cotangents for unused outputs.
+    """
+
+    __slots__ = ("id", "vjp_fn", "inputs", "out_metas", "name", "n_outs")
+
+    def __init__(self, vjp_fn: Callable, inputs: Sequence[Any], outs: Sequence[Any], name: str = ""):
+        self.id = next(_node_counter)
+        self.vjp_fn = vjp_fn
+        self.inputs = list(inputs)
+        self.out_metas = [jax.ShapeDtypeStruct(jnp.shape(o), jnp.result_type(o)) for o in outs]
+        self.n_outs = len(self.out_metas)
+        self.name = name
+
+    def __repr__(self):
+        return f"GradNode({self.name or 'op'}#{self.id})"
+
+
+def _ones_like_val(v):
+    return jnp.ones(jnp.shape(v), jnp.result_type(v))
+
+
+def _accumulate(tensor, g):
+    """Accumulate cotangent ``g`` (a raw jax array) into tensor.grad."""
+    from ..tensor.tensor import Tensor  # local import to avoid cycle
+
+    if tensor.grad is None:
+        tensor.grad = Tensor(g, stop_gradient=True)
+    else:
+        tensor.grad = Tensor(tensor.grad._value + g, stop_gradient=True)
+
+
+def _apply_hooks(tensor, g):
+    for hook in getattr(tensor, "_hooks", ()):
+        out = hook_call(hook, tensor, g)
+        if out is not None:
+            g = out
+    return g
+
+
+def hook_call(hook, tensor, g):
+    """Run a user hook. Hooks receive/return Tensors (paddle contract)."""
+    from ..tensor.tensor import Tensor
+
+    res = hook(Tensor(g, stop_gradient=True))
+    if res is None:
+        return None
+    return res._value if isinstance(res, Tensor) else res
+
+
+def run_backward(
+    tensors: Sequence[Any],
+    grad_tensors: Optional[Sequence[Any]] = None,
+    retain_graph: bool = False,
+    *,
+    targets: Optional[Sequence[Any]] = None,
+    accumulate_leaf: bool = True,
+):
+    """Core backward sweep.
+
+    When ``targets`` is given, returns cotangents for those tensors (the
+    ``paddle.grad`` path, mirrors GeneralGrad,
+    /root/reference/paddle/fluid/eager/general_grad.h) and, if
+    ``accumulate_leaf`` is False, leaves ``.grad`` untouched.
+    """
+    from ..tensor.tensor import Tensor
+
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+
+    # (node.id -> node), (node.id -> per-slot cotangent list)
+    nodes = {}
+    slot_grads = {}
+    heap: List[int] = []
+    target_ids = {id(t): t for t in (targets or ())}
+    target_grads = {id(t): None for t in (targets or ())}
+
+    def seed(node: GradNode, slot: int, g):
+        if node.id not in nodes:
+            nodes[node.id] = node
+            slot_grads[node.id] = [None] * node.n_outs
+            heapq.heappush(heap, -node.id)
+        cur = slot_grads[node.id][slot]
+        slot_grads[node.id][slot] = g if cur is None else cur + g
+
+    def route(tensor, g):
+        """Deliver cotangent g to ``tensor``'s producer (or accumulate)."""
+        if tensor.stop_gradient:
+            return
+        g = _apply_hooks(tensor, g)
+        if g is None:
+            return
+        if id(tensor) in target_grads:
+            prev = target_grads[id(tensor)]
+            target_grads[id(tensor)] = g if prev is None else prev + g
+        node = tensor._grad_node
+        if node is None:
+            if accumulate_leaf:
+                _accumulate(tensor, g)
+        else:
+            if accumulate_leaf and getattr(tensor, "_retain_grads", False):
+                _accumulate(tensor, g)
+            seed(node, tensor._out_index, g)
+
+    for t, gt in zip(tensors, grad_tensors):
+        if t.stop_gradient and t._grad_node is None:
+            continue
+        g = gt._value if isinstance(gt, Tensor) else (gt if gt is not None else _ones_like_val(t._value))
+        route(t, g)
+
+    while heap:
+        nid = -heapq.heappop(heap)
+        node = nodes.pop(nid)
+        slots = slot_grads.pop(nid)
+        cots = tuple(
+            s if s is not None else jnp.zeros(m.shape, m.dtype) for s, m in zip(slots, node.out_metas)
+        )
+        if node.n_outs == 1:
+            cots = cots[0]
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                f"Trying to backward through {node} a second time. "
+                "Set retain_graph=True if you need to backward twice."
+            )
+        in_grads = node.vjp_fn(cots)
+        if not retain_graph:
+            node.vjp_fn = None
+            node.inputs, inputs = [], node.inputs
+        else:
+            inputs = node.inputs
+        for tensor, g in zip(inputs, in_grads):
+            if g is not None:
+                route(tensor, g)
+
+    if targets is not None:
+        return [target_grads[id(t)] for t in targets]
+    return None
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=None,
+    create_graph: bool = False,
+    only_inputs: bool = True,
+    allow_unused: bool = False,
+    no_grad_vars=None,
+):
+    """paddle.grad parity (python/paddle/autograd/__init__.py surface).
+
+    ``create_graph=True`` (double grad) is supported through composed
+    ``jax.vjp`` only in the compiled path for now; eager raises.
+    """
+    from ..tensor.tensor import Tensor
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True in eager mode is not supported yet; "
+            "use paddle_tpu.jit.to_static + jax-level grad composition."
+        )
+    outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
+    inputs = [inputs] if isinstance(inputs, Tensor) else list(inputs)
+    if retain_graph is None:
+        retain_graph = False
+    gs = run_backward(
+        outputs,
+        grad_outputs,
+        retain_graph=retain_graph,
+        targets=inputs,
+        accumulate_leaf=False,
+    )
+    result = []
+    for t, g in zip(inputs, gs):
+        if g is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "One of the differentiated tensors appears unused in the graph; "
+                    "pass allow_unused=True to return None for it."
+                )
+            result.append(None)
+        else:
+            result.append(Tensor(g, stop_gradient=True))
+    return result

@@ -1,0 +1,114 @@
+"""Device management (parity: python/paddle/device).
+
+TPU-native: devices are jax devices; a ``Place`` is a thin descriptor. There is
+no allocator/stream surface — XLA owns both. ``set_device`` selects the default
+jax device for new tensors.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+__all__ = [
+    "Place", "TPUPlace", "CPUPlace", "CUDAPlace", "get_device", "set_device",
+    "get_all_devices", "device_count", "is_compiled_with_cuda", "is_compiled_with_xpu",
+    "is_compiled_with_rocm", "is_compiled_with_custom_device", "synchronize",
+]
+
+
+class Place:
+    def __init__(self, kind: str, index: int = 0):
+        self.kind = kind
+        self.index = index
+
+    def __repr__(self):
+        return f"Place({self.kind}:{self.index})"
+
+    def __eq__(self, other):
+        return isinstance(other, Place) and (self.kind, self.index) == (other.kind, other.index)
+
+    def is_cpu_place(self):
+        return self.kind == "cpu"
+
+    def is_gpu_place(self):
+        return False
+
+    def is_tpu_place(self):
+        return self.kind in ("tpu", "axon")
+
+
+def TPUPlace(idx: int = 0) -> Place:
+    return Place("tpu", idx)
+
+
+def CPUPlace() -> Place:
+    return Place("cpu", 0)
+
+
+def CUDAPlace(idx: int = 0) -> Place:
+    # Accepted for API compatibility; maps to the accelerator jax exposes.
+    return Place(jax.default_backend(), idx)
+
+
+def _place_of(value) -> Place:
+    try:
+        devs = value.devices() if hasattr(value, "devices") else None
+        if devs:
+            d = next(iter(devs))
+            return Place(d.platform, d.id)
+    except Exception:
+        pass
+    return Place(jax.default_backend(), 0)
+
+
+_current = None
+
+
+def get_device() -> str:
+    if _current is not None:
+        return _current
+    b = jax.default_backend()
+    return f"{b}:0"
+
+
+def set_device(device: str):
+    global _current
+    _current = device
+    return Place(*_split(device))
+
+
+def _split(device: str):
+    if ":" in device:
+        k, i = device.split(":")
+        return k, int(i)
+    return device, 0
+
+
+def get_all_devices():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_custom_device(device_type: str) -> bool:
+    return device_type in ("tpu", "axon")
+
+
+def synchronize(device=None):
+    """Block until all dispatched work completes (stream sync analog)."""
+    (jax.device_put(0) + 0).block_until_ready()

@@ -1,0 +1,35 @@
+"""Framework core: dtypes, flags, RNG (parity: python/paddle/framework + base)."""
+from .dtype import (  # noqa: F401
+    DType,
+    bfloat16,
+    bool_,
+    complex128,
+    complex64,
+    convert_dtype,
+    float16,
+    float32,
+    float64,
+    get_default_dtype,
+    int16,
+    int32,
+    int64,
+    int8,
+    set_default_dtype,
+    to_jax_dtype,
+    uint8,
+)
+from .flags import define_flag, get_flags, set_flags  # noqa: F401
+from .random import (  # noqa: F401
+    Generator,
+    default_generator,
+    get_rng_state,
+    get_rng_state_tracker,
+    seed,
+    set_rng_state,
+)
+
+# keep the submodules reachable as attributes (the `random`/`dtype` names above
+# must not shadow them for `from ..framework import dtype` module imports)
+from . import dtype  # noqa: F401,E402
+from . import flags  # noqa: F401,E402
+from . import random  # noqa: F401,E402

@@ -1,0 +1,170 @@
+"""Dtype system for paddle_tpu.
+
+TPU-native rethink of the reference's ``phi::DataType`` enum
+(/root/reference/paddle/phi/common/data_type.h): instead of a closed C++ enum we
+keep a small registry of ``DType`` singletons that wrap numpy/jax dtypes, so the
+whole stack (Tensor meta, AMP lists, checkpoint IO) speaks one vocabulary while
+XLA sees plain ``jnp`` dtypes.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+__all__ = [
+    "DType",
+    "dtype",
+    "bool_",
+    "uint8",
+    "int8",
+    "int16",
+    "int32",
+    "int64",
+    "float16",
+    "bfloat16",
+    "float32",
+    "float64",
+    "complex64",
+    "complex128",
+    "convert_dtype",
+    "to_jax_dtype",
+    "get_default_dtype",
+    "set_default_dtype",
+]
+
+
+class DType:
+    """A framework dtype: name + numpy/jax dtype. Singleton per kind."""
+
+    __slots__ = ("name", "np_dtype")
+    _registry: dict = {}
+
+    def __init__(self, name: str, np_dtype):
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype)
+        DType._registry[name] = self
+
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self.name == other.name
+        if isinstance(other, str):
+            try:
+                return self.name == convert_dtype(other).name
+            except (TypeError, ValueError):
+                return False
+        try:
+            return self.np_dtype == np.dtype(other)
+        except TypeError:
+            return NotImplemented
+
+    def __hash__(self):
+        return hash(self.name)
+
+    @property
+    def itemsize(self) -> int:
+        return self.np_dtype.itemsize
+
+    @property
+    def is_floating_point(self) -> bool:
+        return self.name in ("float16", "bfloat16", "float32", "float64")
+
+    @property
+    def is_integer(self) -> bool:
+        return self.name in ("uint8", "int8", "int16", "int32", "int64")
+
+    @property
+    def is_complex(self) -> bool:
+        return self.name in ("complex64", "complex128")
+
+
+bool_ = DType("bool", np.bool_)
+uint8 = DType("uint8", np.uint8)
+int8 = DType("int8", np.int8)
+int16 = DType("int16", np.int16)
+int32 = DType("int32", np.int32)
+int64 = DType("int64", np.int64)
+float16 = DType("float16", np.float16)
+bfloat16 = DType("bfloat16", jnp.bfloat16)
+float32 = DType("float32", np.float32)
+float64 = DType("float64", np.float64)
+complex64 = DType("complex64", np.complex64)
+complex128 = DType("complex128", np.complex128)
+
+# canonical aliases accepted from user code
+_ALIASES = {
+    "bool": "bool",
+    "bool_": "bool",
+    "uint8": "uint8",
+    "int8": "int8",
+    "int16": "int16",
+    "int32": "int32",
+    "int64": "int64",
+    "float16": "float16",
+    "half": "float16",
+    "bfloat16": "bfloat16",
+    "bf16": "bfloat16",
+    "float32": "float32",
+    "float": "float32",
+    "float64": "float64",
+    "double": "float64",
+    "complex64": "complex64",
+    "complex128": "complex128",
+}
+
+
+def convert_dtype(d) -> DType:
+    """Convert any dtype-like (DType, str, numpy dtype, jnp dtype) to DType."""
+    if isinstance(d, DType):
+        return d
+    if isinstance(d, str):
+        key = _ALIASES.get(d)
+        if key is None:
+            raise ValueError(f"Unknown dtype string: {d!r}")
+        return DType._registry[key]
+    # numpy / jax dtypes
+    npd = np.dtype(d)
+    name = npd.name
+    if name in DType._registry:
+        return DType._registry[name]
+    raise ValueError(f"Unsupported dtype: {d!r}")
+
+
+def to_jax_dtype(d):
+    if d is None:
+        return None
+    npd = convert_dtype(d).np_dtype
+    # TPU-native default: without jax x64, int64/uint64 requests quietly become
+    # 32-bit (indices are int32 on TPU; avoids per-op truncation warnings).
+    import jax
+
+    if not jax.config.jax_enable_x64 and npd in (np.dtype(np.int64), np.dtype(np.uint64)):
+        return np.dtype(np.int32) if npd == np.dtype(np.int64) else np.dtype(np.uint32)
+    return npd
+
+
+_default_dtype = float32
+
+
+def get_default_dtype() -> str:
+    return _default_dtype.name
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    d = convert_dtype(d)
+    if not d.is_floating_point:
+        raise TypeError(f"default dtype must be floating point, got {d}")
+    _default_dtype = d
+
+
+def default_float_dtype() -> DType:
+    return _default_dtype
+
+
+# `paddle.dtype` style callable
+def dtype(d) -> DType:  # noqa: A001 - mirrors reference API name
+    return convert_dtype(d)

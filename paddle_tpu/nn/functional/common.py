@@ -1,0 +1,313 @@
+"""Common functionals: linear/dropout/embedding/pad/interpolate/...
+(parity: python/paddle/nn/functional/common.py, input.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.random import default_generator
+from ...ops.dispatch import apply
+from ...tensor._helpers import to_tensor_like, unary
+from ...tensor.tensor import Tensor
+
+__all__ = [
+    "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout", "embedding", "one_hot",
+    "pad", "zeropad2d", "interpolate", "upsample", "cosine_similarity", "pixel_shuffle",
+    "pixel_unshuffle", "channel_shuffle", "label_smooth", "bilinear", "fold", "unfold",
+    "normalize",
+]
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b. Paddle weight layout [in, out] (transposed vs torch)."""
+    x, weight = to_tensor_like(x), to_tensor_like(weight)
+    if bias is not None:
+        bias = to_tensor_like(bias)
+        return apply(lambda v, w, b: v @ w + b, x, weight, bias, op_name="linear")
+    return apply(lambda v, w: v @ w, x, weight, op_name="linear")
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0:
+        return to_tensor_like(x)
+    x = to_tensor_like(x)
+    if isinstance(p, Tensor):
+        p = float(p._value)
+    key = default_generator().next_key()
+
+    def f(v):
+        if axis is None:
+            mask_shape = v.shape
+        else:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            mask_shape = tuple(v.shape[i] if i in [a % v.ndim for a in axes] else 1 for i in range(v.ndim))
+        keep = jax.random.bernoulli(key, 1.0 - p, mask_shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), jnp.zeros((), v.dtype)).astype(v.dtype)
+        return jnp.where(keep, v, jnp.zeros((), v.dtype)).astype(v.dtype)
+
+    return apply(f, x, op_name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=ax, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ax = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=ax, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0:
+        return to_tensor_like(x)
+    x = to_tensor_like(x)
+    key = default_generator().next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def f(v):
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        a = (1.0 - p + p * alpha_p**2) ** -0.5
+        b = -a * p * alpha_p
+        return (a * jnp.where(keep, v, jnp.asarray(alpha_p, v.dtype)) + b).astype(v.dtype)
+
+    return apply(f, x, op_name="alpha_dropout")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Lookup rows of weight: paddle layout weight[vocab, dim]."""
+    x, weight = to_tensor_like(x), to_tensor_like(weight)
+
+    def f(ids, w):
+        out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, jnp.zeros((), out.dtype), out)
+        return out
+
+    return apply(f, x, weight, op_name="embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    from ...tensor.creation import one_hot as _oh
+
+    return _oh(x, num_classes)
+
+
+def _norm_pad(pad_arg, ndim, data_format):
+    """Normalize paddle pad arg to jnp.pad config for NC... layouts."""
+    if isinstance(pad_arg, Tensor):
+        pad_arg = pad_arg.tolist()
+    pad_arg = list(pad_arg)
+    n_spatial = ndim - 2
+    # paddle order: last-dim pairs first ([left,right] for W, then H, ...)
+    pairs = [(int(pad_arg[2 * i]), int(pad_arg[2 * i + 1])) for i in range(len(pad_arg) // 2)]
+    cfg = [(0, 0)] * ndim
+    if data_format.startswith("NC"):
+        spatial_axes = list(range(2, ndim))
+    else:
+        spatial_axes = list(range(1, ndim - 1))
+    for i, (lo, hi) in enumerate(pairs):
+        cfg[spatial_axes[-1 - i]] = (lo, hi)
+    return cfg
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", pad_from_left_axis=True, name=None):  # noqa: A002
+    x = to_tensor_like(x)
+    if isinstance(pad, (list, tuple)) and len(pad) == 2 * x.ndim:
+        # full per-axis spec (paddle allows len == 2*ndim): pairs in axis order
+        cfg = [(int(pad[2 * i]), int(pad[2 * i + 1])) for i in range(x.ndim)]
+    else:
+        cfg = _norm_pad(pad, x.ndim, data_format)
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+
+    def f(v):
+        if jmode == "constant":
+            return jnp.pad(v, cfg, mode="constant", constant_values=value)
+        return jnp.pad(v, cfg, mode=jmode)
+
+    return apply(f, x, op_name="pad")
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def interpolate(
+    x, size=None, scale_factor=None, mode="nearest", align_corners=False, align_mode=0,
+    data_format="NCHW", name=None,
+):
+    x = to_tensor_like(x)
+    nd = x.ndim
+    channels_first = data_format.startswith("NC")
+    spatial = x.shape[2:] if channels_first else x.shape[1:-1]
+    if size is not None:
+        size = [int(s._value) if isinstance(s, Tensor) else int(s) for s in (size if isinstance(size, (list, tuple)) else [size])]
+        out_spatial = list(size)
+    else:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * len(spatial)
+        out_spatial = [int(d * s) for d, s in zip(spatial, sf)]
+    method = {"nearest": "nearest", "bilinear": "linear", "linear": "linear", "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+
+    def f(v):
+        if channels_first:
+            tgt_shape = v.shape[:2] + tuple(out_spatial)
+        else:
+            tgt_shape = (v.shape[0],) + tuple(out_spatial) + (v.shape[-1],)
+        return jax.image.resize(v, tgt_shape, method=method).astype(v.dtype)
+
+    return apply(f, x, op_name="interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    x1, x2 = to_tensor_like(x1), to_tensor_like(x2)
+
+    def f(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.sqrt(jnp.sum(a * a, axis=axis)) * jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return num / jnp.maximum(den, eps)
+
+    return apply(f, x1, x2, op_name="cosine_similarity")
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = int(upscale_factor)
+
+    def f(v):
+        n, c, h, w = v.shape if data_format == "NCHW" else (v.shape[0], v.shape[3], v.shape[1], v.shape[2])
+        if data_format != "NCHW":
+            v = jnp.transpose(v, (0, 3, 1, 2))
+        oc = c // (r * r)
+        out = v.reshape(n, oc, r, r, h, w).transpose(0, 1, 4, 2, 5, 3).reshape(n, oc, h * r, w * r)
+        if data_format != "NCHW":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return unary(f, x, "pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = int(downscale_factor)
+
+    def f(v):
+        if data_format != "NCHW":
+            v = jnp.transpose(v, (0, 3, 1, 2))
+        n, c, h, w = v.shape
+        out = (
+            v.reshape(n, c, h // r, r, w // r, r)
+            .transpose(0, 1, 3, 5, 2, 4)
+            .reshape(n, c * r * r, h // r, w // r)
+        )
+        if data_format != "NCHW":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return unary(f, x, "pixel_unshuffle")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    g = int(groups)
+
+    def f(v):
+        if data_format != "NCHW":
+            v = jnp.transpose(v, (0, 3, 1, 2))
+        n, c, h, w = v.shape
+        out = v.reshape(n, g, c // g, h, w).transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+        if data_format != "NCHW":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return unary(f, x, "channel_shuffle")
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    label = to_tensor_like(label)
+
+    def f(v):
+        c = v.shape[-1]
+        if prior_dist is None:
+            return (1 - epsilon) * v + epsilon / c
+        pd = prior_dist._value if isinstance(prior_dist, Tensor) else jnp.asarray(prior_dist)
+        return (1 - epsilon) * v + epsilon * pd
+
+    return apply(f, label, op_name="label_smooth")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    x1, x2, weight = to_tensor_like(x1), to_tensor_like(x2), to_tensor_like(weight)
+
+    def f(a, b, w, *rest):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+
+    if bias is not None:
+        return apply(f, x1, x2, weight, to_tensor_like(bias), op_name="bilinear")
+    return apply(f, x1, x2, weight, op_name="bilinear")
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (NCHW): output [N, C*kh*kw, L]."""
+    x = to_tensor_like(x)
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+
+    def f(v):
+        n, c, h, w = v.shape
+        v = jnp.pad(v, [(0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])])
+        oh = (v.shape[2] - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (v.shape[3] - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        cols = []
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                patch = v[:, :, i * dl[0] : i * dl[0] + oh * st[0] : st[0], j * dl[1] : j * dl[1] + ow * st[1] : st[1]]
+                cols.append(patch)
+        out = jnp.stack(cols, axis=2)  # [N, C, kh*kw, OH, OW]
+        return out.reshape(n, c * ks[0] * ks[1], oh * ow)
+
+    return apply(f, x, op_name="unfold")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """col2im inverse of unfold."""
+    x = to_tensor_like(x)
+    os_ = output_sizes if isinstance(output_sizes, (list, tuple)) else [output_sizes] * 2
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+
+    def f(v):
+        n = v.shape[0]
+        c = v.shape[1] // (ks[0] * ks[1])
+        ph, pw = os_[0] + 2 * pd[0], os_[1] + 2 * pd[1]
+        oh = (ph - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (pw - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        v4 = v.reshape(n, c, ks[0], ks[1], oh, ow)
+        out = jnp.zeros((n, c, ph, pw), v.dtype)
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                out = out.at[:, :, i * dl[0] : i * dl[0] + oh * st[0] : st[0], j * dl[1] : j * dl[1] + ow * st[1] : st[1]].add(v4[:, :, i, j])
+        return out[:, :, pd[0] : ph - pd[0], pd[1] : pw - pd[1]]
+
+    return apply(f, x, op_name="fold")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(v):
+        nrm = jnp.power(jnp.sum(jnp.power(jnp.abs(v), p), axis=axis, keepdims=True), 1.0 / p)
+        return v / jnp.maximum(nrm, epsilon)
+
+    return unary(f, x, "normalize")

@@ -1,0 +1,2 @@
+"""Op dispatch layer (TPU-native analog of PHI dispatch, see dispatch.py)."""
+from .dispatch import apply  # noqa: F401

@@ -1,0 +1,68 @@
+"""Eager op dispatch.
+
+This is the TPU-native collapse of the reference's dispatch stack
+(/root/reference/paddle/phi/api/generator/api_base.py:1300 kernel selection,
+paddle/fluid/eager/auto_code_generator/generator/eager_gen.py grad-node
+creation): one function, ``apply``, that (a) runs the op's pure JAX function
+on the operands and (b) when gradients are required, obtains the op's VJP from
+``jax.vjp`` and tapes it as a GradNode. There is no kernel registry — XLA is
+the kernel library — and no generated per-op autograd classes.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd import tape
+from ..framework import flags
+
+
+def _wrap(val, node, index, stop_gradient):
+    from ..tensor.tensor import Tensor
+
+    t = Tensor(val, stop_gradient=stop_gradient)
+    if node is not None:
+        t._grad_node = node
+        t._out_index = index
+    return t
+
+
+def apply(fn: Callable, *inputs, op_name: str = "", n_outs: int = 1):
+    """Run ``fn(*raw_values)`` and tape its vjp if needed.
+
+    ``inputs`` must all be Tensors (op wrappers normalize scalars either by
+    closing over them inside ``fn`` or by converting to Tensor). ``fn`` must be
+    a pure function of the raw jax arrays. Returns Tensor or list of Tensors
+    matching fn's output arity.
+    """
+    vals = tuple(t._value for t in inputs)
+    needs_grad = tape.grad_enabled() and any(not t.stop_gradient for t in inputs)
+    if needs_grad:
+        outs, vjp_fn = jax.vjp(fn, *vals)
+        multi = isinstance(outs, (tuple, list))
+        outs_seq = list(outs) if multi else [outs]
+        node = tape.GradNode(vjp_fn, inputs, outs_seq, name=op_name)
+        results = [_wrap(o, node, i, False) for i, o in enumerate(outs_seq)]
+    else:
+        outs = fn(*vals)
+        multi = isinstance(outs, (tuple, list))
+        outs_seq = list(outs) if multi else [outs]
+        results = [_wrap(o, None, 0, True) for o in outs_seq]
+
+    if flags.flag_value("check_nan_inf"):
+        _check_nan_inf(op_name, outs_seq)
+    return results if multi else results[0]
+
+
+def _check_nan_inf(op_name, outs):
+    # Reference capability: FLAGS_check_nan_inf per-op scan
+    # (/root/reference/paddle/fluid/eager/nan_inf_utils.h). Only meaningful on
+    # concrete (non-traced) values.
+    for o in outs:
+        if isinstance(o, jax.core.Tracer):
+            return
+        if jnp.issubdtype(jnp.result_type(o), jnp.floating):
+            if bool(jnp.any(~jnp.isfinite(o))):
+                raise FloatingPointError(f"nan/inf detected in output of op {op_name or '<anonymous>'}")

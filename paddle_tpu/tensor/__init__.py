@@ -1,0 +1,100 @@
+"""paddle_tpu.tensor — op namespace + Tensor method patching.
+
+Mirrors the reference's method patching
+(python/paddle/base/dygraph/tensor_patch_methods.py): named functions from the
+op modules are attached to ``Tensor`` as methods, plus the operator dunders.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .tensor import Tensor
+from ..ops.dispatch import apply
+from ._helpers import unary
+from . import creation, einsum as einsum_mod, linalg, logic, manipulation, math, random, search, stat
+
+# re-export everything into paddle_tpu.tensor namespace
+from .creation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+from .einsum import einsum  # noqa: F401
+
+
+def real(x, name=None):
+    return unary(jnp.real, x, "real")
+
+
+def imag(x, name=None):
+    return unary(jnp.imag, x, "imag")
+
+
+def _patch_methods():
+    # method name -> function (first arg is the tensor)
+    sources = [math, linalg, manipulation, logic, search, stat, creation, random]
+    method_names = set()
+    for m in sources:
+        for n in getattr(m, "__all__", []):
+            method_names.add((n, m))
+    # not methods on Tensor in paddle
+    skip = {
+        "to_tensor", "tensor", "zeros", "ones", "full", "empty", "arange", "linspace",
+        "logspace", "eye", "tril_indices", "triu_indices", "meshgrid", "rand", "randn",
+        "standard_normal", "normal", "uniform", "randint", "randperm", "is_tensor",
+        "broadcast_tensors", "assign", "one_hot", "complex", "polar", "scatter_nd",
+        "pad_sequences", "broadcast_shape", "multi_dot", "randint_like", "multiplex",
+        "log_normal", "binomial",
+    }
+    for name, mod in method_names:
+        if name in skip or hasattr(Tensor, name):
+            continue
+        fn = getattr(mod, name, None)
+        if fn is None or not callable(fn):
+            continue
+        setattr(Tensor, name, fn)
+
+    Tensor.real = real
+    Tensor.imag = imag
+    Tensor.einsum = None  # not a method
+    del Tensor.einsum
+    Tensor.mean = stat.mean
+    Tensor.matmul = linalg.matmul
+    Tensor.dot = linalg.dot
+
+    # ---- operator dunders ----
+    Tensor.__add__ = lambda s, o: math.add(s, o)
+    Tensor.__radd__ = lambda s, o: math.add(o, s)
+    Tensor.__sub__ = lambda s, o: math.subtract(s, o)
+    Tensor.__rsub__ = lambda s, o: math.subtract(o, s)
+    Tensor.__mul__ = lambda s, o: math.multiply(s, o)
+    Tensor.__rmul__ = lambda s, o: math.multiply(o, s)
+    Tensor.__truediv__ = lambda s, o: math.divide(s, o)
+    Tensor.__rtruediv__ = lambda s, o: math.divide(o, s)
+    Tensor.__floordiv__ = lambda s, o: math.floor_divide(s, o)
+    Tensor.__rfloordiv__ = lambda s, o: math.floor_divide(o, s)
+    Tensor.__mod__ = lambda s, o: math.remainder(s, o)
+    Tensor.__rmod__ = lambda s, o: math.remainder(o, s)
+    Tensor.__pow__ = lambda s, o: math.pow(s, o)
+    Tensor.__rpow__ = lambda s, o: math.pow(o, s)
+    Tensor.__rmatmul__ = lambda s, o: linalg.matmul(o, s)
+    Tensor.__eq__ = lambda s, o: logic.equal(s, o)
+    Tensor.__ne__ = lambda s, o: logic.not_equal(s, o)
+    Tensor.__lt__ = lambda s, o: logic.less_than(s, o)
+    Tensor.__le__ = lambda s, o: logic.less_equal(s, o)
+    Tensor.__gt__ = lambda s, o: logic.greater_than(s, o)
+    Tensor.__ge__ = lambda s, o: logic.greater_equal(s, o)
+    Tensor.__and__ = lambda s, o: logic.logical_and(s, o) if s.dtype.name == "bool" else logic.bitwise_and(s, o)
+    Tensor.__or__ = lambda s, o: logic.logical_or(s, o) if s.dtype.name == "bool" else logic.bitwise_or(s, o)
+    Tensor.__xor__ = lambda s, o: logic.logical_xor(s, o) if s.dtype.name == "bool" else logic.bitwise_xor(s, o)
+    # in-place operator forms adopt the functional result
+    Tensor.__iadd__ = lambda s, o: s._inplace_adopt(math.add(s, o))
+    Tensor.__isub__ = lambda s, o: s._inplace_adopt(math.subtract(s, o))
+    Tensor.__imul__ = lambda s, o: s._inplace_adopt(math.multiply(s, o))
+    Tensor.__itruediv__ = lambda s, o: s._inplace_adopt(math.divide(s, o))
+
+
+_patch_methods()

@@ -1,0 +1,53 @@
+"""Shared helpers for op wrapper modules."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..ops.dispatch import apply
+from .tensor import Tensor
+
+
+def to_tensor_like(x):
+    """Convert x to Tensor if it is not one (scalars stay scalars at call sites
+    that close over them; this is for API args documented as Tensor)."""
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(x)
+
+
+def unary(jnp_fn, x, name: str):
+    x = to_tensor_like(x)
+    return apply(jnp_fn, x, op_name=name)
+
+
+def binary(jnp_fn, x, y, name: str):
+    xt, yt = isinstance(x, Tensor), isinstance(y, Tensor)
+    if xt and yt:
+        return apply(jnp_fn, x, y, op_name=name)
+    if xt:
+        return apply(lambda a: jnp_fn(a, y), x, op_name=name)
+    if yt:
+        return apply(lambda b: jnp_fn(x, b), y, op_name=name)
+    return Tensor(jnp_fn(jnp.asarray(x), jnp.asarray(y)))
+
+
+def normalize_axis(axis):
+    """paddle reduce axis arg: None | int | list/tuple -> jnp axis."""
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    if isinstance(axis, Tensor):
+        return tuple(int(a) for a in np.asarray(axis._value).reshape(-1))
+    return int(axis)
+
+
+def maybe_int_list(v):
+    """shape-like args may be Tensors / lists of Tensors in paddle."""
+    if isinstance(v, Tensor):
+        return [int(x) for x in np.asarray(v._value).reshape(-1)]
+    if isinstance(v, (list, tuple)):
+        return [int(x._value) if isinstance(x, Tensor) else int(x) for x in v]
+    return v

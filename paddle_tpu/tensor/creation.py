@@ -1,0 +1,198 @@
+"""Creation ops (parity: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..framework.dtype import default_float_dtype, to_jax_dtype
+from ..ops.dispatch import apply
+from ._helpers import maybe_int_list, to_tensor_like, unary
+from .tensor import Tensor
+
+__all__ = [
+    "to_tensor", "tensor", "zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+    "full_like", "empty_like", "arange", "linspace", "logspace", "eye", "assign", "clone",
+    "numel", "tril_indices", "triu_indices", "complex", "polar", "cauchy_", "geometric_",
+    "diag", "diagflat", "meshgrid", "one_hot",
+]
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor parity (python/paddle/tensor/creation.py)."""
+    if isinstance(data, Tensor):
+        t = Tensor(data._value, stop_gradient=stop_gradient, dtype=dtype)
+        return t
+    return Tensor(data, stop_gradient=stop_gradient, dtype=dtype)
+
+
+tensor = to_tensor
+
+
+def _resolve_dtype(dtype, like=None):
+    if dtype is not None:
+        return to_jax_dtype(dtype)
+    if like is not None:
+        return like
+    return default_float_dtype().np_dtype
+
+
+def zeros(shape, dtype=None, name=None):
+    shape = tuple(maybe_int_list(shape)) if not isinstance(shape, int) else (shape,)
+    return Tensor(jnp.zeros(shape, _resolve_dtype(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    shape = tuple(maybe_int_list(shape)) if not isinstance(shape, int) else (shape,)
+    return Tensor(jnp.ones(shape, _resolve_dtype(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    shape = tuple(maybe_int_list(shape)) if not isinstance(shape, int) else (shape,)
+    fv = fill_value._value if isinstance(fill_value, Tensor) else fill_value
+    if dtype is None and isinstance(fv, (bool, int, float)):
+        if isinstance(fv, bool):
+            dt = np.bool_
+        elif isinstance(fv, int):
+            dt = np.int64
+        else:
+            dt = default_float_dtype().np_dtype
+        return Tensor(jnp.full(shape, fv, dt))
+    return Tensor(jnp.full(shape, fv, _resolve_dtype(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    x = to_tensor_like(x)
+    jdt = to_jax_dtype(dtype) if dtype is not None else None
+    return Tensor(jnp.zeros(x._value.shape, jdt or x._value.dtype))
+
+
+def ones_like(x, dtype=None, name=None):
+    x = to_tensor_like(x)
+    jdt = to_jax_dtype(dtype) if dtype is not None else None
+    return Tensor(jnp.ones(x._value.shape, jdt or x._value.dtype))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    x = to_tensor_like(x)
+    jdt = to_jax_dtype(dtype) if dtype is not None else None
+    fv = fill_value._value if isinstance(fill_value, Tensor) else fill_value
+    return Tensor(jnp.full(x._value.shape, fv, jdt or x._value.dtype))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    s = start._value if isinstance(start, Tensor) else start
+    e = end._value if isinstance(end, Tensor) else end
+    st = step._value if isinstance(step, Tensor) else step
+    jdt = to_jax_dtype(dtype) if dtype is not None else None
+    if e is None:
+        s, e = 0, s
+    if jdt is None:
+        py = (s, e, st)
+        jdt = default_float_dtype().np_dtype if any(isinstance(v, float) for v in py) else np.int64
+    return Tensor(jnp.arange(s, e, st, dtype=jdt))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    s = start._value if isinstance(start, Tensor) else start
+    e = stop._value if isinstance(stop, Tensor) else stop
+    n = int(num._value) if isinstance(num, Tensor) else int(num)
+    return Tensor(jnp.linspace(s, e, n, dtype=_resolve_dtype(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    s = start._value if isinstance(start, Tensor) else start
+    e = stop._value if isinstance(stop, Tensor) else stop
+    n = int(num._value) if isinstance(num, Tensor) else int(num)
+    return Tensor(jnp.logspace(s, e, n, base=base, dtype=_resolve_dtype(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows), None if num_columns is None else int(num_columns), dtype=_resolve_dtype(dtype)))
+
+
+def assign(x, output=None):
+    x = to_tensor_like(x)
+    out = apply(lambda v: v + jnp.zeros((), v.dtype), x, op_name="assign")
+    if output is not None:
+        output._inplace_adopt(out)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return to_tensor_like(x).clone()
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(to_tensor_like(x).size))
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    if col is None:
+        col = row
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c])))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    if col is None:
+        col = row
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c])))
+
+
+def complex(real, imag, name=None):  # noqa: A001
+    import jax
+
+    real, imag = to_tensor_like(real), to_tensor_like(imag)
+    return apply(lambda r, i: jax.lax.complex(r, i), real, imag, op_name="complex")
+
+
+def polar(abs, angle, name=None):  # noqa: A002
+    import jax
+
+    abs, angle = to_tensor_like(abs), to_tensor_like(angle)  # noqa: A001
+    return apply(lambda a, t: jax.lax.complex(a * jnp.cos(t), a * jnp.sin(t)), abs, angle, op_name="polar")
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    from .random import _next_key
+    import jax
+
+    u = jax.random.cauchy(_next_key(), x._value.shape, dtype=x._value.dtype)
+    x._value = u * scale + loc
+    return x
+
+
+def geometric_(x, probs, name=None):
+    from .random import _next_key
+    import jax
+
+    p = probs._value if isinstance(probs, Tensor) else probs
+    u = jax.random.uniform(_next_key(), x._value.shape, dtype=jnp.float32)
+    x._value = (jnp.ceil(jnp.log1p(-u) / jnp.log1p(-p))).astype(x._value.dtype)
+    return x
+
+
+def one_hot(x, num_classes, name=None):
+    import jax
+
+    x = to_tensor_like(x)
+    return apply(
+        lambda v: jax.nn.one_hot(v.astype(jnp.int32), num_classes, dtype=default_float_dtype().np_dtype),
+        x,
+        op_name="one_hot",
+    )
+
+
+# re-export from manipulation for `paddle.diag` style access
+from .manipulation import diag, diagflat, meshgrid  # noqa: E402,F401

@@ -1,0 +1,14 @@
+"""Einsum (parity: python/paddle/tensor/einsum.py). XLA maps this to MXU dots."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops.dispatch import apply
+from ._helpers import to_tensor_like
+
+__all__ = ["einsum"]
+
+
+def einsum(equation, *operands, name=None):
+    ts = [to_tensor_like(o) for o in operands]
+    return apply(lambda *vs: jnp.einsum(equation, *vs), *ts, op_name="einsum")

@@ -1,0 +1,262 @@
+"""Linear algebra ops (parity: python/paddle/tensor/linalg.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.dispatch import apply
+from ._helpers import normalize_axis, to_tensor_like, unary
+from .tensor import Tensor
+
+__all__ = [
+    "matmul", "mm", "bmm", "dot", "t", "transpose", "norm", "dist", "cross",
+    "cholesky", "cholesky_solve", "triangular_solve", "solve", "inv", "pinv", "det", "slogdet",
+    "svd", "qr", "eig", "eigh", "eigvals", "eigvalsh", "matrix_power", "matrix_rank",
+    "cov", "corrcoef", "lstsq", "lu", "householder_product", "multi_dot", "vecdot", "tensordot",
+]
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    """paddle.matmul parity (python/paddle/tensor/linalg.py:189).
+
+    On TPU this is the MXU op; keep inputs bf16/f32 and batched — XLA tiles it.
+    """
+    x, y = to_tensor_like(x), to_tensor_like(y)
+
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return apply(f, x, y, op_name="matmul")
+
+
+def mm(input, mat2, name=None):  # noqa: A002
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def dot(x, y, name=None):
+    x, y = to_tensor_like(x), to_tensor_like(y)
+    return apply(lambda a, b: jnp.sum(a * b, axis=-1), x, y, op_name="dot")
+
+
+def t(input, name=None):  # noqa: A002
+    x = to_tensor_like(input)
+    if x.ndim < 2:
+        return x
+    return transpose(x, [1, 0])
+
+
+def transpose(x, perm, name=None):
+    x = to_tensor_like(x)
+    perm = [int(p) for p in perm]
+    return apply(lambda v: jnp.transpose(v, perm), x, op_name="transpose")
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    ax = normalize_axis(axis)
+    pp = 2.0 if p is None or p == "fro" else p
+
+    def f(v):
+        if p == "fro" and ax is None:
+            return jnp.sqrt(jnp.sum(v * v))
+        if pp == float("inf"):
+            return jnp.max(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if pp == float("-inf"):
+            return jnp.min(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if pp == 0:
+            return jnp.sum((v != 0).astype(v.dtype), axis=ax, keepdims=keepdim)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(v), pp), axis=ax, keepdims=keepdim), 1.0 / pp)
+
+    return unary(f, x, "norm")
+
+
+def dist(x, y, p=2, name=None):
+    x, y = to_tensor_like(x), to_tensor_like(y)
+
+    def f(a, b):
+        d = a - b
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d))
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(d))
+        if p == 0:
+            return jnp.sum((d != 0).astype(d.dtype))
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(d), p)), 1.0 / p)
+
+    return apply(f, x, y, op_name="dist")
+
+
+def cross(x, y, axis=9, name=None):
+    x, y = to_tensor_like(x), to_tensor_like(y)
+    ax = axis if axis != 9 else None
+
+    def f(a, b):
+        if ax is None:
+            # first axis with dim 3 (paddle semantics)
+            use = next(i for i, d in enumerate(a.shape) if d == 3)
+        else:
+            use = ax
+        return jnp.cross(a, b, axis=use)
+
+    return apply(f, x, y, op_name="cross")
+
+
+def cholesky(x, upper=False, name=None):
+    def f(v):
+        L = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(L, -1, -2).conj() if upper else L
+
+    return unary(f, x, "cholesky")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    x, y = to_tensor_like(x), to_tensor_like(y)
+
+    def f(b, L):
+        Lm = jnp.swapaxes(L, -1, -2) if upper else L
+        return jax.scipy.linalg.cho_solve((Lm, True), b)
+
+    return apply(f, x, y, op_name="cholesky_solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    x, y = to_tensor_like(x), to_tensor_like(y)
+
+    def f(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular
+        )
+
+    return apply(f, x, y, op_name="triangular_solve")
+
+
+def solve(x, y, name=None):
+    x, y = to_tensor_like(x), to_tensor_like(y)
+    return apply(lambda a, b: jnp.linalg.solve(a, b), x, y, op_name="solve")
+
+
+def inv(x, name=None):
+    return unary(jnp.linalg.inv, x, "inv")
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return unary(lambda v: jnp.linalg.pinv(v, rtol=rcond, hermitian=hermitian), x, "pinv")
+
+
+def det(x, name=None):
+    return unary(jnp.linalg.det, x, "det")
+
+
+def slogdet(x, name=None):
+    x = to_tensor_like(x)
+    return apply(lambda v: tuple(jnp.linalg.slogdet(v)), x, op_name="slogdet", n_outs=2)
+
+
+def svd(x, full_matrices=False, name=None):
+    x = to_tensor_like(x)
+    return apply(
+        lambda v: tuple(jnp.linalg.svd(v, full_matrices=full_matrices)), x, op_name="svd", n_outs=3
+    )
+
+
+def qr(x, mode="reduced", name=None):
+    x = to_tensor_like(x)
+    if mode == "r":
+        return apply(lambda v: jnp.linalg.qr(v, mode="r"), x, op_name="qr")
+    return apply(lambda v: tuple(jnp.linalg.qr(v, mode=mode)), x, op_name="qr", n_outs=2)
+
+
+def eig(x, name=None):
+    x = to_tensor_like(x)
+    return apply(lambda v: tuple(jnp.linalg.eig(v)), x, op_name="eig", n_outs=2)
+
+
+def eigh(x, UPLO="L", name=None):
+    x = to_tensor_like(x)
+    return apply(lambda v: tuple(jnp.linalg.eigh(v, UPLO=UPLO)), x, op_name="eigh", n_outs=2)
+
+
+def eigvals(x, name=None):
+    return unary(jnp.linalg.eigvals, x, "eigvals")
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return unary(lambda v: jnp.linalg.eigvalsh(v, UPLO=UPLO), x, "eigvalsh")
+
+
+def matrix_power(x, n, name=None):
+    return unary(lambda v: jnp.linalg.matrix_power(v, n), x, "matrix_power")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return unary(lambda v: jnp.linalg.matrix_rank(v, rtol=tol), x, "matrix_rank")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    fw = fweights._value if isinstance(fweights, Tensor) else fweights
+    aw = aweights._value if isinstance(aweights, Tensor) else aweights
+    return unary(
+        lambda v: jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0, fweights=fw, aweights=aw),
+        x,
+        "cov",
+    )
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return unary(lambda v: jnp.corrcoef(v, rowvar=rowvar), x, "corrcoef")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    x, y = to_tensor_like(x), to_tensor_like(y)
+    return apply(
+        lambda a, b: tuple(jnp.linalg.lstsq(a, b, rcond=rcond)), x, y, op_name="lstsq", n_outs=4
+    )
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    x = to_tensor_like(x)
+
+    def f(v):
+        lu_, piv = jax.scipy.linalg.lu_factor(v)
+        return lu_, piv.astype(jnp.int32) + 1  # paddle returns 1-based pivots
+
+    return apply(f, x, op_name="lu", n_outs=2)
+
+
+def householder_product(x, tau, name=None):
+    x, tau = to_tensor_like(x), to_tensor_like(tau)
+
+    def f(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        q = jnp.eye(m, dtype=a.dtype)
+        for i in range(n):
+            v = jnp.concatenate([jnp.zeros((i,), a.dtype), jnp.ones((1,), a.dtype), a[i + 1:, i]])
+            q = q - t[i] * (q @ jnp.outer(v, v))
+        return q
+
+    return apply(f, x, tau, op_name="householder_product")
+
+
+def multi_dot(x, name=None):
+    ts = [to_tensor_like(v) for v in x]
+    return apply(lambda *vs: jnp.linalg.multi_dot(vs), *ts, op_name="multi_dot")
+
+
+def vecdot(x, y, axis=-1, name=None):
+    x, y = to_tensor_like(x), to_tensor_like(y)
+    return apply(lambda a, b: jnp.sum(a * b, axis=axis), x, y, op_name="vecdot")
+
+
+def tensordot(x, y, axes=2, name=None):
+    x, y = to_tensor_like(x), to_tensor_like(y)
+    ax = axes
+    if isinstance(axes, Tensor):
+        ax = axes.tolist()
+    return apply(lambda a, b: jnp.tensordot(a, b, axes=ax), x, y, op_name="tensordot")

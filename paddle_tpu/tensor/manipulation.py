@@ -1,0 +1,643 @@
+"""Shape/layout manipulation ops (parity: python/paddle/tensor/manipulation.py).
+
+Reference note: inplace/view ops there (reshape_, view, as_strided backed by
+paddle/phi/kernels/stride/) have no XLA analog — everything here is functional
+and XLA's buffer aliasing recovers the memory behavior under jit.
+"""
+from __future__ import annotations
+
+import builtins
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..ops.dispatch import apply
+from ._helpers import maybe_int_list, to_tensor_like, unary
+from .tensor import Tensor
+
+__all__ = [
+    "reshape", "reshape_", "view", "flatten", "squeeze", "squeeze_", "unsqueeze", "unsqueeze_",
+    "concat", "stack", "hstack", "vstack", "dstack", "split", "vsplit", "hsplit", "dsplit",
+    "tensor_split", "chunk", "tile", "expand", "expand_as", "broadcast_to", "broadcast_tensors",
+    "flip", "rot90", "roll", "gather", "gather_nd", "scatter", "scatter_", "scatter_nd",
+    "scatter_nd_add", "index_select", "index_sample", "index_add", "index_put", "index_fill",
+    "masked_select", "masked_fill", "masked_scatter", "take_along_axis", "put_along_axis",
+    "unbind", "unique", "unique_consecutive", "repeat_interleave", "tril", "triu", "tril_",
+    "triu_", "diag", "diagflat", "diag_embed", "meshgrid", "moveaxis", "swapaxes", "as_real",
+    "as_complex", "flatten_", "unstack", "unfold", "pad_sequences", "cast", "cast_", "slice",
+    "crop", "strided_slice", "atleast_1d", "atleast_2d", "atleast_3d", "select_scatter",
+]
+
+
+def cast(x, dtype, name=None):
+    from ..framework.dtype import to_jax_dtype
+
+    jdt = to_jax_dtype(dtype)
+    return unary(lambda v: v.astype(jdt), x, "cast")
+
+
+def cast_(x, dtype):
+    return x._inplace_adopt(cast(x, dtype))
+
+
+def reshape(x, shape, name=None):
+    shape = maybe_int_list(shape)
+    return unary(lambda v: jnp.reshape(v, tuple(shape)), x, "reshape")
+
+
+def reshape_(x, shape, name=None):
+    return x._inplace_adopt(reshape(x, shape))
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    from ..framework.dtype import to_jax_dtype
+
+    jdt = to_jax_dtype(shape_or_dtype)
+    return unary(lambda v: v.view(jdt) if hasattr(v, "view") else v.astype(jdt), x, "view")
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = to_tensor_like(x)
+    nd = x.ndim
+    s = start_axis % nd if nd else 0
+    e = stop_axis % nd if nd else 0
+
+    def f(v):
+        shape = v.shape
+        mid = int(np.prod(shape[s : e + 1])) if shape else 1
+        return jnp.reshape(v, shape[:s] + (mid,) + shape[e + 1 :])
+
+    return apply(f, x, op_name="flatten")
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    return x._inplace_adopt(flatten(x, start_axis, stop_axis))
+
+
+def squeeze(x, axis=None, name=None):
+    x = to_tensor_like(x)
+
+    def f(v):
+        if axis is None:
+            return jnp.squeeze(v)
+        ax = axis if isinstance(axis, (list, tuple)) else [axis]
+        ax = tuple(a % v.ndim for a in ax if v.shape[a % v.ndim] == 1)
+        return jnp.squeeze(v, axis=ax) if ax else v
+
+    return apply(f, x, op_name="squeeze")
+
+
+def squeeze_(x, axis=None, name=None):
+    return x._inplace_adopt(squeeze(x, axis))
+
+
+def unsqueeze(x, axis, name=None):
+    ax = maybe_int_list(axis if isinstance(axis, (list, tuple, Tensor)) else [axis])
+    def f(v):
+        out = v
+        for a in ax:
+            out = jnp.expand_dims(out, a)
+        return out
+
+    return unary(f, x, "unsqueeze")
+
+
+def unsqueeze_(x, axis, name=None):
+    return x._inplace_adopt(unsqueeze(x, axis))
+
+
+def concat(x, axis=0, name=None):
+    ts = [to_tensor_like(v) for v in x]
+    ax = int(axis._value) if isinstance(axis, Tensor) else int(axis)
+    return apply(lambda *vs: jnp.concatenate(vs, axis=ax), *ts, op_name="concat")
+
+
+def stack(x, axis=0, name=None):
+    ts = [to_tensor_like(v) for v in x]
+    return apply(lambda *vs: jnp.stack(vs, axis=axis), *ts, op_name="stack")
+
+
+def hstack(x, name=None):
+    ts = [to_tensor_like(v) for v in x]
+    return apply(lambda *vs: jnp.hstack(vs), *ts, op_name="hstack")
+
+
+def vstack(x, name=None):
+    ts = [to_tensor_like(v) for v in x]
+    return apply(lambda *vs: jnp.vstack(vs), *ts, op_name="vstack")
+
+
+def dstack(x, name=None):
+    ts = [to_tensor_like(v) for v in x]
+    return apply(lambda *vs: jnp.dstack(vs), *ts, op_name="dstack")
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = to_tensor_like(x)
+    ax = int(axis._value) if isinstance(axis, Tensor) else int(axis)
+    dim = x.shape[ax]
+    if isinstance(num_or_sections, int):
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sections = maybe_int_list(num_or_sections)
+        rem = dim - sum(s for s in sections if s > 0)
+        sizes = [s if s > 0 else rem for s in sections]
+    offsets = np.cumsum([0] + sizes[:-1])
+    n = len(sizes)
+
+    def f(v):
+        return tuple(jnp.take(v, jnp.arange(o, o + s), axis=ax) for o, s in zip(offsets, sizes))
+
+    return apply(f, x, op_name="split", n_outs=n)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    x = to_tensor_like(x)
+    dim = x.shape[axis]
+    if isinstance(num_or_indices, int):
+        base, extra = divmod(dim, num_or_indices)
+        sizes = [base + (1 if i < extra else 0) for i in range(num_or_indices)]
+    else:
+        idx = maybe_int_list(num_or_indices)
+        bounds = [0] + list(idx) + [dim]
+        sizes = [bounds[i + 1] - bounds[i] for i in range(len(bounds) - 1)]
+    return split(x, sizes, axis)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def hsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=1)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(input, axis=0, name=None):  # noqa: A002
+    x = to_tensor_like(input)
+    n = x.shape[axis]
+
+    def f(v):
+        return tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(v, n, axis=axis))
+
+    return apply(f, x, op_name="unbind", n_outs=n)
+
+
+unstack = unbind
+
+
+def tile(x, repeat_times, name=None):
+    reps = maybe_int_list(repeat_times)
+    return unary(lambda v: jnp.tile(v, tuple(reps)), x, "tile")
+
+
+def expand(x, shape, name=None):
+    shape = maybe_int_list(shape)
+    x = to_tensor_like(x)
+
+    def f(v):
+        tgt = list(shape)
+        # -1 entries keep the original dim (paddle semantics)
+        off = len(tgt) - v.ndim
+        for i in range(len(tgt)):
+            if tgt[i] == -1:
+                tgt[i] = v.shape[i - off]
+        return jnp.broadcast_to(v, tuple(tgt))
+
+    return apply(f, x, op_name="expand")
+
+
+def expand_as(x, y, name=None):
+    y = to_tensor_like(y)
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape, name=None):
+    shape = maybe_int_list(shape)
+    return unary(lambda v: jnp.broadcast_to(v, tuple(shape)), x, "broadcast_to")
+
+
+def broadcast_tensors(input, name=None):  # noqa: A002
+    ts = [to_tensor_like(v) for v in input]
+    n = len(ts)
+    return apply(lambda *vs: tuple(jnp.broadcast_arrays(*vs)), *ts, op_name="broadcast_tensors", n_outs=n)
+
+
+def flip(x, axis, name=None):
+    ax = maybe_int_list(axis if isinstance(axis, (list, tuple)) else [axis])
+    return unary(lambda v: jnp.flip(v, axis=tuple(ax)), x, "flip")
+
+
+def rot90(x, k=1, axes=[0, 1], name=None):  # noqa: B006
+    return unary(lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), x, "rot90")
+
+
+def roll(x, shifts, axis=None, name=None):
+    sh = maybe_int_list(shifts if isinstance(shifts, (list, tuple, Tensor)) else [shifts])
+    sh = sh if len(sh) > 1 else sh[0]
+    ax = None if axis is None else (tuple(axis) if isinstance(axis, (list, tuple)) else axis)
+    return unary(lambda v: jnp.roll(v, sh, axis=ax), x, "roll")
+
+
+def gather(x, index, axis=0, name=None):
+    x, index = to_tensor_like(x), to_tensor_like(index)
+    ax = int(axis._value) if isinstance(axis, Tensor) else int(axis)
+    return apply(lambda v, i: jnp.take(v, i.reshape(-1).astype(jnp.int32), axis=ax), x, index, op_name="gather")
+
+
+def gather_nd(x, index, name=None):
+    x, index = to_tensor_like(x), to_tensor_like(index)
+
+    def f(v, idx):
+        idx = idx.astype(jnp.int32)
+        k = idx.shape[-1]
+        out = v[tuple(jnp.moveaxis(idx, -1, 0))]
+        return out
+
+    return apply(f, x, index, op_name="gather_nd")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    x, index, updates = to_tensor_like(x), to_tensor_like(index), to_tensor_like(updates)
+
+    def f(v, i, u):
+        i = i.reshape(-1).astype(jnp.int32)
+        if overwrite:
+            return v.at[i].set(u)
+        # paddle overwrite=False: zero destination rows then add
+        z = v.at[i].set(jnp.zeros_like(u))
+        return z.at[i].add(u)
+
+    return apply(f, x, index, updates, op_name="scatter")
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    return x._inplace_adopt(scatter(x, index, updates, overwrite))
+
+
+def scatter_nd(index, updates, shape, name=None):
+    index, updates = to_tensor_like(index), to_tensor_like(updates)
+    shape = tuple(maybe_int_list(shape))
+
+    def f(i, u):
+        z = jnp.zeros(shape, u.dtype)
+        return z.at[tuple(jnp.moveaxis(i.astype(jnp.int32), -1, 0))].add(u)
+
+    return apply(f, index, updates, op_name="scatter_nd")
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    x, index, updates = to_tensor_like(x), to_tensor_like(index), to_tensor_like(updates)
+
+    def f(v, i, u):
+        return v.at[tuple(jnp.moveaxis(i.astype(jnp.int32), -1, 0))].add(u)
+
+    return apply(f, x, index, updates, op_name="scatter_nd_add")
+
+
+def index_select(x, index, axis=0, name=None):
+    x, index = to_tensor_like(x), to_tensor_like(index)
+    return apply(
+        lambda v, i: jnp.take(v, i.reshape(-1).astype(jnp.int32), axis=axis), x, index, op_name="index_select"
+    )
+
+
+def index_sample(x, index, name=None):
+    x, index = to_tensor_like(x), to_tensor_like(index)
+
+    def f(v, i):
+        i = i.astype(jnp.int32)
+        rows = jnp.arange(v.shape[0])[:, None]
+        return v[rows, i]
+
+    return apply(f, x, index, op_name="index_sample")
+
+
+def index_add(x, index, axis, value, name=None):
+    x, index, value = to_tensor_like(x), to_tensor_like(index), to_tensor_like(value)
+
+    def f(v, i, u):
+        i = i.reshape(-1).astype(jnp.int32)
+        idx = [slice(None)] * v.ndim
+        idx[axis] = i
+        return v.at[tuple(idx)].add(u)
+
+    return apply(f, x, index, value, op_name="index_add")
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    x = to_tensor_like(x)
+    value = to_tensor_like(value)
+    raw_idx = tuple(i._value if isinstance(i, Tensor) else i for i in indices)
+
+    def f(v, u):
+        if accumulate:
+            return v.at[raw_idx].add(u)
+        return v.at[raw_idx].set(u)
+
+    return apply(f, x, value, op_name="index_put")
+
+
+def index_fill(x, index, axis, value, name=None):
+    x, index = to_tensor_like(x), to_tensor_like(index)
+    val = value._value if isinstance(value, Tensor) else value
+
+    def f(v, i):
+        idx = [slice(None)] * v.ndim
+        idx[axis] = i.reshape(-1).astype(jnp.int32)
+        return v.at[tuple(idx)].set(val)
+
+    return apply(f, x, index, op_name="index_fill")
+
+
+def masked_select(x, mask, name=None):
+    # Data-dependent output shape: not jittable; eager-only (documented
+    # divergence from XLA static shapes — reference LoD/dynamic analog).
+    x, mask = to_tensor_like(x), to_tensor_like(mask)
+    val = np.asarray(x._value)[np.asarray(mask._value).astype(bool)]
+    return Tensor(jnp.asarray(val))
+
+
+def masked_fill(x, mask, value, name=None):
+    x, mask = to_tensor_like(x), to_tensor_like(mask)
+    val = value._value if isinstance(value, Tensor) else value
+    return apply(lambda v, m: jnp.where(m.astype(bool), jnp.asarray(val, v.dtype), v), x, mask, op_name="masked_fill")
+
+
+def masked_scatter(x, mask, value, name=None):
+    x, mask, value = to_tensor_like(x), to_tensor_like(mask), to_tensor_like(value)
+
+    def f(v, m, u):
+        m = m.astype(bool)
+        m_b = jnp.broadcast_to(m, v.shape)
+        cnt = jnp.cumsum(m_b.reshape(-1)) - 1
+        flat_u = u.reshape(-1)
+        picked = flat_u[jnp.clip(cnt, 0, flat_u.shape[0] - 1)].reshape(v.shape)
+        return jnp.where(m_b, picked, v)
+
+    return apply(f, x, mask, value, op_name="masked_scatter")
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    arr, indices = to_tensor_like(arr), to_tensor_like(indices)
+    return apply(
+        lambda v, i: jnp.take_along_axis(v, i.astype(jnp.int32), axis=axis), arr, indices, op_name="take_along_axis"
+    )
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True, broadcast=True, name=None):  # noqa: A002
+    arr, indices = to_tensor_like(arr), to_tensor_like(indices)
+    values = to_tensor_like(values)
+
+    def f(v, i, u):
+        i = i.astype(jnp.int32)
+        u = jnp.broadcast_to(u, i.shape).astype(v.dtype)
+        mode = {"assign": "set", "add": "add", "mul": "multiply", "multiply": "multiply"}[reduce]
+        idx = []
+        for d in range(v.ndim):
+            if d == axis % v.ndim:
+                idx.append(i)
+            else:
+                sh = [1] * v.ndim
+                sh[d] = v.shape[d]
+                ar = jnp.arange(v.shape[d]).reshape(sh)
+                idx.append(jnp.broadcast_to(ar, i.shape))
+        idx = tuple(idx)
+        if mode == "set":
+            return v.at[idx].set(u)
+        if mode == "add":
+            return v.at[idx].add(u)
+        return v.at[idx].multiply(u)
+
+    return apply(f, arr, indices, values, op_name="put_along_axis")
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    # Data-dependent shapes: eager-only via numpy (documented divergence).
+    x = to_tensor_like(x)
+    res = np.unique(
+        np.asarray(x._value), return_index=return_index, return_inverse=return_inverse,
+        return_counts=return_counts, axis=axis,
+    )
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    x = to_tensor_like(x)
+    a = np.asarray(x._value)
+    if axis is None:
+        a = a.reshape(-1)
+        keep = np.concatenate([[True], a[1:] != a[:-1]]) if a.size else np.zeros(0, bool)
+        out = a[keep]
+        outs = [Tensor(jnp.asarray(out))]
+        if return_inverse:
+            inv = np.cumsum(keep) - 1
+            outs.append(Tensor(jnp.asarray(inv)))
+        if return_counts:
+            idx = np.nonzero(keep)[0]
+            counts = np.diff(np.concatenate([idx, [a.size]]))
+            outs.append(Tensor(jnp.asarray(counts)))
+        return outs[0] if len(outs) == 1 else tuple(outs)
+    raise NotImplementedError("unique_consecutive with axis is not supported yet")
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    x = to_tensor_like(x)
+    if isinstance(repeats, Tensor):
+        reps = np.asarray(repeats._value)
+        a = np.asarray(x._value)
+        return Tensor(jnp.asarray(np.repeat(a, reps, axis=axis)))
+    return unary(lambda v: jnp.repeat(v, repeats, axis=axis), x, "repeat_interleave")
+
+
+def tril(x, diagonal=0, name=None):
+    return unary(lambda v: jnp.tril(v, k=diagonal), x, "tril")
+
+
+def triu(x, diagonal=0, name=None):
+    return unary(lambda v: jnp.triu(v, k=diagonal), x, "triu")
+
+
+def tril_(x, diagonal=0, name=None):
+    return x._inplace_adopt(tril(x, diagonal))
+
+
+def triu_(x, diagonal=0, name=None):
+    return x._inplace_adopt(triu(x, diagonal))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    x = to_tensor_like(x)
+
+    def f(v):
+        if v.ndim == 1:
+            out = jnp.diag(v, k=offset)
+            if padding_value != 0:
+                mask = jnp.eye(out.shape[0], out.shape[1], k=offset, dtype=bool)
+                out = jnp.where(mask, out, jnp.asarray(padding_value, out.dtype))
+            return out
+        return jnp.diag(v, k=offset)
+
+    return apply(f, x, op_name="diag")
+
+
+def diagflat(x, offset=0, name=None):
+    return unary(lambda v: jnp.diagflat(v, k=offset), x, "diagflat")
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):  # noqa: A002
+    x = to_tensor_like(input)
+
+    def f(v):
+        n = v.shape[-1] + abs(offset)
+        out = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
+        rng = jnp.arange(v.shape[-1])
+        r = rng + max(-offset, 0)
+        c = rng + max(offset, 0)
+        out = out.at[..., r, c].set(v)
+        # move the two new dims to dim1/dim2
+        nd = out.ndim
+        d1, d2 = dim1 % nd, dim2 % nd
+        perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+        order = sorted([(d1, nd - 2), (d2, nd - 1)])
+        for pos, src in order:
+            perm.insert(pos, src)
+        return jnp.transpose(out, perm)
+
+    return apply(f, x, op_name="diag_embed")
+
+
+def meshgrid(*args, name=None):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    ts = [to_tensor_like(a) for a in args]
+    n = len(ts)
+    return apply(lambda *vs: tuple(jnp.meshgrid(*vs, indexing="ij")), *ts, op_name="meshgrid", n_outs=n)
+
+
+def moveaxis(x, source, destination, name=None):
+    return unary(lambda v: jnp.moveaxis(v, source, destination), x, "moveaxis")
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    return unary(lambda v: jnp.swapaxes(v, axis1, axis2), x, "swapaxes")
+
+
+def as_real(x, name=None):
+    return unary(lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1), x, "as_real")
+
+
+def as_complex(x, name=None):
+    return unary(lambda v: jax.lax.complex(v[..., 0], v[..., 1]), x, "as_complex")
+
+
+def slice(input, axes, starts, ends, name=None):  # noqa: A001,A002
+    x = to_tensor_like(input)
+    axes = maybe_int_list(axes)
+    starts = maybe_int_list(starts)
+    ends = maybe_int_list(ends)
+
+    def f(v):
+        idx = [builtins_slice(None)] * v.ndim
+        for a, s, e in zip(axes, starts, ends):
+            idx[a] = builtins_slice(s, e)
+        return v[tuple(idx)]
+
+    return apply(f, x, op_name="slice")
+
+
+builtins_slice = builtins.slice
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    x = to_tensor_like(x)
+    axes = maybe_int_list(axes)
+    starts, ends, strides = maybe_int_list(starts), maybe_int_list(ends), maybe_int_list(strides)
+
+    def f(v):
+        idx = [builtins_slice(None)] * v.ndim
+        for a, s, e, st in zip(axes, starts, ends, strides):
+            idx[a] = builtins_slice(s, e, st)
+        return v[tuple(idx)]
+
+    return apply(f, x, op_name="strided_slice")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = to_tensor_like(x)
+    shape = maybe_int_list(shape)
+    offsets = maybe_int_list(offsets) if offsets is not None else [0] * x.ndim
+
+    def f(v):
+        idx = tuple(
+            builtins_slice(o, o + (s if s != -1 else v.shape[d] - o))
+            for d, (o, s) in enumerate(zip(offsets, shape))
+        )
+        return v[idx]
+
+    return apply(f, x, op_name="crop")
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [unary(jnp.atleast_1d, to_tensor_like(v), "atleast_1d") for v in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [unary(jnp.atleast_2d, to_tensor_like(v), "atleast_2d") for v in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [unary(jnp.atleast_3d, to_tensor_like(v), "atleast_3d") for v in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def select_scatter(x, values, axis, index, name=None):
+    x, values = to_tensor_like(x), to_tensor_like(values)
+
+    def f(v, u):
+        idx = [builtins_slice(None)] * v.ndim
+        idx[axis] = index
+        return v.at[tuple(idx)].set(u.astype(v.dtype))
+
+    return apply(f, x, values, op_name="select_scatter")
+
+
+def unfold(x, axis, size, step, name=None):
+    x = to_tensor_like(x)
+
+    def f(v):
+        n = (v.shape[axis] - size) // step + 1
+        slices = [jnp.take(v, jnp.arange(i * step, i * step + size), axis=axis) for i in range(n)]
+        return jnp.stack(slices, axis=axis)
+
+    return apply(f, x, op_name="unfold")
+
+
+def pad_sequences(seqs, pad_value=0.0):
+    """Utility (no direct reference analog): pad a list of variable-length
+    arrays to a static max shape — the bucketing/padding policy SURVEY.md §7.3
+    prescribes for XLA static shapes."""
+    maxlen = max(s.shape[0] for s in seqs)
+    out = []
+    for s in seqs:
+        a = np.asarray(s._value if isinstance(s, Tensor) else s)
+        pad = [(0, maxlen - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
+        out.append(np.pad(a, pad, constant_values=pad_value))
+    return Tensor(jnp.asarray(np.stack(out)))
+
+
+import jax  # noqa: E402  (used by as_complex)

@@ -1,0 +1,446 @@
+"""Math ops.
+
+API parity with /root/reference/python/paddle/tensor/math.py (~the math slice
+of the 463-op YAML surface, /root/reference/paddle/phi/ops/yaml/ops.yaml).
+Every op is a thin wrapper binding a pure jnp function into the eager
+dispatch+tape (``ops.dispatch.apply``); XLA supplies the kernels and fusion.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.dispatch import apply
+from ._helpers import binary, normalize_axis, to_tensor_like, unary
+from .tensor import Tensor
+
+__all__ = []  # filled at bottom
+
+
+# ---------------------------------------------------------------- unary table
+_UNARY = {
+    "abs": jnp.abs,
+    "acos": jnp.arccos,
+    "acosh": jnp.arccosh,
+    "angle": jnp.angle,
+    "asin": jnp.arcsin,
+    "asinh": jnp.arcsinh,
+    "atan": jnp.arctan,
+    "atanh": jnp.arctanh,
+    "ceil": jnp.ceil,
+    "conj": jnp.conj,
+    "cos": jnp.cos,
+    "cosh": jnp.cosh,
+    "deg2rad": jnp.deg2rad,
+    "digamma": jax.scipy.special.digamma,
+    "erf": jax.scipy.special.erf,
+    "erfinv": jax.scipy.special.erfinv,
+    "exp": jnp.exp,
+    "expm1": jnp.expm1,
+    "floor": jnp.floor,
+    "frac": lambda x: x - jnp.trunc(x),
+    "i0": lambda x: jax.scipy.special.i0(x),
+    "lgamma": jax.scipy.special.gammaln,
+    "log": jnp.log,
+    "log10": jnp.log10,
+    "log1p": jnp.log1p,
+    "log2": jnp.log2,
+    "logit": jax.scipy.special.logit,
+    "neg": jnp.negative,
+    "rad2deg": jnp.rad2deg,
+    "reciprocal": jnp.reciprocal,
+    "round": jnp.round,
+    "rsqrt": lax.rsqrt,
+    "sgn": jnp.sign,
+    "sign": jnp.sign,
+    "sin": jnp.sin,
+    "sinh": jnp.sinh,
+    "sqrt": jnp.sqrt,
+    "square": jnp.square,
+    "tan": jnp.tan,
+    "tanh": jnp.tanh,
+    "trunc": jnp.trunc,
+}
+
+
+def _make_unary(name, fn):
+    def op(x, name=None):
+        return unary(fn, x, name or _op_name)
+
+    _op_name = name
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = f"Elementwise {name} (parity: python/paddle/tensor/math.py {name})."
+    return op
+
+
+for _n, _f in _UNARY.items():
+    globals()[_n] = _make_unary(_n, _f)
+    __all__.append(_n)
+
+
+# ------------------------------------------------------------- binary ops
+def add(x, y, name=None):
+    return binary(jnp.add, x, y, "add")
+
+
+def subtract(x, y, name=None):
+    return binary(jnp.subtract, x, y, "subtract")
+
+
+def multiply(x, y, name=None):
+    return binary(jnp.multiply, x, y, "multiply")
+
+
+def divide(x, y, name=None):
+    return binary(jnp.true_divide, x, y, "divide")
+
+
+def floor_divide(x, y, name=None):
+    return binary(jnp.floor_divide, x, y, "floor_divide")
+
+
+def remainder(x, y, name=None):
+    return binary(jnp.remainder, x, y, "remainder")
+
+
+mod = remainder
+floor_mod = remainder
+
+
+def pow(x, y, name=None):  # noqa: A001
+    return binary(jnp.power, x, y, "pow")
+
+
+def maximum(x, y, name=None):
+    return binary(jnp.maximum, x, y, "maximum")
+
+
+def minimum(x, y, name=None):
+    return binary(jnp.minimum, x, y, "minimum")
+
+
+def fmax(x, y, name=None):
+    return binary(jnp.fmax, x, y, "fmax")
+
+
+def fmin(x, y, name=None):
+    return binary(jnp.fmin, x, y, "fmin")
+
+
+def atan2(x, y, name=None):
+    return binary(jnp.arctan2, x, y, "atan2")
+
+
+def heaviside(x, y, name=None):
+    return binary(jnp.heaviside, x, y, "heaviside")
+
+
+def gcd(x, y, name=None):
+    return binary(jnp.gcd, x, y, "gcd")
+
+
+def lcm(x, y, name=None):
+    return binary(jnp.lcm, x, y, "lcm")
+
+
+def logaddexp(x, y, name=None):
+    return binary(jnp.logaddexp, x, y, "logaddexp")
+
+
+def hypot(x, y, name=None):
+    return binary(jnp.hypot, x, y, "hypot")
+
+
+def copysign(x, y, name=None):
+    return binary(jnp.copysign, x, y, "copysign")
+
+
+def nextafter(x, y, name=None):
+    return binary(jnp.nextafter, x, y, "nextafter")
+
+
+def ldexp(x, y, name=None):
+    return binary(lambda a, b: jnp.ldexp(a, b.astype(jnp.int32)), x, to_tensor_like(y), "ldexp")
+
+
+def inner(x, y, name=None):
+    return binary(jnp.inner, x, y, "inner")
+
+
+def outer(x, y, name=None):
+    return binary(lambda a, b: jnp.outer(a, b), x, y, "outer")
+
+
+def kron(x, y, name=None):
+    return binary(jnp.kron, x, y, "kron")
+
+
+def lerp(x, y, weight, name=None):
+    x, y = to_tensor_like(x), to_tensor_like(y)
+    if isinstance(weight, Tensor):
+        return apply(lambda a, b, w: a + w * (b - a), x, y, weight, op_name="lerp")
+    return apply(lambda a, b: a + weight * (b - a), x, y, op_name="lerp")
+
+
+# ------------------------------------------------------------- reductions
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    from ..framework.dtype import to_jax_dtype
+
+    ax = normalize_axis(axis)
+    jdt = to_jax_dtype(dtype)
+    return unary(lambda v: jnp.sum(v, axis=ax, dtype=jdt, keepdims=keepdim), x, "sum")
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    ax = normalize_axis(axis)
+    return unary(lambda v: jnp.mean(v, axis=ax, keepdims=keepdim), x, "mean")
+
+
+def max(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    ax = normalize_axis(axis)
+    return unary(lambda v: jnp.max(v, axis=ax, keepdims=keepdim), x, "max")
+
+
+def min(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    ax = normalize_axis(axis)
+    return unary(lambda v: jnp.min(v, axis=ax, keepdims=keepdim), x, "min")
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    from ..framework.dtype import to_jax_dtype
+
+    ax = normalize_axis(axis)
+    jdt = to_jax_dtype(dtype)
+    return unary(lambda v: jnp.prod(v, axis=ax, dtype=jdt, keepdims=keepdim), x, "prod")
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    ax = normalize_axis(axis)
+    return unary(lambda v: jax.scipy.special.logsumexp(v, axis=ax, keepdims=keepdim), x, "logsumexp")
+
+
+def all(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    ax = normalize_axis(axis)
+    return unary(lambda v: jnp.all(v, axis=ax, keepdims=keepdim), x, "all")
+
+
+def any(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    ax = normalize_axis(axis)
+    return unary(lambda v: jnp.any(v, axis=ax, keepdims=keepdim), x, "any")
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    ax = normalize_axis(axis)
+    return unary(lambda v: jnp.count_nonzero(v, axis=ax, keepdims=keepdim), x, "count_nonzero")
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    from ..framework.dtype import to_jax_dtype
+
+    ax = normalize_axis(axis)
+    jdt = to_jax_dtype(dtype)
+    return unary(lambda v: jnp.nansum(v, axis=ax, dtype=jdt, keepdims=keepdim), x, "nansum")
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    ax = normalize_axis(axis)
+    return unary(lambda v: jnp.nanmean(v, axis=ax, keepdims=keepdim), x, "nanmean")
+
+
+# ------------------------------------------------------------- scans
+def cumsum(x, axis=None, dtype=None, name=None):
+    from ..framework.dtype import to_jax_dtype
+
+    jdt = to_jax_dtype(dtype)
+    if axis is None:
+        return unary(lambda v: jnp.cumsum(v.reshape(-1), dtype=jdt), x, "cumsum")
+    return unary(lambda v: jnp.cumsum(v, axis=int(axis), dtype=jdt), x, "cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    from ..framework.dtype import to_jax_dtype
+
+    jdt = to_jax_dtype(dtype)
+    if dim is None:
+        return unary(lambda v: jnp.cumprod(v.reshape(-1), dtype=jdt), x, "cumprod")
+    return unary(lambda v: jnp.cumprod(v, axis=int(dim), dtype=jdt), x, "cumprod")
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    ax = -1 if axis is None else int(axis)
+
+    def f(v):
+        vv = v.reshape(-1) if axis is None else v
+        values = lax.associative_scan(jnp.maximum, vv, axis=ax if axis is not None else 0)
+        return values
+
+    return unary(f, x, "cummax")
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def f(v):
+        vv = v.reshape(-1) if axis is None else v
+        ax = 0 if axis is None else int(axis)
+        return lax.associative_scan(jnp.logaddexp, vv, axis=ax)
+
+    return unary(f, x, "logcumsumexp")
+
+
+# ------------------------------------------------------------- misc math
+def clip(x, min=None, max=None, name=None):  # noqa: A001
+    lo = min._value if isinstance(min, Tensor) else min
+    hi = max._value if isinstance(max, Tensor) else max
+    return unary(lambda v: jnp.clip(v, lo, hi), x, "clip")
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s = scale._value if isinstance(scale, Tensor) else scale
+
+    def f(v):
+        out = v * s + bias if bias_after_scale else (v + bias) * s
+        return out
+
+    out = unary(f, x, "scale")
+    if act is not None:
+        from ..nn import functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+def increment(x, value=1.0, name=None):
+    return unary(lambda v: v + value, x, "increment")
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return unary(lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf, neginf=neginf), x, "nan_to_num")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return unary(lambda v: scale_b * jnp.tanh(scale_a * v), x, "stanh")
+
+
+def multiplex(inputs, index, name=None):
+    idx = index._value if isinstance(index, Tensor) else jnp.asarray(index)
+    ins = [to_tensor_like(i) for i in inputs]
+    return apply(
+        lambda i, *vs: jnp.stack(vs, axis=0)[i.reshape(-1), jnp.arange(vs[0].shape[0])],
+        Tensor(idx),
+        *ins,
+        op_name="multiplex",
+    )
+
+
+def isfinite(x, name=None):
+    return unary(jnp.isfinite, x, "isfinite")
+
+
+def isinf(x, name=None):
+    return unary(jnp.isinf, x, "isinf")
+
+
+def isnan(x, name=None):
+    return unary(jnp.isnan, x, "isnan")
+
+
+def isneginf(x, name=None):
+    return unary(lambda v: jnp.isneginf(v), x, "isneginf")
+
+
+def isposinf(x, name=None):
+    return unary(lambda v: jnp.isposinf(v), x, "isposinf")
+
+
+def isreal(x, name=None):
+    return unary(jnp.isreal, x, "isreal")
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return unary(lambda v: jnp.trace(v, offset=offset, axis1=axis1, axis2=axis2), x, "trace")
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return unary(lambda v: jnp.diagonal(v, offset=offset, axis1=axis1, axis2=axis2), x, "diagonal")
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    pre = prepend._value if isinstance(prepend, Tensor) else prepend
+    app = append._value if isinstance(append, Tensor) else append
+    return unary(lambda v: jnp.diff(v, n=n, axis=axis, prepend=pre, append=app), x, "diff")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
+    return apply(
+        lambda i, a, b: beta * i + alpha * (a @ b),
+        to_tensor_like(input),
+        to_tensor_like(x),
+        to_tensor_like(y),
+        op_name="addmm",
+    )
+
+
+def broadcast_shape(x_shape, y_shape):
+    import numpy as np
+
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def take(x, index, mode="raise", name=None):
+    idx = index._value if isinstance(index, Tensor) else jnp.asarray(index)
+    m = {"raise": "clip", "clip": "clip", "wrap": "wrap"}[mode]
+    return unary(lambda v: jnp.take(v.reshape(-1), idx.reshape(idx.shape), mode=m), x, "take")
+
+
+# inplace variants (paddle `op_` convention)
+def _make_inplace(fn, name):
+    def op_(x, *args, **kwargs):
+        return x._inplace_adopt(fn(x, *args, **kwargs))
+
+    op_.__name__ = name + "_"
+    return op_
+
+
+add_ = _make_inplace(add, "add")
+subtract_ = _make_inplace(subtract, "subtract")
+multiply_ = _make_inplace(multiply, "multiply")
+divide_ = _make_inplace(divide, "divide")
+clip_ = _make_inplace(clip, "clip")
+scale_ = _make_inplace(scale, "scale")
+exp_ = _make_inplace(globals()["exp"], "exp")
+sqrt_ = _make_inplace(globals()["sqrt"], "sqrt")
+rsqrt_ = _make_inplace(globals()["rsqrt"], "rsqrt")
+reciprocal_ = _make_inplace(globals()["reciprocal"], "reciprocal")
+round_ = _make_inplace(globals()["round"], "round")
+floor_ = _make_inplace(globals()["floor"], "floor")
+ceil_ = _make_inplace(globals()["ceil"], "ceil")
+tanh_ = _make_inplace(globals()["tanh"], "tanh")
+abs_ = _make_inplace(globals()["abs"], "abs")
+neg_ = _make_inplace(globals()["neg"], "neg")
+remainder_ = _make_inplace(remainder, "remainder")
+pow_ = _make_inplace(pow, "pow")
+lerp_ = _make_inplace(lerp, "lerp")
+
+__all__ += [
+    "add", "subtract", "multiply", "divide", "floor_divide", "remainder", "mod", "floor_mod",
+    "pow", "maximum", "minimum", "fmax", "fmin", "atan2", "heaviside", "gcd", "lcm",
+    "logaddexp", "hypot", "copysign", "nextafter", "ldexp", "inner", "outer", "kron", "lerp",
+    "sum", "mean", "max", "min", "amax", "amin", "prod", "logsumexp", "all", "any",
+    "count_nonzero", "nansum", "nanmean", "cumsum", "cumprod", "cummax", "logcumsumexp",
+    "clip", "scale", "increment", "nan_to_num", "stanh", "multiplex",
+    "isfinite", "isinf", "isnan", "isneginf", "isposinf", "isreal",
+    "trace", "diagonal", "diff", "addmm", "broadcast_shape", "take",
+    "add_", "subtract_", "multiply_", "divide_", "clip_", "scale_", "exp_", "sqrt_", "rsqrt_",
+    "reciprocal_", "round_", "floor_", "ceil_", "tanh_", "abs_", "neg_", "remainder_", "pow_", "lerp_",
+]

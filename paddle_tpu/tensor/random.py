@@ -1,0 +1,146 @@
+"""Random sampling ops (parity: python/paddle/tensor/random.py).
+
+All draws pull keys from the active ``framework.random.Generator`` (threefry
+chain), so ``paddle_tpu.seed(n)`` reproduces sequences exactly — the
+capability of the reference's seeded ``phi::Generator``.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.dtype import default_float_dtype, to_jax_dtype
+from ..framework.random import default_generator
+from ._helpers import maybe_int_list, to_tensor_like
+from .tensor import Tensor
+
+__all__ = [
+    "rand", "randn", "standard_normal", "normal", "uniform", "randint", "randint_like",
+    "randperm", "multinomial", "bernoulli", "poisson", "exponential_", "uniform_", "normal_",
+    "binomial", "standard_gamma", "log_normal",
+]
+
+
+def _next_key():
+    return default_generator().next_key()
+
+
+def _shape(shape):
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(maybe_int_list(shape))
+
+
+def rand(shape, dtype=None, name=None):
+    dt = to_jax_dtype(dtype) or default_float_dtype().np_dtype
+    return Tensor(jax.random.uniform(_next_key(), _shape(shape), dtype=dt))
+
+
+def randn(shape, dtype=None, name=None):
+    dt = to_jax_dtype(dtype) or default_float_dtype().np_dtype
+    return Tensor(jax.random.normal(_next_key(), _shape(shape), dtype=dt))
+
+
+standard_normal = randn
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._value if isinstance(mean, Tensor) else mean
+        s = std._value if isinstance(std, Tensor) else std
+        out_shape = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        z = jax.random.normal(_next_key(), out_shape, dtype=default_float_dtype().np_dtype)
+        return Tensor(z * s + m)
+    sh = _shape(shape) if shape is not None else ()
+    z = jax.random.normal(_next_key(), sh, dtype=default_float_dtype().np_dtype)
+    return Tensor(z * std + mean)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):  # noqa: A002
+    dt = to_jax_dtype(dtype) or default_float_dtype().np_dtype
+    key = jax.random.key(seed) if seed else _next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), dtype=dt, minval=min, maxval=max))
+
+
+def randint(low=0, high=None, shape=[1], dtype=None, name=None):  # noqa: B006
+    if high is None:
+        low, high = 0, low
+    dt = to_jax_dtype(dtype) or np.int64
+    return Tensor(jax.random.randint(_next_key(), _shape(shape), low, high, dtype=dt))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    x = to_tensor_like(x)
+    if high is None:
+        low, high = 0, low
+    dt = to_jax_dtype(dtype) or x._value.dtype
+    return Tensor(jax.random.randint(_next_key(), x._value.shape, low, high, dtype=dt))
+
+
+def randperm(n, dtype="int64", name=None):
+    dt = to_jax_dtype(dtype)
+    return Tensor(jax.random.permutation(_next_key(), int(n)).astype(dt))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    x = to_tensor_like(x)
+    probs = x._value
+    logits = jnp.log(jnp.clip(probs, 1e-30, None))
+    if replacement:
+        out = jax.random.categorical(_next_key(), logits, axis=-1, shape=logits.shape[:-1] + (num_samples,))
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(_next_key(), logits.shape, dtype=jnp.float32)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out)
+
+
+def bernoulli(x, name=None):
+    x = to_tensor_like(x)
+    u = jax.random.uniform(_next_key(), x._value.shape, dtype=jnp.float32)
+    return Tensor((u < x._value).astype(x._value.dtype))
+
+
+def poisson(x, name=None):
+    x = to_tensor_like(x)
+    return Tensor(jax.random.poisson(_next_key(), x._value, dtype=jnp.int32).astype(x._value.dtype))
+
+
+def binomial(count, prob, name=None):
+    count, prob = to_tensor_like(count), to_tensor_like(prob)
+    out = jax.random.binomial(_next_key(), count._value.astype(jnp.float32), prob._value)
+    return Tensor(out.astype(jnp.int32))
+
+
+def standard_gamma(x, name=None):
+    x = to_tensor_like(x)
+    return Tensor(jax.random.gamma(_next_key(), x._value))
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    sh = _shape(shape) if shape is not None else ()
+    z = jax.random.normal(_next_key(), sh, dtype=default_float_dtype().np_dtype)
+    return Tensor(jnp.exp(z * std + mean))
+
+
+# ---- inplace variants used by initializers ----
+def uniform_(x, min=-1.0, max=1.0, name=None):  # noqa: A002
+    x._value = jax.random.uniform(_next_key(), x._value.shape, dtype=x._value.dtype, minval=min, maxval=max)
+    x._version += 1
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    z = jax.random.normal(_next_key(), x._value.shape, dtype=x._value.dtype)
+    x._value = z * std + mean
+    x._version += 1
+    return x
+
+
+def exponential_(x, lam=1.0, name=None):
+    u = jax.random.exponential(_next_key(), x._value.shape, dtype=x._value.dtype)
+    x._value = u / lam
+    x._version += 1
+    return x

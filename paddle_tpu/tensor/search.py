@@ -1,0 +1,146 @@
+"""Search/sort ops (parity: python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..ops.dispatch import apply
+from ._helpers import to_tensor_like, unary
+from .tensor import Tensor
+
+__all__ = [
+    "argmax", "argmin", "argsort", "sort", "topk", "nonzero", "searchsorted", "bucketize",
+    "masked_select", "index_select", "kthvalue", "mode", "index_sample", "where",
+]
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def f(v):
+        if axis is None:
+            return jnp.argmax(v.reshape(-1))
+        out = jnp.argmax(v, axis=int(axis))
+        return jnp.expand_dims(out, int(axis)) if keepdim else out
+
+    return unary(f, x, "argmax")
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def f(v):
+        if axis is None:
+            return jnp.argmin(v.reshape(-1))
+        out = jnp.argmin(v, axis=int(axis))
+        return jnp.expand_dims(out, int(axis)) if keepdim else out
+
+    return unary(f, x, "argmin")
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(v):
+        idx = jnp.argsort(v, axis=axis, stable=stable, descending=descending)
+        return idx
+
+    return unary(f, x, "argsort")
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(v):
+        out = jnp.sort(v, axis=axis, stable=stable, descending=descending)
+        return out
+
+    return unary(f, x, "sort")
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):  # noqa: A002
+    x = to_tensor_like(x)
+    kk = int(k._value) if isinstance(k, Tensor) else int(k)
+    ax = -1 if axis is None else int(axis)
+
+    def f(v):
+        vv = jnp.moveaxis(v, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(vv, kk)
+        else:
+            vals, idx = jax.lax.top_k(-vv, kk)
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax)
+
+    out = apply(lambda v: tuple(f(v)), x, op_name="topk", n_outs=2)
+    return out[0], out[1]
+
+
+def nonzero(x, as_tuple=False, name=None):
+    # Data-dependent output shape: eager-only via numpy.
+    x = to_tensor_like(x)
+    a = np.asarray(x._value)
+    nz = np.nonzero(a)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i)) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1)))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    sorted_sequence, values = to_tensor_like(sorted_sequence), to_tensor_like(values)
+    side = "right" if right else "left"
+
+    def f(s, v):
+        if s.ndim == 1:
+            return jnp.searchsorted(s, v, side=side)
+        # batched innermost-dim search
+        import functools
+
+        fn = functools.partial(jnp.searchsorted, side=side)
+        flat_s = s.reshape(-1, s.shape[-1])
+        flat_v = v.reshape(-1, v.shape[-1])
+        out = jax.vmap(fn)(flat_s, flat_v)
+        return out.reshape(v.shape)
+
+    return apply(f, sorted_sequence, values, op_name="searchsorted")
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = to_tensor_like(x)
+
+    def f(v):
+        sv = jnp.sort(v, axis=axis)
+        si = jnp.argsort(v, axis=axis)
+        vals = jnp.take(sv, k - 1, axis=axis)
+        idx = jnp.take(si, k - 1, axis=axis)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            idx = jnp.expand_dims(idx, axis)
+        return vals, idx
+
+    out = apply(lambda v: tuple(f(v)), x, op_name="kthvalue", n_outs=2)
+    return out[0], out[1]
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    x = to_tensor_like(x)
+    a = np.asarray(x._value)
+    mv = np.moveaxis(a, axis, -1)
+    flat = mv.reshape(-1, mv.shape[-1])
+    vals, idxs = [], []
+    for row in flat:
+        uniq, counts = np.unique(row, return_counts=True)
+        best = uniq[np.argmax(counts)]
+        vals.append(best)
+        idxs.append(np.where(row == best)[0][-1])
+    out_shape = mv.shape[:-1]
+    v = np.asarray(vals).reshape(out_shape)
+    i = np.asarray(idxs).reshape(out_shape)
+    if keepdim:
+        v = np.expand_dims(v, axis)
+        i = np.expand_dims(i, axis)
+    return Tensor(jnp.asarray(v)), Tensor(jnp.asarray(i))
+
+
+# re-exported (defined in manipulation/logic)
+from .manipulation import index_sample, index_select, masked_select  # noqa: E402,F401
+from .logic import where  # noqa: E402,F401
+
+import jax  # noqa: E402

@@ -1,0 +1,86 @@
+"""Statistics ops (parity: python/paddle/tensor/stat.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ._helpers import normalize_axis, to_tensor_like, unary
+from .tensor import Tensor
+
+__all__ = ["mean", "std", "var", "median", "nanmedian", "quantile", "nanquantile", "histogram", "bincount", "numel"]
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    ax = normalize_axis(axis)
+    return unary(lambda v: jnp.mean(v, axis=ax, keepdims=keepdim), x, "mean")
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = normalize_axis(axis)
+    return unary(lambda v: jnp.std(v, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim), x, "std")
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = normalize_axis(axis)
+    return unary(lambda v: jnp.var(v, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim), x, "var")
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    ax = normalize_axis(axis)
+    if mode == "avg":
+        return unary(lambda v: jnp.median(v, axis=ax, keepdims=keepdim), x, "median")
+
+    def f(v):
+        # mode="min": lower of the two middle elements, matching reference
+        sv = jnp.sort(v if ax is not None else v.reshape(-1), axis=ax if ax is not None else 0)
+        n = sv.shape[ax if ax is not None else 0]
+        out = jnp.take(sv, (n - 1) // 2, axis=ax if ax is not None else 0)
+        return jnp.expand_dims(out, ax) if (keepdim and ax is not None) else out
+
+    return unary(f, x, "median")
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    ax = normalize_axis(axis)
+    return unary(lambda v: jnp.nanmedian(v, axis=ax, keepdims=keepdim), x, "nanmedian")
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    ax = normalize_axis(axis)
+    qq = q._value if isinstance(q, Tensor) else q
+    return unary(
+        lambda v: jnp.quantile(v, jnp.asarray(qq), axis=ax, keepdims=keepdim, method=interpolation),
+        x,
+        "quantile",
+    )
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    ax = normalize_axis(axis)
+    qq = q._value if isinstance(q, Tensor) else q
+    return unary(
+        lambda v: jnp.nanquantile(v, jnp.asarray(qq), axis=ax, keepdims=keepdim, method=interpolation),
+        x,
+        "nanquantile",
+    )
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):  # noqa: A002
+    x = to_tensor_like(input)
+    a = np.asarray(x._value)
+    lo, hi = (min, max) if (min != 0 or max != 0) else (a.min(), a.max())
+    w = np.asarray(weight._value) if isinstance(weight, Tensor) else weight
+    hist, _ = np.histogram(a, bins=bins, range=(lo, hi), weights=w, density=density)
+    return Tensor(jnp.asarray(hist))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    x = to_tensor_like(x)
+    w = weights._value if isinstance(weights, Tensor) else weights
+    a = np.asarray(x._value)
+    out = np.bincount(a, weights=None if w is None else np.asarray(w), minlength=minlength)
+    return Tensor(jnp.asarray(out))
+
+
+from .creation import numel  # noqa: E402,F401

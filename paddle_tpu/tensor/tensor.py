@@ -1,0 +1,343 @@
+"""The eager Tensor.
+
+Capability parity with the reference's eager Tensor
+(/root/reference/paddle/fluid/pybind/eager.cc pybind type,
+paddle/phi/core/dense_tensor.h:37 meta, autograd_meta.h): value + dtype/shape
+meta + autograd meta (grad node, .grad, hooks) + the ~full paddle method
+surface. TPU-native: the payload is a ``jax.Array`` (possibly sharded across a
+Mesh, possibly a tracer inside jit) — there is no allocator/Place zoo; device
+residency and sharding are carried by the array itself.
+
+Named math/manipulation methods (x.sum(), x.reshape(), ...) are attached by
+``paddle_tpu.tensor.patch_methods`` at import time, mirroring the reference's
+method patching (python/paddle/base/dygraph/tensor_patch_methods.py).
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd import tape
+from ..framework import dtype as dtype_mod
+
+__all__ = ["Tensor"]
+
+_name_counter = itertools.count()
+
+
+def _is_tracer(v) -> bool:
+    return isinstance(v, jax.core.Tracer)
+
+
+class Tensor:
+    __slots__ = (
+        "_value",
+        "stop_gradient",
+        "grad",
+        "_grad_node",
+        "_out_index",
+        "_hooks",
+        "_retain_grads",
+        "_version",
+        "name",
+        "is_parameter",
+        "trainable",
+        "_optimize_attrs",
+        "__weakref__",
+    )
+
+    def __init__(self, value, stop_gradient: bool = True, name: Optional[str] = None, dtype=None):
+        if isinstance(value, Tensor):
+            value = value._value
+        if not isinstance(value, jax.Array) and not _is_tracer(value):
+            jdt = dtype_mod.to_jax_dtype(dtype) if dtype is not None else None
+            if jdt is None and isinstance(value, float):
+                jdt = dtype_mod.default_float_dtype().np_dtype
+            if jdt is None and isinstance(value, (list, tuple)):
+                arr = np.asarray(value)
+                if arr.dtype == np.float64:
+                    jdt = dtype_mod.default_float_dtype().np_dtype
+                value = arr
+            value = jnp.asarray(value, dtype=jdt)
+        elif dtype is not None:
+            value = value.astype(dtype_mod.to_jax_dtype(dtype))
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._grad_node = None
+        self._out_index = 0
+        self._hooks = []
+        self._retain_grads = False
+        self._version = 0
+        self.name = name if name is not None else f"generated_tensor_{next(_name_counter)}"
+        self.is_parameter = False
+        self.trainable = True
+        self._optimize_attrs = None
+
+    # ---------------- meta ----------------
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._value.ndim
+
+    def dim(self) -> int:
+        return self._value.ndim
+
+    def rank(self) -> int:
+        return self._value.ndim
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    def numel(self) -> int:
+        return self.size
+
+    @property
+    def dtype(self) -> dtype_mod.DType:
+        return dtype_mod.convert_dtype(self._value.dtype)
+
+    def element_size(self) -> int:
+        return self.dtype.itemsize
+
+    @property
+    def place(self):
+        from ..device import _place_of
+
+        return _place_of(self._value)
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._grad_node is None
+
+    @property
+    def T(self):
+        from . import linalg
+
+        return linalg.transpose(self, list(range(self.ndim))[::-1])
+
+    @property
+    def persistable(self):
+        return self.is_parameter
+
+    @persistable.setter
+    def persistable(self, v):
+        self.is_parameter = bool(v)
+
+    # ---------------- conversion ----------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._value)
+        return a.astype(dtype) if dtype is not None else a
+
+    def item(self, *args):
+        if args:
+            return self._value[args].item() if len(args) > 1 else np.asarray(self._value).flat[args[0]].item()
+        return self._value.item()
+
+    def tolist(self):
+        return np.asarray(self._value).tolist()
+
+    def __float__(self):
+        return float(self._value)
+
+    def __int__(self):
+        return int(self._value)
+
+    def __bool__(self):
+        return bool(self._value)
+
+    def __index__(self):
+        return int(self._value)
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __hash__(self):
+        return id(self)
+
+    def __repr__(self):
+        sg = self.stop_gradient
+        if _is_tracer(self._value):
+            return f"Tensor(shape={self.shape}, dtype={self.dtype.name}, stop_gradient={sg}, <traced>)"
+        body = np.array2string(np.asarray(self._value), separator=", ", prefix="       ")
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+            f"place={self.place}, stop_gradient={sg},\n       {body})"
+        )
+
+    # ---------------- autograd ----------------
+    def backward(self, grad_tensor: Optional["Tensor"] = None, retain_graph: bool = False):
+        tape.run_backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def register_hook(self, hook):
+        self._hooks.append(hook)
+
+        class _Handle:
+            def __init__(self, hooks, h):
+                self._hooks, self._h = hooks, h
+
+            def remove(self):
+                if self._h in self._hooks:
+                    self._hooks.remove(self._h)
+
+        return _Handle(self._hooks, hook)
+
+    def retain_grads(self):
+        self._retain_grads = True
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._value, stop_gradient=True, name=self.name + ".detach")
+        return t
+
+    def detach_(self) -> "Tensor":
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        from ..ops.dispatch import apply
+
+        return apply(lambda x: x + jnp.zeros((), x.dtype), self, op_name="clone")
+
+    @property
+    def grad_fn(self):
+        return self._grad_node
+
+    # ---------------- dtype/device movement ----------------
+    def astype(self, dt) -> "Tensor":
+        from ..ops.dispatch import apply
+
+        jdt = dtype_mod.to_jax_dtype(dt)
+        return apply(lambda x: x.astype(jdt), self, op_name="cast")
+
+    def cast(self, dt) -> "Tensor":
+        return self.astype(dt)
+
+    def to(self, *args, **kwargs) -> "Tensor":
+        # to(dtype) / to(device) / to(device, dtype) / blocking kwarg ignored
+        out = self
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, (str, dtype_mod.DType)):
+                try:
+                    out = out.astype(dtype_mod.convert_dtype(a))
+                    continue
+                except ValueError:
+                    pass  # a device string like "cpu"
+        return out
+
+    def cpu(self) -> "Tensor":
+        return Tensor(jax.device_get(self._value), stop_gradient=self.stop_gradient)
+
+    def cuda(self, *a, **k) -> "Tensor":
+        return self  # single-accelerator residency is implicit with jax
+
+    def pin_memory(self) -> "Tensor":
+        return self
+
+    def contiguous(self) -> "Tensor":
+        return self
+
+    def is_contiguous(self) -> bool:
+        return True
+
+    # ---------------- inplace machinery ----------------
+    def _inplace_adopt(self, result: "Tensor") -> "Tensor":
+        self._value = result._value
+        self._grad_node = result._grad_node
+        self._out_index = result._out_index
+        self._version += 1
+        return self
+
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._value
+        self._value = jnp.asarray(value, dtype=self._value.dtype).reshape(self._value.shape)
+        self._version += 1
+        return self
+
+    def copy_(self, other, blocking: bool = True):
+        return self.set_value(other)
+
+    def zero_(self):
+        self._value = jnp.zeros_like(self._value)
+        self._version += 1
+        return self
+
+    def fill_(self, v):
+        self._value = jnp.full_like(self._value, v)
+        self._version += 1
+        return self
+
+    # ---------------- indexing ----------------
+    @staticmethod
+    def _unwrap_index(idx):
+        if isinstance(idx, Tensor):
+            return idx._value
+        if isinstance(idx, tuple):
+            return tuple(Tensor._unwrap_index(i) for i in idx)
+        if isinstance(idx, list):
+            return jnp.asarray(np.asarray(idx))
+        return idx
+
+    def __getitem__(self, idx) -> "Tensor":
+        from ..ops.dispatch import apply
+
+        raw = Tensor._unwrap_index(idx)
+        return apply(lambda x: x[raw], self, op_name="getitem")
+
+    def __setitem__(self, idx, value):
+        from ..ops.dispatch import apply
+
+        raw = Tensor._unwrap_index(idx)
+        if isinstance(value, Tensor):
+            out = apply(
+                lambda x, v: x.at[raw].set(v.astype(x.dtype)), self, value, op_name="setitem"
+            )
+        else:
+            out = apply(lambda x: x.at[raw].set(value), self, op_name="setitem")
+        self._inplace_adopt(out)
+
+    # ---------------- operator dunders ----------------
+    # (implementations attached by tensor.patch_methods to avoid circular imports)
+
+    def __matmul__(self, other):
+        from . import linalg
+
+        return linalg.matmul(self, other)
+
+    def __neg__(self):
+        from ..ops.dispatch import apply
+
+        return apply(jnp.negative, self, op_name="neg")
+
+    def __abs__(self):
+        from ..ops.dispatch import apply
+
+        return apply(jnp.abs, self, op_name="abs")
+
+    def __invert__(self):
+        from ..ops.dispatch import apply
+
+        return apply(jnp.logical_not, self, op_name="logical_not")

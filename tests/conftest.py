@@ -1,0 +1,25 @@
+"""Test harness config.
+
+Runs the whole suite on CPU with 8 virtual XLA devices so multi-chip sharding
+paths compile and execute without TPU hardware — the same trick the reference
+uses with its fake custom_cpu plugin device
+(/root/reference/test/custom_runtime/test_custom_cpu_plugin.py:23).
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_everything():
+    import paddle_tpu as P
+
+    P.seed(2024)
+    np.random.seed(2024)
+    yield

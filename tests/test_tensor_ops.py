@@ -1,0 +1,306 @@
+"""Tensor op correctness vs numpy — the OpTest discipline
+(/root/reference/test/legacy_test/op_test.py:418) without the three-mode split:
+paddle_tpu has one execution world, so each op is checked eagerly (jit parity
+is covered in test_jit.py).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+
+
+def check(t, expected, rtol=1e-3, atol=1e-5):
+    np.testing.assert_allclose(np.asarray(t.numpy(), dtype=np.float64),
+                               np.asarray(expected, dtype=np.float64), rtol=rtol, atol=atol)
+
+
+class TestCreation:
+    def test_to_tensor(self):
+        t = P.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        assert t.shape == [2, 2]
+        assert t.dtype == P.float32
+        check(t, [[1, 2], [3, 4]])
+
+    def test_dtype_inference(self):
+        assert P.to_tensor([1, 2]).dtype.name in ("int32", "int64")
+        assert P.to_tensor([1.0, 2.0]).dtype == P.float32
+        assert P.to_tensor(True).dtype == P.bool_
+
+    def test_factories(self):
+        assert P.zeros([2, 3]).numpy().sum() == 0
+        assert P.ones([2, 3]).numpy().sum() == 6
+        check(P.full([2], 7.0), [7, 7])
+        check(P.arange(5), np.arange(5))
+        check(P.linspace(0, 1, 5), np.linspace(0, 1, 5))
+        assert P.eye(3).numpy().trace() == 3
+        check(P.ones_like(P.zeros([4])), np.ones(4))
+
+    def test_one_hot(self):
+        oh = P.one_hot(P.to_tensor([0, 2]), 3)
+        check(oh, [[1, 0, 0], [0, 0, 1]])
+
+
+class TestMath:
+    def test_elementwise(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        b = np.random.randn(3, 4).astype(np.float32)
+        x, y = P.to_tensor(a), P.to_tensor(b)
+        check(P.add(x, y), a + b)
+        check(P.subtract(x, y), a - b)
+        check(P.multiply(x, y), a * b)
+        check(P.divide(x, y), a / b, rtol=1e-4)
+        check(P.maximum(x, y), np.maximum(a, b))
+        check(P.minimum(x, y), np.minimum(a, b))
+        check(x + 2.0, a + 2)
+        check(2.0 - x, 2 - a)
+        check(x * 3, a * 3)
+
+    def test_unary(self):
+        a = np.abs(np.random.randn(10).astype(np.float32)) + 0.1
+        x = P.to_tensor(a)
+        check(P.exp(x), np.exp(a), rtol=1e-4)
+        check(P.log(x), np.log(a), rtol=1e-3, atol=1e-5)
+        check(P.sqrt(x), np.sqrt(a))
+        check(P.rsqrt(x), 1 / np.sqrt(a), rtol=1e-4)
+        check(P.tanh(x), np.tanh(a))
+        check(P.abs(-x), a)
+        check(P.square(x), a * a)
+        check(P.sin(x), np.sin(a))
+        check(P.floor(x), np.floor(a))
+        check(P.round(x), np.round(a))
+
+    def test_reductions(self):
+        a = np.random.randn(3, 4, 5).astype(np.float32)
+        x = P.to_tensor(a)
+        check(P.sum(x), a.sum(), rtol=1e-4)
+        check(P.sum(x, axis=1), a.sum(1), rtol=1e-4)
+        check(P.sum(x, axis=[0, 2], keepdim=True), a.sum((0, 2), keepdims=True), rtol=1e-4)
+        check(P.mean(x, axis=-1), a.mean(-1), rtol=1e-4)
+        check(P.max(x, axis=0), a.max(0))
+        check(P.min(x), a.min())
+        check(P.prod(P.to_tensor([1.0, 2.0, 3.0])), 6.0)
+        check(P.logsumexp(x, axis=1), np.log(np.exp(a).sum(1)), rtol=1e-4)
+
+    def test_cumsum_clip_scale(self):
+        a = np.arange(6, dtype=np.float32).reshape(2, 3)
+        x = P.to_tensor(a)
+        check(P.cumsum(x, axis=1), a.cumsum(1))
+        check(P.clip(x, 1.0, 4.0), a.clip(1, 4))
+        check(P.scale(x, scale=2.0, bias=1.0), a * 2 + 1)
+        check(P.scale(x, scale=2.0, bias=1.0, bias_after_scale=False), (a + 1) * 2)
+
+    def test_pow_mod(self):
+        a = np.array([1.0, 2.0, 3.0], np.float32)
+        x = P.to_tensor(a)
+        check(P.pow(x, 2.0), a**2)
+        check(x**0.5, a**0.5, rtol=1e-5)
+        check(P.remainder(P.to_tensor([5, 7]), P.to_tensor([3, 4])), [2, 3])
+
+
+class TestLinalg:
+    def test_matmul(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        b = np.random.randn(4, 5).astype(np.float32)
+        check(P.matmul(P.to_tensor(a), P.to_tensor(b)), a @ b, rtol=1e-4)
+        check(P.matmul(P.to_tensor(a), P.to_tensor(b.T), transpose_y=True), a @ b, rtol=1e-4)
+        check(P.matmul(P.to_tensor(a.T), P.to_tensor(b), transpose_x=True), a @ b, rtol=1e-4)
+
+    def test_batched_and_t(self):
+        a = np.random.randn(2, 3, 4).astype(np.float32)
+        b = np.random.randn(2, 4, 5).astype(np.float32)
+        check(P.bmm(P.to_tensor(a), P.to_tensor(b)), a @ b, rtol=1e-4)
+        m = np.random.randn(3, 4).astype(np.float32)
+        check(P.t(P.to_tensor(m)), m.T)
+        check(P.transpose(P.to_tensor(a), [2, 0, 1]), a.transpose(2, 0, 1))
+
+    def test_norm_solve(self):
+        a = np.random.randn(4, 4).astype(np.float32) + 4 * np.eye(4, dtype=np.float32)
+        bv = np.random.randn(4, 2).astype(np.float32)
+        check(P.linalg.solve(P.to_tensor(a), P.to_tensor(bv)), np.linalg.solve(a, bv), rtol=1e-3, atol=1e-4)
+        v = np.random.randn(6).astype(np.float32)
+        check(P.norm(P.to_tensor(v), p=2), np.linalg.norm(v), rtol=1e-5)
+        check(P.norm(P.to_tensor(v), p=1), np.abs(v).sum(), rtol=1e-5)
+
+    def test_einsum(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        b = np.random.randn(4, 5).astype(np.float32)
+        check(P.einsum("ij,jk->ik", P.to_tensor(a), P.to_tensor(b)), a @ b, rtol=1e-4)
+
+
+class TestManipulation:
+    def test_reshape_like(self):
+        a = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        x = P.to_tensor(a)
+        assert P.reshape(x, [6, 4]).shape == [6, 4]
+        assert P.reshape(x, [-1, 8]).shape == [3, 8]
+        assert P.flatten(x).shape == [24]
+        assert P.flatten(x, 1, 2).shape == [2, 12]
+        assert P.squeeze(P.ones([1, 3, 1])).shape == [3]
+        assert P.squeeze(P.ones([1, 3, 1]), axis=0).shape == [3, 1]
+        assert P.unsqueeze(x, [0, 2]).shape == [1, 2, 1, 3, 4]
+
+    def test_concat_stack_split(self):
+        a = np.random.randn(2, 3).astype(np.float32)
+        x = P.to_tensor(a)
+        assert P.concat([x, x], axis=1).shape == [2, 6]
+        assert P.stack([x, x, x]).shape == [3, 2, 3]
+        parts = P.split(P.arange(9), [2, 3, 4])
+        assert [p.shape[0] for p in parts] == [2, 3, 4]
+        chunks = P.chunk(P.ones([6, 2]), 3, axis=0)
+        assert len(chunks) == 3 and chunks[0].shape == [2, 2]
+        ub = P.unbind(P.ones([3, 4]), axis=0)
+        assert len(ub) == 3 and ub[0].shape == [4]
+
+    def test_tile_expand_pad(self):
+        x = P.to_tensor([[1.0, 2.0]])
+        assert P.tile(x, [2, 3]).shape == [2, 6]
+        assert P.expand(x, [4, 2]).shape == [4, 2]
+        assert P.broadcast_to(x, [5, 2]).shape == [5, 2]
+
+    def test_gather_scatter(self):
+        a = np.arange(12, dtype=np.float32).reshape(4, 3)
+        x = P.to_tensor(a)
+        check(P.gather(x, P.to_tensor([0, 2])), a[[0, 2]])
+        check(P.index_select(x, P.to_tensor([1, 1]), axis=1), a[:, [1, 1]])
+        out = P.scatter(P.zeros([4, 3]), P.to_tensor([1, 3]), P.ones([2, 3]))
+        assert out.numpy()[1].sum() == 3 and out.numpy()[3].sum() == 3
+        gnd = P.gather_nd(x, P.to_tensor([[0, 1], [2, 2]]))
+        check(gnd, [a[0, 1], a[2, 2]])
+        taa = P.take_along_axis(x, P.to_tensor([[0], [1], [2], [0]]), axis=1)
+        check(taa, np.take_along_axis(a, np.array([[0], [1], [2], [0]]), 1))
+
+    def test_flip_roll_tril(self):
+        a = np.arange(9, dtype=np.float32).reshape(3, 3)
+        x = P.to_tensor(a)
+        check(P.flip(x, 0), a[::-1])
+        check(P.roll(x, 1, axis=0), np.roll(a, 1, 0))
+        check(P.tril(x), np.tril(a))
+        check(P.triu(x, 1), np.triu(a, 1))
+        check(P.diag(P.to_tensor([1.0, 2.0])), np.diag([1.0, 2.0]))
+
+    def test_masked(self):
+        a = np.array([1.0, -2.0, 3.0], np.float32)
+        x = P.to_tensor(a)
+        check(P.masked_select(x, x > 0), [1.0, 3.0])
+        check(P.masked_fill(x, x < 0, 0.0), [1.0, 0.0, 3.0])
+
+
+class TestLogicSearch:
+    def test_comparisons(self):
+        x = P.to_tensor([1.0, 2.0, 3.0])
+        y = P.to_tensor([2.0, 2.0, 2.0])
+        assert (x < y).tolist() == [True, False, False]
+        assert (x == y).tolist() == [False, True, False]
+        assert P.equal_all(x, x).item()
+        assert P.allclose(x, x + 1e-9).item()
+
+    def test_logical(self):
+        t = P.to_tensor([True, False])
+        f = P.to_tensor([False, False])
+        assert P.logical_and(t, f).tolist() == [False, False]
+        assert P.logical_or(t, f).tolist() == [True, False]
+        assert P.logical_not(f).tolist() == [True, True]
+
+    def test_search(self):
+        a = np.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]], np.float32)
+        x = P.to_tensor(a)
+        assert P.argmax(x, axis=1).tolist() == [0, 1]
+        assert P.argmin(x, axis=0).tolist() == [1, 0, 1]
+        vals, idx = P.topk(x, 2, axis=1)
+        check(vals, np.sort(a, 1)[:, ::-1][:, :2])
+        srt = P.sort(x, axis=1)
+        check(srt, np.sort(a, 1))
+        assert P.nonzero(P.to_tensor([0, 1, 0, 2])).tolist() == [[1], [3]]
+        ss = P.searchsorted(P.to_tensor([1.0, 3.0, 5.0]), P.to_tensor([2.0, 6.0]))
+        assert ss.tolist() == [1, 3]
+
+    def test_where(self):
+        c = P.to_tensor([True, False, True])
+        x = P.to_tensor([1.0, 2.0, 3.0])
+        y = P.to_tensor([9.0, 9.0, 9.0])
+        check(P.where(c, x, y), [1, 9, 3])
+
+
+class TestStatRandom:
+    def test_stats(self):
+        a = np.random.randn(100).astype(np.float32)
+        x = P.to_tensor(a)
+        check(P.mean(x), a.mean(), rtol=1e-4, atol=1e-5)
+        check(P.std(x), a.std(ddof=1), rtol=1e-4)
+        check(P.var(x), a.var(ddof=1), rtol=1e-4)
+        check(P.median(P.to_tensor([1.0, 3.0, 2.0])), 2.0)
+
+    def test_random_reproducible(self):
+        P.seed(42)
+        a = P.randn([4, 4]).numpy()
+        P.seed(42)
+        b = P.randn([4, 4]).numpy()
+        np.testing.assert_array_equal(a, b)
+        c = P.randn([4, 4]).numpy()
+        assert not np.array_equal(b, c)
+
+    def test_random_shapes(self):
+        assert P.rand([2, 3]).shape == [2, 3]
+        r = P.randint(0, 10, [100])
+        assert r.numpy().min() >= 0 and r.numpy().max() < 10
+        assert sorted(P.randperm(10).tolist()) == list(range(10))
+        m = P.multinomial(P.to_tensor([0.0, 1.0, 0.0]), 2, replacement=True)
+        assert m.tolist() == [1, 1]
+        b = P.bernoulli(P.full([1000], 0.5))
+        assert 300 < b.numpy().sum() < 700
+
+
+class TestIndexing:
+    def test_getitem(self):
+        a = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        x = P.to_tensor(a)
+        check(x[0], a[0])
+        check(x[:, 1], a[:, 1])
+        check(x[..., -1], a[..., -1])
+        check(x[0, 1:3, ::2], a[0, 1:3, ::2])
+        check(x[P.to_tensor([1, 0])], a[[1, 0]])
+        check(x[x > 11.0], a[a > 11.0])
+
+    def test_setitem(self):
+        x = P.zeros([3, 3])
+        x[0, 0] = 5.0
+        x[2] = P.ones([3])
+        assert x.numpy()[0, 0] == 5
+        assert x.numpy()[2].sum() == 3
+
+    def test_inplace_methods(self):
+        x = P.ones([3])
+        x.add_(P.ones([3]))
+        check(x, [2, 2, 2])
+        x.scale_(scale=0.5)
+        check(x, [1, 1, 1])
+
+
+class TestTensorMisc:
+    def test_meta(self):
+        x = P.ones([2, 3], dtype="float32")
+        assert x.ndim == 2 and x.numel() == 6 and x.size == 6
+        assert x.element_size() == 4
+        assert not x.is_leaf or x.is_leaf  # property exists
+        assert "Tensor(shape=[2, 3]" in repr(x)
+
+    def test_cast(self):
+        x = P.ones([2])
+        assert x.astype("int32").dtype == P.int32
+        assert x.astype(P.bfloat16).dtype == P.bfloat16
+        assert P.cast(x, "bool").dtype == P.bool_
+
+    def test_item_conversion(self):
+        assert float(P.to_tensor(3.5)) == 3.5
+        assert int(P.to_tensor(3)) == 3
+        assert P.to_tensor([1.5]).item() == 1.5
+        assert len(P.ones([4, 2])) == 4
+        assert [t.shape for t in P.ones([2, 3])] == [[3], [3]]
+
+    def test_clone_detach(self):
+        x = P.to_tensor([1.0], stop_gradient=False)
+        d = x.detach()
+        assert d.stop_gradient
+        c = x.clone()
+        (c * 2).backward()
+        check(x.grad, [2.0])

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Benchmark driver entry: Llama pretrain step on the local accelerator.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Metric: Llama pretrain tokens/sec/chip (BASELINE.json headline). The model
+size auto-scales to the visible chip (tiny on CPU so the script always runs;
+~350M-class decoder on a single v5e chip). vs_baseline is achieved MFU /
+0.35 (the north-star MFU target), since the reference publishes no absolute
+in-tree numbers (BASELINE.md).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    backend = jax.default_backend()
+    on_accel = backend in ("tpu", "axon")
+
+    import paddle_tpu as P
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, LlamaPretrainingCriterion
+
+    P.seed(0)
+    if on_accel:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=16, num_attention_heads=16,
+            max_position_embeddings=2048, dtype="bfloat16",
+        )
+        batch, seq, steps = 8, 2048, 20
+    else:
+        cfg = LlamaConfig(vocab_size=512, hidden_size=128, intermediate_size=352,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          max_position_embeddings=256)
+        batch, seq, steps = 2, 128, 5
+
+    model = LlamaForCausalLM(cfg)
+    if cfg.dtype == "bfloat16":
+        model.bfloat16()
+    n_params = model.num_params
+    crit = LlamaPretrainingCriterion()
+    opt = P.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                            multi_precision=True)
+    step = P.jit.TrainStep(model, lambda m, ids: crit(m(ids), ids), opt)
+
+    ids = P.to_tensor(np.random.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+
+    # compile + warmup
+    loss = step(ids)
+    loss.numpy()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(ids)
+    float(loss.numpy())  # sync
+    dt = (time.perf_counter() - t0) / steps
+
+    tokens_per_sec = batch * seq / dt
+    # 6ND per token (fwd+bwd) + attention term
+    flops_per_token = 6 * n_params + 12 * cfg.num_hidden_layers * cfg.hidden_size * seq * 0.5
+    achieved_flops = tokens_per_sec * flops_per_token
+    peak = 197e12 if on_accel else 1e12  # v5e bf16 peak
+    mfu = achieved_flops / peak
+
+    print(json.dumps({
+        "metric": "llama_pretrain_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.35, 4),
+        "extra": {
+            "backend": backend,
+            "params": n_params,
+            "batch": batch,
+            "seq_len": seq,
+            "step_ms": round(dt * 1e3, 2),
+            "mfu": round(mfu, 4),
+            "loss": float(loss.numpy()),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -73,7 +73,8 @@ from .device import (  # noqa: F401
 # subsystems (imported lazily-tolerant during bootstrap; all present by v0.1)
 import importlib as _importlib
 
-for _sub in ("nn", "optimizer", "metric", "amp", "io", "jit", "vision", "distributed"):
+for _sub in ("nn", "optimizer", "metric", "amp", "io", "jit", "vision", "distributed",
+             "models", "profiler", "hapi", "regularizer"):
     try:
         globals()[_sub] = _importlib.import_module(f".{_sub}", __name__)
     except ModuleNotFoundError as _e:
@@ -82,6 +83,13 @@ for _sub in ("nn", "optimizer", "metric", "amp", "io", "jit", "vision", "distrib
 
 try:
     from .framework_io import load, save  # noqa: F401
+except ModuleNotFoundError:
+    pass
+
+from .base.param_attr import ParamAttr  # noqa: F401
+
+try:
+    from .hapi import Model, summary  # noqa: F401
 except ModuleNotFoundError:
     pass
 

@@ -1,0 +1,5 @@
+"""paddle_tpu.amp (parity: python/paddle/amp)."""
+from . import amp_lists  # noqa: F401
+from .auto_cast import amp_guard, amp_state, auto_cast, decorate, is_auto_cast_enabled  # noqa: F401
+from .grad_scaler import GradScaler  # noqa: F401
+from . import debugging  # noqa: F401

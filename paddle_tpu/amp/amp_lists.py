@@ -1,0 +1,21 @@
+"""AMP op lists (parity: python/paddle/amp/amp_lists.py:20-40).
+
+White list: MXU-bound ops worth running in fp16/bf16. Black list: numerically
+sensitive ops kept in fp32. Names match this framework's op_name tags in
+ops.dispatch.
+"""
+
+WHITE_LIST = {
+    "matmul", "linear", "conv1d", "conv2d", "conv3d", "conv1d_transpose",
+    "conv2d_transpose", "conv3d_transpose", "einsum", "bmm", "mm", "addmm",
+    "flash_attention", "sdpa", "lstm", "gru", "rnn_tanh", "rnn_relu",
+}
+
+BLACK_LIST = {
+    "exp", "square", "log", "log2", "log10", "log1p", "mean", "sum", "prod",
+    "cosine_similarity", "cross_entropy", "nll_loss", "binary_cross_entropy",
+    "bce_with_logits", "kl_div", "softmax_with_cross_entropy", "logsumexp",
+    "cumsum", "norm", "var", "std", "renorm", "erfinv", "pow", "rsqrt",
+    "layer_norm", "group_norm", "instance_norm", "rms_norm", "batch_norm",
+    "ctc_loss", "sigmoid_focal_loss", "l1_loss", "smooth_l1_loss", "mse_loss",
+}

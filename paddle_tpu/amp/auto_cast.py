@@ -1,0 +1,112 @@
+"""AMP autocast (parity: python/paddle/amp/auto_cast.py:461 amp_guard).
+
+O1: only white-list ops run in low precision (inputs cast at dispatch).
+O2: everything except black-list runs in low precision; master weights live in
+the optimizer (multi_precision). The cast hook lives in ops.dispatch.apply —
+the same place the reference's codegen injects AmpAutoCast
+(paddle/fluid/eager/amp_auto_cast.h:40).
+
+TPU note: bf16 is the native fast dtype (MXU) — default amp dtype is bfloat16
+and loss scaling is unnecessary for it (GradScaler becomes identity unless
+fp16 is requested).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional, Set
+
+from . import amp_lists
+
+__all__ = ["auto_cast", "amp_guard", "amp_state", "decorate", "is_auto_cast_enabled", "get_amp_dtype"]
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = "bfloat16"
+        self.level = "O1"
+        self.white: Set[str] = set()
+        self.black: Set[str] = set()
+
+
+_state = _AmpState()
+
+
+def amp_state() -> _AmpState:
+    return _state
+
+
+def is_auto_cast_enabled() -> bool:
+    return _state.enabled
+
+
+def get_amp_dtype() -> str:
+    return _state.dtype
+
+
+class auto_cast:
+    """Context manager: paddle.amp.auto_cast parity."""
+
+    def __init__(self, enable=True, custom_white_list=None, custom_black_list=None,
+                 level="O1", dtype="bfloat16", use_promote=True):
+        assert level in ("O0", "O1", "O2")
+        assert dtype in ("float16", "bfloat16")
+        self.enable = enable and level != "O0"
+        self.level = level
+        self.dtype = dtype
+        self.white = (amp_lists.WHITE_LIST | set(custom_white_list or ())) - set(custom_black_list or ())
+        self.black = amp_lists.BLACK_LIST | set(custom_black_list or ())
+
+    def __enter__(self):
+        self._saved = (_state.enabled, _state.dtype, _state.level, _state.white, _state.black)
+        _state.enabled = self.enable
+        _state.dtype = self.dtype
+        _state.level = self.level
+        _state.white = self.white
+        _state.black = self.black
+        return self
+
+    def __exit__(self, *exc):
+        (_state.enabled, _state.dtype, _state.level, _state.white, _state.black) = self._saved
+        return False
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16", master_weight=None,
+             save_dtype=None, master_grad=False, excluded_layers=None):
+    """paddle.amp.decorate parity: O2 casts model params to the amp dtype and
+    switches optimizers to multi_precision master weights."""
+    from ..framework import dtype as dtype_mod
+
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        dt = dtype_mod.convert_dtype(dtype)
+        for m in model_list:
+            excluded = set()
+            if excluded_layers:
+                excl_list = excluded_layers if isinstance(excluded_layers, (list, tuple)) else [excluded_layers]
+                for sub in m.sublayers(include_self=True):
+                    if any(isinstance(sub, e) if isinstance(e, type) else sub is e for e in excl_list):
+                        excluded.add(id(sub))
+            for sub in m.sublayers(include_self=True):
+                if id(sub) in excluded:
+                    continue
+                from ..nn.layer.norm import LayerNorm, _BatchNormBase
+
+                if isinstance(sub, (_BatchNormBase, LayerNorm)):
+                    continue  # norm params stay fp32 (reference keep_batch_norm_fp32)
+                for p in sub._parameters.values():
+                    if p is not None and p.dtype.is_floating_point:
+                        p._value = p._value.astype(dt.np_dtype)
+            m._casted_by_pure_fp16 = True
+    if optimizers is None:
+        return models if single_model else model_list
+    single_opt = not isinstance(optimizers, (list, tuple))
+    opt_list = [optimizers] if single_opt else list(optimizers)
+    if level == "O2" and (master_weight is None or master_weight):
+        for opt in opt_list:
+            opt._multi_precision = True
+    return (models if single_model else model_list), (optimizers if single_opt else opt_list)

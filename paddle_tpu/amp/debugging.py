@@ -1,0 +1,92 @@
+"""Numerical debugging (parity: python/paddle/amp/debugging.py:174 —
+TensorCheckerConfig / enable_tensor_checker / check_numerics; plus the
+FLAGS_check_nan_inf per-op scan which lives in ops.dispatch)."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..framework import flags
+from ..tensor.tensor import Tensor
+
+__all__ = [
+    "TensorCheckerConfig", "enable_tensor_checker", "disable_tensor_checker",
+    "check_numerics", "enable_operator_stats_collection", "disable_operator_stats_collection",
+    "collect_operator_stats", "DebugMode",
+]
+
+
+class DebugMode:
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 4
+
+
+class TensorCheckerConfig:
+    def __init__(self, enable=True, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir=None, checked_op_list=None, skipped_op_list=None,
+                 debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = checked_op_list
+        self.skipped_op_list = skipped_op_list
+        self.debug_step = debug_step
+
+
+def enable_tensor_checker(checker_config: TensorCheckerConfig):
+    flags.set_flags({"FLAGS_check_nan_inf": bool(checker_config.enable)})
+
+
+def disable_tensor_checker():
+    flags.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT):
+    """Return (num_nan, num_inf, num_zero) and raise in abort mode."""
+    v = tensor._value if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    n_nan = int(jnp.sum(jnp.isnan(v)))
+    n_inf = int(jnp.sum(jnp.isinf(v)))
+    n_zero = int(jnp.sum(v == 0))
+    if debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT and (n_nan or n_inf):
+        raise FloatingPointError(
+            f"check_numerics failed for op={op_type} var={var_name}: {n_nan} nan, {n_inf} inf"
+        )
+    return (
+        Tensor(jnp.asarray(n_nan)),
+        Tensor(jnp.asarray(n_inf)),
+        Tensor(jnp.asarray(n_zero)),
+    )
+
+
+_op_stats = None
+
+
+def enable_operator_stats_collection():
+    global _op_stats
+    _op_stats = {}
+    from ..ops import dispatch
+
+    dispatch._stats_sink = _op_stats
+
+
+def disable_operator_stats_collection():
+    from ..ops import dispatch
+
+    stats = dispatch._stats_sink
+    dispatch._stats_sink = None
+    if stats is not None:
+        print("<------------------------------ op list ------------------------------>")
+        for name, cnt in sorted(stats.items()):
+            print(f"  {name:<32} calls: {cnt}")
+
+
+class collect_operator_stats:
+    def __enter__(self):
+        enable_operator_stats_collection()
+        return self
+
+    def __exit__(self, *exc):
+        disable_operator_stats_collection()
+        return False

@@ -1,0 +1,53 @@
+"""paddle_tpu.distributed (parity: python/paddle/distributed).
+
+Layer map vs the reference (SURVEY.md §2.2): ProcessGroups→mesh axes,
+NCCL→XLA collectives over ICI/DCN, TCPStore→JAX coordination service,
+DistTensor/reshard→jax.Array with NamedSharding + device_put, fleet 5-D
+topology→jax.sharding.Mesh.
+"""
+from . import checkpoint  # noqa: F401
+from . import env  # noqa: F401
+from . import fleet  # noqa: F401
+from .auto_parallel import api as _auto_api  # noqa: F401
+from .auto_parallel.api import (  # noqa: F401
+    dtensor_from_fn,
+    dtensor_from_local,
+    is_dist_tensor,
+    reshard,
+    shard_layer,
+    shard_optimizer,
+    shard_tensor,
+    unshard_dtensor,
+)
+from .communication import (  # noqa: F401
+    Group,
+    P2POp,
+    ReduceOp,
+    all_gather,
+    all_gather_object,
+    all_reduce,
+    all_to_all,
+    all_to_all_single,
+    barrier,
+    batch_isend_irecv,
+    broadcast,
+    broadcast_object_list,
+    gather,
+    get_group,
+    irecv,
+    isend,
+    new_group,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    stream,
+    wait,
+)
+from .env import ParallelEnv, get_rank, get_world_size, init_parallel_env, is_initialized  # noqa: F401
+from .parallel import DataParallel  # noqa: F401
+from .placements import Partial, Placement, ProcessMesh, Replicate, Shard  # noqa: F401
+from .topology import get_hybrid_communicate_group  # noqa: F401
+
+# namespace parity: paddle.distributed.fleet.* available as attribute already

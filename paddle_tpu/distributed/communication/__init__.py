@@ -1,0 +1,300 @@
+"""Collective communication API (parity:
+/root/reference/python/paddle/distributed/communication/ —
+all_reduce/all_gather/all_to_all/reduce_scatter/broadcast/... over
+ProcessGroups; C++ stack process_group.h:48 + NCCL backend).
+
+TPU-native layering (SURVEY.md §5 "Distributed communication backend"): the
+ProcessGroup+NCCL+TCPStore stack is replaced by XLA collectives over ICI/DCN.
+Three execution contexts:
+
+1. **Inside shard_map/pjit traces** (the hot path): functions lower to
+   ``lax.psum / all_gather / psum_scatter / ppermute / all_to_all`` over the
+   group's mesh axis — XLA schedules them on ICI.
+2. **Eager, multi-host**: ``jax.experimental.multihost_utils`` collectives
+   over DCN (control-plane uses, e.g. metric reduction).
+3. **Eager, single-process SPMD**: per-rank views don't exist (the "global
+   array" IS the reduced view), so ops degenerate to their mathematical
+   identity on the global array; kept so fleet-style scripts run unchanged.
+
+API-visible contract kept from the reference: ``sync_op`` + returned task with
+``wait()`` (XLA async dispatch gives the async behavior for free).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...tensor.tensor import Tensor
+from .group import Group, ReduceOp, get_group, new_group  # noqa: F401
+
+__all__ = [
+    "all_reduce", "all_gather", "all_gather_object", "all_to_all", "all_to_all_single",
+    "reduce_scatter", "broadcast", "broadcast_object_list", "reduce", "scatter",
+    "gather", "send", "recv", "isend", "irecv", "barrier", "wait", "stream",
+    "Group", "ReduceOp", "new_group", "get_group", "P2POp", "batch_isend_irecv",
+]
+
+
+class _Task:
+    """Returned task object (parity: ProcessGroup task with Wait)."""
+
+    def __init__(self, value=None):
+        self._value = value
+
+    def wait(self):
+        if self._value is not None:
+            jax.block_until_ready(self._value)
+        return True
+
+    def is_completed(self):
+        return True
+
+    def synchronize(self):
+        self.wait()
+
+
+def _axis_in_scope(axis_name) -> bool:
+    """True when called inside a shard_map/pmap trace that binds axis_name."""
+    if axis_name is None:
+        return False
+    try:
+        lax.axis_index(axis_name)
+        return True
+    except Exception:
+        return False
+
+
+def _raw(t):
+    return t._value if isinstance(t, Tensor) else t
+
+
+def _lax_reduce(val, op, axis):
+    if op == ReduceOp.SUM:
+        return lax.psum(val, axis)
+    if op == ReduceOp.MAX:
+        return lax.pmax(val, axis)
+    if op == ReduceOp.MIN:
+        return lax.pmin(val, axis)
+    if op == ReduceOp.AVG:
+        return lax.pmean(val, axis)
+    if op == ReduceOp.PROD:
+        return lax.pprod(val, axis) if hasattr(lax, "pprod") else jnp.exp(lax.psum(jnp.log(val), axis))
+    raise ValueError(f"unsupported reduce op {op}")
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[Group] = None, sync_op=True):
+    axis = group.axis_name if group is not None else None
+    val = _raw(tensor)
+    if _axis_in_scope(axis):
+        out = _lax_reduce(val, op, axis)
+    elif jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        out = multihost_utils.process_allgather(val)
+        out = out.sum(0) if op == ReduceOp.SUM else out.max(0) if op == ReduceOp.MAX else out.min(0)
+        out = jnp.asarray(out)
+    else:
+        out = val  # single-process SPMD: global array already holds the reduced view
+    if isinstance(tensor, Tensor):
+        tensor._value = out
+        return _Task(out)
+    return out
+
+
+def all_gather(tensor_list, tensor, group: Optional[Group] = None, sync_op=True):
+    axis = group.axis_name if group is not None else None
+    val = _raw(tensor)
+    n = group.nranks if group is not None else 1
+    if _axis_in_scope(axis):
+        gathered = lax.all_gather(val, axis)  # [n, ...]
+        parts = [gathered[i] for i in range(n)]
+    elif jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        gathered = multihost_utils.process_allgather(val)
+        parts = [jnp.asarray(gathered[i]) for i in range(gathered.shape[0])]
+    else:
+        parts = [val for _ in range(n)]
+    if isinstance(tensor_list, list):
+        tensor_list.clear()
+        tensor_list.extend(Tensor(p) for p in parts)
+        return _Task()
+    return [Tensor(p) for p in parts]
+
+
+def all_gather_object(object_list, obj, group=None):
+    if jax.process_count() > 1:
+        raise NotImplementedError("all_gather_object over multi-host is not wired yet")
+    n = group.nranks if group is not None else 1
+    object_list.clear()
+    object_list.extend(obj for _ in range(n))
+
+
+def reduce_scatter(tensor, tensor_list_or_input, op=ReduceOp.SUM, group=None, sync_op=True):
+    axis = group.axis_name if group is not None else None
+    if isinstance(tensor_list_or_input, (list, tuple)):
+        val = jnp.concatenate([_raw(t) for t in tensor_list_or_input], axis=0)
+    else:
+        val = _raw(tensor_list_or_input)
+    if _axis_in_scope(axis):
+        out = lax.psum_scatter(val, axis, scatter_dimension=0, tiled=True)
+    else:
+        n = group.nranks if group is not None else 1
+        out = val[: val.shape[0] // n] if n > 1 else val
+    if isinstance(tensor, Tensor):
+        tensor._value = out
+        return _Task(out)
+    return Tensor(out)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    axis = group.axis_name if group is not None else None
+    val = _raw(tensor)
+    if _axis_in_scope(axis):
+        src_local = group.get_group_rank(src) if group is not None else src
+        out = lax.all_gather(val, axis)[src_local]
+    elif jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        out = multihost_utils.broadcast_one_to_all(val, is_source=jax.process_index() == src)
+        out = jnp.asarray(out)
+    else:
+        out = val
+    if isinstance(tensor, Tensor):
+        tensor._value = out
+        return _Task(out)
+    return out
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    return object_list
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op, group, sync_op)  # dst holds it; others too (SPMD)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    axis = group.axis_name if group is not None else None
+    if _axis_in_scope(axis):
+        stacked = jnp.stack([_raw(t) for t in tensor_list], 0) if tensor_list else _raw(tensor)
+        idx = lax.axis_index(axis)
+        out = lax.dynamic_index_in_dim(stacked, idx, 0, keepdims=False)
+    else:
+        out = _raw(tensor_list[0]) if tensor_list else _raw(tensor)
+    if isinstance(tensor, Tensor):
+        tensor._value = out
+        return _Task(out)
+    return Tensor(out)
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    out = []
+    all_gather(out, tensor, group, sync_op)
+    if gather_list is not None:
+        gather_list.clear()
+        gather_list.extend(out)
+    return _Task()
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    axis = group.axis_name if group is not None else None
+    if _axis_in_scope(axis):
+        stacked = jnp.stack([_raw(t) for t in in_tensor_list], 0)  # [n, ...]
+        out = lax.all_to_all(stacked, axis, split_axis=0, concat_axis=0, tiled=False)
+        parts = [out[i] for i in range(out.shape[0])]
+    else:
+        parts = [_raw(t) for t in in_tensor_list]
+    out_tensor_list.clear()
+    out_tensor_list.extend(Tensor(p) for p in parts)
+    return _Task()
+
+
+def all_to_all_single(out_tensor, in_tensor, out_split_sizes=None, in_split_sizes=None, group=None, sync_op=True):
+    axis = group.axis_name if group is not None else None
+    val = _raw(in_tensor)
+    if _axis_in_scope(axis):
+        out = lax.all_to_all(val, axis, split_axis=0, concat_axis=0, tiled=True)
+    else:
+        out = val
+    if isinstance(out_tensor, Tensor):
+        out_tensor._value = out
+        return _Task(out)
+    return Tensor(out)
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    axis = group.axis_name if group is not None else None
+    if _axis_in_scope(axis):
+        raise RuntimeError("inside shard_map use p2p.ppermute_send_recv (paired send/recv)")
+    if jax.process_count() == 1:
+        _p2p_buf.append(_raw(tensor))
+        return _Task()
+    raise NotImplementedError("cross-process eager send requires the pipeline p2p helpers")
+
+
+_p2p_buf = []
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    if jax.process_count() == 1 and _p2p_buf:
+        val = _p2p_buf.pop(0)
+        if isinstance(tensor, Tensor):
+            tensor._value = val
+        return _Task(val)
+    raise NotImplementedError("cross-process eager recv requires the pipeline p2p helpers")
+
+
+isend = send
+irecv = recv
+
+
+class P2POp:
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    tasks = []
+    for op in p2p_op_list:
+        tasks.append(op.op(op.tensor, op.peer, op.group))
+    return tasks
+
+
+def barrier(group=None):
+    jax.block_until_ready(jnp.zeros(()))
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")
+    return _Task()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    jax.block_until_ready(_raw(tensor))
+
+
+class _StreamNS:
+    """paddle.distributed.communication.stream parity — async variants; XLA
+    dispatch is already async so these alias the sync forms."""
+
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    reduce_scatter = staticmethod(reduce_scatter)
+    broadcast = staticmethod(broadcast)
+    scatter = staticmethod(scatter)
+    reduce = staticmethod(reduce)
+    all_to_all = staticmethod(all_to_all)
+    send = staticmethod(send)
+    recv = staticmethod(recv)
+
+
+stream = _StreamNS()

@@ -1,0 +1,92 @@
+"""Group — the communicator facade (parity:
+/root/reference/python/paddle/distributed/communication/group.py).
+
+TPU-native: a Group names a mesh axis (or a standalone mesh over a rank
+subset). Collectives on a Group become XLA collectives over that axis.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["Group", "new_group", "get_group", "ReduceOp"]
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+class Group:
+    def __init__(self, ranks: List[int], axis_name: str, mesh: Mesh, gid: int = 0):
+        self.ranks = list(ranks)
+        self.nranks = len(ranks)
+        self.axis_name = axis_name
+        self.mesh = mesh
+        self.id = gid
+
+    @classmethod
+    def for_axis(cls, hcg, axis: str) -> "Group":
+        topo = hcg.topology()
+        name_map = dict(dp="data", pp="pipe", sharding="sharding", sep="sep", mp="model")
+        groups = topo.get_comm_list(name_map[axis])
+        # single-controller SPMD: this process sees group 0's shape; ranks list
+        # is informational (parity with the reference's bookkeeping)
+        ranks = groups[0] if groups else [0]
+        return cls(ranks, axis, hcg.mesh)
+
+    @property
+    def rank(self) -> int:
+        pid = jax.process_index()
+        return self.ranks.index(pid) if pid in self.ranks else 0
+
+    @property
+    def world_size(self) -> int:
+        return self.nranks
+
+    def get_group_rank(self, rank: int) -> int:
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def __repr__(self):
+        return f"Group(axis={self.axis_name}, nranks={self.nranks})"
+
+
+_groups = {}
+_next_gid = [1]
+
+
+def new_group(ranks=None, backend=None, timeout=None) -> Group:
+    """parity: paddle.distributed.new_group. Creates a 1-axis mesh over the
+    given ranks' devices."""
+    devs = np.asarray(jax.devices())
+    if ranks is None:
+        ranks = list(range(devs.size))
+    sub = devs[np.asarray(ranks) % devs.size]
+    mesh = Mesh(sub, ("group",))
+    g = Group(list(ranks), "group", mesh, gid=_next_gid[0])
+    _groups[g.id] = g
+    _next_gid[0] += 1
+    return g
+
+
+def get_group(gid: int = 0) -> Optional[Group]:
+    return _groups.get(gid)
+
+
+def _get_default_group() -> Group:
+    from ..topology import get_hybrid_communicate_group
+
+    hcg = get_hybrid_communicate_group()
+    if hcg is not None:
+        devs = np.asarray(jax.devices())
+        mesh = hcg.mesh
+        return Group(list(range(devs.size)), None, mesh)
+    devs = np.asarray(jax.devices())
+    return Group(list(range(devs.size)), "group", Mesh(devs, ("group",)))

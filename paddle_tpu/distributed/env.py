@@ -1,0 +1,93 @@
+"""Process/rank environment (parity: python/paddle/distributed/parallel.py env
+surface + launch env contract PADDLE_TRAINER_*).
+
+TPU-native: ranks map to jax processes (multi-host pods); the JAX distributed
+runtime's coordination service replaces TCPStore rendezvous
+(reference: paddle/phi/core/distributed/store/tcp_store.h:121).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+__all__ = [
+    "get_rank", "get_world_size", "init_parallel_env", "ParallelEnv",
+    "is_initialized", "get_local_rank",
+]
+
+_initialized = False
+
+
+def get_rank(group=None) -> int:
+    if group is not None:
+        return group.rank
+    return jax.process_index()
+
+
+def get_local_rank() -> int:
+    return int(os.environ.get("PADDLE_LOCAL_RANK", 0))
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    return jax.process_count()
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def init_parallel_env():
+    """parity: paddle.distributed.init_parallel_env (parallel.py:977).
+
+    Multi-host: reads the launch env contract (PADDLE_TRAINER_ENDPOINTS /
+    PADDLE_TRAINER_ID or standard JAX coordinator vars) and brings up the JAX
+    distributed runtime. Single-host: no-op (SPMD over local devices).
+    """
+    global _initialized
+    if _initialized:
+        return
+    coord = os.environ.get("PADDLE_MASTER") or os.environ.get("COORDINATOR_ADDRESS")
+    endpoints = os.environ.get("PADDLE_TRAINER_ENDPOINTS")
+    n_proc = os.environ.get("PADDLE_TRAINERS_NUM")
+    rank = os.environ.get("PADDLE_TRAINER_ID")
+    if coord is None and endpoints:
+        coord = endpoints.split(",")[0]
+    if coord and n_proc and int(n_proc) > 1:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(n_proc),
+            process_id=int(rank or 0),
+        )
+    _initialized = True
+
+
+class ParallelEnv:
+    """parity: paddle.distributed.ParallelEnv."""
+
+    @property
+    def rank(self) -> int:
+        return get_rank()
+
+    @property
+    def local_rank(self) -> int:
+        return get_local_rank()
+
+    @property
+    def world_size(self) -> int:
+        return get_world_size()
+
+    @property
+    def device_id(self) -> int:
+        return get_local_rank()
+
+    @property
+    def dev_id(self) -> int:
+        return get_local_rank()
+
+    @property
+    def nranks(self) -> int:
+        return get_world_size()

@@ -1,0 +1,104 @@
+"""fleet — hybrid parallel orchestration (parity:
+/root/reference/python/paddle/distributed/fleet/fleet.py:99 fleet.init,
+model.py:32 distributed_model, base/distributed_strategy.py:178).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import env as _env
+from ..topology import (
+    HybridCommunicateGroup,
+    get_hybrid_communicate_group,
+    set_hybrid_communicate_group,
+)
+from .distributed_strategy import DistributedStrategy
+from . import mp_layers  # noqa: F401
+from .mp_layers import (  # noqa: F401
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+
+__all__ = [
+    "init", "DistributedStrategy", "distributed_model", "distributed_optimizer",
+    "get_hybrid_communicate_group", "HybridCommunicateGroup", "worker_num", "worker_index",
+    "is_first_worker", "barrier_worker",
+]
+
+_fleet_initialized = False
+_strategy: Optional[DistributedStrategy] = None
+
+
+def init(role_maker=None, is_collective=False, strategy: Optional[DistributedStrategy] = None, log_level="INFO"):
+    """parity: fleet.init — builds the 5-D topology mesh from the strategy's
+    hybrid_configs (reference axis order [dp, pp, sharding, sep, mp])."""
+    global _fleet_initialized, _strategy
+    _env.init_parallel_env()
+    strategy = strategy or DistributedStrategy()
+    _strategy = strategy
+    cfg = strategy.hybrid_configs
+    hcg = HybridCommunicateGroup(
+        dp=cfg.get("dp_degree", 1),
+        mp=cfg.get("mp_degree", 1),
+        pp=cfg.get("pp_degree", 1),
+        sharding=cfg.get("sharding_degree", 1),
+        sep=cfg.get("sep_degree", 1),
+    )
+    set_hybrid_communicate_group(hcg)
+    _fleet_initialized = True
+    return None
+
+
+def get_strategy() -> Optional[DistributedStrategy]:
+    return _strategy
+
+
+def worker_num() -> int:
+    return _env.get_world_size()
+
+
+def worker_index() -> int:
+    return _env.get_rank()
+
+
+def is_first_worker() -> bool:
+    return _env.get_rank() == 0
+
+
+def barrier_worker():
+    from ..communication import barrier
+
+    barrier()
+
+
+def distributed_model(model):
+    """parity: fleet/model.py:32 — wrap per strategy. TPU-native: data-parallel
+    gradient sync is a by-product of batch sharding under pjit, so the wrapper
+    annotates inputs with dp sharding; TP layers already carry mp shardings."""
+    from ..parallel import DataParallel
+
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        return model
+    if hcg.axis_size("dp") > 1 or hcg.axis_size("sharding") > 1:
+        return DataParallel(model)
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """parity: fleet.distributed_optimizer — hybrid-parallel optimizer wrap.
+    In SPMD the gradient averaging over dp rides the compiled step; sharded
+    grad-clip norms are global already (the array is global). Returns the
+    optimizer (optionally stage-sharded via auto_parallel.shard_optimizer)."""
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        return optimizer
+    cfg = (_strategy.hybrid_configs if _strategy else {})
+    sharding_degree = cfg.get("sharding_degree", 1)
+    if sharding_degree > 1:
+        from ..auto_parallel.api import ShardingStage1, shard_optimizer
+
+        return shard_optimizer(optimizer, ShardingStage1("sharding", hcg.process_mesh))
+    return optimizer

@@ -1,0 +1,51 @@
+"""DistributedStrategy (parity:
+/root/reference/python/paddle/distributed/fleet/base/distributed_strategy.py:178,
+proto paddle/fluid/framework/distributed_strategy.proto) — plain-python config
+object with the reference's field surface (the proto becomes a dict)."""
+from __future__ import annotations
+
+__all__ = ["DistributedStrategy"]
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1,
+            "mp_degree": 1,
+            "pp_degree": 1,
+            "sharding_degree": 1,
+            "sep_degree": 1,
+            "mp_configs": {},
+            "pp_configs": {},
+        }
+        self.amp = False
+        self.amp_configs = {
+            "init_loss_scaling": 65536.0,
+            "use_pure_fp16": False,
+            "use_bf16": True,
+        }
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": []}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        self.sharding = False
+        self.sharding_configs = {"stage": 1}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {"tensor_parallel_degree": 1}
+        self.lamb = False
+        self.lars = False
+        self.dgc = False
+        self.localsgd = False
+        self.find_unused_parameters = False
+        self.fuse_grad_size_in_MB = 32
+        self.last_comm_group_size_MB = 1
+        self.nccl_comm_num = 1  # kept for config compat; meaningless on ICI
+
+    def __setattr__(self, k, v):
+        object.__setattr__(self, k, v)
+
+    def __repr__(self):
+        fields = {k: v for k, v in self.__dict__.items()}
+        return f"DistributedStrategy({fields})"

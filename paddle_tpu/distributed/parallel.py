@@ -1,0 +1,80 @@
+"""DataParallel (parity: /root/reference/python/paddle/distributed/parallel.py:218
+paddle.DataParallel + C++ EagerReducer reducer.h:88).
+
+TPU-native: DDP's bucketed backward-hook all-reduce is what XLA emits
+automatically when the batch is sharded on 'dp' inside a compiled step — the
+wrapper shards inputs on the dp axis and leaves gradient sync to GSPMD
+(overlap/bucketing included: XLA's async collectives + latency-hiding
+scheduler do what EagerGroup buckets did). The user-visible hook surface
+(no_sync, find_unused_parameters) is preserved.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..nn.layer.layers import Layer
+from ..ops.dispatch import apply
+from ..tensor.tensor import Tensor
+from .topology import get_hybrid_communicate_group
+
+__all__ = ["DataParallel"]
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25, last_comm_buffer_size=1,
+                 find_unused_parameters=False, group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+        self._grad_sync_enabled = True
+
+    def forward(self, *inputs, **kwargs):
+        hcg = get_hybrid_communicate_group()
+        if hcg is not None and (hcg.axis_size("dp") > 1 or hcg.axis_size("sharding") > 1):
+            mesh = hcg.mesh
+            axes = tuple(a for a in ("dp", "sharding") if hcg.axis_size(a) > 1)
+            batch_axes = axes if len(axes) > 1 else axes[0]
+
+            def shard_batch(t):
+                if not isinstance(t, Tensor) or t.ndim == 0:
+                    return t
+                spec = PartitionSpec(batch_axes, *([None] * (t.ndim - 1)))
+                sharding = NamedSharding(mesh, spec)
+                if isinstance(t._value, jax.core.Tracer):
+                    return apply(lambda v: jax.lax.with_sharding_constraint(v, sharding), t,
+                                 op_name="dp_shard")
+                t._value = jax.device_put(t._value, sharding)
+                return t
+
+            inputs = tuple(shard_batch(t) for t in inputs)
+        return self._layers(*inputs, **kwargs)
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        """parity: DataParallel.no_sync — under SPMD the grad reduction happens
+        in the compiled step, so accumulating without sync is expressed by not
+        stepping the optimizer; this context is a semantic no-op kept for API
+        compatibility."""
+        self._grad_sync_enabled = False
+        try:
+            yield
+        finally:
+            self._grad_sync_enabled = True
+
+    def scale_loss(self, loss):
+        return loss
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)

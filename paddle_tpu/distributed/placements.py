@@ -1,0 +1,154 @@
+"""Placements + ProcessMesh (parity:
+/root/reference/paddle/phi/core/distributed/auto_parallel/placement_types.h —
+Shard/Replicate/Partial; process_mesh.h:34 ProcessMesh).
+
+TPU-native: ProcessMesh wraps a jax.sharding.Mesh; a placements list converts
+to a PartitionSpec (the GSPMD annotation XLA partitions by).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["Placement", "Shard", "Replicate", "Partial", "ProcessMesh", "placements_to_spec"]
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = int(dim)
+
+    def is_shard(self, dim=None):
+        return True if dim is None else dim == self.dim
+
+    def get_dim(self):
+        return self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("shard", self.dim))
+
+
+class Replicate(Placement):
+    def is_replicated(self):
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("replicate")
+
+
+class Partial(Placement):
+    """Pending-reduction placement. XLA materializes the reduction at the next
+    reshard/constraint — kept for API parity; eager reshard resolves it."""
+
+    def __init__(self, reduce_type: str = "sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and other.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("partial", self.reduce_type))
+
+
+class ProcessMesh:
+    """N-d mesh of processes with named axes (parity: process_mesh.h:34 and
+    python/paddle/distributed/auto_parallel/process_mesh.py)."""
+
+    def __init__(self, mesh, dim_names: Optional[Sequence[str]] = None, shape=None, process_ids=None):
+        if isinstance(mesh, Mesh):
+            self._jax_mesh = mesh
+            self._shape = list(mesh.devices.shape)
+            self._dim_names = list(mesh.axis_names)
+            return
+        arr = np.asarray(mesh)
+        self._shape = list(arr.shape)
+        self._dim_names = list(dim_names) if dim_names else [f"d{i}" for i in range(arr.ndim)]
+        devices = np.asarray(jax.devices())
+        flat_ids = arr.reshape(-1)
+        if len(flat_ids) > len(devices):
+            raise ValueError(
+                f"ProcessMesh wants {len(flat_ids)} devices but only {len(devices)} are visible "
+                "(use XLA_FLAGS=--xla_force_host_platform_device_count=N for virtual devices)"
+            )
+        dev_grid = devices[flat_ids].reshape(arr.shape)
+        self._jax_mesh = Mesh(dev_grid, tuple(self._dim_names))
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def dim_names(self):
+        return self._dim_names
+
+    @property
+    def process_ids(self):
+        return list(range(int(np.prod(self._shape))))
+
+    @property
+    def jax_mesh(self) -> Mesh:
+        return self._jax_mesh
+
+    def get_dim_size(self, name: str) -> int:
+        return self._shape[self._dim_names.index(name)]
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self._shape}, dim_names={self._dim_names})"
+
+    def __eq__(self, other):
+        return isinstance(other, ProcessMesh) and self._jax_mesh == other._jax_mesh
+
+    def __hash__(self):
+        return hash(self._jax_mesh)
+
+
+def placements_to_spec(placements: Sequence[Placement], mesh: ProcessMesh, ndim: int) -> PartitionSpec:
+    """[Shard(0), Replicate(), ...] indexed by MESH dim → PartitionSpec indexed
+    by TENSOR dim (the dtensor→GSPMD translation)."""
+    entries: List = [None] * ndim
+    for mesh_dim, p in enumerate(placements):
+        if isinstance(p, Shard):
+            axis_name = mesh.dim_names[mesh_dim]
+            if entries[p.dim] is None:
+                entries[p.dim] = axis_name
+            elif isinstance(entries[p.dim], tuple):
+                entries[p.dim] = entries[p.dim] + (axis_name,)
+            else:
+                entries[p.dim] = (entries[p.dim], axis_name)
+    return PartitionSpec(*entries)

@@ -1,0 +1,216 @@
+"""Hybrid-parallel topology (parity:
+/root/reference/python/paddle/distributed/fleet/base/topology.py:65
+CommunicateTopology + :178 HybridCommunicateGroup).
+
+The reference builds a 5-D cartesian rank topology [data, pipe, sharding, sep,
+model] and derives per-axis process groups. TPU-native: the SAME axis algebra
+produces a ``jax.sharding.Mesh`` with named axes — groups become mesh axes and
+collectives become XLA collectives over those axes. This is the single most
+direct "ancestor" mapping in the whole rebuild (SURVEY.md §2.2).
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from .placements import ProcessMesh
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup", "ParallelMode"]
+
+
+class ParallelMode:
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+class CommunicateTopology:
+    """Axis-order bookkeeping (reference axis order
+    ["data", "pipe", "sharding", "sep", "model"], topology.py:68)."""
+
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "sep", "model"),
+                 dims=(1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = list(itertools.product(*[range(d) for d in self._dims]))
+        self._world_size = int(np.prod(self._dims))
+        self._rank2coord = {self._coord_to_rank(c): c for c in self.coordinate}
+
+    def _coord_to_rank(self, coord) -> int:
+        rank = 0
+        for c, d in zip(coord, self._dims):
+            rank = rank * d + c
+        return rank
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name: str) -> int:
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self) -> int:
+        return self._world_size
+
+    def get_rank(self, **kwargs) -> int:
+        coord = tuple(kwargs[name] for name in self._parallel_names)
+        return self._coord_to_rank(coord)
+
+    def get_coord(self, rank: int):
+        return self._rank2coord[rank]
+
+    def get_axis_list(self, axis_name: str, index: int) -> List[int]:
+        axis = self._parallel_names.index(axis_name)
+        return sorted(self._coord_to_rank(c) for c in self.coordinate if c[axis] == index)
+
+    def get_comm_list(self, axis_name: str) -> List[List[int]]:
+        """All groups along axis_name: ranks varying on that axis only."""
+        axis = self._parallel_names.index(axis_name)
+        others = [i for i in range(len(self._dims)) if i != axis]
+        groups = []
+        for fixed in itertools.product(*[range(self._dims[i]) for i in others]):
+            group = []
+            for a in range(self._dims[axis]):
+                coord = [0] * len(self._dims)
+                for i, f in zip(others, fixed):
+                    coord[i] = f
+                coord[axis] = a
+                group.append(self._coord_to_rank(tuple(coord)))
+            groups.append(sorted(group))
+        return groups
+
+
+class HybridCommunicateGroup:
+    """parity: topology.py:178. Holds the named-axis mesh and exposes the
+    reference's per-axis rank/world-size query surface."""
+
+    # reference axis order; jax mesh axis names use the fleet short names
+    AXES = ("dp", "pp", "sharding", "sep", "mp")
+
+    def __init__(self, dp=1, mp=1, pp=1, sharding=1, sep=1, devices=None):
+        dims = dict(dp=dp, pp=pp, sharding=sharding, sep=sep, mp=mp)
+        self._dims = dims
+        n_needed = int(np.prod(list(dims.values())))
+        devs = np.asarray(devices if devices is not None else jax.devices())
+        if n_needed > devs.size:
+            raise ValueError(
+                f"topology {dims} needs {n_needed} devices, only {devs.size} visible"
+            )
+        grid = devs[:n_needed].reshape([dims[a] for a in self.AXES])
+        self._mesh = Mesh(grid, self.AXES)
+        self._topo = CommunicateTopology(("data", "pipe", "sharding", "sep", "model"),
+                                         [dims[a] for a in self.AXES])
+        self.global_rank = jax.process_index()
+
+    # ---- mesh access (TPU-native surface) ----
+    @property
+    def mesh(self) -> Mesh:
+        return self._mesh
+
+    @property
+    def process_mesh(self) -> ProcessMesh:
+        return ProcessMesh(self._mesh)
+
+    def axis_size(self, axis: str) -> int:
+        return self._dims[axis]
+
+    # ---- reference query surface ----
+    def get_parallel_mode(self):
+        if self._dims["mp"] == 1 and self._dims["pp"] == 1 and self._dims["sharding"] == 1:
+            return ParallelMode.DATA_PARALLEL
+        if self._dims["mp"] > 1:
+            return ParallelMode.TENSOR_PARALLEL
+        if self._dims["pp"] > 1:
+            return ParallelMode.PIPELINE_PARALLEL
+        return ParallelMode.SHARDING_PARALLEL
+
+    def topology(self):
+        return self._topo
+
+    def get_global_rank(self) -> int:
+        return self.global_rank
+
+    def _axis_rank(self, axis: str) -> int:
+        # single-controller SPMD: per-axis coordinate of this process is only
+        # meaningful multi-host; return 0 on a single process.
+        world = jax.process_count()
+        if world == 1:
+            return 0
+        coord = self._topo.get_coord(self.global_rank)
+        return coord[self.AXES.index(axis)]
+
+    def get_data_parallel_rank(self):
+        return self._axis_rank("dp")
+
+    def get_data_parallel_world_size(self):
+        return self._dims["dp"]
+
+    def get_model_parallel_rank(self):
+        return self._axis_rank("mp")
+
+    def get_model_parallel_world_size(self):
+        return self._dims["mp"]
+
+    def get_stage_id(self):
+        return self._axis_rank("pp")
+
+    def get_pipe_parallel_world_size(self):
+        return self._dims["pp"]
+
+    def get_sharding_parallel_rank(self):
+        return self._axis_rank("sharding")
+
+    def get_sharding_parallel_world_size(self):
+        return self._dims["sharding"]
+
+    def get_sep_parallel_rank(self):
+        return self._axis_rank("sep")
+
+    def get_sep_parallel_world_size(self):
+        return self._dims["sep"]
+
+    # group objects (Group facade over a mesh axis)
+    def get_data_parallel_group(self):
+        from .communication.group import Group
+
+        return Group.for_axis(self, "dp")
+
+    def get_model_parallel_group(self):
+        from .communication.group import Group
+
+        return Group.for_axis(self, "mp")
+
+    def get_pipe_parallel_group(self):
+        from .communication.group import Group
+
+        return Group.for_axis(self, "pp")
+
+    def get_sharding_parallel_group(self):
+        from .communication.group import Group
+
+        return Group.for_axis(self, "sharding")
+
+    def get_sep_parallel_group(self):
+        from .communication.group import Group
+
+        return Group.for_axis(self, "sep")
+
+
+_global_hcg: Optional[HybridCommunicateGroup] = None
+
+
+def set_hybrid_communicate_group(hcg: HybridCommunicateGroup):
+    global _global_hcg
+    _global_hcg = hcg
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _global_hcg

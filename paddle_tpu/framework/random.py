@@ -35,7 +35,17 @@ class Generator:
         return self
 
     def next_key(self):
-        """Return a fresh key; advances the stream."""
+        """Return a fresh key; advances the stream. Inside a to_static trace
+        the key comes from the trace context (a traced input), so compiled
+        functions re-randomize per call instead of baking one mask."""
+        try:
+            from ..jit import trace_state
+
+            ctx = trace_state.current()
+            if ctx is not None:
+                return ctx.next_key()
+        except ImportError:
+            pass
         self._counter += 1
         return jax.random.fold_in(self._key, self._counter)
 

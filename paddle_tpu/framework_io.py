@@ -1,0 +1,58 @@
+"""paddle.save / paddle.load (parity: python/paddle/framework/io.py:773,1020).
+
+Format: pickle of the nested object with Tensor leaves replaced by tagged
+numpy payloads — same capability (nested state_dicts, optimizer states,
+arbitrary picklable metadata) without the reference's custom protocol.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from .tensor.tensor import Tensor
+
+__all__ = ["save", "load"]
+
+_TAG = "__paddle_tpu_tensor__"
+
+
+def _pack(obj: Any) -> Any:
+    if isinstance(obj, Tensor):
+        return {_TAG: True, "data": np.asarray(obj._value), "stop_gradient": obj.stop_gradient,
+                "name": obj.name, "is_parameter": obj.is_parameter}
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj: Any, return_numpy: bool = False) -> Any:
+    if isinstance(obj, dict):
+        if obj.get(_TAG):
+            if return_numpy:
+                return obj["data"]
+            t = Tensor(obj["data"], stop_gradient=obj.get("stop_gradient", True), name=obj.get("name"))
+            t.is_parameter = obj.get("is_parameter", False)
+            return t
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False, **configs) -> Any:
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy)

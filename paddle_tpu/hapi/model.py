@@ -1,0 +1,205 @@
+"""High-level Model API (parity: /root/reference/python/paddle/hapi/model.py:1081
+paddle.Model.fit/evaluate/predict + callbacks + summary)."""
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from ..io import DataLoader
+from ..metric import Metric
+from ..tensor.tensor import Tensor
+
+__all__ = ["Model", "summary"]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._train_step = None
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is not None:
+            self._metrics = metrics if isinstance(metrics, (list, tuple)) else [metrics]
+        return self
+
+    # ------------------------------------------------------------ training
+    def _loss_fn(self, net, *batch):
+        *xs, y = batch
+        out = net(*xs)
+        return self._loss(out, y)
+
+    def train_batch(self, inputs, labels=None):
+        from .. import jit
+
+        if self._train_step is None:
+            self._train_step = jit.TrainStep(self.network, self._loss_fn, self._optimizer)
+        batch = list(inputs if isinstance(inputs, (list, tuple)) else [inputs])
+        if labels is not None:
+            batch += list(labels if isinstance(labels, (list, tuple)) else [labels])
+        loss = self._train_step(*batch)
+        return [float(loss.numpy())]
+
+    def eval_batch(self, inputs, labels=None):
+        was_training = self.network.training
+        self.network.eval()
+        xs = list(inputs if isinstance(inputs, (list, tuple)) else [inputs])
+        out = self.network(*xs)
+        loss = None
+        if self._loss is not None and labels is not None:
+            y = labels[0] if isinstance(labels, (list, tuple)) else labels
+            loss = float(self._loss(out, y).numpy())
+        if was_training:
+            self.network.train()
+        return loss, out
+
+    def predict_batch(self, inputs):
+        was_training = self.network.training
+        self.network.eval()
+        xs = list(inputs if isinstance(inputs, (list, tuple)) else [inputs])
+        out = self.network(*xs)
+        if was_training:
+            self.network.train()
+        return out
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1, eval_freq=1,
+            log_freq=10, save_dir=None, save_freq=1, verbose=2, drop_last=False,
+            shuffle=True, num_workers=0, callbacks=None, accumulate_grad_batches=1, num_iters=None):
+        loader = train_data if isinstance(train_data, DataLoader) else DataLoader(
+            train_data, batch_size=batch_size, shuffle=shuffle, drop_last=drop_last,
+            num_workers=num_workers,
+        )
+        history = {"loss": []}
+        it = 0
+        accum = max(int(accumulate_grad_batches), 1)
+        for epoch in range(epochs):
+            t0 = time.time()
+            epoch_losses = []
+            for bi, batch in enumerate(loader):
+                xs, y = batch[:-1], batch[-1]
+                if accum > 1:
+                    # gradient accumulation rides the eager path: backward each
+                    # micro-batch, step every `accum` batches
+                    xs_l = list(xs if isinstance(xs, (list, tuple)) else [xs])
+                    out = self.network(*xs_l)
+                    loss_t = self._loss(out, y) / accum
+                    loss_t.backward()
+                    loss = float(loss_t.numpy()) * accum
+                    if (bi + 1) % accum == 0:
+                        self._optimizer.step()
+                        self._optimizer.clear_grad()
+                else:
+                    loss = self.train_batch(xs, y)[0]
+                epoch_losses.append(loss)
+                it += 1
+                if verbose and log_freq and it % log_freq == 0:
+                    print(f"epoch {epoch} step {it}: loss {loss:.4f}")
+                if num_iters is not None and it >= num_iters:
+                    break
+            history["loss"].append(float(np.mean(epoch_losses)) if epoch_losses else None)
+            if verbose:
+                print(f"Epoch {epoch + 1}/{epochs}: loss {history['loss'][-1]:.4f} "
+                      f"({time.time() - t0:.1f}s)")
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size, verbose=verbose)
+            if save_dir is not None and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/epoch_{epoch}")
+            if num_iters is not None and it >= num_iters:
+                break
+        if self._train_step is not None:
+            self._train_step.sync_to_model()
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2, num_workers=0,
+                 callbacks=None, num_iters=None):
+        loader = eval_data if isinstance(eval_data, DataLoader) else DataLoader(
+            eval_data, batch_size=batch_size, num_workers=num_workers,
+        )
+        if self._train_step is not None:
+            self._train_step.sync_to_model()
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for i, batch in enumerate(loader):
+            xs, y = batch[:-1], batch[-1]
+            loss, out = self.eval_batch(xs, y)
+            if loss is not None:
+                losses.append(loss)
+            for m in self._metrics:
+                computed = m.compute(out, y)
+                if isinstance(computed, (list, tuple)):
+                    m.update(*computed)
+                else:
+                    m.update(computed)
+            if num_iters is not None and i + 1 >= num_iters:
+                break
+        result = {"loss": [float(np.mean(losses))] if losses else []}
+        for m in self._metrics:
+            result[m.name()] = m.accumulate()
+        if verbose:
+            print("Eval:", result)
+        return result
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False, verbose=1, callbacks=None):
+        loader = test_data if isinstance(test_data, DataLoader) else DataLoader(
+            test_data, batch_size=batch_size, num_workers=num_workers,
+        )
+        outputs = []
+        for batch in loader:
+            xs = batch[:-1] if isinstance(batch, (list, tuple)) and len(batch) > 1 else [batch[0] if isinstance(batch, (list, tuple)) else batch]
+            outputs.append(self.predict_batch(xs))
+        return outputs
+
+    # ------------------------------------------------------------ persistence
+    def save(self, path, training=True):
+        from .. import framework_io
+
+        if self._train_step is not None:
+            self._train_step.sync_to_model()
+        framework_io.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            framework_io.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from .. import framework_io
+
+        state = framework_io.load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        if not reset_optimizer and self._optimizer is not None:
+            try:
+                self._optimizer.set_state_dict(framework_io.load(path + ".pdopt"))
+            except FileNotFoundError:
+                pass
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        return summary(self.network, input_size, dtype)
+
+
+def summary(net, input_size=None, dtypes=None):
+    """parity: paddle.summary — parameter count table."""
+    rows = []
+    total = 0
+    trainable = 0
+    for name, p in net.named_parameters():
+        n = p.size
+        total += n
+        if not p.stop_gradient:
+            trainable += n
+        rows.append((name, tuple(p.shape), n))
+    width = max((len(r[0]) for r in rows), default=20) + 2
+    lines = ["-" * (width + 30), f"{'Layer (param)':<{width}}{'Shape':<18}{'Params':>10}", "-" * (width + 30)]
+    for name, shape, n in rows:
+        lines.append(f"{name:<{width}}{str(shape):<18}{n:>10}")
+    lines += ["-" * (width + 30), f"Total params: {total}", f"Trainable params: {trainable}"]
+    out = "\n".join(lines)
+    print(out)
+    return {"total_params": total, "trainable_params": trainable}

@@ -1,0 +1,30 @@
+"""Data loading (parity: python/paddle/io).
+
+Reference design: worker processes + shared-memory mmap handoff
+(/root/reference/python/paddle/io/dataloader/dataloader_iter.py:370,
+paddle/fluid/memory/allocation/mmap_allocator.h:45). TPU-native: the hot
+requirement is keeping the accelerator fed — a background prefetch pipeline
+(threads by default; numpy collation releases the GIL) with a bounded queue,
+then a single H2D device_put per batch. Static shapes are the contract
+(SURVEY.md §7.3): collation pads/stacks to fixed shapes.
+"""
+from .dataset import (  # noqa: F401
+    ChainDataset,
+    ComposeDataset,
+    ConcatDataset,
+    Dataset,
+    IterableDataset,
+    Subset,
+    TensorDataset,
+    random_split,
+)
+from .sampler import (  # noqa: F401
+    BatchSampler,
+    DistributedBatchSampler,
+    RandomSampler,
+    Sampler,
+    SequenceSampler,
+    SubsetRandomSampler,
+    WeightedRandomSampler,
+)
+from .reader import DataLoader, default_collate_fn  # noqa: F401

@@ -1,0 +1,177 @@
+"""DataLoader (parity: python/paddle/io/reader.py:266).
+
+Pipeline: index batches from the BatchSampler → worker pool fetches+collates
+numpy batches → bounded prefetch queue → main thread converts to device
+Tensors. Thread workers by default (numpy stacking releases the GIL); the
+reference's process+shm pipeline is the num_workers>0 analog and the planned
+native IO queue slots in behind the same interface.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..tensor.tensor import Tensor
+
+__all__ = ["DataLoader", "default_collate_fn"]
+
+
+def default_collate_fn(batch):
+    """Stack samples into batch arrays (parity: collate.py default_collate_fn)."""
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        import jax.numpy as jnp
+
+        return Tensor(jnp.stack([b._value for b in batch]))
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, dtype=np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, dtype=np.float32)
+    if isinstance(sample, (list, tuple)):
+        transposed = list(zip(*batch))
+        return [default_collate_fn(list(s)) for s in transposed]
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    return batch
+
+
+def _to_tensor(obj):
+    if isinstance(obj, Tensor):
+        return obj
+    if isinstance(obj, np.ndarray):
+        return Tensor(obj)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_tensor(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _to_tensor(v) for k, v in obj.items()}
+    return obj
+
+
+class _PrefetchIter:
+    _SENTINEL = object()
+
+    def __init__(self, loader):
+        self.loader = loader
+        ds = loader.dataset
+        self.batches = iter(loader.batch_sampler)
+        self.collate = loader.collate_fn or default_collate_fn
+        depth = max(2, loader.prefetch_factor)
+        self.q: queue.Queue = queue.Queue(maxsize=depth)
+        self.workers = []
+        self._idx_q: queue.Queue = queue.Queue()
+        self._stop = threading.Event()
+        n_workers = max(1, loader.num_workers)
+        self._out_buf = {}
+        self._next_out = 0
+        for indices in self.batches:
+            self._idx_q.put(indices)
+        self._total = self._idx_q.qsize()
+        self._emitted = 0
+        # order-preserving: tag batches with sequence numbers
+        self._tagged_q: queue.Queue = queue.Queue()
+        i = 0
+        while not self._idx_q.empty():
+            self._tagged_q.put((i, self._idx_q.get()))
+            i += 1
+        for _ in range(n_workers):
+            t = threading.Thread(target=self._worker, daemon=True)
+            t.start()
+            self.workers.append(t)
+
+    def _worker(self):
+        while not self._stop.is_set():
+            try:
+                seq, indices = self._tagged_q.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                samples = [self.loader.dataset[i] for i in indices]
+                batch = self.collate(samples)
+                self.q.put((seq, batch))
+            except Exception as e:  # propagate to main thread
+                self.q.put((seq, e))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._emitted >= self._total:
+            self._stop.set()
+            raise StopIteration
+        while self._next_out not in self._out_buf:
+            seq, item = self.q.get()
+            self._out_buf[seq] = item
+        item = self._out_buf.pop(self._next_out)
+        self._next_out += 1
+        self._emitted += 1
+        if isinstance(item, Exception):
+            self._stop.set()
+            raise item
+        return _to_tensor(item)
+
+
+class _IterableIter:
+    def __init__(self, loader):
+        self.loader = loader
+        self.it = iter(loader.dataset)
+        self.collate = loader.collate_fn or default_collate_fn
+        self.batch_size = loader.batch_size
+        self.drop_last = loader.drop_last
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        batch = []
+        try:
+            for _ in range(self.batch_size):
+                batch.append(next(self.it))
+        except StopIteration:
+            if not batch or self.drop_last:
+                raise
+        return _to_tensor(self.collate(batch))
+
+
+class DataLoader:
+    def __init__(
+        self, dataset, feed_list=None, places=None, return_list=True, batch_sampler=None,
+        batch_size=1, shuffle=False, drop_last=False, collate_fn=None, num_workers=0,
+        use_buffer_reader=True, prefetch_factor=2, use_shared_memory=True, timeout=0,
+        worker_init_fn=None, persistent_workers=False,
+    ):
+        from .dataset import IterableDataset
+
+        self.dataset = dataset
+        self.collate_fn = collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = prefetch_factor
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        self._iterable = isinstance(dataset, IterableDataset)
+        if not self._iterable:
+            if batch_sampler is not None:
+                self.batch_sampler = batch_sampler
+            else:
+                from .sampler import BatchSampler
+
+                self.batch_sampler = BatchSampler(
+                    dataset, shuffle=shuffle, batch_size=batch_size, drop_last=drop_last
+                )
+
+    def __iter__(self):
+        if self._iterable:
+            return _IterableIter(self)
+        return _PrefetchIter(self)
+
+    def __len__(self):
+        if self._iterable:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        return len(self.batch_sampler)
+
+    def __call__(self):
+        return self.__iter__()

@@ -1,0 +1,363 @@
+"""jit.to_static — trace-and-compile (parity: python/paddle/jit/api.py:197).
+
+Capability mapping (SURVEY.md §3.3): the reference needs a PEP-523 bytecode
+tracer (SOT) + PIR programs + an interpreter because Python is opaque to its
+compiler. Here Python IS the tracer: the eager op layer runs unchanged on jax
+tracers, so to_static = run the function under jax.jit with parameters,
+buffers, RNG key, and inputs as traced arguments. The SOT guard discipline
+(executor_cache.py guards) survives as the specialization cache key:
+(input treedef, shapes, dtypes, training flag, amp state).
+
+Backward: calling .backward() on outputs of a compiled forward executes a
+second jitted function that recomputes forward + backward in one XLA program
+(rematerialization — the TPU-favored memory/compute tradeoff). For peak
+training throughput use paddle_tpu.jit.TrainStep, which compiles loss + grads
++ optimizer update into a single donated-buffer step.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd import tape
+from ..framework.random import default_generator
+from ..tensor.tensor import Tensor
+from . import trace_state
+
+__all__ = ["to_static", "not_to_static", "StaticFunction", "ignore_module", "TrainStep", "InputSpec"]
+
+
+class InputSpec:
+    """paddle.static.InputSpec parity (shape with None for dynamic dims)."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+
+# ---------------------------------------------------------------- tree utils
+def flatten_tensors(obj) -> Tuple[List[Tensor], Any]:
+    """Flatten nested (list/tuple/dict) structure, extracting Tensor leaves."""
+    tensors: List[Tensor] = []
+
+    def rec(o):
+        if isinstance(o, Tensor):
+            tensors.append(o)
+            return ("__T__", len(tensors) - 1)
+        if isinstance(o, (list, tuple)):
+            return (type(o).__name__, [rec(x) for x in o])
+        if isinstance(o, dict):
+            return ("dict", {k: rec(v) for k, v in o.items()})
+        return ("leaf", o)
+
+    spec = rec(obj)
+    return tensors, spec
+
+
+def unflatten_tensors(spec, tensors: List):
+    kind, payload = spec
+    if kind == "__T__":
+        return tensors[payload]
+    if kind == "list":
+        return [unflatten_tensors(s, tensors) for s in payload]
+    if kind == "tuple":
+        return tuple(unflatten_tensors(s, tensors) for s in payload)
+    if kind == "dict":
+        return {k: unflatten_tensors(v, tensors) for k, v in payload.items()}
+    return payload
+
+
+def _spec_signature(spec) -> Any:
+    """Hashable structural signature of a flatten spec."""
+    kind, payload = spec
+    if kind == "__T__":
+        return ("T", payload)
+    if kind in ("list", "tuple"):
+        return (kind, tuple(_spec_signature(s) for s in payload))
+    if kind == "dict":
+        return ("dict", tuple(sorted((k, _spec_signature(v)) for k, v in payload.items())))
+    try:
+        hash(payload)
+        return ("leaf", payload)
+    except TypeError:
+        return ("leaf", repr(payload))
+
+
+class _SwapValues:
+    """Temporarily swap Tensor payloads for tracers during tracing."""
+
+    def __init__(self, tensors: List[Tensor], values):
+        self.tensors = tensors
+        self.values = values
+
+    def __enter__(self):
+        self.saved = [t._value for t in self.tensors]
+        for t, v in zip(self.tensors, self.values):
+            t._value = v
+        return self
+
+    def __exit__(self, *exc):
+        for t, v in zip(self.tensors, self.saved):
+            t._value = v
+        return False
+
+
+class StaticFunction:
+    def __init__(self, function: Callable, input_spec=None, build_strategy=None, backend=None,
+                 full_graph=True, donate_state=False):
+        from ..nn.layer.layers import Layer
+
+        self._layer: Optional[Layer] = None
+        if isinstance(function, Layer):
+            self._layer = function
+            self._fn = function.forward
+        elif hasattr(function, "__self__") and isinstance(getattr(function, "__self__", None), Layer):
+            self._layer = function.__self__
+            self._fn = function
+        else:
+            self._fn = function
+        self._input_spec = input_spec
+        self._cache: Dict[Any, Any] = {}
+        functools.update_wrapper(self, function if callable(function) else self._fn)
+
+    # paddle surface
+    @property
+    def concrete_program(self):
+        return None
+
+    def _state_tensors(self) -> List[Tensor]:
+        if self._layer is None:
+            return []
+        out = list(self._layer.parameters())
+        out += [b for b in self._layer.buffers() if b is not None]
+        return out
+
+    def _guards(self, arg_tensors, spec, training):
+        from ..amp.auto_cast import amp_state
+
+        st = amp_state()
+        return (
+            _spec_signature(spec),
+            tuple((tuple(t._value.shape), str(t._value.dtype), t.stop_gradient) for t in arg_tensors),
+            training,
+            (st.enabled, st.dtype, st.level),
+            tape.grad_enabled(),
+        )
+
+    def _build(self, spec, n_state, n_args, training):
+        fn = self._fn
+        state_tensors = self._state_tensors()
+        meta = {}
+
+        def functional(rng_key, flat_vals):
+            state_vals = flat_vals[:n_state]
+            arg_vals = flat_vals[n_state:]
+            ctx = trace_state.TraceContext(rng_key)
+            arg_tensors = [Tensor(v, stop_gradient=False) for v in arg_vals]
+            with trace_state.activate(ctx), _SwapValues(state_tensors, state_vals):
+                args, kwargs = unflatten_tensors(spec, arg_tensors)
+                with tape.no_grad():
+                    out = fn(*args, **kwargs)
+                out_tensors, out_spec = flatten_tensors(out)
+                meta["out_spec"] = out_spec
+                meta["updated_buffers"] = [b for b, _ in ctx.buffer_updates]
+                buf_vals = tuple(v for _, v in ctx.buffer_updates)
+                return tuple(t._value for t in out_tensors) + buf_vals
+
+        jit_fwd = jax.jit(functional)
+
+        def fwd_bwd(rng_key, flat_vals, cotangents):
+            outs, vjp_fn = jax.vjp(lambda fv: functional(rng_key, fv), list(flat_vals))
+            (grads,) = vjp_fn(cotangents)
+            return grads
+
+        jit_bwd = jax.jit(fwd_bwd)
+        return {"fwd": jit_fwd, "bwd": jit_bwd, "meta": meta}
+
+    def __call__(self, *args, **kwargs):
+        training = self._layer.training if self._layer is not None else True
+        arg_tensors, spec = flatten_tensors((args, kwargs))
+        state_tensors = self._state_tensors()
+        key = self._guards(arg_tensors, spec, training)
+        entry = self._cache.get(key)
+        n_state = len(state_tensors)
+        if entry is None:
+            entry = self._build(spec, n_state, len(arg_tensors), training)
+            self._cache[key] = entry
+        all_tensors = state_tensors + arg_tensors
+        flat_vals = tuple(t._value for t in all_tensors)
+        rng_key = default_generator().next_key()
+
+        raw_outs = entry["fwd"](rng_key, flat_vals)
+        meta = entry["meta"]
+        out_spec = meta["out_spec"]
+        updated_buffers = meta["updated_buffers"]
+        n_real = len(raw_outs) - len(updated_buffers)
+
+        # write back buffer updates (concrete device arrays)
+        for b, v in zip(updated_buffers, raw_outs[n_real:]):
+            b._value = v
+
+        needs_grad = tape.grad_enabled() and any(not t.stop_gradient for t in all_tensors)
+        out_vals = list(raw_outs[:n_real])
+        if needs_grad:
+            jit_bwd = entry["bwd"]
+            n_outs_total = len(raw_outs)
+            out_metas = [jax.ShapeDtypeStruct(jnp.shape(o), jnp.result_type(o)) for o in raw_outs]
+
+            def vjp_fn(cots):
+                cot_seq = list(cots) if isinstance(cots, tuple) else [cots]
+                # pad zero cotangents for the buffer-update outputs
+                cot_full = tuple(cot_seq) + tuple(
+                    jnp.zeros(m.shape, m.dtype) for m in out_metas[n_real:]
+                )
+                grads = jit_bwd(rng_key, flat_vals, cot_full)
+                return tuple(grads)
+
+            node = tape.GradNode(vjp_fn, all_tensors, out_vals, name="to_static")
+            out_tensors = []
+            for i, v in enumerate(out_vals):
+                t = Tensor(v, stop_gradient=False)
+                t._grad_node = node
+                t._out_index = i
+                out_tensors.append(t)
+        else:
+            out_tensors = [Tensor(v, stop_gradient=True) for v in out_vals]
+        return unflatten_tensors(out_spec, out_tensors)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None, **kwargs):
+    """Decorator/wrapper parity with paddle.jit.to_static."""
+
+    def decorate(fn):
+        return StaticFunction(fn, input_spec=input_spec, build_strategy=build_strategy, backend=backend)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn=None):
+    if fn is None:
+        return lambda f: f
+    return fn
+
+
+def ignore_module(modules):
+    return None
+
+
+class TrainStep:
+    """Whole-training-step compilation — the TPU-idiomatic hot path.
+
+    Compiles loss_fn(model(x), y) + grads + optimizer update into ONE XLA
+    program with donated parameter/optimizer buffers. The eager Optimizer's
+    hyperparameters are mapped onto an optax transform (optax is the
+    functional optimizer library of the jax ecosystem); state lives on-device
+    between steps. ``sync_to_model()`` writes params back into the Layer for
+    checkpointing/eval interop.
+    """
+
+    def __init__(self, model, loss_fn, optimizer, donate: bool = True):
+        import optax
+
+        from ..optimizer.optimizers import SGD, Adam, AdamW, Momentum
+
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self._params = list(model.parameters())
+        self._buffers = [b for b in model.buffers() if b is not None]
+        lr = optimizer.get_lr()
+        self._lr_is_sched = not isinstance(optimizer._learning_rate, (int, float))
+        if isinstance(optimizer, AdamW):
+            self._tx = optax.adamw(self._lr_fn, b1=optimizer._beta1, b2=optimizer._beta2,
+                                   eps=optimizer._epsilon, weight_decay=optimizer._wd)
+        elif isinstance(optimizer, Adam):
+            self._tx = optax.adam(self._lr_fn, b1=optimizer._beta1, b2=optimizer._beta2,
+                                  eps=optimizer._epsilon)
+        elif isinstance(optimizer, Momentum):
+            self._tx = optax.sgd(self._lr_fn, momentum=optimizer._momentum,
+                                 nesterov=optimizer._nesterov)
+        elif isinstance(optimizer, SGD):
+            self._tx = optax.sgd(self._lr_fn)
+        else:
+            raise NotImplementedError(f"TrainStep does not support {type(optimizer).__name__} yet")
+        grad_clip = optimizer._grad_clip
+        if grad_clip is not None:
+            from ..nn.clip import ClipGradByGlobalNorm, ClipGradByNorm
+
+            if isinstance(grad_clip, ClipGradByGlobalNorm):
+                self._tx = optax.chain(optax.clip_by_global_norm(grad_clip.clip_norm), self._tx)
+            elif isinstance(grad_clip, ClipGradByNorm):
+                self._tx = optax.chain(optax.clip(grad_clip.clip_norm), self._tx)
+        self._param_vals = [p._value for p in self._params]
+        self._opt_state = self._tx.init(self._param_vals)
+        self._step_i = jnp.zeros((), jnp.int32)
+        self._compiled = None
+        self._donate = donate
+
+    def _lr_fn(self, count):
+        opt = self.optimizer
+        if isinstance(opt._learning_rate, (int, float)):
+            return opt._learning_rate
+        # LRScheduler: evaluate python-side per step; traced as a jnp scalar input
+        return self._current_lr
+
+    def _build(self, batch_spec):
+        model = self.model
+        loss_fn = self.loss_fn
+        buffers = self._buffers
+        params = self._params
+        tx = self._tx
+
+        def step(param_vals, opt_state, buf_vals, rng_key, batch_vals, lr):
+            self._current_lr = lr  # read by _lr_fn during trace
+
+            def loss_of(pv):
+                ctx = trace_state.TraceContext(rng_key)
+                batch_tensors = [Tensor(v, stop_gradient=True) for v in batch_vals]
+                with trace_state.activate(ctx), _SwapValues(params, pv), _SwapValues(buffers, buf_vals):
+                    with tape.no_grad():
+                        args = unflatten_tensors(batch_spec, batch_tensors)
+                        loss = loss_fn(model, *args)
+                    new_bufs = {id(b): v for b, v in ctx.buffer_updates}
+                    buf_out = [new_bufs.get(id(b), bv) for b, bv in zip(buffers, buf_vals)]
+                return loss._value, buf_out
+
+            (loss_val, buf_out), grads = jax.value_and_grad(loss_of, has_aux=True)(list(param_vals))
+            updates, new_opt_state = tx.update(grads, opt_state, list(param_vals))
+            import optax
+
+            new_params = optax.apply_updates(list(param_vals), updates)
+            return loss_val, new_params, new_opt_state, buf_out
+
+        donate = (0, 1, 2) if self._donate else ()
+        return jax.jit(step, donate_argnums=donate)
+
+    def __call__(self, *batch):
+        batch_tensors, spec = flatten_tensors(batch)
+        if self._compiled is None:
+            self._spec = spec
+            self._compiled = self._build(spec)
+        batch_vals = tuple(t._value for t in batch_tensors)
+        rng_key = default_generator().next_key()
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        buf_vals = [b._value for b in self._buffers]
+        loss, self._param_vals, self._opt_state, buf_out = self._compiled(
+            self._param_vals, self._opt_state, buf_vals, rng_key, batch_vals, lr
+        )
+        for b, v in zip(self._buffers, buf_out):
+            b._value = v
+        self.optimizer._step_count += 1
+        return Tensor(loss)
+
+    def sync_to_model(self):
+        for p, v in zip(self._params, self._param_vals):
+            p._value = v
+        return self.model

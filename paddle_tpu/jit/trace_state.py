@@ -1,0 +1,56 @@
+"""Trace context for to_static.
+
+Solves the two eager↔trace impedance mismatches (SURVEY.md §7.3 "eager hooks
+inside compiled graphs"):
+- RNG: eager ops draw concrete threefry keys; inside a trace the key must be a
+  traced *input* or every compiled call replays the same randomness. The ctx
+  carries a traced base key; Generator.next_key folds a counter into it.
+- Mutable buffers (BN running stats): eager code writes buffer._value; inside
+  a trace that would leak tracers. Updates are registered here and returned as
+  extra outputs of the compiled function, then written back concretely.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional, Tuple
+
+import jax
+
+_tls = threading.local()
+
+
+class TraceContext:
+    def __init__(self, base_key):
+        self.base_key = base_key
+        self._key_counter = 0
+        self.buffer_updates: List[Tuple[Any, Any]] = []  # (buffer Tensor, traced new value)
+
+    def next_key(self):
+        self._key_counter += 1
+        return jax.random.fold_in(self.base_key, self._key_counter)
+
+    def register_buffer_update(self, buffer, new_value):
+        # replace any previous pending update for the same buffer
+        for i, (b, _) in enumerate(self.buffer_updates):
+            if b is buffer:
+                self.buffer_updates[i] = (buffer, new_value)
+                return
+        self.buffer_updates.append((buffer, new_value))
+
+
+def current() -> Optional[TraceContext]:
+    return getattr(_tls, "ctx", None)
+
+
+class activate:
+    def __init__(self, ctx: TraceContext):
+        self.ctx = ctx
+
+    def __enter__(self):
+        self.prev = getattr(_tls, "ctx", None)
+        _tls.ctx = self.ctx
+        return self.ctx
+
+    def __exit__(self, *exc):
+        _tls.ctx = self.prev
+        return False

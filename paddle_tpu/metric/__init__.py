@@ -1,0 +1,164 @@
+"""Metrics (parity: python/paddle/metric/metrics.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..tensor.tensor import Tensor
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):  # noqa: A002
+    """paddle.metric.accuracy functional parity."""
+    pred = np.asarray(input._value if isinstance(input, Tensor) else input)
+    lab = np.asarray(label._value if isinstance(label, Tensor) else label).reshape(-1)
+    topk = np.argsort(-pred, axis=-1)[..., :k]
+    hit = (topk == lab[:, None]).any(axis=-1)
+    import jax.numpy as jnp
+
+    return Tensor(jnp.asarray(hit.mean(dtype=np.float32)))
+
+
+class Metric:
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        return type(self).__name__.lower()
+
+    def compute(self, *args):
+        return args
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name=None):
+        self.topk = topk if isinstance(topk, (list, tuple)) else (topk,)
+        self._name = name or "acc"
+        self.reset()
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def compute(self, pred, label, *args):
+        pred_np = np.asarray(pred._value if isinstance(pred, Tensor) else pred)
+        lab = np.asarray(label._value if isinstance(label, Tensor) else label)
+        if lab.ndim > 1 and lab.shape[-1] == 1:
+            lab = lab.reshape(lab.shape[:-1])
+        maxk = max(self.topk)
+        order = np.argsort(-pred_np, axis=-1)[..., :maxk]
+        correct = order == lab[..., None]
+        return Tensor(correct.astype(np.float32))
+
+    def update(self, correct, *args):
+        c = np.asarray(correct._value if isinstance(correct, Tensor) else correct)
+        n = c.shape[0] if c.ndim else 1
+        for i, k in enumerate(self.topk):
+            self.total[i] += float(c[..., :k].sum())
+            self.count[i] += n
+        res = [t / max(cn, 1) for t, cn in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res
+
+    def accumulate(self):
+        res = [t / max(c, 1) for t, c in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        return self._name
+
+
+class Precision(Metric):
+    def __init__(self, name=None):
+        self._name = name or "precision"
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        p = np.asarray(preds._value if isinstance(preds, Tensor) else preds).reshape(-1)
+        l = np.asarray(labels._value if isinstance(labels, Tensor) else labels).reshape(-1)
+        pred_pos = (p > 0.5).astype(int)
+        self.tp += int(((pred_pos == 1) & (l == 1)).sum())
+        self.fp += int(((pred_pos == 1) & (l == 0)).sum())
+
+    def accumulate(self):
+        ap = self.tp + self.fp
+        return self.tp / ap if ap else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    def __init__(self, name=None):
+        self._name = name or "recall"
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        p = np.asarray(preds._value if isinstance(preds, Tensor) else preds).reshape(-1)
+        l = np.asarray(labels._value if isinstance(labels, Tensor) else labels).reshape(-1)
+        pred_pos = (p > 0.5).astype(int)
+        self.tp += int(((pred_pos == 1) & (l == 1)).sum())
+        self.fn += int(((pred_pos == 0) & (l == 1)).sum())
+
+    def accumulate(self):
+        ap = self.tp + self.fn
+        return self.tp / ap if ap else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    def __init__(self, curve="ROC", num_thresholds=4095, name=None):
+        self._name = name or "auc"
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1)
+        self._stat_neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, preds, labels):
+        p = np.asarray(preds._value if isinstance(preds, Tensor) else preds)
+        l = np.asarray(labels._value if isinstance(labels, Tensor) else labels).reshape(-1)
+        if p.ndim == 2:
+            p = p[:, 1]
+        else:
+            p = p.reshape(-1)
+        idx = np.minimum((p * self.num_thresholds).astype(int), self.num_thresholds)
+        for i, lab in zip(idx, l):
+            if lab:
+                self._stat_pos[i] += 1
+            else:
+                self._stat_neg[i] += 1
+
+    def accumulate(self):
+        tot_pos = self._stat_pos.sum()
+        tot_neg = self._stat_neg.sum()
+        if not tot_pos or not tot_neg:
+            return 0.0
+        # trapezoidal over thresholds (descending)
+        area = 0.0
+        pos = neg = 0.0
+        for i in range(self.num_thresholds, -1, -1):
+            new_pos = pos + self._stat_pos[i]
+            new_neg = neg + self._stat_neg[i]
+            area += (new_neg - neg) * (pos + new_pos) / 2
+            pos, neg = new_pos, new_neg
+        return area / (tot_pos * tot_neg)
+
+    def name(self):
+        return self._name

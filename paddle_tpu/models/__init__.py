@@ -1,0 +1,10 @@
+"""paddle_tpu.models — NLP model families (the PaddleNLP-capability surface
+BASELINE exercises; vision models live in paddle_tpu.vision.models)."""
+from .llama import (  # noqa: F401
+    LlamaConfig,
+    LlamaForCausalLM,
+    LlamaModel,
+    LlamaPretrainingCriterion,
+    llama_7b,
+    llama_tiny,
+)

@@ -1,0 +1,236 @@
+"""Llama model family — the flagship decoder LM.
+
+Capability target: PaddleNLP's Llama implementation exercised by BASELINE
+(Llama-7B pretrain tokens/sec/chip); the reference framework supplies its
+building blocks (fused rope/rms_norm/swiglu:
+/root/reference/python/paddle/incubate/nn/functional/, flash attention:
+python/paddle/nn/functional/flash_attention.py:198, TP layers:
+fleet/layers/mpu/mp_layers.py).
+
+TPU-first construction: bf16 params, Pallas flash attention, RMSNorm in fp32
+accumulation, rotary embeddings precomputed once, Column/RowParallel layers
+that lower to GSPMD shardings on the 'mp' axis, batch sharded on 'dp', and
+optional sequence-parallel activation sharding on 'sep'.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..distributed.fleet.mp_layers import ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding
+from ..nn import functional as F
+from ..ops.dispatch import apply
+from ..tensor import manipulation as M
+from ..tensor.tensor import Tensor
+
+__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM", "LlamaPretrainingCriterion", "llama_tiny", "llama_7b"]
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: Optional[int] = None
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    use_flash_attention: bool = True
+    dtype: str = "float32"
+
+    def __post_init__(self):
+        if self.num_key_value_heads is None:
+            self.num_key_value_heads = self.num_attention_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+def llama_tiny(**kw) -> "LlamaConfig":
+    return LlamaConfig(vocab_size=512, hidden_size=128, intermediate_size=352,
+                       num_hidden_layers=2, num_attention_heads=4,
+                       max_position_embeddings=256, **kw)
+
+
+def llama_7b(**kw) -> "LlamaConfig":
+    return LlamaConfig(**kw)
+
+
+def _rope_cache(config: LlamaConfig):
+    dim = config.head_dim
+    inv_freq = 1.0 / (config.rope_theta ** (np.arange(0, dim, 2, dtype=np.float64) / dim))
+    t = np.arange(config.max_position_embeddings, dtype=np.float64)
+    freqs = np.outer(t, inv_freq)  # [S, dim/2]
+    return np.cos(freqs).astype(np.float32), np.sin(freqs).astype(np.float32)
+
+
+def apply_rotary_pos_emb(q, k, cos, sin, position_offset: int = 0):
+    """q/k: [B, S, H, D]; cos/sin buffers [Smax, D/2] (reference fused analog:
+    incubate fused_rotary_position_embedding)."""
+
+    def rope(x, c, s):
+        S = x.shape[1]
+        c = c[position_offset : position_offset + S][None, :, None, :]  # [1,S,1,D/2]
+        s_ = s[position_offset : position_offset + S][None, :, None, :]
+        x1, x2 = jnp.split(x, 2, axis=-1)
+        return jnp.concatenate([x1 * c - x2 * s_, x2 * c + x1 * s_], axis=-1).astype(x.dtype)
+
+    def f(qv, kv, c, s):
+        return rope(qv, c, s), rope(kv, c, s)
+
+    return apply(lambda qv, kv, c, s: tuple(f(qv, kv, c, s)), q, k, cos, sin,
+                 op_name="fused_rope", n_outs=2)
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        h = config.hidden_size
+        self.head_dim = config.head_dim
+        self.num_heads = config.num_attention_heads
+        self.num_kv_heads = config.num_key_value_heads
+        self.q_proj = ColumnParallelLinear(h, self.num_heads * self.head_dim, has_bias=False, gather_output=False)
+        self.k_proj = ColumnParallelLinear(h, self.num_kv_heads * self.head_dim, has_bias=False, gather_output=False)
+        self.v_proj = ColumnParallelLinear(h, self.num_kv_heads * self.head_dim, has_bias=False, gather_output=False)
+        self.o_proj = RowParallelLinear(self.num_heads * self.head_dim, h, has_bias=False, input_is_parallel=True)
+
+    def forward(self, hidden, cos, sin, attn_mask=None, cache=None):
+        b, s = hidden.shape[0], hidden.shape[1]
+        q = M.reshape(self.q_proj(hidden), [b, s, self.num_heads, self.head_dim])
+        k = M.reshape(self.k_proj(hidden), [b, s, self.num_kv_heads, self.head_dim])
+        v = M.reshape(self.v_proj(hidden), [b, s, self.num_kv_heads, self.head_dim])
+        offset = 0
+        if cache is not None:
+            offset = cache[0].shape[1]
+        q, k = apply_rotary_pos_emb(q, k, cos, sin, position_offset=offset)
+        new_cache = None
+        if cache is not None:
+            k = M.concat([cache[0], k], axis=1)
+            v = M.concat([cache[1], v], axis=1)
+            new_cache = (k, v)
+        if attn_mask is None and cache is None:
+            out, _ = F.flash_attention(q, k, v, causal=True)
+        else:
+            out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                                 is_causal=attn_mask is None)
+        out = M.reshape(out, [b, s, self.num_heads * self.head_dim])
+        out = self.o_proj(out)
+        if cache is not None:
+            return out, new_cache
+        return out
+
+
+class LlamaMLP(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        h, m = config.hidden_size, config.intermediate_size
+        self.gate_proj = ColumnParallelLinear(h, m, has_bias=False, gather_output=False)
+        self.up_proj = ColumnParallelLinear(h, m, has_bias=False, gather_output=False)
+        self.down_proj = RowParallelLinear(m, h, has_bias=False, input_is_parallel=True)
+
+    def forward(self, x):
+        # swiglu (reference fused analog: incubate/nn/functional/swiglu.py)
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.self_attn = LlamaAttention(config)
+        self.mlp = LlamaMLP(config)
+        self.input_layernorm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        self.post_attention_layernorm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+
+    def forward(self, hidden, cos, sin, attn_mask=None, cache=None):
+        residual = hidden
+        attn_out = self.self_attn(self.input_layernorm(hidden), cos, sin, attn_mask, cache)
+        if cache is not None:
+            attn_out, new_cache = attn_out
+        hidden = residual + attn_out
+        hidden = hidden + self.mlp(self.post_attention_layernorm(hidden))
+        if cache is not None:
+            return hidden, new_cache
+        return hidden
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = VocabParallelEmbedding(config.vocab_size, config.hidden_size)
+        self.layers = nn.LayerList([LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)])
+        self.norm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        cos, sin = _rope_cache(config)
+        self.register_buffer("rope_cos", Tensor(cos), persistable=False)
+        self.register_buffer("rope_sin", Tensor(sin), persistable=False)
+
+    def forward(self, input_ids, attn_mask=None, caches=None):
+        hidden = self.embed_tokens(input_ids)
+        if self.config.dtype == "bfloat16":
+            hidden = hidden.astype("bfloat16")
+        cos, sin = self._buffers["rope_cos"], self._buffers["rope_sin"]
+        new_caches = []
+        for i, layer in enumerate(self.layers):
+            if caches is not None:
+                hidden, c = layer(hidden, cos, sin, attn_mask, caches[i])
+                new_caches.append(c)
+            else:
+                hidden = layer(hidden, cos, sin, attn_mask)
+        hidden = self.norm(hidden)
+        if caches is not None:
+            return hidden, new_caches
+        return hidden
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.llama = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = ColumnParallelLinear(config.hidden_size, config.vocab_size,
+                                                has_bias=False, gather_output=True)
+
+    def forward(self, input_ids, attn_mask=None, caches=None):
+        out = self.llama(input_ids, attn_mask, caches)
+        hidden = out[0] if caches is not None else out
+        if self.lm_head is None:
+            logits = F.linear(hidden, Tensor(self.llama.embed_tokens.weight._value.T,
+                                             stop_gradient=self.llama.embed_tokens.weight.stop_gradient))
+        else:
+            logits = self.lm_head(hidden)
+        if caches is not None:
+            return logits, out[1]
+        return logits
+
+    @property
+    def num_params(self) -> int:
+        return sum(p.size for p in self.parameters())
+
+
+class LlamaPretrainingCriterion(nn.Layer):
+    """Shifted next-token CE (PaddleNLP criterion parity)."""
+
+    def __init__(self, config: Optional[LlamaConfig] = None):
+        super().__init__()
+
+    def forward(self, logits, labels):
+        shift_logits = logits[:, :-1, :]
+        shift_labels = labels[:, 1:]
+        return F.cross_entropy(
+            M.reshape(shift_logits, [-1, shift_logits.shape[-1]]),
+            M.reshape(shift_labels, [-1]),
+        )

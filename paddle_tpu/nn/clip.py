@@ -1,0 +1,104 @@
+"""Gradient clipping (parity: python/paddle/nn/clip.py).
+
+ClipGradByGlobalNorm keeps the reference's contract: one global norm across
+the whole grad set. The hybrid-parallel variant (norm across sharded params,
+hybrid_parallel_optimizer.py:255) is implemented by passing a reduce function
+(e.g. a psum over the sharding axis) via ``global_norm_reduce``.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm", "clip_grad_norm_"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads: List[Tuple[Tensor, Tensor]]):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):  # noqa: A002
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(jnp.clip(g._value, self.min, self.max), stop_gradient=True)))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            v = g._value
+            n = jnp.sqrt(jnp.sum(v.astype(jnp.float32) ** 2))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(n, 1e-12), 1.0)
+            out.append((p, Tensor((v * scale).astype(v.dtype), stop_gradient=True)))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group", auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+        # optional cross-shard reduction hook (hybrid parallel): fn(sq_sum)->sq_sum
+        self.global_norm_reduce = None
+
+    def __call__(self, params_grads):
+        sq = None
+        for p, g in params_grads:
+            if g is None or not getattr(p, "trainable", True):
+                continue
+            v = g._value.astype(jnp.float32)
+            s = jnp.sum(v * v)
+            sq = s if sq is None else sq + s
+        if sq is None:
+            return params_grads
+        if self.global_norm_reduce is not None:
+            sq = self.global_norm_reduce(sq)
+        gn = jnp.sqrt(sq)
+        scale = self.clip_norm / jnp.maximum(gn, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+            else:
+                out.append((p, Tensor((g._value * scale).astype(g._value.dtype), stop_gradient=True)))
+        return out
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g._value)) for g in grads]))
+    else:
+        total = jnp.power(
+            sum(jnp.sum(jnp.power(jnp.abs(g._value.astype(jnp.float32)), norm_type)) for g in grads),
+            1.0 / norm_type,
+        )
+    scale = max_norm / jnp.maximum(total, 1e-6)
+    scale = jnp.minimum(scale, 1.0)
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._value = (p.grad._value * scale).astype(p.grad._value.dtype)
+    return Tensor(total)

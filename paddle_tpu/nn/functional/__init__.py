@@ -1,0 +1,15 @@
+"""paddle_tpu.nn.functional (parity: python/paddle/nn/functional)."""
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .flash_attention import (  # noqa: F401
+    flash_attention,
+    scaled_dot_product_attention,
+    sdp_kernel,
+)
+from .loss import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+
+# paddle exposes some tensor fns through nn.functional too
+from ...tensor.manipulation import pad_sequences  # noqa: F401

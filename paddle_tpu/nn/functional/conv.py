@@ -1,0 +1,157 @@
+"""Convolutions (parity: python/paddle/nn/functional/conv.py).
+
+TPU-native: all convs lower to ``lax.conv_general_dilated`` — XLA tiles them
+onto the MXU (the reference needs cudnn + layout autotune for this,
+/root/reference/paddle/phi/kernels/gpudnn/conv_kernel.cu analog).
+"""
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax.numpy as jnp
+from jax import lax
+
+from ...ops.dispatch import apply
+from ...tensor._helpers import to_tensor_like
+from ...tensor.tensor import Tensor
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose", "conv3d_transpose"]
+
+
+def _tuplize(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(x) for x in v)
+        return tuple(int(v[0]) for _ in range(n))
+    return tuple(int(v) for _ in range(n))
+
+
+def _padding(padding, n, strides=None):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, (list, tuple)):
+        flat = list(padding)
+        if len(flat) == n:
+            return [(int(p), int(p)) for p in flat]
+        if len(flat) == 2 * n:
+            return [(int(flat[2 * i]), int(flat[2 * i + 1])) for i in range(n)]
+        # NCHW-style 4-pair form [[0,0],[0,0],[ph,ph],[pw,pw]]
+        if len(flat) == n + 2 and isinstance(flat[0], (list, tuple)):
+            return [tuple(int(q) for q in p) for p in flat[2:]]
+    p = int(padding)
+    return [(p, p)] * n
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n_spatial, data_format):
+    x, weight = to_tensor_like(x), to_tensor_like(weight)
+    strides = _tuplize(stride, n_spatial)
+    dil = _tuplize(dilation, n_spatial)
+    pad = _padding(padding, n_spatial)
+    channels_first = data_format.startswith("NC")
+    if n_spatial == 1:
+        io_spec = "NCH" if channels_first else "NHC"
+        k_spec = "OIH"
+    elif n_spatial == 2:
+        io_spec = "NCHW" if channels_first else "NHWC"
+        k_spec = "OIHW"
+    else:
+        io_spec = "NCDHW" if channels_first else "NDHWC"
+        k_spec = "OIDHW"
+
+    def f(v, w, *rest):
+        dn = lax.conv_dimension_numbers(v.shape, w.shape, (io_spec, k_spec, io_spec))
+        out = lax.conv_general_dilated(
+            v, w, window_strides=strides, padding=pad, rhs_dilation=dil,
+            dimension_numbers=dn, feature_group_count=groups,
+        )
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[1 if channels_first else -1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    if bias is not None:
+        return apply(f, x, weight, to_tensor_like(bias), op_name=f"conv{n_spatial}d")
+    return apply(f, x, weight, op_name=f"conv{n_spatial}d")
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, "NC" if data_format == "NCL" else "NLC")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, n_spatial, data_format):
+    x, weight = to_tensor_like(x), to_tensor_like(weight)
+    strides = _tuplize(stride, n_spatial)
+    dil = _tuplize(dilation, n_spatial)
+    pad = _padding(padding, n_spatial)
+    out_pad = _tuplize(output_padding, n_spatial)
+    channels_first = data_format.startswith("NC")
+    if n_spatial == 1:
+        io_spec = "NCH" if channels_first else "NHC"
+    elif n_spatial == 2:
+        io_spec = "NCHW" if channels_first else "NHWC"
+    else:
+        io_spec = "NCDHW" if channels_first else "NDHWC"
+    # paddle transpose-conv weight layout: [in, out/groups, *k]
+    k_spec = {1: "IOH", 2: "IOHW", 3: "IODHW"}[n_spatial]
+
+    def f(v, w, *rest):
+        if isinstance(pad, str):
+            padding_cfg = pad
+        else:
+            # grad-style transpose conv: effective padding = k-1-p (with dilation)
+            padding_cfg = []
+            for i, (lo, hi) in enumerate(pad):
+                k = w.shape[2 + i]
+                eff = dil[i] * (k - 1)
+                padding_cfg.append((eff - lo, eff - hi + out_pad[i]))
+        dn = lax.conv_dimension_numbers(v.shape, (w.shape[0], w.shape[1], *w.shape[2:]), (io_spec, k_spec, io_spec))
+        if groups > 1:
+            # split groups manually (lax transpose conv w/ groups)
+            vs = jnp.split(v, groups, axis=1 if channels_first else -1)
+            ws = jnp.split(w, groups, axis=0)
+            outs = [
+                lax.conv_general_dilated(
+                    vv, ww, window_strides=(1,) * n_spatial, padding=padding_cfg,
+                    lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn,
+                    transpose_kernel=True,
+                )
+                for vv, ww in zip(vs, ws)
+            ]
+            out = jnp.concatenate(outs, axis=1 if channels_first else -1)
+        else:
+            out = lax.conv_general_dilated(
+                v, w, window_strides=(1,) * n_spatial, padding=padding_cfg, lhs_dilation=strides,
+                rhs_dilation=dil, dimension_numbers=dn, transpose_kernel=True,
+            )
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[1 if channels_first else -1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    if bias is not None:
+        return apply(f, x, weight, to_tensor_like(bias), op_name=f"conv{n_spatial}d_transpose")
+    return apply(f, x, weight, op_name=f"conv{n_spatial}d_transpose")
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, 1, "NCH" if data_format == "NCL" else "NHC")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, 2, data_format)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, 3, data_format)

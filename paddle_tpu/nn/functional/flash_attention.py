@@ -1,0 +1,131 @@
+"""Attention functionals.
+
+Parity: python/paddle/nn/functional/flash_attention.py:198 (flash_attention),
+:602 (scaled_dot_product_attention); kernels paddle/phi/kernels/flash_attn_kernel.h.
+
+TPU-native: the public API dispatches to a Pallas flash-attention kernel on
+TPU (paddle_tpu.ops.pallas.flash_attention) and to a fused jnp reference
+elsewhere (CPU tests, interpret mode). Layout is paddle's [batch, seqlen,
+num_heads, head_dim].
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.dispatch import apply
+from ...tensor._helpers import to_tensor_like
+
+__all__ = ["flash_attention", "scaled_dot_product_attention", "flash_attn_unpadded", "sdp_kernel"]
+
+
+def _ref_attention(q, k, v, *, causal: bool, scale, mask=None, dropout: float = 0.0,
+                   dropout_key=None):
+    """Reference attention on [B, S, H, D] layout; fp32 softmax accumulator."""
+    B, Sq, H, D = q.shape
+    sc = scale if scale is not None else 1.0 / math.sqrt(D)
+    qh = jnp.moveaxis(q, 2, 1)  # [B,H,S,D]
+    kh = jnp.moveaxis(k, 2, 1)
+    vh = jnp.moveaxis(v, 2, 1)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh).astype(jnp.float32) * sc
+    if causal:
+        Sk = kh.shape[2]
+        cm = jnp.tril(jnp.ones((Sq, Sk), bool), k=Sk - Sq)
+        logits = jnp.where(cm, logits, -1e30)
+    if mask is not None:
+        logits = logits + mask.astype(jnp.float32)
+    p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if dropout > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout), jnp.zeros((), p.dtype)).astype(p.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
+    return jnp.moveaxis(out, 1, 2)  # back to [B,S,H,D]
+
+
+def _use_pallas(q_val) -> bool:
+    try:
+        plat = q_val.devices() if hasattr(q_val, "devices") else None
+        if plat:
+            return any(d.platform in ("tpu", "axon") for d in plat)
+    except Exception:
+        pass
+    return jax.default_backend() in ("tpu", "axon")
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False, *, fixed_seed_offset=None, rng_name="", training=True, name=None):
+    """Flash attention on [B, S, H, D]. Returns (out, softmax) like paddle
+    (softmax is None unless return_softmax, which the TPU kernel does not
+    materialize — documented divergence)."""
+    query, key, value = to_tensor_like(query), to_tensor_like(key), to_tensor_like(value)
+    drop = float(dropout) if training else 0.0
+    drop_key = None
+    if drop > 0.0:
+        from ...framework.random import default_generator
+
+        drop_key = default_generator().next_key()
+
+    def f(q, k, v):
+        if _use_pallas(q) and drop == 0.0:
+            from ...ops.pallas.flash_attention import flash_attention_fwd
+
+            return flash_attention_fwd(q, k, v, causal=causal)
+        return _ref_attention(q, k, v, causal=causal, scale=None, dropout=drop,
+                              dropout_key=drop_key)
+
+    out = apply(f, query, key, value, op_name="flash_attention")
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+def flash_attn_unpadded(*args, **kwargs):
+    raise NotImplementedError(
+        "varlen flash attention is replaced by static-shape + segment masks on TPU; "
+        "use flash_attention with an attention mask."
+    )
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False, training=True, name=None):
+    """paddle SDPA parity ([B,S,H,D] layout)."""
+    query, key, value = to_tensor_like(query), to_tensor_like(key), to_tensor_like(value)
+    drop = float(dropout_p) if training else 0.0
+    drop_key = None
+    if drop > 0.0:
+        from ...framework.random import default_generator
+
+        drop_key = default_generator().next_key()
+
+    if attn_mask is not None:
+        attn_mask = to_tensor_like(attn_mask)
+
+        def f(q, k, v, m):
+            return _ref_attention(q, k, v, causal=is_causal, scale=None, mask=m,
+                                  dropout=drop, dropout_key=drop_key)
+
+        return apply(f, query, key, value, attn_mask, op_name="sdpa")
+
+    def g(q, k, v):
+        if _use_pallas(q) and drop == 0.0:
+            from ...ops.pallas.flash_attention import flash_attention_fwd
+
+            return flash_attention_fwd(q, k, v, causal=is_causal)
+        return _ref_attention(q, k, v, causal=is_causal, scale=None, dropout=drop,
+                              dropout_key=drop_key)
+
+    return apply(g, query, key, value, op_name="sdpa")
+
+
+class sdp_kernel:
+    """Context manager stub for kernel selection (cuda-flash/mem-efficient/math
+    in the reference); TPU has one fused path so this is a no-op switch."""
+
+    def __init__(self, enable_flash=True, enable_math=True, enable_mem_efficient=True):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

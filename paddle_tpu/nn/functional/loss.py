@@ -1,0 +1,372 @@
+"""Loss functionals (parity: python/paddle/nn/functional/loss.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.dispatch import apply
+from ...tensor._helpers import to_tensor_like
+from ...tensor.tensor import Tensor
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "mse_loss", "l1_loss", "nll_loss",
+    "binary_cross_entropy", "binary_cross_entropy_with_logits", "smooth_l1_loss",
+    "kl_div", "margin_ranking_loss", "cosine_embedding_loss", "hinge_embedding_loss",
+    "log_loss", "square_error_cost", "sigmoid_focal_loss", "triplet_margin_loss",
+    "ctc_loss", "poisson_nll_loss", "multi_label_soft_margin_loss", "soft_margin_loss",
+]
+
+
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+def cross_entropy(
+    input, label, weight=None, ignore_index=-100, reduction="mean",  # noqa: A002
+    soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0, name=None,
+):
+    """paddle.nn.functional.cross_entropy parity: int or soft labels, class
+    weights, ignore_index, label smoothing, optional pre-softmaxed input."""
+    input, label = to_tensor_like(input), to_tensor_like(label)  # noqa: A001
+
+    w_t = to_tensor_like(weight) if weight is not None else None
+
+    def f(logits, lab, *rest):
+        w = rest[0] if rest else None
+        nc = logits.shape[axis]
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=axis)
+        else:
+            logp = jnp.log(jnp.clip(logits, 1e-12, None))
+        if soft_label or (lab.ndim == logits.ndim and lab.shape == logits.shape and jnp.issubdtype(lab.dtype, jnp.floating)):
+            soft = lab
+            if label_smoothing > 0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / nc
+            per = -jnp.sum(soft * logp, axis=axis)
+            if w is not None:
+                per = per * jnp.sum(soft * w.reshape((1,) * (logp.ndim - 1) + (-1,)), axis=axis)
+            return _reduce(per, reduction)
+        # hard labels
+        lab_i = lab.astype(jnp.int32)
+        if lab_i.ndim == logp.ndim:  # trailing 1 dim (paddle allows [N,1])
+            lab_i = jnp.squeeze(lab_i, axis=axis)
+        valid = lab_i != ignore_index
+        safe_lab = jnp.where(valid, lab_i, 0)
+        if label_smoothing > 0:
+            onehot = jax.nn.one_hot(safe_lab, nc, axis=axis, dtype=logp.dtype)
+            soft = onehot * (1 - label_smoothing) + label_smoothing / nc
+            per = -jnp.sum(soft * logp, axis=axis)
+        else:
+            per = -jnp.take_along_axis(logp, jnp.expand_dims(safe_lab, axis), axis=axis).squeeze(axis)
+        per = jnp.where(valid, per, 0.0)
+        if w is not None:
+            wc = w[safe_lab]
+            wc = jnp.where(valid, wc, 0.0)
+            per = per * wc
+            if reduction == "mean":
+                return jnp.sum(per) / jnp.maximum(jnp.sum(wc), 1e-12)
+        if reduction == "mean":
+            return jnp.sum(per) / jnp.maximum(jnp.sum(valid.astype(per.dtype)), 1.0)
+        return _reduce(per, reduction)
+
+    args = [input, label]
+    if w_t is not None:
+        args.append(w_t)
+    return apply(f, *args, op_name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100, numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label, ignore_index=ignore_index, reduction="none", axis=axis)
+    # paddle returns loss with kept dim
+    from ...tensor.manipulation import unsqueeze
+
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        from .activation import softmax as _softmax
+
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def mse_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    input, label = to_tensor_like(input), to_tensor_like(label)  # noqa: A001
+    return apply(lambda a, b: _reduce((a - b) ** 2, reduction), input, label, op_name="mse_loss")
+
+
+def square_error_cost(input, label):  # noqa: A002
+    return mse_loss(input, label, reduction="none")
+
+
+def l1_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    input, label = to_tensor_like(input), to_tensor_like(label)  # noqa: A001
+    return apply(lambda a, b: _reduce(jnp.abs(a - b), reduction), input, label, op_name="l1_loss")
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):  # noqa: A002
+    input, label = to_tensor_like(input), to_tensor_like(label)  # noqa: A001
+
+    def f(logp, lab, *rest):
+        lab_i = lab.astype(jnp.int32)
+        valid = lab_i != ignore_index
+        safe = jnp.where(valid, lab_i, 0)
+        per = -jnp.take_along_axis(logp, jnp.expand_dims(safe, 1), axis=1).squeeze(1)
+        per = jnp.where(valid, per, 0.0)
+        if rest:
+            wc = rest[0][safe]
+            wc = jnp.where(valid, wc, 0.0)
+            per = per * wc
+            if reduction == "mean":
+                return jnp.sum(per) / jnp.maximum(jnp.sum(wc), 1e-12)
+        if reduction == "mean":
+            return jnp.sum(per) / jnp.maximum(jnp.sum(valid.astype(per.dtype)), 1.0)
+        return _reduce(per, reduction)
+
+    args = [input, label]
+    if weight is not None:
+        args.append(to_tensor_like(weight))
+    return apply(f, *args, op_name="nll_loss")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):  # noqa: A002
+    input, label = to_tensor_like(input), to_tensor_like(label)  # noqa: A001
+
+    def f(p, y, *rest):
+        p = jnp.clip(p, 1e-12, 1 - 1e-12)
+        per = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if rest:
+            per = per * rest[0]
+        return _reduce(per, reduction)
+
+    args = [input, label]
+    if weight is not None:
+        args.append(to_tensor_like(weight))
+    return apply(f, *args, op_name="binary_cross_entropy")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean", pos_weight=None, name=None):
+    logit, label = to_tensor_like(logit), to_tensor_like(label)
+
+    pw = to_tensor_like(pos_weight) if pos_weight is not None else None
+    w = to_tensor_like(weight) if weight is not None else None
+
+    def f(z, y, *rest):
+        idx = 0
+        pwv = None
+        wv = None
+        if pw is not None:
+            pwv = rest[idx]
+            idx += 1
+        if w is not None:
+            wv = rest[idx]
+        # stable: max(z,0) - z*y + log(1+exp(-|z|)), pos_weight scales the y term
+        if pwv is None:
+            per = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        else:
+            log_sig = jax.nn.log_sigmoid(z)
+            log_sig_neg = jax.nn.log_sigmoid(-z)
+            per = -(pwv * y * log_sig + (1 - y) * log_sig_neg)
+        if wv is not None:
+            per = per * wv
+        return _reduce(per, reduction)
+
+    args = [logit, label]
+    if pw is not None:
+        args.append(pw)
+    if w is not None:
+        args.append(w)
+    return apply(f, *args, op_name="bce_with_logits")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):  # noqa: A002
+    input, label = to_tensor_like(input), to_tensor_like(label)  # noqa: A001
+
+    def f(a, b):
+        d = a - b
+        ad = jnp.abs(d)
+        per = jnp.where(ad < delta, 0.5 * d * d / delta, ad - 0.5 * delta)
+        return _reduce(per, reduction)
+
+    return apply(f, input, label, op_name="smooth_l1_loss")
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):  # noqa: A002
+    input, label = to_tensor_like(input), to_tensor_like(label)  # noqa: A001
+
+    def f(logp, t):
+        if log_target:
+            per = jnp.exp(t) * (t - logp)
+        else:
+            per = t * (jnp.log(jnp.clip(t, 1e-12, None)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(per) / logp.shape[0]
+        return _reduce(per, reduction)
+
+    return apply(f, input, label, op_name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):  # noqa: A002
+    input, other, label = to_tensor_like(input), to_tensor_like(other), to_tensor_like(label)  # noqa: A001
+    return apply(
+        lambda a, b, y: _reduce(jnp.maximum(0.0, -y * (a - b) + margin), reduction),
+        input, other, label, op_name="margin_ranking_loss",
+    )
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    input1, input2, label = to_tensor_like(input1), to_tensor_like(input2), to_tensor_like(label)
+
+    def f(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / (
+            jnp.sqrt(jnp.sum(a * a, axis=-1)) * jnp.sqrt(jnp.sum(b * b, axis=-1)) + 1e-12
+        )
+        per = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(per, reduction)
+
+    return apply(f, input1, input2, label, op_name="cosine_embedding_loss")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):  # noqa: A002
+    input, label = to_tensor_like(input), to_tensor_like(label)  # noqa: A001
+    return apply(
+        lambda x, y: _reduce(jnp.where(y == 1, x, jnp.maximum(0.0, margin - x)), reduction),
+        input, label, op_name="hinge_embedding_loss",
+    )
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):  # noqa: A002
+    input, label = to_tensor_like(input), to_tensor_like(label)  # noqa: A001
+    return apply(
+        lambda p, y: -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon),
+        input, label, op_name="log_loss",
+    )
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0, reduction="sum", name=None):
+    logit, label = to_tensor_like(logit), to_tensor_like(label)
+
+    def f(z, y, *rest):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        per = a_t * jnp.power(1 - p_t, gamma) * ce
+        if rest:
+            per = per / rest[0]
+        return _reduce(per, reduction)
+
+    args = [logit, label]
+    if normalizer is not None:
+        args.append(to_tensor_like(normalizer))
+    return apply(f, *args, op_name="sigmoid_focal_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6, swap=False, reduction="mean", name=None):  # noqa: A002
+    input, positive, negative = to_tensor_like(input), to_tensor_like(positive), to_tensor_like(negative)  # noqa: A001
+
+    def f(a, pos, neg):
+        def dist(u, v):
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(u - v) + epsilon, p), axis=-1), 1.0 / p)
+
+        d_pos = dist(a, pos)
+        d_neg = dist(a, neg)
+        if swap:
+            d_neg = jnp.minimum(d_neg, dist(pos, neg))
+        return _reduce(jnp.maximum(0.0, d_pos - d_neg + margin), reduction)
+
+    return apply(f, input, positive, negative, op_name="triplet_margin_loss")
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8, reduction="mean", name=None):  # noqa: A002
+    input, label = to_tensor_like(input), to_tensor_like(label)  # noqa: A001
+
+    def f(x, y):
+        if log_input:
+            per = jnp.exp(x) - y * x
+        else:
+            per = x - y * jnp.log(x + epsilon)
+        if full:
+            stirling = y * jnp.log(y + epsilon) - y + 0.5 * jnp.log(2 * jnp.pi * (y + epsilon))
+            per = per + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(per, reduction)
+
+    return apply(f, input, label, op_name="poisson_nll_loss")
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean", name=None):  # noqa: A002
+    input, label = to_tensor_like(input), to_tensor_like(label)  # noqa: A001
+
+    def f(z, y, *rest):
+        per = -(y * jax.nn.log_sigmoid(z) + (1 - y) * jax.nn.log_sigmoid(-z))
+        per = jnp.mean(per, axis=-1)
+        if rest:
+            per = per * rest[0]
+        return _reduce(per, reduction)
+
+    args = [input, label]
+    if weight is not None:
+        args.append(to_tensor_like(weight))
+    return apply(f, *args, op_name="multi_label_soft_margin_loss")
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    input, label = to_tensor_like(input), to_tensor_like(label)  # noqa: A001
+    return apply(
+        lambda z, y: _reduce(jnp.log1p(jnp.exp(-y * z)), reduction), input, label, op_name="soft_margin_loss"
+    )
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean", norm_by_times=False):
+    """CTC via the classic forward algorithm on a lax.scan (reference:
+    warpctc-backed paddle ctc_loss). log_probs: [T, N, C] (paddle layout)."""
+    log_probs = to_tensor_like(log_probs)
+    labels = to_tensor_like(labels)
+    input_lengths = to_tensor_like(input_lengths)
+    label_lengths = to_tensor_like(label_lengths)
+
+    def f(lp, lab, in_len, lab_len):
+        # lp: [T,N,C] logits — paddle passes logits; take log_softmax
+        lp = jax.nn.log_softmax(lp, axis=-1)
+        T, N, C = lp.shape
+        S = lab.shape[1]
+        ext = 2 * S + 1
+        # extended label seq: blank, l1, blank, l2, ... blank
+        ext_labels = jnp.full((N, ext), blank, dtype=jnp.int32)
+        ext_labels = ext_labels.at[:, 1::2].set(lab.astype(jnp.int32))
+        neg_inf = -1e30
+
+        alpha0 = jnp.full((N, ext), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+        alpha0 = alpha0.at[:, 1].set(jnp.take_along_axis(lp[0], ext_labels[:, 1:2], axis=1)[:, 0])
+
+        same_as_prev2 = jnp.concatenate(
+            [jnp.ones((N, 2), bool), ext_labels[:, 2:] == ext_labels[:, :-2]], axis=1
+        )
+
+        def step(alpha, lp_t):
+            a1 = alpha
+            a2 = jnp.concatenate([jnp.full((N, 1), neg_inf), alpha[:, :-1]], axis=1)
+            a3 = jnp.concatenate([jnp.full((N, 2), neg_inf), alpha[:, :-2]], axis=1)
+            a3 = jnp.where(same_as_prev2, neg_inf, a3)
+            m = jnp.maximum(jnp.maximum(a1, a2), a3)
+            new = m + jnp.log(
+                jnp.exp(a1 - m) + jnp.exp(a2 - m) + jnp.exp(a3 - m)
+            )
+            emit = jnp.take_along_axis(lp_t, ext_labels, axis=1)
+            return new + emit, None
+
+        alphaT, _ = jax.lax.scan(step, alpha0, lp[1:])
+        # Note: assumes full-length inputs (static shapes); in_len handling via
+        # masking would scan with per-step freeze — acceptable v1 contract.
+        last = 2 * lab_len.astype(jnp.int32)
+        a_last = jnp.take_along_axis(alphaT, last[:, None], axis=1)[:, 0]
+        a_prev = jnp.take_along_axis(alphaT, jnp.maximum(last - 1, 0)[:, None], axis=1)[:, 0]
+        m = jnp.maximum(a_last, a_prev)
+        ll = m + jnp.log(jnp.exp(a_last - m) + jnp.exp(a_prev - m))
+        loss = -ll
+        return _reduce(loss, reduction)
+
+    return apply(f, log_probs, labels, input_lengths, label_lengths, op_name="ctc_loss")

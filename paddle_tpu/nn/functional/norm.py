@@ -1,0 +1,215 @@
+"""Normalization functionals (parity: python/paddle/nn/functional/norm.py).
+
+batch_norm keeps the reference's running-stat update contract
+(running = momentum*running + (1-momentum)*batch); stats are updated on the
+passed buffer tensors in eager mode (functional state threading under jit is
+handled by the Layer's to_static path).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...autograd import tape
+from ...ops.dispatch import apply
+from ...tensor._helpers import to_tensor_like
+from ...tensor.tensor import Tensor
+
+__all__ = ["batch_norm", "layer_norm", "group_norm", "instance_norm", "local_response_norm", "rms_norm"]
+
+
+def _channel_shape(ndim, ch, data_format):
+    shape = [1] * ndim
+    axis = 1 if data_format.startswith("NC") else ndim - 1
+    shape[axis] = ch
+    return shape, axis
+
+
+def batch_norm(
+    x, running_mean, running_var, weight=None, bias=None, training=False,
+    momentum=0.9, epsilon=1e-5, data_format="NCHW", use_global_stats=None, name=None,
+):
+    x = to_tensor_like(x)
+    nd = x.ndim
+    ch = running_mean.shape[0]
+    shape, axis = _channel_shape(nd, ch, data_format)
+    reduce_axes = tuple(i for i in range(nd) if i != axis)
+    use_batch = training and not use_global_stats
+
+    if use_batch:
+        # batch statistics participate in the graph; the same statistics are
+        # returned as aux outputs so the running-stat update reuses them
+        # (single reduction pass)
+        def f(v, *params):
+            m = jnp.mean(v, axis=reduce_axes)
+            var = jnp.var(v, axis=reduce_axes)
+            out = (v - m.reshape(shape)) / jnp.sqrt(var.reshape(shape) + epsilon)
+            if params:
+                w, b = params
+                out = out * w.reshape(shape) + b.reshape(shape)
+            return out, jax.lax.stop_gradient(m), jax.lax.stop_gradient(var)
+
+        if weight is not None:
+            out, m_t, var_t = apply(f, x, to_tensor_like(weight), to_tensor_like(bias),
+                                    op_name="batch_norm", n_outs=3)
+        else:
+            out, m_t, var_t = apply(f, x, op_name="batch_norm", n_outs=3)
+        # update running stats out-of-graph (buffer semantics); inside a
+        # to_static trace, register the update so it is threaded out of the
+        # compiled function instead of leaking tracers into the buffer.
+        with tape.no_grad():
+            new_mean = momentum * running_mean._value + (1 - momentum) * m_t._value.astype(running_mean._value.dtype)
+            new_var = momentum * running_var._value + (1 - momentum) * var_t._value.astype(running_var._value.dtype)
+            from ...jit import trace_state
+
+            ctx = trace_state.current()
+            if ctx is not None:
+                ctx.register_buffer_update(running_mean, new_mean)
+                ctx.register_buffer_update(running_var, new_var)
+            else:
+                running_mean._value = new_mean
+                running_var._value = new_var
+        return out
+
+    rm, rv = to_tensor_like(running_mean), to_tensor_like(running_var)
+
+    def g(v, m, var, *params):
+        out = (v - m.reshape(shape)) / jnp.sqrt(var.reshape(shape) + epsilon)
+        if params:
+            w, b = params
+            out = out * w.reshape(shape) + b.reshape(shape)
+        return out
+
+    if weight is not None:
+        return apply(g, x, rm, rv, to_tensor_like(weight), to_tensor_like(bias), op_name="batch_norm")
+    return apply(g, x, rm, rv, op_name="batch_norm")
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    x = to_tensor_like(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(list(normalized_shape))
+    axes = tuple(range(x.ndim - n_axes, x.ndim))
+
+    def f(v, *params):
+        m = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - m) / jnp.sqrt(var + epsilon)
+        if params:
+            w = params[0]
+            out = out * w
+            if len(params) > 1:
+                out = out + params[1]
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(to_tensor_like(weight))
+    if bias is not None:
+        args.append(to_tensor_like(bias))
+    return apply(f, *args, op_name="layer_norm")
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (reference fused analog:
+    python/paddle/incubate/nn/functional/fused_rms_norm.py). XLA fuses the
+    naive form on TPU; a Pallas kernel covers the long-row case."""
+    x = to_tensor_like(x)
+
+    def f(v, *params):
+        dt = v.dtype
+        v32 = v.astype(jnp.float32)
+        ms = jnp.mean(v32 * v32, axis=-1, keepdims=True)
+        out = (v32 * jax.lax.rsqrt(ms + epsilon)).astype(dt)
+        if params:
+            out = out * params[0]
+        return out
+
+    if weight is not None:
+        return apply(f, x, to_tensor_like(weight), op_name="rms_norm")
+    return apply(f, x, op_name="rms_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None, data_format="NCHW", name=None):
+    x = to_tensor_like(x)
+    channels_first = data_format.startswith("NC")
+
+    def f(v, *params):
+        if not channels_first:
+            v = jnp.moveaxis(v, -1, 1)
+        n, c = v.shape[0], v.shape[1]
+        g = num_groups
+        rest = v.shape[2:]
+        vg = v.reshape(n, g, c // g, *rest)
+        axes = tuple(range(2, vg.ndim))
+        m = jnp.mean(vg, axis=axes, keepdims=True)
+        var = jnp.var(vg, axis=axes, keepdims=True)
+        out = ((vg - m) / jnp.sqrt(var + epsilon)).reshape(v.shape)
+        if params:
+            shape = [1, c] + [1] * len(rest)
+            out = out * params[0].reshape(shape)
+            if len(params) > 1:
+                out = out + params[1].reshape(shape)
+        if not channels_first:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(to_tensor_like(weight))
+    if bias is not None:
+        args.append(to_tensor_like(bias))
+    return apply(f, *args, op_name="group_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None, use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW", name=None):
+    x = to_tensor_like(x)
+    channels_first = data_format.startswith("NC")
+
+    def f(v, *params):
+        if not channels_first:
+            v = jnp.moveaxis(v, -1, 1)
+        axes = tuple(range(2, v.ndim))
+        m = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - m) / jnp.sqrt(var + eps)
+        if params:
+            shape = [1, v.shape[1]] + [1] * (v.ndim - 2)
+            out = out * params[0].reshape(shape)
+            if len(params) > 1:
+                out = out + params[1].reshape(shape)
+        if not channels_first:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(to_tensor_like(weight))
+    if bias is not None:
+        args.append(to_tensor_like(bias))
+    return apply(f, *args, op_name="instance_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    x = to_tensor_like(x)
+
+    def f(v):
+        channels_first = data_format.startswith("NC")
+        if not channels_first:
+            v = jnp.moveaxis(v, -1, 1)
+        sq = v * v
+        c = v.shape[1]
+        half = size // 2
+        pad_cfg = [(0, 0)] * v.ndim
+        pad_cfg[1] = (half, size - half - 1)
+        sq_p = jnp.pad(sq, pad_cfg)
+        acc = sum(sq_p[:, i : i + c] for i in range(size))
+        out = v / jnp.power(k + alpha * acc / size, beta)
+        if not channels_first:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return apply(f, x, op_name="local_response_norm")
+
+
+import jax  # noqa: E402

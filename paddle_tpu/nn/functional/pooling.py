@@ -1,0 +1,132 @@
+"""Pooling functionals (parity: python/paddle/nn/functional/pooling.py).
+All lower to lax.reduce_window."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from ...ops.dispatch import apply
+from ...tensor._helpers import to_tensor_like
+
+__all__ = [
+    "max_pool1d", "max_pool2d", "max_pool3d", "avg_pool1d", "avg_pool2d", "avg_pool3d",
+    "adaptive_avg_pool1d", "adaptive_avg_pool2d", "adaptive_avg_pool3d",
+    "adaptive_max_pool1d", "adaptive_max_pool2d", "adaptive_max_pool3d",
+]
+
+
+def _tuplize(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in (v if len(v) == n else [v[0]] * n))
+    return (int(v),) * n
+
+
+def _pool(x, kernel, stride, padding, n, mode, ceil_mode, exclusive, channels_first):
+    x = to_tensor_like(x)
+    ks = _tuplize(kernel, n)
+    st = _tuplize(stride if stride is not None else kernel, n)
+    if isinstance(padding, str):
+        pad_cfg = padding.upper()
+    else:
+        pd = _tuplize(padding, n)
+        pad_cfg = [(p, p) for p in pd]
+
+    def f(v):
+        nd = v.ndim
+        if channels_first:
+            window = (1, 1) + ks
+            strides = (1, 1) + st
+            pads = [(0, 0), (0, 0)] + (pad_cfg if not isinstance(pad_cfg, str) else [])
+        else:
+            window = (1,) + ks + (1,)
+            strides = (1,) + st + (1,)
+            pads = [(0, 0)] + (pad_cfg if not isinstance(pad_cfg, str) else []) + [(0, 0)]
+        padding_arg = pad_cfg if isinstance(pad_cfg, str) else pads
+        if mode == "max":
+            init = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) else jnp.iinfo(v.dtype).min
+            return lax.reduce_window(v, init, lax.max, window, strides, padding_arg)
+        # avg
+        summed = lax.reduce_window(v, 0.0, lax.add, window, strides, padding_arg)
+        if exclusive and not isinstance(padding_arg, str):
+            ones = jnp.ones_like(v)
+            counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding_arg)
+            return summed / counts
+        return summed / float(np.prod(ks))
+
+    return apply(f, x, op_name=f"{mode}_pool{n}d")
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "max", ceil_mode, False, data_format.startswith("NC"))
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, "max", ceil_mode, False, data_format.startswith("NC"))
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, "max", ceil_mode, False, data_format.startswith("NC"))
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "avg", ceil_mode, exclusive, data_format.startswith("NC"))
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, "avg", ceil_mode, exclusive, data_format.startswith("NC"))
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, "avg", ceil_mode, exclusive, data_format.startswith("NC"))
+
+
+def _adaptive(x, output_size, n, mode, channels_first):
+    x = to_tensor_like(x)
+    os_ = _tuplize(output_size, n)
+
+    def f(v):
+        spatial = v.shape[2:] if channels_first else v.shape[1:-1]
+        # split each spatial dim into output_size regions (paddle adaptive rule)
+        def pool_axis(arr, axis, in_d, out_d):
+            starts = [int(np.floor(i * in_d / out_d)) for i in range(out_d)]
+            ends = [int(np.ceil((i + 1) * in_d / out_d)) for i in range(out_d)]
+            slices = []
+            for s, e in zip(starts, ends):
+                seg = jnp.take(arr, jnp.arange(s, e), axis=axis)
+                red = jnp.max(seg, axis=axis, keepdims=True) if mode == "max" else jnp.mean(seg, axis=axis, keepdims=True)
+                slices.append(red)
+            return jnp.concatenate(slices, axis=axis)
+
+        out = v
+        for i in range(n):
+            axis = (2 + i) if channels_first else (1 + i)
+            out = pool_axis(out, axis, spatial[i], os_[i])
+        return out
+
+    return apply(f, x, op_name=f"adaptive_{mode}_pool{n}d")
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, output_size, 1, "avg", True)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, output_size, 2, "avg", data_format.startswith("NC"))
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, output_size, 3, "avg", data_format.startswith("NC"))
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 1, "max", True)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 2, "max", True)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 3, "max", True)

@@ -1,0 +1,92 @@
+"""Conv layers (parity: python/paddle/nn/layer/conv.py)."""
+from __future__ import annotations
+
+from ...base.param_attr import ParamAttr
+from .. import functional as F
+from ..initializer import KaimingUniform
+from .layers import Layer
+
+__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose", "Conv3DTranspose"]
+
+
+def _ntuple(v, n):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v,) * n
+
+
+class _ConvNd(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, n, transpose, stride, padding,
+                 output_padding, dilation, groups, padding_mode, weight_attr, bias_attr, data_format):
+        super().__init__()
+        self._in_channels = in_channels
+        self._out_channels = out_channels
+        self._kernel_size = _ntuple(kernel_size, n)
+        self._stride = stride
+        self._padding = padding
+        self._output_padding = output_padding
+        self._dilation = dilation
+        self._groups = groups
+        self._data_format = data_format
+        self._n = n
+        self._transpose = transpose
+        if transpose:
+            w_shape = [in_channels, out_channels // groups, *self._kernel_size]
+        else:
+            w_shape = [out_channels, in_channels // groups, *self._kernel_size]
+        self.weight = self.create_parameter(
+            w_shape, attr=ParamAttr._to_attr(weight_attr), default_initializer=KaimingUniform(),
+        )
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_channels], attr=ParamAttr._to_attr(bias_attr), is_bias=True,
+        )
+
+    def forward(self, x):
+        if self._transpose:
+            fn = {1: F.conv1d_transpose, 2: F.conv2d_transpose, 3: F.conv3d_transpose}[self._n]
+            return fn(x, self.weight, self.bias, stride=self._stride, padding=self._padding,
+                      output_padding=self._output_padding, groups=self._groups,
+                      dilation=self._dilation, data_format=self._data_format)
+        fn = {1: F.conv1d, 2: F.conv2d, 3: F.conv3d}[self._n]
+        return fn(x, self.weight, self.bias, stride=self._stride, padding=self._padding,
+                  dilation=self._dilation, groups=self._groups, data_format=self._data_format)
+
+
+class Conv1D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0, dilation=1,
+                 groups=1, padding_mode="zeros", weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__(in_channels, out_channels, kernel_size, 1, False, stride, padding, 0,
+                         dilation, groups, padding_mode, weight_attr, bias_attr, data_format)
+
+
+class Conv2D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0, dilation=1,
+                 groups=1, padding_mode="zeros", weight_attr=None, bias_attr=None, data_format="NCHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 2, False, stride, padding, 0,
+                         dilation, groups, padding_mode, weight_attr, bias_attr, data_format)
+
+
+class Conv3D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0, dilation=1,
+                 groups=1, padding_mode="zeros", weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 3, False, stride, padding, 0,
+                         dilation, groups, padding_mode, weight_attr, bias_attr, data_format)
+
+
+class Conv1DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0, output_padding=0,
+                 groups=1, dilation=1, weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__(in_channels, out_channels, kernel_size, 1, True, stride, padding,
+                         output_padding, dilation, groups, "zeros", weight_attr, bias_attr, data_format)
+
+
+class Conv2DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0, output_padding=0,
+                 groups=1, dilation=1, weight_attr=None, bias_attr=None, data_format="NCHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 2, True, stride, padding,
+                         output_padding, dilation, groups, "zeros", weight_attr, bias_attr, data_format)
+
+
+class Conv3DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0, output_padding=0,
+                 groups=1, dilation=1, weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 3, True, stride, padding,
+                         output_padding, dilation, groups, "zeros", weight_attr, bias_attr, data_format)

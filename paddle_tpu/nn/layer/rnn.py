@@ -1,0 +1,286 @@
+"""Recurrent layers (parity: python/paddle/nn/layer/rnn.py).
+
+TPU-native: the time loop is a ``lax.scan`` inside one taped op — XLA compiles
+the whole sequence as one fused loop (the reference needs cudnn RNN kernels
+for this). Layout follows paddle: batch-first [B, T, size] by default with
+``time_major=False``.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...base.param_attr import ParamAttr
+from ...ops.dispatch import apply
+from ...tensor._helpers import to_tensor_like
+from ...tensor.tensor import Tensor
+from ..initializer import Uniform
+from .layers import Layer
+
+__all__ = ["SimpleRNN", "LSTM", "GRU", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN"]
+
+
+class _RNNCellBase(Layer):
+    def __init__(self, input_size, hidden_size, gate_mult, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter([gate_mult * hidden_size, input_size],
+                                               attr=ParamAttr._to_attr(weight_ih_attr), default_initializer=init)
+        self.weight_hh = self.create_parameter([gate_mult * hidden_size, hidden_size],
+                                               attr=ParamAttr._to_attr(weight_hh_attr), default_initializer=init)
+        self.bias_ih = self.create_parameter([gate_mult * hidden_size], attr=ParamAttr._to_attr(bias_ih_attr),
+                                             is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([gate_mult * hidden_size], attr=ParamAttr._to_attr(bias_hh_attr),
+                                             is_bias=True, default_initializer=init)
+
+
+class SimpleRNNCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", **kwargs):
+        super().__init__(input_size, hidden_size, 1, **kwargs)
+        self.activation = activation
+
+    def forward(self, inputs, states=None):
+        from ...tensor.creation import zeros
+
+        if states is None:
+            states = zeros([inputs.shape[0], self.hidden_size], dtype=inputs.dtype)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def f(x, h, wih, whh, bih, bhh):
+            out = act(x @ wih.T + bih + h @ whh.T + bhh)
+            return out
+
+        out = apply(f, to_tensor_like(inputs), to_tensor_like(states), self.weight_ih,
+                    self.weight_hh, self.bias_ih, self.bias_hh, op_name="simple_rnn_cell")
+        return out, out
+
+
+class LSTMCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, **kwargs):
+        super().__init__(input_size, hidden_size, 4, **kwargs)
+
+    def forward(self, inputs, states=None):
+        from ...tensor.creation import zeros
+
+        if states is None:
+            h = zeros([inputs.shape[0], self.hidden_size], dtype=inputs.dtype)
+            c = zeros([inputs.shape[0], self.hidden_size], dtype=inputs.dtype)
+        else:
+            h, c = states
+
+        def f(x, hv, cv, wih, whh, bih, bhh):
+            gates = x @ wih.T + bih + hv @ whh.T + bhh
+            i, fg, g, o = jnp.split(gates, 4, axis=-1)
+            i, fg, o = jax.nn.sigmoid(i), jax.nn.sigmoid(fg), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            new_c = fg * cv + i * g
+            new_h = o * jnp.tanh(new_c)
+            return new_h, new_c
+
+        new_h, new_c = apply(lambda *a: tuple(f(*a)), to_tensor_like(inputs), to_tensor_like(h),
+                             to_tensor_like(c), self.weight_ih, self.weight_hh, self.bias_ih,
+                             self.bias_hh, op_name="lstm_cell", n_outs=2)
+        return new_h, (new_h, new_c)
+
+
+class GRUCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, **kwargs):
+        super().__init__(input_size, hidden_size, 3, **kwargs)
+
+    def forward(self, inputs, states=None):
+        from ...tensor.creation import zeros
+
+        if states is None:
+            states = zeros([inputs.shape[0], self.hidden_size], dtype=inputs.dtype)
+
+        def f(x, h, wih, whh, bih, bhh):
+            gi = x @ wih.T + bih
+            gh = h @ whh.T + bhh
+            ir, iz, ic = jnp.split(gi, 3, axis=-1)
+            hr, hz, hc = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            c = jnp.tanh(ic + r * hc)
+            return (1 - z) * c + z * h
+
+        out = apply(f, to_tensor_like(inputs), to_tensor_like(states), self.weight_ih,
+                    self.weight_hh, self.bias_ih, self.bias_hh, op_name="gru_cell")
+        return out, out
+
+
+class RNN(Layer):
+    """Generic wrapper running a cell over time (parity: nn/layer/rnn.py RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor import manipulation as M
+
+        steps = inputs.shape[0] if self.time_major else inputs.shape[1]
+        axis = 0 if self.time_major else 1
+        outputs = []
+        states = initial_states
+        order = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        for t in order:
+            x_t = inputs[t] if self.time_major else inputs[:, t]
+            out, states = self.cell(x_t, states)
+            outputs.append(out)
+        if self.is_reverse:
+            outputs = outputs[::-1]
+        out = M.stack(outputs, axis=axis)
+        return out, states
+
+
+class _ScanRNNBase(Layer):
+    """Multi-layer (optionally bidirectional) scan-based RNN.
+
+    mode in {"RNN_TANH", "RNN_RELU", "LSTM", "GRU"}; weights per layer per
+    direction follow the cell layout so state_dicts port from the reference.
+    """
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        num_dir = 2 if self.bidirect else 1
+        self.num_directions = num_dir
+        gate_mult = {"LSTM": 4, "GRU": 3}.get(mode, 1)
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self._all_weights = []
+        for layer in range(num_layers):
+            for d in range(num_dir):
+                in_sz = input_size if layer == 0 else hidden_size * num_dir
+                suffix = f"_l{layer}" + ("_rev" if d else "")
+                wih = self.create_parameter([gate_mult * hidden_size, in_sz], default_initializer=init)
+                whh = self.create_parameter([gate_mult * hidden_size, hidden_size], default_initializer=init)
+                bih = self.create_parameter([gate_mult * hidden_size], is_bias=True, default_initializer=init)
+                bhh = self.create_parameter([gate_mult * hidden_size], is_bias=True, default_initializer=init)
+                self.add_parameter(f"weight_ih{suffix}", wih)
+                self.add_parameter(f"weight_hh{suffix}", whh)
+                self.add_parameter(f"bias_ih{suffix}", bih)
+                self.add_parameter(f"bias_hh{suffix}", bhh)
+                self._all_weights.append((f"weight_ih{suffix}", f"weight_hh{suffix}",
+                                          f"bias_ih{suffix}", f"bias_hh{suffix}"))
+
+    def _cell_fn(self):
+        mode = self.mode
+
+        def step(x, h, c, wih, whh, bih, bhh):
+            if mode == "LSTM":
+                gates = x @ wih.T + bih + h @ whh.T + bhh
+                i, fg, g, o = jnp.split(gates, 4, axis=-1)
+                i, fg, o = jax.nn.sigmoid(i), jax.nn.sigmoid(fg), jax.nn.sigmoid(o)
+                g = jnp.tanh(g)
+                new_c = fg * c + i * g
+                new_h = o * jnp.tanh(new_c)
+                return new_h, new_c
+            if mode == "GRU":
+                gi = x @ wih.T + bih
+                gh = h @ whh.T + bhh
+                ir, iz, ic = jnp.split(gi, 3, axis=-1)
+                hr, hz, hc = jnp.split(gh, 3, axis=-1)
+                r = jax.nn.sigmoid(ir + hr)
+                z = jax.nn.sigmoid(iz + hz)
+                cand = jnp.tanh(ic + r * hc)
+                new_h = (1 - z) * cand + z * h
+                return new_h, new_h
+            act = jnp.tanh if mode == "RNN_TANH" else jax.nn.relu
+            new_h = act(x @ wih.T + bih + h @ whh.T + bhh)
+            return new_h, new_h
+
+        return step
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        inputs = to_tensor_like(inputs)
+        step = self._cell_fn()
+        time_major = self.time_major
+        num_dir = self.num_directions
+        H = self.hidden_size
+        L = self.num_layers
+        is_lstm = self.mode == "LSTM"
+
+        weights = []
+        for names in self._all_weights:
+            weights.extend(self._parameters[n] for n in names)
+
+        def f(x, *ws):
+            if not time_major:
+                x = jnp.swapaxes(x, 0, 1)  # [T, B, E]
+            B = x.shape[1]
+            h_all, c_all = [], []
+            layer_in = x
+            wi = 0
+            for layer in range(L):
+                dir_outs = []
+                for d in range(num_dir):
+                    wih, whh, bih, bhh = ws[wi : wi + 4]
+                    wi += 4
+                    h0 = jnp.zeros((B, H), x.dtype)
+                    c0 = jnp.zeros((B, H), x.dtype)
+                    xs = jnp.flip(layer_in, 0) if d == 1 else layer_in
+
+                    def scan_fn(carry, x_t):
+                        h, c = carry
+                        new_h, new_c = step(x_t, h, c, wih, whh, bih, bhh)
+                        return (new_h, new_c), new_h
+
+                    (hT, cT), outs = jax.lax.scan(scan_fn, (h0, c0), xs)
+                    if d == 1:
+                        outs = jnp.flip(outs, 0)
+                    dir_outs.append(outs)
+                    h_all.append(hT)
+                    c_all.append(cT)
+                layer_in = jnp.concatenate(dir_outs, axis=-1) if num_dir == 2 else dir_outs[0]
+            out = layer_in
+            if not time_major:
+                out = jnp.swapaxes(out, 0, 1)
+            h_stack = jnp.stack(h_all, 0)  # [L*num_dir, B, H]
+            c_stack = jnp.stack(c_all, 0)
+            if is_lstm:
+                return out, h_stack, c_stack
+            return out, h_stack
+
+        n_outs = 3 if is_lstm else 2
+        results = apply(lambda *a: tuple(f(*a)), inputs, *weights, op_name=self.mode.lower(), n_outs=n_outs)
+        if is_lstm:
+            out, h, c = results
+            return out, (h, c)
+        out, h = results
+        return out, h
+
+
+class SimpleRNN(_ScanRNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kwargs):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction, time_major, dropout)
+
+
+class LSTM(_ScanRNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers, direction, time_major, dropout)
+
+
+class GRU(_ScanRNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction, time_major, dropout)

@@ -1,0 +1,82 @@
+"""nn.utils (parity: python/paddle/nn/utils): weight/spectral norm hooks,
+parameters_to_vector helpers."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...tensor.tensor import Tensor
+
+__all__ = ["parameters_to_vector", "vector_to_parameters", "spectral_norm_hook", "weight_norm", "remove_weight_norm"]
+
+
+def parameters_to_vector(parameters, name=None):
+    vals = [np.asarray(p._value).reshape(-1) for p in parameters]
+    return Tensor(jnp.asarray(np.concatenate(vals)))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    arr = np.asarray(vec._value)
+    off = 0
+    for p in parameters:
+        n = int(np.prod(p._value.shape))
+        p.set_value(arr[off : off + n].reshape(p._value.shape))
+        off += n
+
+
+def spectral_norm_hook(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=None):
+    """Wrap a layer's weight with spectral normalization applied on each call."""
+    from ..layer.norm import SpectralNorm
+
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    sn = SpectralNorm(w.shape, dim=dim, power_iters=n_power_iterations, eps=eps)
+    layer.add_sublayer(name + "_spectral_norm", sn)
+    orig_forward = layer.forward
+
+    def forward(*args, **kwargs):
+        w_orig = getattr(layer, name)
+        normalized = sn(w_orig)
+        object.__setattr__(layer, "_sn_weight", normalized)
+        # temporarily swap the parameter value
+        saved = w_orig._value
+        w_orig._value = normalized._value
+        try:
+            return orig_forward(*args, **kwargs)
+        finally:
+            w_orig._value = saved
+
+    layer.forward = forward
+    return layer
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """v/g reparameterization applied eagerly at call time."""
+    w = getattr(layer, name)
+    g_val = jnp.sqrt(jnp.sum(jnp.square(w._value), axis=tuple(i for i in range(w._value.ndim) if i != dim), keepdims=True))
+    g = Tensor(g_val, stop_gradient=False)
+    g.is_parameter = True
+    v = Tensor(w._value, stop_gradient=False)
+    v.is_parameter = True
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+    orig_forward = layer.forward
+
+    def forward(*args, **kwargs):
+        vv = layer._parameters[name + "_v"]
+        gg = layer._parameters[name + "_g"]
+        norm = jnp.sqrt(jnp.sum(jnp.square(vv._value), axis=tuple(i for i in range(vv._value.ndim) if i != dim), keepdims=True))
+        getattr(layer, name)._value = vv._value / norm * gg._value
+        return orig_forward(*args, **kwargs)
+
+    layer.forward = forward
+    layer._weight_norm_name = name
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    for suffix in ("_g", "_v"):
+        layer._parameters.pop(name + suffix, None)
+    return layer

@@ -18,6 +18,9 @@ import jax.numpy as jnp
 from ..autograd import tape
 from ..framework import flags
 
+# op-call counter sink for amp.debugging.collect_operator_stats
+_stats_sink = None
+
 
 def _wrap(val, node, index, stop_gradient):
     from ..tensor.tensor import Tensor
@@ -29,6 +32,28 @@ def _wrap(val, node, index, stop_gradient):
     return t
 
 
+def _amp_cast_vals(op_name: str, vals):
+    """AMP autocast at the dispatch boundary — the TPU-native analog of the
+    generated AmpAutoCast calls (reference eager_gen.py / amp_auto_cast.h:40)."""
+    from ..amp.auto_cast import amp_state
+    from ..framework.dtype import to_jax_dtype
+
+    st = amp_state()
+    if not st.enabled:
+        return vals
+    low = to_jax_dtype(st.dtype)
+    f32 = jnp.float32
+
+    def is_float(v):
+        return jnp.issubdtype(jnp.result_type(v), jnp.floating)
+
+    if op_name in st.black:
+        return tuple(v.astype(f32) if is_float(v) and jnp.result_type(v) != f32 else v for v in vals)
+    if op_name in st.white or st.level == "O2":
+        return tuple(v.astype(low) if is_float(v) and jnp.result_type(v) == f32 else v for v in vals)
+    return vals
+
+
 def apply(fn: Callable, *inputs, op_name: str = "", n_outs: int = 1):
     """Run ``fn(*raw_values)`` and tape its vjp if needed.
 
@@ -37,7 +62,10 @@ def apply(fn: Callable, *inputs, op_name: str = "", n_outs: int = 1):
     a pure function of the raw jax arrays. Returns Tensor or list of Tensors
     matching fn's output arity.
     """
+    if _stats_sink is not None:
+        _stats_sink[op_name or "<anonymous>"] = _stats_sink.get(op_name or "<anonymous>", 0) + 1
     vals = tuple(t._value for t in inputs)
+    vals = _amp_cast_vals(op_name, vals)
     needs_grad = tape.grad_enabled() and any(not t.stop_gradient for t in inputs)
     if needs_grad:
         outs, vjp_fn = jax.vjp(fn, *vals)

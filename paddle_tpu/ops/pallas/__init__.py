@@ -1,0 +1,9 @@
+"""Pallas TPU kernel tier.
+
+TPU-native analog of the reference's handwritten kernel layer
+(/root/reference/paddle/phi/kernels/fusion/, third_party/flashattn, and the
+Kernel Primitive API paddle/phi/kernels/primitive/kernel_primitives.h): the
+small set of ops XLA does not fuse optimally gets hand-tiled VMEM kernels.
+Every kernel has a jnp reference implementation used on CPU and as the
+backward recompute path.
+"""

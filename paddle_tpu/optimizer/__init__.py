@@ -1,0 +1,15 @@
+"""paddle_tpu.optimizer (parity: python/paddle/optimizer)."""
+from . import lr  # noqa: F401
+from .optimizer import Optimizer  # noqa: F401
+from .optimizers import (  # noqa: F401
+    SGD,
+    Adadelta,
+    Adagrad,
+    Adam,
+    Adamax,
+    AdamW,
+    Lamb,
+    Lars,
+    Momentum,
+    RMSProp,
+)

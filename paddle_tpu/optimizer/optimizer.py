@@ -1,0 +1,161 @@
+"""Optimizer base (parity: python/paddle/optimizer/optimizer.py:125).
+
+Keeps the reference's contracts: parameter groups, per-state accumulators,
+grad clip plug-in, weight decay, LRScheduler integration, state_dict with
+master weights (multi_precision). TPU-native: the update math is pure jnp on
+the raw arrays under no_grad; the jit'd training-step path fuses these updates
+into the compiled step.
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List, Optional, Union
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..autograd import tape
+from ..tensor.tensor import Tensor
+from .lr import LRScheduler
+
+__all__ = ["Optimizer"]
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None, grad_clip=None, multi_precision=False, name=None):
+        if parameters is None:
+            raise ValueError("parameters is required in eager mode (pass model.parameters())")
+        params = list(parameters)
+        if params and isinstance(params[0], dict):
+            self._param_groups = params
+            self._parameter_list = [p for g in params for p in g["params"]]
+        else:
+            self._param_groups = [{"params": params}]
+            self._parameter_list = params
+        self._learning_rate = learning_rate
+        self._weight_decay = self._parse_decay(weight_decay)
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        # accumulators: name -> {id(param): jnp array}
+        self._accumulators: Dict[str, Dict[int, jnp.ndarray]] = defaultdict(dict)
+        self._master_weights: Dict[int, jnp.ndarray] = {}
+        self._step_count = 0
+
+    @staticmethod
+    def _parse_decay(weight_decay):
+        if weight_decay is None:
+            return 0.0
+        from ..regularizer import L2Decay
+
+        if isinstance(weight_decay, L2Decay):
+            return float(weight_decay.coeff)
+        if isinstance(weight_decay, float) or isinstance(weight_decay, int):
+            return float(weight_decay)
+        return float(weight_decay)
+
+    # ----------------------------------------------------------- lr
+    def get_lr(self) -> float:
+        lr = self._learning_rate
+        return lr() if isinstance(lr, LRScheduler) else float(lr)
+
+    def set_lr(self, value: float):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    def _group_lr(self, group) -> float:
+        base = self.get_lr()
+        return base * group.get("learning_rate", 1.0)
+
+    # ----------------------------------------------------------- accumulators
+    def _acc(self, name: str, p: Tensor, init=None):
+        d = self._accumulators[name]
+        if id(p) not in d:
+            d[id(p)] = jnp.zeros_like(self._master(p)) if init is None else init
+        return d[id(p)]
+
+    def _set_acc(self, name: str, p: Tensor, value):
+        self._accumulators[name][id(p)] = value
+
+    def _master(self, p: Tensor):
+        """fp32 master weight when multi_precision and p is low precision."""
+        if self._multi_precision and p._value.dtype in (jnp.float16, jnp.bfloat16):
+            if id(p) not in self._master_weights:
+                self._master_weights[id(p)] = p._value.astype(jnp.float32)
+            return self._master_weights[id(p)]
+        return p._value
+
+    def _write_back(self, p: Tensor, new_master):
+        if id(p) in self._master_weights:
+            self._master_weights[id(p)] = new_master
+            p._value = new_master.astype(p._value.dtype)
+        else:
+            p._value = new_master
+
+    # ----------------------------------------------------------- step
+    @tape.no_grad()
+    def step(self):
+        for group in self._param_groups:
+            params_grads = [(p, p.grad) for p in group["params"] if p.grad is not None and p.trainable]
+            if self._grad_clip is not None:
+                params_grads = self._grad_clip(params_grads)
+            lr = self._group_lr(group)
+            wd = group.get("weight_decay", self._weight_decay)
+            wd = self._parse_decay(wd) if not isinstance(wd, float) else wd
+            for p, g in params_grads:
+                if g is None:
+                    continue
+                gv = g._value.astype(jnp.float32) if self._multi_precision else g._value
+                self._update_param(p, gv, lr, wd)
+        self._step_count += 1
+
+    def _update_param(self, p: Tensor, grad, lr: float, weight_decay: float):
+        raise NotImplementedError
+
+    def clear_grad(self, set_to_zero: bool = False):
+        for p in self._parameter_list:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    # ----------------------------------------------------------- state
+    def state_dict(self) -> dict:
+        out = {}
+        name_of = {id(p): (p.name or f"param_{i}") for i, p in enumerate(self._parameter_list)}
+        for acc_name, d in self._accumulators.items():
+            for pid, val in d.items():
+                out[f"{name_of.get(pid, pid)}__{acc_name}"] = Tensor(val)
+        for pid, mw in self._master_weights.items():
+            out[f"{name_of.get(pid, pid)}__master_weight"] = Tensor(mw)
+        if isinstance(self._learning_rate, LRScheduler):
+            out["LR_Scheduler"] = self._learning_rate.state_dict()
+        out["@step"] = self._step_count
+        return out
+
+    def set_state_dict(self, state: dict):
+        name_of = {(p.name or f"param_{i}"): p for i, p in enumerate(self._parameter_list)}
+        for key, val in state.items():
+            if key == "LR_Scheduler":
+                if isinstance(self._learning_rate, LRScheduler):
+                    self._learning_rate.set_state_dict(val)
+                continue
+            if key == "@step":
+                self._step_count = int(val)
+                continue
+            if "__" not in key:
+                continue
+            pname, acc_name = key.rsplit("__", 1)
+            p = name_of.get(pname)
+            if p is None:
+                continue
+            arr = jnp.asarray(val.numpy() if isinstance(val, Tensor) else np.asarray(val))
+            if acc_name == "master_weight":
+                self._master_weights[id(p)] = arr
+            else:
+                self._accumulators[acc_name][id(p)] = arr

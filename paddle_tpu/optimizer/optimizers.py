@@ -1,0 +1,246 @@
+"""Concrete optimizers (parity: python/paddle/optimizer/{sgd,momentum,adam,adamw,...}.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor
+from .optimizer import Optimizer
+
+__all__ = ["SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad", "Adadelta", "RMSProp", "Lamb", "Lars"]
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None, grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+
+    def _update_param(self, p, grad, lr, weight_decay):
+        w = self._master(p)
+        if weight_decay:
+            grad = grad + weight_decay * w
+        self._write_back(p, w - lr * grad.astype(w.dtype))
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None, use_nesterov=False,
+                 weight_decay=None, grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _update_param(self, p, grad, lr, weight_decay):
+        w = self._master(p)
+        if weight_decay:
+            grad = grad + weight_decay * w
+        v = self._acc("velocity", p)
+        v = self._momentum * v + grad
+        self._set_acc("velocity", p, v)
+        if self._nesterov:
+            update = grad + self._momentum * v
+        else:
+            update = v
+        self._write_back(p, w - lr * update.astype(w.dtype))
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None,
+                 weight_decay=None, grad_clip=None, lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _update_param(self, p, grad, lr, weight_decay):
+        w = self._master(p)
+        if weight_decay:  # paddle Adam applies decay as L2 regularization on grads
+            grad = grad + weight_decay * w
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        t = self._acc("beta_pow", p, init=jnp.zeros((), jnp.float32)) + 1
+        self._set_acc("beta_pow", p, t)
+        m = self._beta1 * m + (1 - self._beta1) * grad
+        v = self._beta2 * v + (1 - self._beta2) * grad * grad
+        self._set_acc("moment1", p, m)
+        self._set_acc("moment2", p, v)
+        mhat = m / (1 - self._beta1**t)
+        vhat = v / (1 - self._beta2**t)
+        self._write_back(p, w - (lr * mhat / (jnp.sqrt(vhat) + self._epsilon)).astype(w.dtype))
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (parity: python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None,
+                 weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision, name=name)
+        self._wd = float(weight_decay) if not callable(weight_decay) else weight_decay
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def _update_param(self, p, grad, lr, weight_decay):
+        if self._lr_ratio is not None:
+            lr = lr * self._lr_ratio(p)
+        w = self._master(p)
+        do_decay = True
+        if self._apply_decay_param_fun is not None:
+            do_decay = self._apply_decay_param_fun(p.name)
+        wd = self._wd() if callable(self._wd) else self._wd
+        if do_decay and wd:
+            w = w * (1 - lr * wd)
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        t = self._acc("beta_pow", p, init=jnp.zeros((), jnp.float32)) + 1
+        self._set_acc("beta_pow", p, t)
+        m = self._beta1 * m + (1 - self._beta1) * grad
+        v = self._beta2 * v + (1 - self._beta2) * grad * grad
+        self._set_acc("moment1", p, m)
+        self._set_acc("moment2", p, v)
+        mhat = m / (1 - self._beta1**t)
+        vhat = v / (1 - self._beta2**t)
+        self._write_back(p, w - (lr * mhat / (jnp.sqrt(vhat) + self._epsilon)).astype(w.dtype))
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, False, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _update_param(self, p, grad, lr, weight_decay):
+        w = self._master(p)
+        if weight_decay:
+            grad = grad + weight_decay * w
+        m = self._acc("moment", p)
+        u = self._acc("inf_norm", p)
+        t = self._acc("beta_pow", p, init=jnp.zeros((), jnp.float32)) + 1
+        self._set_acc("beta_pow", p, t)
+        m = self._beta1 * m + (1 - self._beta1) * grad
+        u = jnp.maximum(self._beta2 * u, jnp.abs(grad))
+        self._set_acc("moment", p, m)
+        self._set_acc("inf_norm", p, u)
+        self._write_back(p, w - (lr / (1 - self._beta1**t) * m / (u + self._epsilon)).astype(w.dtype))
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None, weight_decay=None,
+                 grad_clip=None, initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, False, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _update_param(self, p, grad, lr, weight_decay):
+        w = self._master(p)
+        if weight_decay:
+            grad = grad + weight_decay * w
+        acc = self._acc("moment", p, init=jnp.full_like(w, self._init_acc))
+        acc = acc + grad * grad
+        self._set_acc("moment", p, acc)
+        self._write_back(p, w - (lr * grad / (jnp.sqrt(acc) + self._epsilon)).astype(w.dtype))
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, False, name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _update_param(self, p, grad, lr, weight_decay):
+        w = self._master(p)
+        if weight_decay:
+            grad = grad + weight_decay * w
+        avg_sq = self._acc("avg_squared_grad", p)
+        avg_up = self._acc("avg_squared_update", p)
+        avg_sq = self._rho * avg_sq + (1 - self._rho) * grad * grad
+        update = jnp.sqrt(avg_up + self._epsilon) / jnp.sqrt(avg_sq + self._epsilon) * grad
+        avg_up = self._rho * avg_up + (1 - self._rho) * update * update
+        self._set_acc("avg_squared_grad", p, avg_sq)
+        self._set_acc("avg_squared_update", p, avg_up)
+        self._write_back(p, w - (lr * update).astype(w.dtype))
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0, centered=False,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, False, name)
+        self._rho, self._epsilon, self._momentum, self._centered = rho, epsilon, momentum, centered
+
+    def _update_param(self, p, grad, lr, weight_decay):
+        w = self._master(p)
+        if weight_decay:
+            grad = grad + weight_decay * w
+        ms = self._acc("mean_square", p)
+        ms = self._rho * ms + (1 - self._rho) * grad * grad
+        self._set_acc("mean_square", p, ms)
+        if self._centered:
+            mg = self._acc("mean_grad", p)
+            mg = self._rho * mg + (1 - self._rho) * grad
+            self._set_acc("mean_grad", p, mg)
+            denom = jnp.sqrt(ms - mg * mg + self._epsilon)
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._acc("momentum", p)
+        mom = self._momentum * mom + lr * grad / denom
+        self._set_acc("momentum", p, mom)
+        self._write_back(p, w - mom.astype(w.dtype))
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, parameters=None, grad_clip=None, exclude_from_weight_decay_fn=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, multi_precision, name)
+        self._wd = lamb_weight_decay
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _update_param(self, p, grad, lr, weight_decay):
+        w = self._master(p)
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        t = self._acc("beta_pow", p, init=jnp.zeros((), jnp.float32)) + 1
+        self._set_acc("beta_pow", p, t)
+        m = self._beta1 * m + (1 - self._beta1) * grad
+        v = self._beta2 * v + (1 - self._beta2) * grad * grad
+        self._set_acc("moment1", p, m)
+        self._set_acc("moment2", p, v)
+        mhat = m / (1 - self._beta1**t)
+        vhat = v / (1 - self._beta2**t)
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon)
+        wd = self._wd
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            wd = 0.0
+        update = r + wd * w
+        w_norm = jnp.linalg.norm(w.astype(jnp.float32))
+        u_norm = jnp.linalg.norm(update.astype(jnp.float32))
+        trust = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+        self._write_back(p, w - (lr * trust * update).astype(w.dtype))
+
+
+class Lars(Momentum):
+    """LARS (parity: incubate lars_momentum op + fleet LarsOptimizer)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001, lars_weight_decay=0.0005,
+                 parameters=None, grad_clip=None, exclude_from_weight_decay=None, epsilon=1e-9,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, momentum, parameters, False, None, grad_clip, multi_precision, name)
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._lars_eps = epsilon
+
+    def _update_param(self, p, grad, lr, weight_decay):
+        w = self._master(p)
+        w_norm = jnp.linalg.norm(w.astype(jnp.float32))
+        g_norm = jnp.linalg.norm(grad.astype(jnp.float32))
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self._lars_coeff * w_norm / (g_norm + self._lars_wd * w_norm + self._lars_eps),
+            1.0,
+        )
+        eff_lr = lr * local_lr
+        grad = grad + self._lars_wd * w
+        v = self._acc("velocity", p)
+        v = self._momentum * v + eff_lr * grad
+        self._set_acc("velocity", p, v)
+        self._write_back(p, w - v.astype(w.dtype))

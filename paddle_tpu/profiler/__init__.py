@@ -1,0 +1,247 @@
+"""Profiler (parity: python/paddle/profiler — Profiler ctx mgr with
+CLOSED→READY→RECORD scheduler profiler.py:79,346, chrome-trace export,
+summary tables profiler_statistic.py, step timer/ips timer.py).
+
+TPU-native: device tracing is jax.profiler (XPlane → TensorBoard/Perfetto,
+replacing the reference's CUPTI tracer); host spans use
+jax.profiler.TraceAnnotation (the RecordEvent analog); the step-timer /
+throughput surface is reimplemented natively.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+from collections import defaultdict
+from enum import Enum
+from typing import Callable, Iterable, Optional
+
+import jax
+
+__all__ = [
+    "Profiler", "ProfilerState", "ProfilerTarget", "make_scheduler", "export_chrome_tracing",
+    "RecordEvent", "benchmark", "SummaryView",
+]
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    CUSTOM_DEVICE = 2
+    TPU = 3
+
+
+def make_scheduler(closed: int, ready: int, record: int, repeat: int = 0, skip_first: int = 0):
+    """parity: profiler.make_scheduler — step-indexed state machine."""
+    cycle = closed + ready + record
+
+    def scheduler(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= cycle * repeat:
+            return ProfilerState.CLOSED
+        pos = s % cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    def handler(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        path = os.path.join(dir_name, f"{worker_name or 'worker'}_{int(time.time())}.json")
+        prof._export_host_events(path)
+
+    return handler
+
+
+class RecordEvent:
+    """Host span (parity: paddle.profiler.RecordEvent / C++ RecordEvent)."""
+
+    _active_sink = None
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._jax_ann = None
+
+    def begin(self):
+        self._t0 = time.perf_counter()
+        self._jax_ann = jax.profiler.TraceAnnotation(self.name)
+        self._jax_ann.__enter__()
+
+    def end(self):
+        if self._jax_ann is not None:
+            self._jax_ann.__exit__(None, None, None)
+        dt = time.perf_counter() - self._t0
+        sink = RecordEvent._active_sink
+        if sink is not None:
+            sink.append((self.name, self._t0, dt))
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+class SummaryView(Enum):
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+
+
+class Profiler:
+    def __init__(self, *, targets: Optional[Iterable] = None, scheduler=None,
+                 on_trace_ready: Optional[Callable] = None, timer_only: bool = False,
+                 record_shapes: bool = False, profile_memory: bool = False,
+                 with_flops: bool = False, emit_nvtx: bool = False):
+        self._scheduler = scheduler if callable(scheduler) else (
+            make_scheduler(*scheduler) if isinstance(scheduler, (tuple, list)) else None
+        )
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._step = 0
+        self._state = ProfilerState.CLOSED
+        self._host_events = []
+        self._jax_active = False
+        self._logdir = os.environ.get("PADDLE_TPU_PROFILE_DIR", "/tmp/paddle_tpu_profile")
+        self._step_times = []
+        self._last_step_t = None
+
+    # ---- lifecycle ----
+    def start(self):
+        RecordEvent._active_sink = self._host_events
+        self._last_step_t = time.perf_counter()
+        self._transition(self._scheduler(self._step) if self._scheduler else ProfilerState.RECORD)
+
+    def stop(self):
+        if self._jax_active:
+            jax.profiler.stop_trace()
+            self._jax_active = False
+        RecordEvent._active_sink = None
+        if self._on_trace_ready:
+            self._on_trace_ready(self)
+
+    def _transition(self, new_state: ProfilerState):
+        if self._timer_only:
+            self._state = new_state
+            return
+        if new_state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN) and not self._jax_active:
+            os.makedirs(self._logdir, exist_ok=True)
+            jax.profiler.start_trace(self._logdir)
+            self._jax_active = True
+        if new_state == ProfilerState.CLOSED and self._jax_active:
+            jax.profiler.stop_trace()
+            self._jax_active = False
+        self._state = new_state
+
+    def step(self, num_samples: Optional[int] = None):
+        now = time.perf_counter()
+        if self._last_step_t is not None:
+            self._step_times.append((now - self._last_step_t, num_samples))
+        self._last_step_t = now
+        self._step += 1
+        if self._scheduler:
+            self._transition(self._scheduler(self._step))
+
+    def step_info(self, unit: str = "samples") -> str:
+        if not self._step_times:
+            return "no steps recorded"
+        dts = [d for d, _ in self._step_times[-10:]]
+        avg = sum(dts) / len(dts)
+        info = f"avg step {avg*1e3:.2f} ms"
+        samples = [n for _, n in self._step_times[-10:] if n]
+        if samples:
+            ips = sum(samples) / sum(dts)
+            info += f", ips {ips:.2f} {unit}/s"
+        return info
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # ---- reporting ----
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False, time_unit="ms", views=None):
+        agg = defaultdict(lambda: [0, 0.0])
+        for name, _, dt in self._host_events:
+            agg[name][0] += 1
+            agg[name][1] += dt
+        lines = ["-" * 64, f"{'Event':<36}{'Calls':>8}{'Total(ms)':>12}", "-" * 64]
+        for name, (calls, total) in sorted(agg.items(), key=lambda kv: -kv[1][1]):
+            lines.append(f"{name:<36}{calls:>8}{total*1e3:>12.3f}")
+        if self._step_times:
+            lines.append("-" * 64)
+            lines.append(f"steps: {len(self._step_times)}  {self.step_info()}")
+        out = "\n".join(lines)
+        print(out)
+        return out
+
+    def _export_host_events(self, path: str):
+        events = [
+            {"name": name, "ph": "X", "pid": 0, "tid": 0,
+             "ts": t0 * 1e6, "dur": dt * 1e6}
+            for name, t0, dt in self._host_events
+        ]
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events}, f)
+
+    def export(self, path: str, format: str = "json"):  # noqa: A002
+        self._export_host_events(path)
+
+
+class benchmark:
+    """parity: paddle.profiler.benchmark timer (timer.py) — begin/step/end."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._times = []
+        self._t = None
+
+    def begin(self):
+        self._t = time.perf_counter()
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._t is not None:
+            self._times.append((now - self._t, num_samples))
+        self._t = now
+
+    def end(self):
+        self._t = None
+
+    def report(self):
+        if not self._times:
+            return {}
+        dts = [d for d, _ in self._times]
+        rep = {"avg_step_s": sum(dts) / len(dts), "steps": len(dts)}
+        samples = [n for _, n in self._times if n]
+        if samples:
+            rep["ips"] = sum(samples) / sum(dts)
+        return rep

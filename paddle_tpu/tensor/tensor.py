@@ -48,6 +48,7 @@ class Tensor:
         "is_parameter",
         "trainable",
         "_optimize_attrs",
+        "_dist_meta",
         "__weakref__",
     )
 
@@ -78,6 +79,7 @@ class Tensor:
         self.is_parameter = False
         self.trainable = True
         self._optimize_attrs = None
+        self._dist_meta = None
 
     # ---------------- meta ----------------
     @property

@@ -7,10 +7,17 @@ uses with its fake custom_cpu plugin device
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force CPU regardless of the shell's JAX_PLATFORMS (the dev shell points at a
+# tunneled TPU and its sitecustomize pins jax_platforms=axon,cpu in the CONFIG,
+# so the env var alone is not enough); opt out with PADDLE_TPU_TEST_ON_TPU=1
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+if os.environ.get("PADDLE_TPU_TEST_ON_TPU") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

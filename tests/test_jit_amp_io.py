@@ -1,0 +1,265 @@
+"""to_static / TrainStep / amp / DataLoader / save-load tests."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class SmallNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.drop = nn.Dropout(0.5)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(self.drop(F.relu(self.fc1(x))))
+
+
+class TestToStatic:
+    def test_forward_matches_eager(self):
+        net = SmallNet()
+        net.eval()
+        x = P.randn([4, 8])
+        eager = net(x).numpy()
+        static = P.jit.to_static(net)(x).numpy()
+        np.testing.assert_allclose(eager, static, rtol=1e-4, atol=1e-5)
+
+    def test_backward_matches_eager(self):
+        net = SmallNet()
+        net.eval()
+        x = P.randn([4, 8])
+        net(x).sum().backward()
+        eager_grad = net.fc1.weight.grad.numpy().copy()
+        net.clear_gradients()
+        P.jit.to_static(net)(x).sum().backward()
+        np.testing.assert_allclose(net.fc1.weight.grad.numpy(), eager_grad, rtol=1e-3, atol=1e-5)
+
+    def test_guard_cache_respecialization(self):
+        net = SmallNet()
+        net.eval()
+        sf = P.jit.to_static(net)
+        sf(P.randn([2, 8]))
+        sf(P.randn([4, 8]))
+        assert len(sf._cache) == 2  # two shape specializations
+        sf(P.randn([2, 8]))
+        assert len(sf._cache) == 2  # cache hit
+
+    def test_training_flag_respecializes(self):
+        net = SmallNet()
+        sf = P.jit.to_static(net)
+        net.train()
+        a = sf(P.ones([2, 8]))
+        net.eval()
+        b = sf(P.ones([2, 8]))
+        assert len(sf._cache) == 2
+        # eval is deterministic
+        c = sf(P.ones([2, 8]))
+        np.testing.assert_allclose(b.numpy(), c.numpy())
+
+    def test_compiled_dropout_rerandomizes(self):
+        net = SmallNet()
+        net.train()
+        sf = P.jit.to_static(net)
+        a = sf(P.ones([4, 8])).numpy()
+        b = sf(P.ones([4, 8])).numpy()
+        assert not np.allclose(a, b)
+
+    def test_param_update_visible_to_compiled_fn(self):
+        net = nn.Linear(2, 2, bias_attr=False)
+        net.eval()
+        sf = P.jit.to_static(net)
+        x = P.ones([1, 2])
+        y1 = sf(x).numpy()
+        net.weight.set_value(net.weight.numpy() * 2)
+        y2 = sf(x).numpy()
+        np.testing.assert_allclose(y2, y1 * 2, rtol=1e-5)
+
+    def test_plain_function(self):
+        @P.jit.to_static
+        def f(a, b):
+            return P.matmul(a, b) + 1
+
+        x, y = P.randn([3, 4]), P.randn([4, 5])
+        np.testing.assert_allclose(
+            f(x, y).numpy(), (P.matmul(x, y) + 1).numpy(), rtol=1e-4, atol=1e-5
+        )
+
+
+class TestTrainStep:
+    def test_compiled_training_converges(self):
+        P.seed(3)
+        net = nn.Linear(2, 1)
+        opt = P.optimizer.Adam(learning_rate=0.05, parameters=net.parameters())
+        step = P.jit.TrainStep(net, lambda m, x, y: F.mse_loss(m(x), y), opt)
+        X = np.random.randn(128, 2).astype(np.float32)
+        Y = X @ np.array([[1.5], [-2.0]], np.float32) + 0.5
+        for _ in range(250):
+            loss = step(P.to_tensor(X), P.to_tensor(Y))
+        step.sync_to_model()
+        np.testing.assert_allclose(net.weight.numpy().reshape(-1), [1.5, -2.0], atol=0.05)
+        assert float(loss.numpy()) < 1e-3
+
+    def test_grad_clip_in_trainstep(self):
+        net = nn.Linear(2, 1)
+        opt = P.optimizer.SGD(0.1, parameters=net.parameters(),
+                              grad_clip=nn.ClipGradByGlobalNorm(0.01))
+        step = P.jit.TrainStep(net, lambda m, x, y: F.mse_loss(m(x), y), opt)
+        w0 = net.weight.numpy().copy()
+        step(P.ones([4, 2]), P.full([4, 1], 100.0))
+        step.sync_to_model()
+        # update magnitude bounded by lr * clip_norm
+        assert np.abs(net.weight.numpy() - w0).max() <= 0.1 * 0.01 + 1e-6
+
+
+class TestAmp:
+    def test_o1_white_black(self):
+        with P.amp.auto_cast(level="O1"):
+            y = P.matmul(P.randn([4, 4]), P.randn([4, 4]))
+            assert y.dtype == P.bfloat16
+            z = P.exp(y)
+            assert z.dtype == P.float32
+        y2 = P.matmul(P.randn([4, 4]), P.randn([4, 4]))
+        assert y2.dtype == P.float32
+
+    def test_o2_casts_everything_but_black(self):
+        with P.amp.auto_cast(level="O2"):
+            s = P.add(P.randn([4]), P.randn([4]))
+            assert s.dtype == P.bfloat16
+
+    def test_grad_scaler_skips_inf(self):
+        w = P.to_tensor([1.0], stop_gradient=False)
+        w.is_parameter = True
+        opt = P.optimizer.SGD(0.1, parameters=[w])
+        scaler = P.amp.GradScaler(init_loss_scaling=2.0)
+        loss = w * float("inf")
+        scaler.scale(loss).backward()
+        scaler.step(opt)
+        scaler.update()
+        assert float(w.numpy()) == 1.0  # step skipped
+        assert scaler.get_loss_scaling() == 1.0  # halved and floored
+
+    def test_grad_scaler_normal_step(self):
+        w = P.to_tensor([1.0], stop_gradient=False)
+        w.is_parameter = True
+        opt = P.optimizer.SGD(0.1, parameters=[w])
+        scaler = P.amp.GradScaler(init_loss_scaling=8.0)
+        loss = (w * 3.0).sum()
+        scaler.scale(loss).backward()
+        scaler.step(opt)
+        scaler.update()
+        np.testing.assert_allclose(float(w.numpy()), 1.0 - 0.1 * 3.0, rtol=1e-5)
+
+    def test_decorate_o2(self):
+        net = nn.Sequential(nn.Linear(4, 4), nn.LayerNorm(4))
+        opt = P.optimizer.Adam(parameters=net.parameters())
+        net, opt = P.amp.decorate(net, opt, level="O2")
+        assert net[0].weight.dtype == P.bfloat16
+        assert net[1].weight.dtype == P.float32  # norms stay fp32
+        assert opt._multi_precision
+
+
+class TestDataLoader:
+    def test_basic_iteration(self):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class DS(Dataset):
+            def __len__(self):
+                return 10
+
+            def __getitem__(self, i):
+                return np.full((3,), i, np.float32), i
+
+        dl = DataLoader(DS(), batch_size=4, drop_last=False)
+        batches = list(dl)
+        assert len(batches) == 3
+        x, y = batches[0]
+        assert x.shape == [4, 3]
+        assert y.tolist() == [0, 1, 2, 3]
+
+    def test_shuffle_and_workers(self):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class DS(Dataset):
+            def __len__(self):
+                return 32
+
+            def __getitem__(self, i):
+                return np.asarray([i], np.float32)
+
+        dl = DataLoader(DS(), batch_size=8, shuffle=True, num_workers=2)
+        seen = np.sort(np.concatenate([b.numpy().reshape(-1) for b in dl]))
+        np.testing.assert_array_equal(seen, np.arange(32))
+
+    def test_tensor_dataset_and_split(self):
+        from paddle_tpu.io import TensorDataset, random_split
+
+        ds = TensorDataset([P.randn([10, 2]), P.arange(10)])
+        a, b = random_split(ds, [7, 3])
+        assert len(a) == 7 and len(b) == 3
+
+    def test_distributed_batch_sampler(self):
+        from paddle_tpu.io import Dataset, DistributedBatchSampler
+
+        class DS(Dataset):
+            def __len__(self):
+                return 10
+
+            def __getitem__(self, i):
+                return i
+
+        s0 = DistributedBatchSampler(DS(), batch_size=2, num_replicas=2, rank=0)
+        s1 = DistributedBatchSampler(DS(), batch_size=2, num_replicas=2, rank=1)
+        i0 = [i for b in s0 for i in b]
+        i1 = [i for b in s1 for i in b]
+        assert len(i0) == len(i1) == 5
+        assert set(i0 + i1) == set(range(10))
+
+
+class TestSaveLoad:
+    def test_paddle_save_load_state_dict(self, tmp_path):
+        net = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+        path = str(tmp_path / "model.pdparams")
+        P.save(net.state_dict(), path)
+        loaded = P.load(path)
+        net2 = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+        net2.set_state_dict(loaded)
+        x = P.randn([2, 4])
+        np.testing.assert_allclose(net(x).numpy(), net2(x).numpy(), rtol=1e-5)
+
+    def test_save_load_optimizer(self, tmp_path):
+        net = nn.Linear(2, 2)
+        opt = P.optimizer.Adam(parameters=net.parameters())
+        net(P.ones([1, 2])).sum().backward()
+        opt.step()
+        path = str(tmp_path / "opt.pdopt")
+        P.save(opt.state_dict(), path)
+        st = P.load(path)
+        assert any("moment1" in k for k in st)
+
+    def test_save_nested_objects(self, tmp_path):
+        obj = {"epoch": 5, "tensors": [P.ones([2]), P.zeros([3])], "meta": {"lr": 0.1}}
+        path = str(tmp_path / "ckpt")
+        P.save(obj, path)
+        back = P.load(path)
+        assert back["epoch"] == 5 and back["meta"]["lr"] == 0.1
+        np.testing.assert_array_equal(back["tensors"][0].numpy(), np.ones(2))
+
+    def test_jit_save(self, tmp_path):
+        net = SmallNet()
+        net.eval()
+        path = str(tmp_path / "inference/model")
+        P.jit.save(net, path, input_spec=[P.jit.InputSpec([1, 8], "float32")])
+        assert os.path.exists(path + ".pdiparams.npz")
+        assert os.path.exists(path + ".pdmodel.json")
+        assert os.path.exists(path + ".stablehlo")
+        loaded = P.jit.load(path)
+        net2 = SmallNet()
+        loaded.set_onto(net2)
+        x = P.randn([2, 8])
+        np.testing.assert_allclose(net(x).numpy(), net2.eval()(x).numpy() if callable(net2) else None, rtol=1e-5)

@@ -1,0 +1,319 @@
+"""nn layer tests (reference analog: test/legacy_test per-layer tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class TestFunctional:
+    def test_activations(self):
+        x = P.to_tensor(np.linspace(-3, 3, 13).astype(np.float32))
+        a = x.numpy()
+        np.testing.assert_allclose(F.relu(x).numpy(), np.maximum(a, 0))
+        np.testing.assert_allclose(F.sigmoid(x).numpy(), 1 / (1 + np.exp(-a)), rtol=1e-3, atol=1e-5)
+        np.testing.assert_allclose(F.softmax(x).numpy().sum(), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(F.leaky_relu(x, 0.1).numpy(), np.where(a > 0, a, 0.1 * a), rtol=1e-5)
+        np.testing.assert_allclose(F.silu(x).numpy(), a / (1 + np.exp(-a)), rtol=1e-3, atol=1e-5)
+        g = F.gelu(x).numpy()
+        assert g[0] < 0.01 and abs(g[-1] - 3) < 0.01
+
+    def test_linear(self):
+        x = np.random.randn(4, 8).astype(np.float32)
+        w = np.random.randn(8, 3).astype(np.float32)
+        b = np.random.randn(3).astype(np.float32)
+        out = F.linear(P.to_tensor(x), P.to_tensor(w), P.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), x @ w + b, rtol=1e-4, atol=1e-5)
+
+    def test_conv2d_identity(self):
+        x = np.random.randn(1, 1, 5, 5).astype(np.float32)
+        w = np.zeros((1, 1, 3, 3), np.float32)
+        w[0, 0, 1, 1] = 1.0  # identity kernel
+        out = F.conv2d(P.to_tensor(x), P.to_tensor(w), padding=1)
+        np.testing.assert_allclose(out.numpy(), x, rtol=1e-5)
+
+    def test_conv2d_vs_manual(self):
+        x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+        w = np.random.randn(4, 3, 3, 3).astype(np.float32)
+        out = F.conv2d(P.to_tensor(x), P.to_tensor(w), stride=2, padding=1)
+        assert out.shape == [2, 4, 4, 4]
+        # spot check one output position vs manual correlation
+        xp = np.pad(x, [(0, 0), (0, 0), (1, 1), (1, 1)])
+        manual = (xp[0, :, 0:3, 0:3] * w[1]).sum()
+        np.testing.assert_allclose(out.numpy()[0, 1, 0, 0], manual, rtol=1e-3)
+
+    def test_pools(self):
+        x = P.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        mp = F.max_pool2d(x, 2, 2)
+        np.testing.assert_allclose(mp.numpy()[0, 0], [[5, 7], [13, 15]])
+        ap = F.avg_pool2d(x, 2, 2)
+        np.testing.assert_allclose(ap.numpy()[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+        aap = F.adaptive_avg_pool2d(x, 1)
+        np.testing.assert_allclose(aap.numpy()[0, 0], [[7.5]])
+
+    def test_layer_norm(self):
+        x = np.random.randn(4, 10).astype(np.float32)
+        out = F.layer_norm(P.to_tensor(x), 10)
+        np.testing.assert_allclose(out.numpy().mean(-1), np.zeros(4), atol=1e-5)
+        np.testing.assert_allclose(out.numpy().std(-1), np.ones(4), atol=1e-2)
+
+    def test_rms_norm(self):
+        x = np.random.randn(4, 16).astype(np.float32)
+        w = np.ones(16, np.float32) * 2
+        out = F.rms_norm(P.to_tensor(x), P.to_tensor(w))
+        expected = x / np.sqrt((x**2).mean(-1, keepdims=True) + 1e-6) * 2
+        np.testing.assert_allclose(out.numpy(), expected, rtol=1e-3, atol=1e-4)
+
+    def test_dropout_train_eval(self):
+        x = P.ones([1000])
+        out_t = F.dropout(x, 0.5, training=True)
+        zeros = (out_t.numpy() == 0).mean()
+        assert 0.3 < zeros < 0.7
+        nz = out_t.numpy()[out_t.numpy() != 0]
+        np.testing.assert_allclose(nz, np.full_like(nz, 2.0))  # upscale_in_train
+        out_e = F.dropout(x, 0.5, training=False)
+        np.testing.assert_allclose(out_e.numpy(), np.ones(1000))
+
+    def test_embedding(self):
+        w = np.random.randn(10, 4).astype(np.float32)
+        out = F.embedding(P.to_tensor([1, 3]), P.to_tensor(w))
+        np.testing.assert_allclose(out.numpy(), w[[1, 3]])
+
+    def test_cross_entropy(self):
+        logits = np.random.randn(8, 5).astype(np.float32)
+        labels = np.random.randint(0, 5, 8)
+        loss = F.cross_entropy(P.to_tensor(logits), P.to_tensor(labels))
+        # manual
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        expected = -np.log(p[np.arange(8), labels]).mean()
+        np.testing.assert_allclose(float(loss.numpy()), expected, rtol=1e-4)
+
+    def test_cross_entropy_options(self):
+        logits = np.random.randn(8, 5).astype(np.float32)
+        labels = np.random.randint(0, 5, 8)
+        l_none = F.cross_entropy(P.to_tensor(logits), P.to_tensor(labels), reduction="none")
+        assert l_none.shape == [8]
+        soft = np.full((8, 5), 0.2, np.float32)
+        l_soft = F.cross_entropy(P.to_tensor(logits), P.to_tensor(soft), soft_label=True)
+        assert l_soft.numpy() > 0
+        labels2 = labels.copy()
+        labels2[0] = -100
+        l_ign = F.cross_entropy(P.to_tensor(logits), P.to_tensor(labels2), ignore_index=-100)
+        assert np.isfinite(float(l_ign.numpy()))
+
+    def test_losses(self):
+        a = np.random.randn(6).astype(np.float32)
+        b = np.random.randn(6).astype(np.float32)
+        np.testing.assert_allclose(
+            float(F.mse_loss(P.to_tensor(a), P.to_tensor(b)).numpy()), ((a - b) ** 2).mean(), rtol=1e-4
+        )
+        np.testing.assert_allclose(
+            float(F.l1_loss(P.to_tensor(a), P.to_tensor(b)).numpy()), np.abs(a - b).mean(), rtol=1e-4
+        )
+        p = 1 / (1 + np.exp(-a))
+        y = (np.random.rand(6) > 0.5).astype(np.float32)
+        bce = F.binary_cross_entropy(P.to_tensor(p), P.to_tensor(y))
+        bcel = F.binary_cross_entropy_with_logits(P.to_tensor(a), P.to_tensor(y))
+        np.testing.assert_allclose(float(bce.numpy()), float(bcel.numpy()), rtol=1e-3)
+
+    def test_attention(self):
+        q = np.random.randn(2, 6, 2, 8).astype(np.float32)
+        out = F.scaled_dot_product_attention(P.to_tensor(q), P.to_tensor(q), P.to_tensor(q))
+        assert out.shape == [2, 6, 2, 8]
+        out_c = F.scaled_dot_product_attention(P.to_tensor(q), P.to_tensor(q), P.to_tensor(q), is_causal=True)
+        assert not np.allclose(out.numpy(), out_c.numpy())
+        fa, _ = F.flash_attention(P.to_tensor(q), P.to_tensor(q), P.to_tensor(q), causal=True)
+        np.testing.assert_allclose(fa.numpy(), out_c.numpy(), rtol=1e-3, atol=1e-4)
+
+    def test_pad_interpolate(self):
+        x = P.ones([1, 1, 2, 2])
+        p = F.pad(x, [1, 1, 1, 1])
+        assert p.shape == [1, 1, 4, 4]
+        assert p.numpy().sum() == 4
+        up = F.interpolate(x, scale_factor=2, mode="nearest")
+        assert up.shape == [1, 1, 4, 4]
+        assert up.numpy().sum() == 16
+
+
+class TestLayers:
+    def test_layer_registry(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 3)
+                self.act = nn.ReLU()
+
+            def forward(self, x):
+                return self.act(self.fc(x))
+
+        net = Net()
+        params = net.parameters()
+        assert len(params) == 2  # weight + bias
+        names = [n for n, _ in net.named_parameters()]
+        assert "fc.weight" in names and "fc.bias" in names
+        assert len(list(net.sublayers())) == 2
+
+    def test_state_dict_roundtrip(self):
+        net1 = nn.Linear(3, 2)
+        net2 = nn.Linear(3, 2)
+        assert not np.allclose(net1.weight.numpy(), net2.weight.numpy())
+        missing, unexpected = net2.set_state_dict(net1.state_dict())
+        assert not missing and not unexpected
+        np.testing.assert_array_equal(net1.weight.numpy(), net2.weight.numpy())
+
+    def test_train_eval_mode(self):
+        net = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
+        net.eval()
+        assert not net[1].training
+        net.train()
+        assert net[1].training
+
+    def test_batchnorm_running_stats(self):
+        bn = nn.BatchNorm1D(4, data_format="NCL")
+        x = P.to_tensor(np.random.randn(16, 4).astype(np.float32) * 3 + 5)
+        bn.train()
+        _ = bn(x)
+        m = bn._buffers["_mean"].numpy()
+        assert np.all(m != 0)  # running mean moved toward ~5*0.1
+        bn.eval()
+        out = bn(x)
+        assert out.shape == [16, 4]
+
+    def test_sequential_containers(self):
+        seq = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        out = seq(P.randn([3, 4]))
+        assert out.shape == [3, 2]
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        assert len(ll) == 3 and len(ll[0].parameters()) == 2
+        pl = nn.ParameterList([nn.Linear(2, 2).weight for _ in range(2)])
+        assert len(list(pl)) == 2
+
+    def test_hooks(self):
+        net = nn.Linear(2, 2)
+        calls = []
+        h = net.register_forward_post_hook(lambda layer, inp, out: calls.append(1))
+        net(P.ones([1, 2]))
+        assert calls == [1]
+        h.remove()
+        net(P.ones([1, 2]))
+        assert calls == [1]
+
+    def test_embedding_layer_padding(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        out = emb(P.to_tensor([0, 1]))
+        assert np.allclose(out.numpy()[0], 0)
+
+    def test_transformer_encoder(self):
+        enc_layer = nn.TransformerEncoderLayer(d_model=16, nhead=2, dim_feedforward=32)
+        enc = nn.TransformerEncoder(enc_layer, 2)
+        out = enc(P.randn([2, 5, 16]))
+        assert out.shape == [2, 5, 16]
+        # distinct layers (deepcopy) should have independent params
+        p0 = enc.layers[0].linear1.weight
+        p1 = enc.layers[1].linear1.weight
+        assert p0 is not p1
+
+    def test_mha_self_attention_grad(self):
+        mha = nn.MultiHeadAttention(8, 2)
+        x = P.randn([2, 4, 8])
+        x.stop_gradient = False
+        out = mha(x)
+        out.sum().backward()
+        assert x.grad is not None and mha.q_proj.weight.grad is not None
+
+    def test_lstm(self):
+        lstm = nn.LSTM(input_size=4, hidden_size=8, num_layers=2)
+        out, (h, c) = lstm(P.randn([3, 6, 4]))
+        assert out.shape == [3, 6, 8]
+        assert h.shape == [2, 3, 8] and c.shape == [2, 3, 8]
+        out.sum().backward()
+        assert lstm._parameters["weight_ih_l0"].grad is not None
+
+    def test_gru_bidirect(self):
+        gru = nn.GRU(input_size=4, hidden_size=8, direction="bidirect")
+        out, h = gru(P.randn([2, 5, 4]))
+        assert out.shape == [2, 5, 16]
+
+    def test_grad_clip_global_norm(self):
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        p = P.to_tensor([3.0, 4.0], stop_gradient=False)
+        g = P.to_tensor([30.0, 40.0])
+        (_, clipped), = clip([(p, g)])
+        np.testing.assert_allclose(np.linalg.norm(clipped.numpy()), 1.0, rtol=1e-5)
+
+
+class TestOptimizers:
+    def _quad_fit(self, make_opt, steps=120, tol=0.05):
+        P.seed(7)
+        w = P.to_tensor([5.0], stop_gradient=False)
+        w.is_parameter = True
+        opt = make_opt([w])
+        for _ in range(steps):
+            loss = (w * w).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert abs(float(w.numpy())) < tol, float(w.numpy())
+
+    def test_sgd(self):
+        self._quad_fit(lambda ps: P.optimizer.SGD(0.1, parameters=ps))
+
+    def test_momentum(self):
+        self._quad_fit(lambda ps: P.optimizer.Momentum(0.05, 0.9, parameters=ps))
+
+    def test_adam(self):
+        self._quad_fit(lambda ps: P.optimizer.Adam(0.2, parameters=ps))
+
+    def test_adamw(self):
+        self._quad_fit(lambda ps: P.optimizer.AdamW(0.2, parameters=ps))
+
+    def test_rmsprop(self):
+        self._quad_fit(lambda ps: P.optimizer.RMSProp(0.05, parameters=ps), steps=400, tol=0.1)
+
+    def test_adagrad(self):
+        self._quad_fit(lambda ps: P.optimizer.Adagrad(0.9, parameters=ps), steps=250)
+
+    def test_lamb(self):
+        self._quad_fit(lambda ps: P.optimizer.Lamb(0.05, parameters=ps), steps=300, tol=0.2)
+
+    def test_optimizer_state_roundtrip(self):
+        w = P.to_tensor([1.0], stop_gradient=False)
+        w.is_parameter = True
+        w.name = "w"
+        opt = P.optimizer.Adam(0.1, parameters=[w])
+        (w * w).sum().backward()
+        opt.step()
+        state = opt.state_dict()
+        w2 = P.to_tensor([1.0], stop_gradient=False)
+        w2.is_parameter = True
+        w2.name = "w"
+        opt2 = P.optimizer.Adam(0.1, parameters=[w2])
+        opt2.set_state_dict(state)
+        assert np.allclose(
+            opt2._accumulators["moment1"][id(w2)], opt._accumulators["moment1"][id(w)]
+        )
+
+    def test_lr_scheduler_integration(self):
+        sched = P.optimizer.lr.StepDecay(learning_rate=0.1, step_size=2, gamma=0.5)
+        w = P.to_tensor([1.0], stop_gradient=False)
+        w.is_parameter = True
+        opt = P.optimizer.SGD(sched, parameters=[w])
+        assert opt.get_lr() == 0.1
+        sched.step()
+        sched.step()
+        assert opt.get_lr() == 0.05
+
+    def test_schedulers_values(self):
+        lr = P.optimizer.lr.CosineAnnealingDecay(1.0, T_max=10)
+        vals = []
+        for _ in range(11):
+            vals.append(lr())
+            lr.step()
+        assert abs(vals[0] - 1.0) < 1e-6 and vals[10] < 1e-6
+        warm = P.optimizer.lr.LinearWarmup(1.0, warmup_steps=10, start_lr=0.0, end_lr=1.0)
+        assert warm() < 0.2
+        for _ in range(12):
+            warm.step()
+        assert abs(warm() - 1.0) < 1e-6

@@ -205,7 +205,7 @@ class TestLogicSearch:
         a = np.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]], np.float32)
         x = P.to_tensor(a)
         assert P.argmax(x, axis=1).tolist() == [0, 1]
-        assert P.argmin(x, axis=0).tolist() == [1, 0, 1]
+        assert P.argmin(x, axis=0).tolist() == [1, 0, 0]
         vals, idx = P.topk(x, 2, axis=1)
         check(vals, np.sort(a, 1)[:, ::-1][:, :2])
         srt = P.sort(x, axis=1)

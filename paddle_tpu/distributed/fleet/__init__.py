@@ -14,6 +14,7 @@ from ..topology import (
 )
 from .distributed_strategy import DistributedStrategy
 from . import mp_layers  # noqa: F401
+from . import meta_parallel  # noqa: F401
 from .mp_layers import (  # noqa: F401
     ColumnParallelLinear,
     ParallelCrossEntropy,
@@ -78,10 +79,13 @@ def distributed_model(model):
     gradient sync is a by-product of batch sharding under pjit, so the wrapper
     annotates inputs with dp sharding; TP layers already carry mp shardings."""
     from ..parallel import DataParallel
+    from .meta_parallel import PipelineLayer, PipelineParallel
 
     hcg = get_hybrid_communicate_group()
     if hcg is None:
         return model
+    if isinstance(model, PipelineLayer) and hcg.axis_size("pp") > 1:
+        return PipelineParallel(model, hcg, _strategy)
     if hcg.axis_size("dp") > 1 or hcg.axis_size("sharding") > 1:
         return DataParallel(model)
     return model

@@ -1,0 +1,222 @@
+"""Pipeline model description (parity:
+/root/reference/python/paddle/distributed/fleet/meta_parallel/parallel_layers/pp_layers.py —
+LayerDesc:56, SharedLayerDesc:76, SegmentLayers:92, PipelineLayer:257).
+
+TPU-native placement: single-controller SPMD sees every stage, so
+PipelineLayer builds ALL stages and pins each stage's parameters onto that
+stage's slice of the 'pp' mesh axis (a per-stage submesh NamedSharding).
+SharedLayerDesc's cross-stage weight sharing (tied embeddings) becomes literal
+object sharing — no broadcast/allreduce bookkeeping needed.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ....nn.layer.layers import Layer
+from ...topology import get_hybrid_communicate_group
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "SegmentLayers", "PipelineLayer"]
+
+
+class LayerDesc:
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer):
+            raise TypeError("LayerDesc expects a Layer subclass")
+
+    def build_layer(self) -> Layer:
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_func, forward_func=None, shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """parity: SegmentLayers:92 — split N layer descs into num_parts segments,
+    uniformly or by a seg_method ('layer:<ClassName>' cuts at class
+    occurrences, 'uniform' by count)."""
+
+    def __init__(self, layers_desc, num_parts, method="uniform", num_virtual_pipeline_stage=None):
+        self.layers_desc = layers_desc
+        self.num_items = len(layers_desc)
+        self.num_parts = num_parts
+        self.method = method
+        assert self.num_items >= self.num_parts
+
+    def do_segment(self) -> List[int]:
+        if self.method == "uniform":
+            return self._uniform(self.num_items, self.num_parts)
+        if self.method.startswith("layer:"):
+            cls_name = self.method.split(":", 1)[1]
+            marks = [
+                i for i, d in enumerate(self.layers_desc)
+                if (d.layer_func.__name__ if isinstance(d, LayerDesc) else type(d).__name__) == cls_name
+            ]
+            if len(marks) >= self.num_parts:
+                # segment boundaries fall on marked-layer starts, spread evenly
+                chunks = np.array_split(marks, self.num_parts)
+                return [0] + [int(c[0]) for c in chunks[1:]] + [self.num_items]
+        return self._uniform(self.num_items, self.num_parts)
+
+    @staticmethod
+    def _uniform(n, parts) -> List[int]:
+        base, extra = divmod(n, parts)
+        bounds = [0]
+        for i in range(parts):
+            bounds.append(bounds[-1] + base + (1 if i < extra else 0))
+        return bounds
+
+
+class PipelineLayer(Layer):
+    """parity: PipelineLayer:257 — sequential model described by layer descs,
+    segmented into pp stages."""
+
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0, recompute_ctx=None,
+                 num_virtual_pipeline_stages=None):
+        super().__init__()
+        hcg = get_hybrid_communicate_group()
+        if num_stages is None:
+            num_stages = hcg.axis_size("pp") if hcg is not None else 1
+        self._num_stages = num_stages
+        self._loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
+        self._descs = list(layers)
+        seg = SegmentLayers(self._descs, num_stages, seg_method)
+        self.segment_parts = seg.do_segment()
+        self._shared_layers: Dict[str, Layer] = {}
+        self._stage_layers: List[List] = []
+        self._stage_fwd_funcs: List[List] = []
+        from ....nn.layer.container import LayerList
+
+        all_built = []
+        for s in range(num_stages):
+            stage = []
+            fwd_funcs = []
+            for i in range(self.segment_parts[s], self.segment_parts[s + 1]):
+                desc = self._descs[i]
+                if isinstance(desc, SharedLayerDesc):
+                    if desc.layer_name not in self._shared_layers:
+                        self._shared_layers[desc.layer_name] = desc.build_layer()
+                    layer = self._shared_layers[desc.layer_name]
+                    fwd_funcs.append(desc.forward_func)
+                elif isinstance(desc, LayerDesc):
+                    layer = desc.build_layer()
+                    fwd_funcs.append(None)
+                elif isinstance(desc, Layer):
+                    layer = desc
+                    fwd_funcs.append(None)
+                elif callable(desc):
+                    stage.append(desc)
+                    fwd_funcs.append("plain_fn")
+                    continue
+                else:
+                    raise TypeError(f"unsupported layer desc: {desc}")
+                stage.append(layer)
+            self._stage_layers.append(stage)
+            self._stage_fwd_funcs.append(fwd_funcs)
+            built = LayerList([l for l in stage if isinstance(l, Layer)])
+            all_built.append(built)
+            self.add_sublayer(f"stage_{s}", built)
+        self._submeshes = [self._stage_submesh(s) for s in range(num_stages)]
+        self._place_stages()
+
+    # ---------------------------------------------------------------- place
+    def _stage_submesh(self, stage: int) -> Optional[Mesh]:
+        hcg = get_hybrid_communicate_group()
+        if hcg is None or hcg.axis_size("pp") == 1:
+            return None
+        mesh = hcg.mesh
+        pp_index = mesh.axis_names.index("pp")
+        devs = np.take(mesh.devices, stage, axis=pp_index)
+        names = tuple(n for n in mesh.axis_names if n != "pp")
+        return Mesh(devs, names)
+
+    def _place_stages(self):
+        for s in range(self._num_stages):
+            sub = self._submeshes[s]
+            if sub is None:
+                continue
+            for layer in self._stage_layers[s]:
+                if not isinstance(layer, Layer):
+                    continue
+                for p in layer.parameters():
+                    if isinstance(p._value, jax.core.Tracer):
+                        continue
+                    # keep any existing mp sharding dims, restricted to this
+                    # stage's submesh
+                    try:
+                        old_spec = p._value.sharding.spec
+                    except Exception:
+                        old_spec = None
+                    spec = PartitionSpec(*[
+                        e if e in sub.axis_names or (isinstance(e, tuple)) else None
+                        for e in (old_spec or [None] * p.ndim)
+                    ]) if old_spec else PartitionSpec(*([None] * p.ndim))
+                    p._value = jax.device_put(p._value, NamedSharding(sub, spec))
+                    p._pp_stage = s  # type: ignore[attr-defined]
+
+    # ---------------------------------------------------------------- run
+    @property
+    def num_stages(self) -> int:
+        return self._num_stages
+
+    def get_stage_from_index(self, layer_idx: int) -> int:
+        for s in range(self._num_stages):
+            if self.segment_parts[s] <= layer_idx < self.segment_parts[s + 1]:
+                return s
+        raise IndexError(layer_idx)
+
+    def forward_stage(self, x, stage: int):
+        """Run one stage's chain; input is moved onto the stage submesh by a
+        TAPED device_put (the ICI hop that p2p send/recv does in the
+        reference) — its vjp moves the cotangent back to the previous stage.
+        The batch dim keeps its dp/sharding split on the submesh so dp×pp
+        composes (data parallelism inside each stage)."""
+        sub = self._submeshes[stage]
+        from ....tensor.tensor import Tensor
+
+        if sub is not None and isinstance(x, Tensor) and not isinstance(x._value, jax.core.Tracer):
+            from ....ops.dispatch import apply
+
+            batch_axes = tuple(a for a in ("dp", "sharding")
+                               if a in sub.axis_names and sub.shape[a] > 1)
+            b_entry = batch_axes if len(batch_axes) > 1 else (batch_axes[0] if batch_axes else None)
+            sharding = NamedSharding(sub, PartitionSpec(b_entry, *([None] * (x.ndim - 1))))
+            x = apply(lambda v: jax.device_put(v, sharding), x, op_name="pp_transfer")
+        for layer, ffunc in zip(self._stage_layers[stage], self._stage_fwd_funcs[stage]):
+            if ffunc == "plain_fn":
+                x = layer(x)
+            elif ffunc is not None:
+                x = ffunc(layer, x)
+            else:
+                x = layer(x)
+        return x
+
+    def forward(self, x):
+        for s in range(self._num_stages):
+            x = self.forward_stage(x, s)
+        return x
+
+    def loss_fn(self, output, label):
+        if self._loss_fn is None:
+            raise RuntimeError("PipelineLayer built without loss_fn")
+        return self._loss_fn(output, label)
+
+    def get_shared_layer(self, key: str) -> Layer:
+        return self._shared_layers[key]

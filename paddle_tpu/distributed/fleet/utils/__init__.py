@@ -1,0 +1,3 @@
+"""fleet.utils (parity: python/paddle/distributed/fleet/utils)."""
+from . import sequence_parallel_utils  # noqa: F401
+from .recompute import recompute, recompute_sequential  # noqa: F401

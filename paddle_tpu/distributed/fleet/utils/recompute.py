@@ -1,0 +1,141 @@
+"""Recompute / activation checkpointing (parity:
+/root/reference/python/paddle/distributed/fleet/recompute/recompute.py:423
+paddle.distributed.fleet.utils.recompute).
+
+TPU-native: in the eager tape, recompute wraps the function so only its INPUTS
+are saved; the backward replays the forward under jax.vjp at backward time
+(exactly the reference's RecomputeFunction PyLayer). Inside jit/TrainStep,
+``jax.checkpoint`` (remat) does the same at the XLA level — ``recompute``
+detects tracing and switches.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+
+from ....autograd import tape
+from ....ops.dispatch import apply
+from ....tensor.tensor import Tensor
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def recompute(function: Callable, *args, **kwargs):
+    use_reentrant = kwargs.pop("use_reentrant", True)
+    preserve_rng_state = kwargs.pop("preserve_rng_state", True)
+
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    other = [(i, a) for i, a in enumerate(args) if not isinstance(a, Tensor)]
+    tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+
+    from ....framework.random import default_generator
+
+    in_trace = any(isinstance(t._value, jax.core.Tracer) for t in tensor_args)
+    # Inside a jit trace the TraceContext owns randomness (traced keys) and the
+    # global generator must not be touched — storing trace-scoped keys on it
+    # would leak tracers.
+    rng_snapshot = default_generator().get_state() if (preserve_rng_state and not in_trace) else None
+
+    def rebuild(vals):
+        full = [None] * len(args)
+        for (i, a) in other:
+            full[i] = a
+        for i, v in zip(tensor_idx, vals):
+            full[i] = Tensor(v, stop_gradient=False)
+        return full
+
+    def pure_fn(*vals):
+        gen = default_generator()
+        if rng_snapshot is not None:
+            saved = gen.get_state()
+            gen.set_state(rng_snapshot)
+        try:
+            out = function(*rebuild(list(vals)), **kwargs)
+        finally:
+            if rng_snapshot is not None:
+                gen.set_state(saved)
+        if isinstance(out, (tuple, list)):
+            return tuple(o._value if isinstance(o, Tensor) else o for o in out)
+        return out._value if isinstance(out, Tensor) else out
+
+    if in_trace:
+        # inside jit: lean on XLA remat
+        fn = jax.checkpoint(pure_fn)
+        return apply(fn, *tensor_args, op_name="recompute")
+
+    # eager: run forward under no_grad (saves nothing but inputs), tape a node
+    # whose vjp replays the forward
+    with tape.no_grad():
+        out_vals = pure_fn(*[t._value for t in tensor_args])
+    multi = isinstance(out_vals, tuple)
+    outs_seq = list(out_vals) if multi else [out_vals]
+
+    needs = tape.grad_enabled()
+    if not needs:
+        outs = [Tensor(v, stop_gradient=True) for v in outs_seq]
+        return tuple(outs) if multi else outs[0]
+
+    in_vals = tuple(t._value for t in tensor_args)
+
+    def vjp_fn(cots):
+        # Replay the forward under the TAPE (grad enabled) so closure-captured
+        # parameters accumulate .grad exactly like the reference's
+        # RecomputeFunction backward; input cotangents are returned to the
+        # outer tape.
+        gen_state = None
+        if rng_snapshot is not None:
+            from ....framework.random import default_generator
+
+            gen = default_generator()
+            gen_state = gen.get_state()
+            gen.set_state(rng_snapshot)
+        try:
+            with tape.enable_grad():
+                replay_ins = [
+                    Tensor(v, stop_gradient=t.stop_gradient)
+                    for t, v in zip(tensor_args, in_vals)
+                ]
+                full = [None] * len(args)
+                for (i, a) in other:
+                    full[i] = a
+                for i, t in zip(tensor_idx, replay_ins):
+                    full[i] = t
+                out = function(*full, **kwargs)
+        finally:
+            if gen_state is not None:
+                gen.set_state(gen_state)
+        out_ts = list(out) if isinstance(out, (tuple, list)) else [out]
+        cot_seq = list(cots) if isinstance(cots, tuple) else [cots]
+        grads = tape.run_backward(out_ts, cot_seq, targets=replay_ins, accumulate_leaf=True)
+        return tuple(grads)
+
+    node = tape.GradNode(vjp_fn, tensor_args, outs_seq, name="recompute")
+    outs = []
+    for i, v in enumerate(outs_seq):
+        t = Tensor(v, stop_gradient=False)
+        t._grad_node = node
+        t._out_index = i
+        outs.append(t)
+    return tuple(outs) if multi else outs[0]
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """parity: recompute_sequential — checkpoint each segment of a Sequential."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    layers = list(functions)
+    n = len(layers)
+    seg_size = max(n // segments, 1)
+
+    def make_seg(seg_layers):
+        def run(x):
+            for l in seg_layers:
+                x = l(x)
+            return x
+
+        return run
+
+    x = args[0]
+    for s in range(0, n, seg_size):
+        x = recompute(make_seg(layers[s : s + seg_size]), x, **kwargs)
+    return x

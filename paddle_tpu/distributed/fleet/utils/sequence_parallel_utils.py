@@ -1,0 +1,137 @@
+"""Megatron-style sequence parallelism utilities (parity:
+/root/reference/python/paddle/distributed/fleet/utils/sequence_parallel_utils.py —
+ScatterOp:85, AllGatherOp:111, ReduceScatterOp:127,
+ColumnSequenceParallelLinear:427, RowSequenceParallelLinear,
+register_sequence_parallel_allreduce_hooks:192).
+
+TPU-native: activation scatter/gather along the sequence dim inside the MP
+group becomes sharding-constraint flips between P(sep-on-mp) and replicated —
+GSPMD inserts the all-gather/reduce-scatter pair on ICI. The grad-sync hooks
+for SP layer norms are unnecessary (XLA reduces automatically); the API is
+kept as no-ops for porting.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from .... import nn
+from ....base.param_attr import ParamAttr
+from ....nn import functional as F
+from ....ops.dispatch import apply
+from ....tensor.tensor import Tensor
+from ...topology import get_hybrid_communicate_group
+
+__all__ = [
+    "ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp", "mark_as_sequence_parallel_parameter",
+    "register_sequence_parallel_allreduce_hooks", "ColumnSequenceParallelLinear",
+    "RowSequenceParallelLinear", "create_fused_allreduce_gradient_hook",
+]
+
+
+def _mp_mesh():
+    hcg = get_hybrid_communicate_group()
+    if hcg is None or hcg.axis_size("mp") == 1:
+        return None
+    return hcg.mesh
+
+
+def _constrain(x: Tensor, spec: PartitionSpec) -> Tensor:
+    mesh = _mp_mesh()
+    if mesh is None:
+        return x
+    sharding = NamedSharding(mesh, spec)
+    return apply(lambda v: jax.lax.with_sharding_constraint(v, sharding), x, op_name="sp_constraint")
+
+
+def _seq_spec(ndim: int) -> PartitionSpec:
+    # paddle SP layout: [s, b, h] sequence-major; shard dim 0 on the mp axis
+    return PartitionSpec("mp", *([None] * (ndim - 1)))
+
+
+def _rep_spec(ndim: int) -> PartitionSpec:
+    return PartitionSpec(*([None] * ndim))
+
+
+class ScatterOp:
+    """Split activations along seq dim across the mp group (fwd scatter /
+    bwd all-gather) — as a sharding flip."""
+
+    @staticmethod
+    def apply(x: Tensor) -> Tensor:
+        return _constrain(x, _seq_spec(x.ndim))
+
+
+class GatherOp:
+    @staticmethod
+    def apply(x: Tensor) -> Tensor:
+        return _constrain(x, _rep_spec(x.ndim))
+
+
+AllGatherOp = GatherOp
+
+
+class ReduceScatterOp:
+    @staticmethod
+    def apply(x: Tensor) -> Tensor:
+        # partial-sum input → sequence-sharded output; XLA materializes the
+        # reduce-scatter when the constraint flips
+        return _constrain(x, _seq_spec(x.ndim))
+
+
+def mark_as_sequence_parallel_parameter(param: Tensor):
+    param._optimize_attrs = {**(param._optimize_attrs or {}), "sequence_parallel": True}
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1, fuse_sequence_parallel_allreduce=False):
+    """No-op on TPU: XLA emits the SP grad reductions inside the compiled step."""
+    return None
+
+
+def create_fused_allreduce_gradient_hook(parameter_list, accumulation_steps):
+    return lambda *a, **k: None
+
+
+class ColumnSequenceParallelLinear(nn.Layer):
+    """parity: ColumnSequenceParallelLinear:427 — input seq-sharded, weight
+    column-sharded; forward all-gathers activations then matmuls."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=None,
+                 gather_output=True, fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter([in_features, out_features],
+                                            attr=ParamAttr._to_attr(weight_attr))
+        mesh = _mp_mesh()
+        if mesh is not None and not isinstance(self.weight._value, jax.core.Tracer):
+            self.weight._value = jax.device_put(
+                self.weight._value, NamedSharding(mesh, PartitionSpec(None, "mp")))
+        self.bias = None
+        if has_bias is not False:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+        self.gather_output = gather_output
+
+    def forward(self, x):
+        x = GatherOp.apply(x)  # all-gather sequence shards
+        out = F.linear(x, self.weight, self.bias)
+        spec = PartitionSpec(*([None] * (out.ndim - 1)), "mp")
+        return _constrain(out, spec) if not self.gather_output else _constrain(out, _rep_spec(out.ndim))
+
+
+class RowSequenceParallelLinear(nn.Layer):
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 input_is_parallel=True, fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter([in_features, out_features],
+                                            attr=ParamAttr._to_attr(weight_attr))
+        mesh = _mp_mesh()
+        if mesh is not None and not isinstance(self.weight._value, jax.core.Tracer):
+            self.weight._value = jax.device_put(
+                self.weight._value, NamedSharding(mesh, PartitionSpec("mp", None)))
+        self.bias = self.create_parameter([out_features], is_bias=True) if has_bias else None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight)
+        out = ReduceScatterOp.apply(out)  # partial sums → seq-sharded
+        if self.bias is not None:
+            out = out + self.bias
+        return out

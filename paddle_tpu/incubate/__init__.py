@@ -1,0 +1,3 @@
+"""paddle_tpu.incubate (parity: python/paddle/incubate — fused ops + MoE)."""
+from . import nn  # noqa: F401
+from . import distributed  # noqa: F401

@@ -1,0 +1,132 @@
+"""Mixture-of-Experts (parity:
+/root/reference/python/paddle/incubate/distributed/models/moe/moe_layer.py:263
+MoELayer + gating ops number_count/limit_by_capacity/prune_gate_by_capacity/
+random_routing kernels).
+
+TPU-native: GShard-style dense dispatch — routing becomes one-hot einsums and
+the token shuffle becomes an all-to-all XLA inserts when expert weights are
+sharded on the expert axis of the mesh. Capacity-factor token dropping matches
+the reference's limit_by_capacity semantics.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..... import nn
+from .....nn import functional as F
+from .....ops.dispatch import apply
+from .....tensor.tensor import Tensor
+from .....distributed.topology import get_hybrid_communicate_group
+
+__all__ = ["MoELayer", "GShardGate", "SwitchGate", "NaiveGate"]
+
+
+class NaiveGate(nn.Layer):
+    """Linear router (parity: gate/naive_gate.py)."""
+
+    def __init__(self, d_model, num_experts):
+        super().__init__()
+        self.weight = self.create_parameter([d_model, num_experts])
+
+    def forward(self, x):
+        return F.linear(x, self.weight)
+
+
+class GShardGate(NaiveGate):
+    top_k = 2
+
+
+class SwitchGate(NaiveGate):
+    top_k = 1
+
+
+class MoELayer(nn.Layer):
+    """Top-k routed expert FFN bank.
+
+    Experts are a stacked weight bank [E, ...] sharded on ``expert_axis`` of
+    the active mesh ('mp' by default — the reference's moe group rides its mp
+    group too unless a dedicated group is passed).
+    """
+
+    def __init__(self, d_model, d_hidden, num_experts, top_k=2, capacity_factor=1.25,
+                 gate: Optional[nn.Layer] = None, expert_axis="mp", activation="gelu",
+                 group=None, recompute_interval=0, name=None):
+        super().__init__()
+        self.d_model = d_model
+        self.d_hidden = d_hidden
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.activation = activation
+        self.gate = gate or NaiveGate(d_model, num_experts)
+        self.w1 = self.create_parameter([num_experts, d_model, d_hidden])
+        self.b1 = self.create_parameter([num_experts, 1, d_hidden], is_bias=True)
+        self.w2 = self.create_parameter([num_experts, d_hidden, d_model])
+        self.b2 = self.create_parameter([num_experts, 1, d_model], is_bias=True)
+        hcg = get_hybrid_communicate_group()
+        if hcg is not None and hcg.axis_size(expert_axis) > 1:
+            mesh = hcg.mesh
+            for p in (self.w1, self.b1, self.w2, self.b2):
+                if not isinstance(p._value, jax.core.Tracer):
+                    spec = PartitionSpec("mp", *([None] * (p.ndim - 1)))
+                    p._value = jax.device_put(p._value, NamedSharding(mesh, spec))
+
+    def forward(self, x):
+        """x: [B, S, d] (or [N, d]). Returns same shape + aux loss stored on
+        ``self.l_aux`` (load-balancing, Switch/GShard style)."""
+        orig_shape = x.shape
+        squeeze_back = len(orig_shape) == 3
+        gate_logits = self.gate(x)
+
+        E, K = self.num_experts, self.top_k
+        cap_factor = self.capacity_factor
+        act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu, "silu": jax.nn.silu}[self.activation]
+
+        def f(xv, gv, w1, b1, w2, b2):
+            xt = xv.reshape(-1, xv.shape[-1])  # [N, d]
+            gt = gv.reshape(-1, E).astype(jnp.float32)
+            N = xt.shape[0]
+            C = max(int(math.ceil(N / E * cap_factor * K)), 1)
+            probs = jax.nn.softmax(gt, axis=-1)
+            topw, topi = jax.lax.top_k(probs, K)  # [N, K]
+            topw = topw / jnp.maximum(topw.sum(-1, keepdims=True), 1e-9)
+
+            combine = jnp.zeros((N, E, C), jnp.float32)
+            # GShard priority assignment: capacity positions are allocated
+            # jointly across top-k slots (slot 0 first), so two tokens routed
+            # to the same expert via different slots never share a slot.
+            counts = jnp.zeros((E,), jnp.int32)
+            for slot in range(K):
+                e = topi[:, slot]  # [N]
+                onehot = jax.nn.one_hot(e, E, dtype=jnp.int32)  # [N, E]
+                pos = jnp.cumsum(onehot, axis=0) * onehot  # 1-based positions per expert (this slot)
+                pos_tok = jnp.sum(pos, axis=-1) - 1 + jnp.take(counts, e)  # offset by prior slots
+                keep = pos_tok < C  # capacity drop (limit_by_capacity parity)
+                cpos = jnp.clip(pos_tok, 0, C - 1)
+                oh_c = jax.nn.one_hot(cpos, C, dtype=jnp.float32) * keep[:, None]
+                combine = combine + topw[:, slot, None, None] * onehot[..., None] * oh_c[:, None, :]
+                counts = counts + jnp.sum(onehot, axis=0)
+            dispatch = (combine > 0).astype(xt.dtype)  # [N, E, C]
+            exp_in = jnp.einsum("nec,nd->ecd", dispatch, xt)
+            h = act(jnp.einsum("ecd,edh->ech", exp_in, w1) + b1)
+            exp_out = jnp.einsum("ech,ehd->ecd", h, w2) + b2
+            out = jnp.einsum("nec,ecd->nd", combine.astype(xt.dtype), exp_out)
+            # load-balance aux loss (GShard): E * sum(fraction_tokens * fraction_probs)
+            me = probs.mean(0)
+            ce = jax.nn.one_hot(topi[:, 0], E, dtype=jnp.float32).mean(0)
+            l_aux = E * jnp.sum(me * ce)
+            return out.reshape(xv.shape), l_aux
+
+        out, l_aux = apply(
+            lambda *a: tuple(f(*a)), x, gate_logits, self.w1, self.b1, self.w2, self.b2,
+            op_name="moe", n_outs=2,
+        )
+        self.l_aux = l_aux
+        return out

@@ -1,0 +1,117 @@
+"""Fused-op functional APIs (parity: /root/reference/python/paddle/incubate/nn/functional/ —
+fused_rms_norm.py, fused_rotary_position_embedding.py, swiglu.py,
+fused_dropout_add.py, fused_linear.py ...).
+
+TPU-native: "fused" means "expressed so XLA/Pallas fuses it" — these share
+implementations with the core functional ops and exist for API parity with
+reference model code.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....nn import functional as F
+from ....ops.dispatch import apply
+from ....tensor._helpers import to_tensor_like
+from ....tensor.tensor import Tensor
+
+__all__ = [
+    "fused_rms_norm", "fused_layer_norm", "fused_rotary_position_embedding", "swiglu",
+    "fused_linear", "fused_bias_act", "fused_dropout_add", "fused_multi_head_attention",
+]
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6, begin_norm_axis=-1,
+                   bias=None, residual=None, quant_scale=-1, **kw):
+    out = F.rms_norm(x, norm_weight, epsilon)
+    if norm_bias is not None:
+        out = out + norm_bias
+    return (out,)
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5, begin_norm_axis=1, **kw):
+    shape = x.shape[begin_norm_axis:]
+    return (F.layer_norm(x, shape, norm_weight, norm_bias, epsilon),)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None, position_ids=None,
+                                    use_neox_rotary_style=True, time_major=False, rotary_emb_base=10000.0):
+    """parity: fused_rotary_position_embedding — q/k/v [B, S, H, D]."""
+    q = to_tensor_like(q)
+    outs = []
+
+    def rope_one(x, c, s):
+        # c/s: [1, S, 1, D/2] or [S, D/2]
+        if c.ndim == 2:
+            c = c[None, :, None, :]
+            s = s[None, :, None, :]
+        if use_neox_rotary_style:
+            x1, x2 = jnp.split(x, 2, axis=-1)
+            return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)
+        x1 = x[..., 0::2]
+        x2 = x[..., 1::2]
+        ro = jnp.stack([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+        return ro.reshape(x.shape).astype(x.dtype)
+
+    if sin is None or cos is None:
+        S, D = q.shape[1], q.shape[-1]
+        inv = 1.0 / (rotary_emb_base ** (jnp.arange(0, D, 2, dtype=jnp.float32) / D))
+        t = jnp.arange(S, dtype=jnp.float32)
+        fr = jnp.outer(t, inv)
+        cos_v, sin_v = jnp.cos(fr), jnp.sin(fr)
+    else:
+        cos_v = cos._value if isinstance(cos, Tensor) else jnp.asarray(cos)
+        sin_v = sin._value if isinstance(sin, Tensor) else jnp.asarray(sin)
+        if cos_v.ndim == 4:
+            cos_v = cos_v[0, :, 0, :]
+            sin_v = sin_v[0, :, 0, :]
+        if cos_v.shape[-1] == q.shape[-1]:  # full-dim cos caches store doubled
+            cos_v = cos_v[..., : cos_v.shape[-1] // 2]
+            sin_v = sin_v[..., : sin_v.shape[-1] // 2]
+
+    for t_in in (q, k, v):
+        if t_in is None:
+            outs.append(None)
+            continue
+        t_in = to_tensor_like(t_in)
+        outs.append(apply(lambda x: rope_one(x, cos_v, sin_v), t_in, op_name="fused_rope"))
+    return tuple(outs)
+
+
+def swiglu(x, y=None, name=None):
+    """parity: incubate/nn/functional/swiglu.py — silu(x) * y (y defaults to
+    second half of x)."""
+    x = to_tensor_like(x)
+    if y is None:
+        def f(v):
+            a, b = jnp.split(v, 2, axis=-1)
+            return jax.nn.silu(a) * b
+
+        return apply(f, x, op_name="swiglu")
+    y = to_tensor_like(y)
+    return apply(lambda a, b: jax.nn.silu(a) * b, x, y, op_name="swiglu")
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    if transpose_weight:
+        from ....tensor.linalg import transpose
+
+        weight = transpose(to_tensor_like(weight), [1, 0])
+    return F.linear(x, weight, bias)
+
+
+def fused_bias_act(x, bias=None, act_method="gelu", **kw):
+    if bias is not None:
+        x = to_tensor_like(x) + to_tensor_like(bias)
+    return getattr(F, act_method)(x)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train", name=None):
+    return F.dropout(x, p, training=training, mode=mode) + to_tensor_like(y)
+
+
+def fused_multi_head_attention(*args, **kwargs):
+    raise NotImplementedError(
+        "use paddle_tpu.nn.functional.flash_attention / MultiHeadAttention (fused on TPU)"
+    )

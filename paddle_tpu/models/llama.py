@@ -20,6 +20,7 @@ from typing import Optional
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from .. import nn
@@ -92,7 +93,20 @@ def apply_rotary_pos_emb(q, k, cos, sin, position_offset: int = 0):
                  op_name="fused_rope", n_outs=2)
 
 
+def _hcg():
+    from ..distributed.topology import get_hybrid_communicate_group
+
+    return get_hybrid_communicate_group()
+
+
 class LlamaAttention(nn.Layer):
+    @staticmethod
+    def _sep_mesh():
+        hcg = _hcg()
+        if hcg is not None and hcg.axis_size("sep") > 1:
+            return hcg.mesh
+        return None
+
     def __init__(self, config: LlamaConfig):
         super().__init__()
         self.config = config
@@ -119,7 +133,25 @@ class LlamaAttention(nn.Layer):
             k = M.concat([cache[0], k], axis=1)
             v = M.concat([cache[1], v], axis=1)
             new_cache = (k, v)
-        if attn_mask is None and cache is None:
+        ring_mesh = self._sep_mesh() if (cache is None and attn_mask is None) else None
+        if ring_mesh is not None:
+            # sequence parallelism: exact blockwise ring attention over 'sep'
+            from ..ops.ring_attention import ring_attention
+
+            hcg = _hcg()
+            b_ax = "dp" if hcg.axis_size("dp") > 1 else None
+            h_ax = "mp" if hcg.axis_size("mp") > 1 else None
+            rep = self.num_heads // self.num_kv_heads  # GQA: repeat kv heads
+
+            def ring_fn(qv, kv, vv):
+                if rep > 1:
+                    kv = jnp.repeat(kv, rep, axis=2)
+                    vv = jnp.repeat(vv, rep, axis=2)
+                return ring_attention(qv, kv, vv, mesh=ring_mesh, axis_name="sep",
+                                      causal=True, batch_axis=b_ax, head_axis=h_ax)
+
+            out = apply(ring_fn, q, k, v, op_name="ring_attention")
+        elif attn_mask is None and cache is None:
             out, _ = F.flash_attention(q, k, v, causal=True)
         else:
             out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
@@ -179,6 +211,22 @@ class LlamaModel(nn.Layer):
         hidden = self.embed_tokens(input_ids)
         if self.config.dtype == "bfloat16":
             hidden = hidden.astype("bfloat16")
+        hcg = _hcg()
+        if hcg is not None and hcg.axis_size("sep") > 1 and caches is None:
+            sep = hcg.axis_size("sep")
+            if input_ids.shape[1] % sep != 0:
+                raise ValueError(
+                    f"sequence length {input_ids.shape[1]} must be divisible by "
+                    f"sep_degree={sep} for sequence parallelism (pad the batch; "
+                    "XLA needs static equal shards)"
+                )
+            # sequence parallelism: shard activations [B, S, H] on (dp, sep)
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            b_ax = "dp" if hcg.axis_size("dp") > 1 else None
+            sharding = NamedSharding(hcg.mesh, PartitionSpec(b_ax, "sep", None))
+            hidden = apply(lambda v: jax.lax.with_sharding_constraint(v, sharding),
+                           hidden, op_name="sep_shard")
         cos, sin = self._buffers["rope_cos"], self._buffers["rope_sin"]
         new_caches = []
         for i, layer in enumerate(self.layers):

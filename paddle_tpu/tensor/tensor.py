@@ -49,6 +49,7 @@ class Tensor:
         "trainable",
         "_optimize_attrs",
         "_dist_meta",
+        "_pp_stage",
         "__weakref__",
     )
 

@@ -4,104 +4,261 @@ load_state_dict.py, metadata.py).
 
 Design kept from the reference: each run writes shard files + ONE global
 metadata file mapping tensor key → shard extents; load reshards to the
-CURRENT parallel config. TPU-native implementation: per-host shard npz files
-(only locally-addressable shards are written, so a pod writes in parallel) and
-device_put-with-sharding on load performs the reshard (no reshard rule
-library needed).
+CURRENT parallel config.
+
+TPU-native implementation:
+
+* save — only locally-addressable shards are written (a pod writes in
+  parallel); bfloat16 is stored losslessly as a uint16 view with the true
+  dtype recorded in metadata; ``async_save=True`` snapshots device arrays to
+  host then runs the file write in a background thread (reference capability:
+  async checkpoint).
+* load — **lazy and shard-local**: when the target tensor is sharded, each
+  host reads only the saved-shard regions that overlap its addressable
+  shards (``jax.make_array_from_callback``); a full global array is never
+  materialized on any host.  npz member arrays are decompressed per key on
+  demand, so a host touching 1/N of a tensor reads ~1/N of the bytes.
 """
 from __future__ import annotations
 
 import json
 import os
-from typing import Dict, Optional
+import threading
+from typing import Dict, List, Optional
 
 import numpy as np
 
 import jax
+import jax.numpy as jnp
+import ml_dtypes
 
 from ...tensor.tensor import Tensor
 
 __all__ = ["save_state_dict", "load_state_dict"]
+
+_BF16_STORED = "uint16"  # npz storage encoding for bfloat16
+
+_pending_saves: List[threading.Thread] = []
+_pending_errors: List[BaseException] = []
 
 
 def _meta_path(path):
     return os.path.join(path, "metadata.json")
 
 
+def _rank_meta_path(path, rank):
+    return os.path.join(path, f"metadata_rank{rank}.json")
+
+
 def _shard_file(path, rank):
     return os.path.join(path, f"shard_{rank}.npz")
 
 
-def save_state_dict(state_dict: Dict[str, Tensor], path: str, process_group=None, coordinator_rank: int = 0):
+def _merge_rank_metas(metas):
+    """Union the per-rank metadata fragments into one global view: every
+    rank's shard extents appear; shape/dtype come from any rank that holds
+    data for the tensor."""
+    merged = {"tensors": {}, "world_size": max(m.get("world_size", 1) for m in metas)}
+    for m in metas:
+        for key, tm in m["tensors"].items():
+            dst = merged["tensors"].setdefault(
+                key, {"global_shape": tm["global_shape"], "dtype": tm["dtype"], "shards": []}
+            )
+            if dst["dtype"] is None:
+                dst["dtype"] = tm["dtype"]
+            dst["shards"].extend(tm["shards"])
+    return merged
+
+
+def _encode(arr: np.ndarray):
+    """-> (storable ndarray, true dtype string)."""
+    if arr.dtype == ml_dtypes.bfloat16:
+        return arr.view(np.uint16), "bfloat16"
+    return arr, str(arr.dtype)
+
+
+def _decode(arr: np.ndarray, dtype_str: str) -> np.ndarray:
+    if dtype_str == "bfloat16":
+        return arr.view(ml_dtypes.bfloat16)
+    return arr
+
+
+def wait_pending_saves():
+    """Block until all async checkpoint writes issued by this process finish.
+    Re-raises the first error any background write hit."""
+    while _pending_saves:
+        _pending_saves.pop().join()
+    if _pending_errors:
+        err = _pending_errors[0]
+        _pending_errors.clear()
+        raise RuntimeError("async checkpoint save failed") from err
+
+
+def save_state_dict(state_dict: Dict[str, Tensor], path: str, process_group=None,
+                    coordinator_rank: int = 0, async_save: bool = False):
     os.makedirs(path, exist_ok=True)
     rank = jax.process_index()
     local_arrays = {}
     meta = {"tensors": {}, "world_size": jax.process_count()}
     for key, t in state_dict.items():
         val = t._value if isinstance(t, Tensor) else t
-        if hasattr(val, "addressable_shards"):
+        if hasattr(val, "addressable_shards") and not isinstance(val, np.ndarray):
             shards_meta = []
+            dtype_str = None
             for i, shard in enumerate(val.addressable_shards):
                 skey = f"{key}::{rank}::{i}"
-                local_arrays[skey] = np.asarray(shard.data)
+                arr, dtype_str = _encode(np.asarray(shard.data))
+                local_arrays[skey] = arr
                 index = [[s.start or 0, s.stop if s.stop is not None else dim]
                          for s, dim in zip(shard.index, val.shape)]
                 shards_meta.append({"file": f"shard_{rank}.npz", "key": skey, "index": index})
             meta["tensors"][key] = {
                 "global_shape": list(val.shape),
-                "dtype": str(val.dtype),
+                "dtype": dtype_str,
                 "shards": shards_meta,
             }
         else:
             skey = f"{key}::{rank}::0"
-            arr = np.asarray(val)
+            arr, dtype_str = _encode(np.asarray(val))
             local_arrays[skey] = arr
             meta["tensors"][key] = {
                 "global_shape": list(arr.shape),
-                "dtype": str(arr.dtype),
+                "dtype": dtype_str,
                 "shards": [{"file": f"shard_{rank}.npz", "key": skey,
                             "index": [[0, d] for d in arr.shape]}],
             }
-    np.savez(_shard_file(path, rank), **local_arrays)
-    if rank == coordinator_rank:
-        with open(_meta_path(path), "w") as f:
-            json.dump(meta, f)
-    if jax.process_count() > 1:
+
+    multi_host = jax.process_count() > 1
+
+    def _write():
+        try:
+            np.savez(_shard_file(path, rank), **local_arrays)
+            if multi_host:
+                # every rank records ITS OWN shard extents; the loader (or the
+                # coordinator below) merges the fragments into the global view
+                with open(_rank_meta_path(path, rank), "w") as f:
+                    json.dump(meta, f)
+            else:
+                with open(_meta_path(path), "w") as f:
+                    json.dump(meta, f)
+        except BaseException as e:  # propagated by wait_pending_saves
+            _pending_errors.append(e)
+            raise
+
+    if async_save:
+        th = threading.Thread(target=_write, daemon=False)
+        th.start()
+        _pending_saves.append(th)
+        return
+    _write()
+    if multi_host:
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices("ckpt_save")
+        if rank == coordinator_rank:
+            metas = []
+            for r in range(jax.process_count()):
+                fp = _rank_meta_path(path, r)
+                if os.path.exists(fp):
+                    with open(fp) as f:
+                        metas.append(json.load(f))
+            with open(_meta_path(path), "w") as f:
+                json.dump(_merge_rank_metas(metas), f)
+
+
+class _LazyShardReader:
+    """Per-key lazy access into the run's npz shard files."""
+
+    def __init__(self, path):
+        self.path = path
+        self._files: Dict[str, "np.lib.npyio.NpzFile"] = {}
+
+    def read(self, file, key):
+        if file not in self._files:
+            self._files[file] = np.load(os.path.join(self.path, file))
+        return self._files[file][key]
+
+    def close(self):
+        for f in self._files.values():
+            f.close()
+        self._files.clear()
+
+
+def _fill_region(dst: np.ndarray, dst_index, tm, reader):
+    """Copy the [dst_index] region of tensor ``tm`` out of saved shards into
+    ``dst`` (whose shape equals the region)."""
+    region = [(s.start or 0, s.stop if s.stop is not None else dim)
+              for s, dim in zip(dst_index, tm["global_shape"])]
+    for sh in tm["shards"]:
+        # overlap of saved shard extent with requested region, per dim
+        inter = []
+        ok = True
+        for (rs, re), (ss, se) in zip(region, sh["index"]):
+            lo, hi = max(rs, ss), min(re, se)
+            if lo >= hi:
+                ok = False
+                break
+            inter.append((lo, hi, rs, ss))
+        if not ok:
+            continue
+        src = _decode(np.asarray(reader.read(sh["file"], sh["key"])), tm["dtype"])
+        src_idx = tuple(slice(lo - ss, hi - ss) for lo, hi, rs, ss in inter)
+        dst_idx = tuple(slice(lo - rs, hi - rs) for lo, hi, rs, ss in inter)
+        dst[dst_idx] = src[src_idx]
+    return dst
 
 
 def load_state_dict(state_dict: Dict[str, Tensor], path: str, process_group=None,
                     coordinator_rank: int = 0, offload: bool = False):
     """Fills ``state_dict`` tensors in place, resharding saved shards to each
     tensor's current sharding (different dp/mp/pp config than at save time is
-    fine — the reference's headline capability)."""
-    with open(_meta_path(path)) as f:
-        meta = json.load(f)
-    # lazy-load shard files
-    cache: Dict[str, dict] = {}
-
-    def shard_data(file, key):
-        if file not in cache:
-            cache[file] = np.load(os.path.join(path, file))
-        return cache[file][key]
+    fine — the reference's headline capability).  Sharded targets read only
+    the slices this host needs."""
+    wait_pending_saves()
+    if os.path.exists(_meta_path(path)):
+        with open(_meta_path(path)) as f:
+            meta = json.load(f)
+    else:
+        # async multi-host save skips the coordinator merge; merge fragments here
+        metas = []
+        r = 0
+        while os.path.exists(_rank_meta_path(path, r)):
+            with open(_rank_meta_path(path, r)) as f:
+                metas.append(json.load(f))
+            r += 1
+        if not metas:
+            raise FileNotFoundError(f"no checkpoint metadata found under {path}")
+        meta = _merge_rank_metas(metas)
+    reader = _LazyShardReader(path)
 
     for key, t in state_dict.items():
         if key not in meta["tensors"]:
             continue
         tm = meta["tensors"][key]
-        full = np.zeros(tm["global_shape"], dtype=np.dtype(tm["dtype"]) if "bfloat16" not in tm["dtype"] else np.float32)
-        for sh in tm["shards"]:
-            idx = tuple(slice(a, b) for a, b in sh["index"])
-            full[idx] = np.asarray(shard_data(sh["file"], sh["key"]), dtype=full.dtype)
         val = t._value
         target_dtype = val.dtype
-        if hasattr(val, "sharding") and not isinstance(val, np.ndarray):
-            new_val = jax.device_put(full.astype(target_dtype), val.sharding)
-        else:
-            import jax.numpy as jnp
+        np_src_dtype = ml_dtypes.bfloat16 if tm["dtype"] == "bfloat16" else np.dtype(tm["dtype"])
+        sharding = getattr(val, "sharding", None)
+        if sharding is not None and not isinstance(val, np.ndarray) and \
+                not getattr(sharding, "is_fully_replicated", True):
 
-            new_val = jnp.asarray(full, target_dtype)
+            def cb(index, tm=tm, np_src_dtype=np_src_dtype, target_dtype=target_dtype):
+                shape = tuple(
+                    (s.stop if s.stop is not None else dim) - (s.start or 0)
+                    for s, dim in zip(index, tm["global_shape"])
+                )
+                block = np.zeros(shape, dtype=np_src_dtype)
+                _fill_region(block, index, tm, reader)
+                return block.astype(target_dtype)
+
+            new_val = jax.make_array_from_callback(tuple(tm["global_shape"]), sharding, cb)
+        else:
+            full = np.zeros(tm["global_shape"], dtype=np_src_dtype)
+            _fill_region(full, tuple(slice(0, d) for d in tm["global_shape"]), tm, reader)
+            if sharding is not None and not isinstance(val, np.ndarray):
+                new_val = jax.device_put(full.astype(target_dtype), sharding)
+            else:
+                new_val = jnp.asarray(full, target_dtype)
         t._value = new_val
+    reader.close()
     return state_dict

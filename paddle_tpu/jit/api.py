@@ -255,70 +255,73 @@ def ignore_module(modules):
 class TrainStep:
     """Whole-training-step compilation — the TPU-idiomatic hot path.
 
-    Compiles loss_fn(model(x), y) + grads + optimizer update into ONE XLA
-    program with donated parameter/optimizer buffers. The eager Optimizer's
-    hyperparameters are mapped onto an optax transform (optax is the
-    functional optimizer library of the jax ecosystem); state lives on-device
-    between steps. ``sync_to_model()`` writes params back into the Layer for
-    checkpointing/eval interop.
+    Compiles loss_fn(model(x), y) + grads + THE FRAMEWORK'S OWN optimizer
+    update (``Optimizer._update_param`` for all ten optimizers, param groups,
+    grad clip, ``multi_precision`` fp32 master weights) into ONE XLA program
+    with donated parameter/optimizer buffers.  The optimizer's accumulators
+    are materialized up front (``_ensure_state``) and threaded through the
+    compiled step as a pytree, so eager ``state_dict()``/checkpointing always
+    sees the live state.  LR schedulers are evaluated host-side per call and
+    enter the graph as a traced scalar.  Pass a ``paddle_tpu.amp.GradScaler``
+    to get fp16-style dynamic loss scaling with the found-inf skip executed
+    *inside* the compiled step (no per-step host sync).
+
+    Reference anchor: python/paddle/optimizer/optimizer.py:125 (_create_
+    accumulators / master-weight semantics), amp/grad_scaler.py.
     """
 
-    def __init__(self, model, loss_fn, optimizer, donate: bool = True):
-        import optax
-
-        from ..optimizer.optimizers import SGD, Adam, AdamW, Momentum
-
+    def __init__(self, model, loss_fn, optimizer, donate: bool = True, scaler=None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
+        self.scaler = scaler if (scaler is not None and scaler.is_enable()) else None
         self._params = list(model.parameters())
         self._buffers = [b for b in model.buffers() if b is not None]
-        lr = optimizer.get_lr()
-        self._lr_is_sched = not isinstance(optimizer._learning_rate, (int, float))
-        if isinstance(optimizer, AdamW):
-            self._tx = optax.adamw(self._lr_fn, b1=optimizer._beta1, b2=optimizer._beta2,
-                                   eps=optimizer._epsilon, weight_decay=optimizer._wd)
-        elif isinstance(optimizer, Adam):
-            self._tx = optax.adam(self._lr_fn, b1=optimizer._beta1, b2=optimizer._beta2,
-                                  eps=optimizer._epsilon)
-        elif isinstance(optimizer, Momentum):
-            self._tx = optax.sgd(self._lr_fn, momentum=optimizer._momentum,
-                                 nesterov=optimizer._nesterov)
-        elif isinstance(optimizer, SGD):
-            self._tx = optax.sgd(self._lr_fn)
-        else:
-            raise NotImplementedError(f"TrainStep does not support {type(optimizer).__name__} yet")
-        grad_clip = optimizer._grad_clip
-        if grad_clip is not None:
-            from ..nn.clip import ClipGradByGlobalNorm, ClipGradByNorm
-
-            if isinstance(grad_clip, ClipGradByGlobalNorm):
-                self._tx = optax.chain(optax.clip_by_global_norm(grad_clip.clip_norm), self._tx)
-            elif isinstance(grad_clip, ClipGradByNorm):
-                self._tx = optax.chain(optax.clip(grad_clip.clip_norm), self._tx)
-        self._param_vals = [p._value for p in self._params]
-        self._opt_state = self._tx.init(self._param_vals)
-        self._step_i = jnp.zeros((), jnp.int32)
+        optimizer._ensure_state()
+        self._pid2idx = {id(p): i for i, p in enumerate(self._params)}
         self._compiled = None
         self._donate = donate
 
-    def _lr_fn(self, count):
+    # -------------------------------------------------- state pytree helpers
+    def _get_opt_state(self):
         opt = self.optimizer
-        if isinstance(opt._learning_rate, (int, float)):
-            return opt._learning_rate
-        # LRScheduler: evaluate python-side per step; traced as a jnp scalar input
-        return self._current_lr
+        accs = {
+            name: {self._pid2idx[pid]: v for pid, v in d.items() if pid in self._pid2idx}
+            for name, d in opt._accumulators.items()
+        }
+        masters = {self._pid2idx[pid]: v
+                   for pid, v in opt._master_weights.items() if pid in self._pid2idx}
+        return accs, masters
 
+    def _put_opt_state(self, accs, masters):
+        opt = self.optimizer
+        for name, d in accs.items():
+            for i, v in d.items():
+                opt._accumulators[name][id(self._params[i])] = v
+        for i, v in masters.items():
+            opt._master_weights[id(self._params[i])] = v
+
+    def _scaler_state(self):
+        s = self.scaler
+        if s is None:
+            return {}
+        return {
+            "scale": jnp.asarray(s._scale, jnp.float32),
+            "good": jnp.asarray(s._good_steps, jnp.int32),
+            "bad": jnp.asarray(s._bad_steps, jnp.int32),
+        }
+
+    # ------------------------------------------------------------- build
     def _build(self, batch_spec):
         model = self.model
         loss_fn = self.loss_fn
         buffers = self._buffers
         params = self._params
-        tx = self._tx
+        opt = self.optimizer
+        scaler = self.scaler
 
-        def step(param_vals, opt_state, buf_vals, rng_key, batch_vals, lr):
-            self._current_lr = lr  # read by _lr_fn during trace
-
+        def step(param_vals, accs, masters, buf_vals, scaler_state, rng_key, batch_vals, lr):
+            # ---- forward + grads (scaled loss when a GradScaler is active)
             def loss_of(pv):
                 ctx = trace_state.TraceContext(rng_key)
                 batch_tensors = [Tensor(v, stop_gradient=True) for v in batch_vals]
@@ -328,18 +331,88 @@ class TrainStep:
                         loss = loss_fn(model, *args)
                     new_bufs = {id(b): v for b, v in ctx.buffer_updates}
                     buf_out = [new_bufs.get(id(b), bv) for b, bv in zip(buffers, buf_vals)]
-                return loss._value, buf_out
+                lv = loss._value
+                scaled = lv * scaler_state["scale"].astype(lv.dtype) if scaler else lv
+                return scaled, (lv, buf_out)
 
-            (loss_val, buf_out), grads = jax.value_and_grad(loss_of, has_aux=True)(list(param_vals))
-            updates, new_opt_state = tx.update(grads, opt_state, list(param_vals))
-            import optax
+            (_, (loss_val, buf_out)), grads = jax.value_and_grad(loss_of, has_aux=True)(
+                list(param_vals)
+            )
 
-            new_params = optax.apply_updates(list(param_vals), updates)
-            return loss_val, new_params, new_opt_state, buf_out
+            found_inf = None
+            if scaler:
+                inv = (1.0 / scaler_state["scale"])
+                grads = [g * inv.astype(g.dtype) for g in grads]
+                nonfinite = sum(jnp.sum(~jnp.isfinite(g)) for g in grads)
+                found_inf = nonfinite > 0
 
-        donate = (0, 1, 2) if self._donate else ()
+            # ---- optimizer update: trace the framework's own _update_param.
+            # Install traced state into the optimizer's dicts for the duration
+            # of the trace, then restore the concrete values.
+            saved_accs = {name: dict(d) for name, d in opt._accumulators.items()}
+            saved_masters = dict(opt._master_weights)
+            self._put_opt_state(accs, masters)
+            grad_of = {id(p): g for p, g in zip(params, grads)}
+            try:
+                with _SwapValues(params, list(param_vals)):
+                    for group in opt._param_groups:
+                        pg = [
+                            (p, Tensor(grad_of[id(p)], stop_gradient=True))
+                            for p in group["params"]
+                            if id(p) in grad_of and p.trainable
+                        ]
+                        if opt._grad_clip is not None:
+                            pg = opt._grad_clip(pg)
+                        glr = lr * group.get("learning_rate", 1.0)
+                        wd = group.get("weight_decay", opt._weight_decay)
+                        wd = opt._parse_decay(wd) if not isinstance(wd, float) else wd
+                        with tape.no_grad():
+                            for p, g in pg:
+                                gv = (
+                                    g._value.astype(jnp.float32)
+                                    if opt._multi_precision
+                                    else g._value
+                                )
+                                opt._update_param(p, gv, glr, wd)
+                    new_params = [p._value for p in params]
+                new_accs = {
+                    name: {i: opt._accumulators[name][id(params[i])] for i in accs[name]}
+                    for name in accs
+                }
+                new_masters = {i: opt._master_weights[id(params[i])] for i in masters}
+            finally:
+                opt._accumulators.clear()
+                opt._accumulators.update(
+                    {name: dict(d) for name, d in saved_accs.items()}
+                )
+                opt._master_weights.clear()
+                opt._master_weights.update(saved_masters)
+
+            new_scaler_state = scaler_state
+            if scaler:
+                # skip the whole update when any grad is nonfinite
+                keep = lambda new, old: jnp.where(found_inf, old, new)  # noqa: E731
+                new_params = [keep(n, o) for n, o in zip(new_params, param_vals)]
+                new_accs = jax.tree_util.tree_map(keep, new_accs, accs)
+                new_masters = jax.tree_util.tree_map(keep, new_masters, masters)
+                if scaler._dynamic:
+                    scale = scaler_state["scale"]
+                    bad = jnp.where(found_inf, scaler_state["bad"] + 1, 0)
+                    good = jnp.where(found_inf, 0, scaler_state["good"] + 1)
+                    dec = bad >= scaler._decr_every_n
+                    scale = jnp.where(dec, jnp.maximum(scale * scaler._decr_ratio, 1.0), scale)
+                    bad = jnp.where(dec, 0, bad)
+                    inc = good >= scaler._incr_every_n_steps
+                    scale = jnp.where(inc, scale * scaler._incr_ratio, scale)
+                    good = jnp.where(inc, 0, good)
+                    new_scaler_state = {"scale": scale, "good": good, "bad": bad}
+
+            return loss_val, new_params, new_accs, new_masters, buf_out, new_scaler_state
+
+        donate = (0, 1, 2, 3) if self._donate else ()
         return jax.jit(step, donate_argnums=donate)
 
+    # ------------------------------------------------------------- call
     def __call__(self, *batch):
         batch_tensors, spec = flatten_tensors(batch)
         if self._compiled is None:
@@ -349,15 +422,23 @@ class TrainStep:
         rng_key = default_generator().next_key()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         buf_vals = [b._value for b in self._buffers]
-        loss, self._param_vals, self._opt_state, buf_out = self._compiled(
-            self._param_vals, self._opt_state, buf_vals, rng_key, batch_vals, lr
+        accs, masters = self._get_opt_state()
+        loss, new_params, new_accs, new_masters, buf_out, new_scaler = self._compiled(
+            [p._value for p in self._params], accs, masters, buf_vals,
+            self._scaler_state(), rng_key, batch_vals, lr,
         )
+        for p, v in zip(self._params, new_params):
+            p._value = v
+        self._put_opt_state(new_accs, new_masters)
         for b, v in zip(self._buffers, buf_out):
             b._value = v
+        if self.scaler is not None and new_scaler:
+            self.scaler._scale = new_scaler["scale"]
+            self.scaler._good_steps = new_scaler["good"]
+            self.scaler._bad_steps = new_scaler["bad"]
         self.optimizer._step_count += 1
         return Tensor(loss)
 
     def sync_to_model(self):
-        for p, v in zip(self._params, self._param_vals):
-            p._value = v
+        """Params are written back after every step; kept for API compat."""
         return self.model

@@ -26,6 +26,10 @@ def _ref_attention(q, k, v, *, causal: bool, scale, mask=None, dropout: float = 
     """Reference attention on [B, S, H, D] layout; fp32 softmax accumulator."""
     B, Sq, H, D = q.shape
     sc = scale if scale is not None else 1.0 / math.sqrt(D)
+    if k.shape[2] != H:  # grouped-query attention: repeat kv heads
+        rep = H // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     qh = jnp.moveaxis(q, 2, 1)  # [B,H,S,D]
     kh = jnp.moveaxis(k, 2, 1)
     vh = jnp.moveaxis(v, 2, 1)

@@ -346,7 +346,8 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction
             [jnp.ones((N, 2), bool), ext_labels[:, 2:] == ext_labels[:, :-2]], axis=1
         )
 
-        def step(alpha, lp_t):
+        def step(alpha, inp):
+            lp_t, t = inp
             a1 = alpha
             a2 = jnp.concatenate([jnp.full((N, 1), neg_inf), alpha[:, :-1]], axis=1)
             a3 = jnp.concatenate([jnp.full((N, 2), neg_inf), alpha[:, :-2]], axis=1)
@@ -356,11 +357,12 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction
                 jnp.exp(a1 - m) + jnp.exp(a2 - m) + jnp.exp(a3 - m)
             )
             emit = jnp.take_along_axis(lp_t, ext_labels, axis=1)
-            return new + emit, None
+            # freeze alpha for batch elements whose input already ended
+            # (t >= in_len): padded time steps must not enter the forward sum
+            active = (t < in_len.astype(jnp.int32))[:, None]
+            return jnp.where(active, new + emit, alpha), None
 
-        alphaT, _ = jax.lax.scan(step, alpha0, lp[1:])
-        # Note: assumes full-length inputs (static shapes); in_len handling via
-        # masking would scan with per-step freeze — acceptable v1 contract.
+        alphaT, _ = jax.lax.scan(step, alpha0, (lp[1:], jnp.arange(1, T)))
         last = 2 * lab_len.astype(jnp.int32)
         a_last = jnp.take_along_axis(alphaT, last[:, None], axis=1)[:, 0]
         a_prev = jnp.take_along_axis(alphaT, jnp.maximum(last - 1, 0)[:, None], axis=1)[:, 0]

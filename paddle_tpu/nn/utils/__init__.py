@@ -53,30 +53,57 @@ def spectral_norm_hook(layer, name="weight", n_power_iterations=1, eps=1e-12, di
 
 
 def weight_norm(layer, name="weight", dim=0):
-    """v/g reparameterization applied eagerly at call time."""
-    w = getattr(layer, name)
-    g_val = jnp.sqrt(jnp.sum(jnp.square(w._value), axis=tuple(i for i in range(w._value.ndim) if i != dim), keepdims=True))
+    """v/g reparameterization: w = v / ||v|| * g recomputed each forward
+    *through the autograd tape* so gradients reach g and v; the original
+    weight is removed from the parameter list (paddle semantics —
+    reference python/paddle/nn/utils/weight_norm_hook.py)."""
+    from ...ops.dispatch import apply
+
+    w = layer._parameters[name]
+    reduce_axes = tuple(i for i in range(w._value.ndim) if i != dim)
+    g_val = jnp.sqrt(jnp.sum(jnp.square(w._value), axis=reduce_axes, keepdims=True))
     g = Tensor(g_val, stop_gradient=False)
     g.is_parameter = True
     v = Tensor(w._value, stop_gradient=False)
     v.is_parameter = True
     layer.add_parameter(name + "_g", g)
     layer.add_parameter(name + "_v", v)
+    # the original weight is no longer a trainable parameter
+    del layer._parameters[name]
     orig_forward = layer.forward
+
+    def _compute_w(vv, gg):
+        norm = jnp.sqrt(jnp.sum(jnp.square(vv), axis=reduce_axes, keepdims=True))
+        return vv / jnp.maximum(norm, 1e-12) * gg
 
     def forward(*args, **kwargs):
         vv = layer._parameters[name + "_v"]
         gg = layer._parameters[name + "_g"]
-        norm = jnp.sqrt(jnp.sum(jnp.square(vv._value), axis=tuple(i for i in range(vv._value.ndim) if i != dim), keepdims=True))
-        getattr(layer, name)._value = vv._value / norm * gg._value
-        return orig_forward(*args, **kwargs)
+        w_t = apply(_compute_w, vv, gg, op_name="weight_norm")
+        layer.__dict__[name] = w_t  # plain attr shadows nothing in _parameters
+        try:
+            return orig_forward(*args, **kwargs)
+        finally:
+            layer.__dict__.pop(name, None)
 
     layer.forward = forward
     layer._weight_norm_name = name
+    layer._weight_norm_orig_forward = orig_forward
+    layer._weight_norm_dim = dim
     return layer
 
 
 def remove_weight_norm(layer, name="weight"):
-    for suffix in ("_g", "_v"):
-        layer._parameters.pop(name + suffix, None)
+    v = layer._parameters.pop(name + "_v", None)
+    g = layer._parameters.pop(name + "_g", None)
+    if v is not None and g is not None:
+        dim = getattr(layer, "_weight_norm_dim", 0)
+        reduce_axes = tuple(i for i in range(v._value.ndim) if i != dim)
+        norm = jnp.sqrt(jnp.sum(jnp.square(v._value), axis=reduce_axes, keepdims=True))
+        w = Tensor(v._value / jnp.maximum(norm, 1e-12) * g._value, stop_gradient=False)
+        w.is_parameter = True
+        layer._parameters[name] = w
+    if hasattr(layer, "_weight_norm_orig_forward"):
+        layer.forward = layer._weight_norm_orig_forward
+        del layer._weight_norm_orig_forward
     return layer

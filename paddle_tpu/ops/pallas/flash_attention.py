@@ -1,14 +1,24 @@
-"""Flash attention — Pallas TPU kernel.
+"""Flash attention — Pallas TPU kernels, forward AND backward.
 
 Replaces the reference's FlashAttention2 CUDA dependency
 (/root/reference/third_party/flashattn, paddle/phi/kernels/flash_attn_kernel.h)
-with a TPU kernel: online-softmax tiling in VMEM, fp32 accumulators, MXU
+with TPU kernels: online-softmax tiling in VMEM, fp32 accumulators, MXU
 matmuls. Layout is paddle's [batch, seq, heads, head_dim].
 
-Forward: pallas kernel (one grid cell per (batch*head, q-block); streamed
-K/V with a fori_loop of MXU tiles). Backward: recompute-based VJP in jnp —
-rematerialization is the standard TPU tradeoff; a pallas backward kernel is a
-planned upgrade.
+Forward: one grid cell per (batch*head, q-block); K/V streamed through a
+fori_loop of MXU tiles; emits per-row logsumexp (LSE) for the backward.
+
+Backward (FlashAttention-2 algorithm): two kernels.
+  * dQ:  grid (bh, q-block) — recompute P = exp(S - LSE) tile by tile,
+         dS = P * (dO·Vᵀ - Δ), dQ += dS·K, where Δ = rowsum(dO ∘ O).
+  * dKV: grid (bh, k-block) — same recomputation streaming Q/dO tiles,
+         dV += Pᵀ·dO, dK += dSᵀ·Q.
+No S×S matrix is ever materialized; memory is O(S·D) like the forward.
+
+Causal masking uses FlashAttention-2's bottom-right alignment
+(row + seq_k - seq_q >= col) in every path, so kernel and jnp fallback agree
+for seq_q != seq_k. Causal loops skip fully-masked tiles via traced loop
+bounds.
 """
 from __future__ import annotations
 
@@ -28,41 +38,87 @@ except Exception:  # pragma: no cover
 NEG_INF = -1e30
 
 
-def _ref_impl(q, k, v, causal: bool, scale: float):
-    """[BH, S, D] reference with fp32 softmax."""
+# --------------------------------------------------------------- jnp fallback
+def _ref_fwd_impl(q, k, v, causal: bool, scale: float):
+    """[BH, S, D] reference with fp32 softmax; returns (out, lse).
+
+    Rows with no visible key (causal with seq_q > seq_k) produce zeros, the
+    same convention as the Pallas kernel (FlashAttention-2 behavior)."""
     logits = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * scale
+    row_valid = None
     if causal:
         sq, sk = logits.shape[1], logits.shape[2]
         mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
         logits = jnp.where(mask, logits, NEG_INF)
-    p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    return jnp.einsum("bqk,bkd->bqd", p, v)
+        row_valid = jnp.any(mask, axis=-1)  # [Sq]
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    p_un = jnp.exp(logits - m)
+    l = jnp.sum(p_un, axis=-1, keepdims=True)  # noqa: E741
+    lse = (m + jnp.log(l))[..., 0]
+    p = (p_un / l).astype(q.dtype)
+    if row_valid is not None:
+        p = jnp.where(row_valid[None, :, None], p, jnp.zeros((), p.dtype))
+    return jnp.einsum("bqk,bkd->bqd", p, v), lse
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool, scale: float, seq_k: int):
-    """One (bh, q_block) grid cell: online softmax over K tiles."""
+def _ref_impl(q, k, v, causal: bool, scale: float):
+    return _ref_fwd_impl(q, k, v, causal, scale)[0]
+
+
+def _ref_bwd_impl(q, k, v, o, lse, g, causal: bool, scale: float):
+    """jnp backward from saved LSE (used on CPU / odd shapes)."""
+    s = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * scale
+    row_valid = None
+    if causal:
+        sq, sk = s.shape[1], s.shape[2]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, NEG_INF)
+        row_valid = jnp.any(mask, axis=-1)
+    p = jnp.exp(s - lse[..., None])
+    if row_valid is not None:
+        # fully-masked rows: output/grads are zero by convention
+        p = jnp.where(row_valid[None, :, None], p, 0.0)
+    gf = g.astype(jnp.float32)
+    delta = jnp.sum(gf * o.astype(jnp.float32), axis=-1)  # [BH, Sq]
+    dv = jnp.einsum("bqk,bqd->bkd", p, gf)
+    dp = jnp.einsum("bqd,bkd->bqk", gf, v.astype(jnp.float32))
+    ds = p * (dp - delta[..., None]) * scale
+    dq = jnp.einsum("bqk,bkd->bqd", ds, k.astype(jnp.float32))
+    dk = jnp.einsum("bqk,bqd->bkd", ds, q.astype(jnp.float32))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# ------------------------------------------------------------ forward kernel
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bool,
+                scale: float, seq_k: int, causal_offset: int):
     q = q_ref[0].astype(jnp.float32) * scale  # [block_q, D]
     block_q, d = q.shape
     q_idx = pl.program_id(1)
-    q_offset = q_idx * block_q
+    q_offset = q_idx * block_q + causal_offset
 
     num_kb = seq_k // block_k
 
     def body(kb, carry):
         acc, m_prev, l_prev = carry
-        k_tile = k_ref[0, pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)  # [block_k, D]
+        k_tile = k_ref[0, pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
         v_tile = v_ref[0, pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k_tile, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [block_q, block_k]
+        valid = None
         if causal:
             rows = q_offset + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             cols = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            valid = rows >= cols
+            s = jnp.where(valid, s, NEG_INF)
         m_cur = jnp.max(s, axis=1)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new[:, None])
+        if valid is not None:
+            # explicit zero: a fully-masked row has m_new == NEG_INF and would
+            # otherwise get p == 1 at masked positions
+            p = jnp.where(valid, p, 0.0)
         l_new = l_prev * alpha + jnp.sum(p, axis=1)
         acc = acc * alpha[:, None] + jax.lax.dot_general(
             p, v_tile, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -72,17 +128,28 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool, scal
     acc0 = jnp.zeros((block_q, d), jnp.float32)
     m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q,), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, num_kb, body, (acc0, m0, l0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    if causal:
+        # last k tile that any row of this q block can see
+        hi = jnp.minimum(
+            num_kb, (q_offset + block_q - 1) // block_k + 1
+        ).astype(jnp.int32)
+        hi = jnp.maximum(hi, 0)
+    else:
+        hi = num_kb
+    acc, m, l = jax.lax.fori_loop(0, hi, body, (acc0, m0, l0))  # noqa: E741
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l_safe)
 
 
-def _pallas_fwd_bhsd(q, k, v, causal: bool, scale: float, block_q: int, block_k: int, interpret: bool):
-    """q,k,v: [BH, S, D]."""
+def _pallas_fwd(q, k, v, causal: bool, scale: float, block_q: int, block_k: int, interpret: bool):
+    """q,k,v: [BH, S, D] → (o, lse[f32])."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     grid = (bh, sq // block_q)
     kernel = functools.partial(
-        _attn_kernel, block_k=block_k, causal=causal, scale=scale, seq_k=sk
+        _fwd_kernel, block_k=block_k, causal=causal, scale=scale, seq_k=sk,
+        causal_offset=sk - sq,
     )
     return pl.pallas_call(
         kernel,
@@ -92,17 +159,179 @@ def _pallas_fwd_bhsd(q, k, v, causal: bool, scale: float, block_q: int, block_k:
             pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+        ],
         interpret=interpret,
     )(q, k, v)
 
 
+# ------------------------------------------------------------ backward: dQ
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               block_k: int, causal: bool, scale: float, seq_k: int, causal_offset: int):
+    q = q_ref[0].astype(jnp.float32)  # [block_q, D]
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]  # [block_q]
+    delta = delta_ref[0]
+    block_q, d = q.shape
+    q_idx = pl.program_id(1)
+    q_offset = q_idx * block_q + causal_offset
+    num_kb = seq_k // block_k
+
+    def body(kb, dq_acc):
+        k_tile = k_ref[0, pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
+        v_tile = v_ref[0, pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_tile, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        valid = None
+        if causal:
+            rows = q_offset + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            valid = rows >= cols
+            s = jnp.where(valid, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])  # [block_q, block_k]
+        if valid is not None:
+            # fully-masked rows carry a sentinel lse; zero p explicitly
+            p = jnp.where(valid, p, 0.0)
+        dp = jax.lax.dot_general(
+            do, v_tile, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta[:, None])
+        return dq_acc + jax.lax.dot_general(
+            ds, k_tile, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    if causal:
+        hi = jnp.maximum(jnp.minimum(num_kb, (q_offset + block_q - 1) // block_k + 1), 0)
+    else:
+        hi = num_kb
+    dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+# ----------------------------------------------------------- backward: dK/dV
+def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *,
+                block_q: int, causal: bool, scale: float, seq_q: int, causal_offset: int):
+    k = k_ref[0].astype(jnp.float32)  # [block_k, D]
+    v = v_ref[0].astype(jnp.float32)
+    block_k, d = k.shape
+    k_idx = pl.program_id(1)
+    k_offset = k_idx * block_k
+    num_qb = seq_q // block_q
+
+    def body(qb, carry):
+        dk_acc, dv_acc = carry
+        q_tile = q_ref[0, pl.dslice(qb * block_q, block_q), :].astype(jnp.float32)
+        do_tile = do_ref[0, pl.dslice(qb * block_q, block_q), :].astype(jnp.float32)
+        lse_tile = lse_ref[0, pl.dslice(qb * block_q, block_q)]
+        delta_tile = delta_ref[0, pl.dslice(qb * block_q, block_q)]
+        s = jax.lax.dot_general(
+            q_tile, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [block_q, block_k]
+        valid = None
+        if causal:
+            rows = qb * block_q + causal_offset + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            cols = k_offset + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            valid = rows >= cols
+            s = jnp.where(valid, s, NEG_INF)
+        p = jnp.exp(s - lse_tile[:, None])
+        if valid is not None:
+            p = jnp.where(valid, p, 0.0)
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p, do_tile, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # pᵀ·dO : [block_k, D]
+        dp = jax.lax.dot_general(
+            do_tile, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_tile[:, None])
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds, q_tile, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # dSᵀ·Q : [block_k, D]
+        return dk_acc, dv_acc
+
+    if causal:
+        # first q tile whose last row can see this k block
+        lo = jnp.maximum(jnp.minimum((k_offset - causal_offset) // block_q, num_qb), 0)
+    else:
+        lo = 0
+    z = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(lo, num_qb, body, (z, z))
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _pallas_bwd(q, k, v, o, lse, g, causal: bool, scale: float,
+                block_q: int, block_k: int, interpret: bool):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    off = sk - sq
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [BH, Sq]
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, block_k=block_k, causal=causal, scale=scale,
+                          seq_k=sk, causal_offset=off),
+        grid=(bh, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),   # q
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),        # k
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),        # v
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),   # do
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),         # lse
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),         # delta
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, block_q=block_q, causal=causal, scale=scale,
+                          seq_q=sq, causal_offset=off),
+        grid=(bh, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),   # k
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),   # v
+            pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0)),        # q
+            pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0)),        # do
+            pl.BlockSpec((1, sq), lambda b, j: (b, 0)),              # lse
+            pl.BlockSpec((1, sq), lambda b, j: (b, 0)),              # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(k, v, q, g, lse, delta)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------- vjp wiring
 def _pick_block(s: int, target: int) -> int:
     b = min(target, s)
     while s % b:
         b //= 2
     return max(b, 1)
+
+
+def _use_kernel(sq: int, sk: int, interpret: bool) -> bool:
+    return (
+        _HAS_PALLAS
+        and (interpret or jax.default_backend() in ("tpu", "axon"))
+        and sq % 8 == 0
+        and sk % 8 == 0
+    )
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -114,30 +343,23 @@ def _flash_core(q, k, v, causal, scale, interpret):
 def _flash_core_fwd(q, k, v, causal, scale, interpret):
     bh, sq, d = q.shape
     sk = k.shape[1]
-    use_kernel = (
-        _HAS_PALLAS
-        and (interpret or jax.default_backend() in ("tpu", "axon"))
-        and sq % 8 == 0
-        and sk % 8 == 0
-    )
-    if use_kernel:
-        block_q = _pick_block(sq, 256)
+    if _use_kernel(sq, sk, interpret):
+        block_q = _pick_block(sq, 512)
         block_k = _pick_block(sk, 512)
-        out = _pallas_fwd_bhsd(q, k, v, causal, scale, block_q, block_k, interpret)
+        out, lse = _pallas_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
     else:
-        out = _ref_impl(q, k, v, causal, scale)
-    return out, (q, k, v)
+        out, lse = _ref_fwd_impl(q, k, v, causal, scale)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_core_bwd(causal, scale, interpret, res, g):
-    q, k, v = res
-    # Recompute-based backward through the reference formulation (one fused
-    # XLA program; memory-light).
-    def f(q_, k_, v_):
-        return _ref_impl(q_, k_, v_, causal, scale)
-
-    _, vjp_fn = jax.vjp(f, q, k, v)
-    return vjp_fn(g)
+    q, k, v, o, lse = res
+    sq, sk = q.shape[1], k.shape[1]
+    if _use_kernel(sq, sk, interpret):
+        block_q = _pick_block(sq, 256)
+        block_k = _pick_block(sk, 256)
+        return _pallas_bwd(q, k, v, o, lse, g, causal, scale, block_q, block_k, interpret)
+    return _ref_bwd_impl(q, k, v, o, lse, g, causal, scale)
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)

@@ -30,6 +30,10 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, scale: float
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     B, Sl, H, D = q.shape
+    if k.shape[2] != H:  # grouped-query attention: repeat kv heads
+        rep = H // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     qh = jnp.moveaxis(q, 2, 1).astype(jnp.float32) * scale  # [B,H,Sl,D]
 
     perm = [(i, (i + 1) % n) for i in range(n)]

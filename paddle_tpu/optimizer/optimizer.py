@@ -113,6 +113,21 @@ class Optimizer:
     def _update_param(self, p: Tensor, grad, lr: float, weight_decay: float):
         raise NotImplementedError
 
+    # ------------------------------------------------- state pre-creation
+    def _create_accumulators(self, p: Tensor):
+        """Eagerly create this optimizer's accumulators for ``p`` (paddle
+        parity: Optimizer._create_accumulators). Gives jit.TrainStep a stable
+        state pytree before the first traced step."""
+
+    def _ensure_state(self):
+        """Materialize accumulators + master weights for every parameter so
+        the optimizer state structure is fixed (required before tracing the
+        update into a compiled step)."""
+        for group in self._param_groups:
+            for p in group["params"]:
+                self._master(p)
+                self._create_accumulators(p)
+
     def clear_grad(self, set_to_zero: bool = False):
         for p in self._parameter_list:
             p.clear_grad()

@@ -27,6 +27,9 @@ class Momentum(Optimizer):
         self._momentum = momentum
         self._nesterov = use_nesterov
 
+    def _create_accumulators(self, p):
+        self._acc("velocity", p)
+
     def _update_param(self, p, grad, lr, weight_decay):
         w = self._master(p)
         if weight_decay:
@@ -49,6 +52,11 @@ class Adam(Optimizer):
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
+
+    def _create_accumulators(self, p):
+        self._acc("moment1", p)
+        self._acc("moment2", p)
+        self._acc("beta_pow", p, init=jnp.zeros((), jnp.float32))
 
     def _update_param(self, p, grad, lr, weight_decay):
         w = self._master(p)
@@ -108,6 +116,11 @@ class Adamax(Optimizer):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, False, name)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
 
+    def _create_accumulators(self, p):
+        self._acc("moment", p)
+        self._acc("inf_norm", p)
+        self._acc("beta_pow", p, init=jnp.zeros((), jnp.float32))
+
     def _update_param(self, p, grad, lr, weight_decay):
         w = self._master(p)
         if weight_decay:
@@ -130,6 +143,9 @@ class Adagrad(Optimizer):
         self._epsilon = epsilon
         self._init_acc = initial_accumulator_value
 
+    def _create_accumulators(self, p):
+        self._acc("moment", p, init=jnp.full_like(self._master(p), self._init_acc))
+
     def _update_param(self, p, grad, lr, weight_decay):
         w = self._master(p)
         if weight_decay:
@@ -145,6 +161,10 @@ class Adadelta(Optimizer):
                  weight_decay=None, grad_clip=None, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, False, name)
         self._epsilon, self._rho = epsilon, rho
+
+    def _create_accumulators(self, p):
+        self._acc("avg_squared_grad", p)
+        self._acc("avg_squared_update", p)
 
     def _update_param(self, p, grad, lr, weight_decay):
         w = self._master(p)
@@ -165,6 +185,12 @@ class RMSProp(Optimizer):
                  parameters=None, weight_decay=None, grad_clip=None, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, False, name)
         self._rho, self._epsilon, self._momentum, self._centered = rho, epsilon, momentum, centered
+
+    def _create_accumulators(self, p):
+        self._acc("mean_square", p)
+        self._acc("momentum", p)
+        if self._centered:
+            self._acc("mean_grad", p)
 
     def _update_param(self, p, grad, lr, weight_decay):
         w = self._master(p)
@@ -194,6 +220,11 @@ class Lamb(Optimizer):
         self._wd = lamb_weight_decay
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
         self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _create_accumulators(self, p):
+        self._acc("moment1", p)
+        self._acc("moment2", p)
+        self._acc("beta_pow", p, init=jnp.zeros((), jnp.float32))
 
     def _update_param(self, p, grad, lr, weight_decay):
         w = self._master(p)

@@ -5,6 +5,7 @@ import tempfile
 import numpy as np
 import pytest
 
+import jax.numpy as jnp
 import paddle_tpu as P
 import paddle_tpu.nn as nn
 import paddle_tpu.nn.functional as F
@@ -263,3 +264,101 @@ class TestSaveLoad:
         loaded.set_onto(net2)
         x = P.randn([2, 8])
         np.testing.assert_allclose(net(x).numpy(), net2.eval()(x).numpy() if callable(net2) else None, rtol=1e-5)
+
+
+class TestTrainStepOptimizerParity:
+    """TrainStep must trace the framework's own optimizers: one compiled step
+    == one eager step for every optimizer (VERDICT r1 item 3)."""
+
+    OPTS = [
+        ("SGD", lambda ps: P.optimizer.SGD(0.05, parameters=ps)),
+        ("Momentum", lambda ps: P.optimizer.Momentum(0.05, 0.9, parameters=ps)),
+        ("Adam", lambda ps: P.optimizer.Adam(0.05, parameters=ps)),
+        ("AdamW", lambda ps: P.optimizer.AdamW(0.05, parameters=ps, weight_decay=0.01)),
+        ("Adamax", lambda ps: P.optimizer.Adamax(0.05, parameters=ps)),
+        ("Adagrad", lambda ps: P.optimizer.Adagrad(0.05, parameters=ps)),
+        ("Adadelta", lambda ps: P.optimizer.Adadelta(0.05, parameters=ps)),
+        ("RMSProp", lambda ps: P.optimizer.RMSProp(0.05, parameters=ps)),
+        ("Lamb", lambda ps: P.optimizer.Lamb(0.05, parameters=ps)),
+        ("Lars", lambda ps: P.optimizer.Lars(0.05, parameters=ps)),
+    ]
+
+    @pytest.mark.parametrize("name,mk", OPTS, ids=[n for n, _ in OPTS])
+    def test_compiled_matches_eager(self, name, mk):
+        X = np.random.RandomState(0).randn(16, 4).astype(np.float32)
+        Y = np.random.RandomState(1).randn(16, 3).astype(np.float32)
+
+        def run(compiled):
+            P.seed(7)
+            net = nn.Linear(4, 3)
+            opt = mk(net.parameters())
+            if compiled:
+                step = P.jit.TrainStep(net, lambda m, x, y: F.mse_loss(m(x), y), opt)
+                for _ in range(3):
+                    loss = step(P.to_tensor(X), P.to_tensor(Y))
+            else:
+                for _ in range(3):
+                    loss = F.mse_loss(net(P.to_tensor(X)), P.to_tensor(Y))
+                    loss.backward()
+                    opt.step()
+                    opt.clear_grad()
+            return net.weight.numpy(), net.bias.numpy(), float(loss.numpy())
+
+        w_c, b_c, l_c = run(True)
+        w_e, b_e, l_e = run(False)
+        np.testing.assert_allclose(w_c, w_e, rtol=2e-5, atol=2e-6)
+        np.testing.assert_allclose(b_c, b_e, rtol=2e-5, atol=2e-6)
+        np.testing.assert_allclose(l_c, l_e, rtol=2e-5, atol=2e-6)
+
+    def test_multi_precision_master_weights(self):
+        P.seed(11)
+        net = nn.Linear(8, 8)
+        for p in net.parameters():
+            p._value = p._value.astype(jnp.bfloat16)
+        opt = P.optimizer.AdamW(1e-3, parameters=net.parameters(), multi_precision=True)
+        step = P.jit.TrainStep(net, lambda m, x, y: F.mse_loss(m(x), y), opt)
+        X, Y = P.randn([4, 8]).astype("bfloat16"), P.randn([4, 8]).astype("bfloat16")
+        for _ in range(2):
+            loss = step(X, Y)
+        assert np.isfinite(float(loss.numpy()))
+        # fp32 master weights exist and drive the update
+        assert opt._master_weights
+        for mw in opt._master_weights.values():
+            assert mw.dtype == jnp.float32
+        # params remain bf16
+        assert net.weight._value.dtype == jnp.bfloat16
+
+    def test_lr_scheduler_traced_scalar(self):
+        P.seed(13)
+        net = nn.Linear(2, 2)
+        sched = P.optimizer.lr.StepDecay(learning_rate=0.1, step_size=1, gamma=0.1)
+        opt = P.optimizer.SGD(sched, parameters=net.parameters())
+        step = P.jit.TrainStep(net, lambda m, x, y: F.mse_loss(m(x), y), opt)
+        X, Y = P.ones([2, 2]), P.zeros([2, 2])
+        w0 = net.weight.numpy().copy()
+        step(X, Y)
+        d1 = np.abs(net.weight.numpy() - w0).max()
+        sched.step()  # lr drops 10x; no recompile should be needed
+        w1 = net.weight.numpy().copy()
+        step(X, Y)
+        d2 = np.abs(net.weight.numpy() - w1).max()
+        assert d2 < d1 * 0.5  # smaller lr -> smaller update
+
+    def test_grad_scaler_inside_trainstep(self):
+        P.seed(17)
+        net = nn.Linear(4, 4)
+        opt = P.optimizer.SGD(0.1, parameters=net.parameters())
+        scaler = P.amp.GradScaler(init_loss_scaling=1024.0, incr_every_n_steps=2,
+                                  decr_every_n_nan_or_inf=1)
+        step = P.jit.TrainStep(net, lambda m, x, y: F.mse_loss(m(x), y), opt, scaler=scaler)
+        X, Y = P.randn([4, 4]), P.randn([4, 4])
+        for _ in range(2):
+            loss = step(X, Y)
+        assert np.isfinite(float(loss.numpy()))
+        # 2 good steps with incr_every_n_steps=2 -> scale doubled
+        assert float(scaler.get_loss_scaling()) == 2048.0
+        # a nan batch must skip the update and halve the scale
+        w_before = net.weight.numpy().copy()
+        step(P.full([4, 4], np.nan), Y)
+        np.testing.assert_array_equal(net.weight.numpy(), w_before)
+        assert float(scaler.get_loss_scaling()) == 1024.0

@@ -31,10 +31,12 @@ def main():
 
     P.seed(0)
     if on_accel:
+        # largest decoder that fits one v5e chip with fp32 AdamW master
+        # weights + moments (14 bytes/param): ~0.94B params -> ~13GB state
         cfg = LlamaConfig(
-            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
-            num_hidden_layers=16, num_attention_heads=16,
-            max_position_embeddings=2048, dtype="bfloat16",
+            vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+            num_hidden_layers=15, num_attention_heads=16,
+            max_position_embeddings=2048, dtype="bfloat16", recompute=True,
         )
         batch, seq, steps = 8, 2048, 20
     else:

@@ -164,6 +164,12 @@ class _ShardOptimizer:
             orig_set(name, p, value)
 
         optimizer._set_acc = wrapped_set
+        # stage 3: reshard the params themselves before any state is created
+        if shard_fn is not None and hasattr(shard_fn, "shard_params"):
+            shard_fn.shard_params(optimizer._parameter_list)
+        # stage >= 2: expose the grad-sharding hook to TrainStep / eager step
+        if shard_fn is not None and hasattr(shard_fn, "shard_grad"):
+            optimizer._shard_grad = shard_fn.shard_grad
 
     def __getattr__(self, k):
         return getattr(self._inner, k)
@@ -174,27 +180,82 @@ def shard_optimizer(optimizer, shard_fn=None):
 
 
 class ShardingStage1:
-    """Placement policy objects (parity api.py:1154): accumulators sharded on
-    the 'sharding'/dp axis along dim 0 when divisible."""
+    """ZeRO stage 1 (parity api.py:1154): optimizer accumulators sharded on
+    the 'sharding' axis along dim 0 when divisible. On TPU the shard lives as
+    a dim-0 NamedSharding; the optimizer update then runs shard-local under
+    GSPMD (reference: dygraph_sharding_optimizer.py:44)."""
+
+    stage = 1
 
     def __init__(self, axis_name="dp", mesh: Optional[ProcessMesh] = None):
         self.axis = axis_name
         self.mesh = mesh
 
-    def __call__(self, acc_name, param, value):
-        mesh = self.mesh or getattr(param, "_dist_meta", (None,))[0]
+    # -- helpers -----------------------------------------------------------
+    def _mesh_for(self, param):
+        return self.mesh or getattr(param, "_dist_meta", (None,))[0]
+
+    def _dim0_sharding(self, mesh, value) -> Optional[NamedSharding]:
         if mesh is None or np.ndim(value) == 0:
-            return value
+            return None
         size = mesh.get_dim_size(self.axis)
-        if value.shape[0] % size == 0:
-            spec = [None] * np.ndim(value)
-            spec[0] = self.axis
-            return jax.device_put(value, NamedSharding(mesh.jax_mesh, PartitionSpec(*spec)))
-        return value
+        if size <= 1 or value.shape[0] % size != 0:
+            return None
+        spec = [None] * np.ndim(value)
+        spec[0] = self.axis
+        return NamedSharding(mesh.jax_mesh, PartitionSpec(*spec))
+
+    # -- accumulator placement (hooked by _ShardOptimizer._set_acc) --------
+    def __call__(self, acc_name, param, value):
+        sharding = self._dim0_sharding(self._mesh_for(param), value)
+        if sharding is None:
+            return value
+        if _is_tracer(value):
+            return jax.lax.with_sharding_constraint(value, sharding)
+        return jax.device_put(value, sharding)
 
 
-ShardingStage2 = ShardingStage1  # grads also live sharded: same placement policy in SPMD
+class ShardingStage2(ShardingStage1):
+    """ZeRO stage 2: stage 1 + gradients sharded on the sharding axis.
+    Inside a compiled step the grad constraint turns the dp grad all-reduce
+    into a reduce-scatter (the ZeRO-2 communication pattern); eagerly the
+    grad is re-laid-out to dim-0 shards so replicated grad storage is freed
+    (reference: group_sharded_stage2.py:46)."""
+
+    stage = 2
+
+    def shard_grad(self, param, grad_value):
+        sharding = self._dim0_sharding(self._mesh_for(param), grad_value)
+        if sharding is None:
+            return grad_value
+        if _is_tracer(grad_value):
+            return jax.lax.with_sharding_constraint(grad_value, sharding)
+        return jax.device_put(grad_value, sharding)
 
 
-class ShardingStage3(ShardingStage1):
-    pass
+class ShardingStage3(ShardingStage2):
+    """ZeRO stage 3: stage 2 + parameters STORED sharded on the sharding
+    axis; GSPMD inserts the gather-on-use (all-gather before the matmul) and
+    the reduce-scatter on the grad — the reference's explicit param-slice +
+    prefetch machinery (group_sharded_stage3.py:85) collapses into sharding
+    annotations."""
+
+    stage = 3
+
+    def shard_params(self, parameters):
+        for p in parameters:
+            if p is None or not getattr(p, "trainable", True):
+                continue
+            mesh = self._mesh_for(p)
+            sharding = self._dim0_sharding(mesh, p._value)
+            if sharding is None:
+                continue
+            # keep any existing non-trivial sharding (e.g. TP mp shard) —
+            # stage 3 only reshards params that are replicated on this axis
+            cur = getattr(p._value, "sharding", None)
+            if cur is not None and not cur.is_fully_replicated:
+                continue
+            p._value = jax.device_put(p._value, sharding)
+            if mesh is not None:
+                p._dist_meta = (mesh, [Shard(0) if n == self.axis else Replicate()
+                                       for n in mesh.dim_names])

@@ -102,7 +102,44 @@ def distributed_optimizer(optimizer, strategy=None):
     cfg = (_strategy.hybrid_configs if _strategy else {})
     sharding_degree = cfg.get("sharding_degree", 1)
     if sharding_degree > 1:
-        from ..auto_parallel.api import ShardingStage1, shard_optimizer
+        from ..auto_parallel.api import (
+            ShardingStage1,
+            ShardingStage2,
+            ShardingStage3,
+            shard_optimizer,
+        )
 
-        return shard_optimizer(optimizer, ShardingStage1("sharding", hcg.process_mesh))
+        stage = int((_strategy.sharding_configs if _strategy else {}).get("stage", 1))
+        cls = {1: ShardingStage1, 2: ShardingStage2, 3: ShardingStage3}[stage]
+        return shard_optimizer(optimizer, cls("sharding", hcg.process_mesh))
     return optimizer
+
+
+def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
+                           offload=False, sync_buffers=False, buffer_max_size=None,
+                           segment_size=None, sync_comm=False):
+    """parity: paddle.distributed.sharding.group_sharded_parallel — dygraph
+    ZeRO entry. level: 'os' (stage 1), 'os_g' (stage 2), 'p_g_os' (stage 3).
+    Reference: python/paddle/distributed/sharding/group_sharded.py."""
+    from ..auto_parallel.api import (
+        ShardingStage1,
+        ShardingStage2,
+        ShardingStage3,
+        shard_optimizer,
+    )
+
+    levels = {"os": ShardingStage1, "os_g": ShardingStage2, "p_g_os": ShardingStage3}
+    if level not in levels:
+        raise ValueError(
+            f"group_sharded_parallel level must be one of {sorted(levels)} "
+            f"(got {level!r})")
+    if offload:
+        import warnings
+
+        warnings.warn("group_sharded_parallel(offload=True) is not supported "
+                      "on TPU (HBM-resident state only); ignoring", stacklevel=2)
+    hcg = get_hybrid_communicate_group()
+    mesh = hcg.process_mesh if hcg is not None else None
+    axis = "sharding" if (hcg is not None and hcg.axis_size("sharding") > 1) else "dp"
+    opt = shard_optimizer(optimizer, levels[level](axis, mesh))
+    return model, opt, scaler

@@ -72,8 +72,44 @@ class PipelineParallel(Layer):
         return self._layers(x)
 
     # -------------------------------------------------------------- engine
+    def _build_schedule(self, num_micro: int):
+        from .schedules import (
+            fthenb_schedule,
+            interleaved_1f1b_schedule,
+            one_f_one_b_schedule,
+            zero_bubble_schedule,
+        )
+
+        from .schedules import BWD, FWD, ScheduleOp
+
+        mode = self.schedule.upper()
+        p = self._layers.num_stages
+        v = self._layers.num_chunks
+        if mode == "VPP" or (mode == "1F1B" and v > 1):
+            return interleaved_1f1b_schedule(num_micro, p, v)
+        if mode == "1F1B":
+            return one_f_one_b_schedule(num_micro, p)
+        if mode in ("ZBH1", "ZB", "ZEROBUBBLE", "ZERO_BUBBLE"):
+            if v > 1:
+                raise ValueError(
+                    "zero-bubble schedule does not support virtual pipeline "
+                    "chunks; use schedule_mode='VPP' for interleaved stages")
+            return zero_bubble_schedule(num_micro, p)
+        if v > 1:  # chunk-aware GPipe: all chunks forward, reverse backward
+            return (
+                [ScheduleOp(FWD, m, c) for m in range(num_micro) for c in range(v)]
+                + [ScheduleOp(BWD, m, c) for m in range(num_micro)
+                   for c in range(v - 1, -1, -1)]
+            )
+        return fthenb_schedule(num_micro, p)
+
     def forward_backward_pipeline(self, data, scaler=None):
-        """Run one global batch: returns the averaged loss tensor."""
+        """Run one global batch by executing the explicit schedule op list
+        (schedules.py — 1F1B / interleaved VPP / ZB-H1 / FThenB as distinct
+        programs). Returns the averaged loss tensor."""
+        from ....autograd import tape
+        from .schedules import BWD, BWD_INPUT, BWD_WEIGHT, FWD
+
         x, label = data
         num_micro = self.accumulate_steps
         if self.micro_batch_size is not None:
@@ -81,39 +117,63 @@ class PipelineParallel(Layer):
         xs = self._split_micro(x, num_micro) if num_micro > 1 else [x]
         ys = self._split_micro(label, num_micro) if num_micro > 1 else [label]
 
-        losses = []
+        v = self._layers.num_chunks
+        last_chunk = v - 1
+        losses = [None] * num_micro
+        # (micro, chunk) -> {"in": boundary leaf, "out": chunk output,
+        #                    "scaled": scaled loss (last chunk only)}
+        state = {}
 
-        def run_one(mb_x, mb_y):
-            out = mb_x
-            for s in range(self._layers.num_stages):
-                out = self._layers.forward_stage(out, s)
-            loss = self._layers.loss_fn(out, mb_y)
-            scaled = loss / num_micro
-            if scaler is not None:
-                scaled = scaler.scale(scaled)
-            return loss, scaled
-
-        if self.schedule.upper() in ("1F1B", "VPP"):
-            # depth-first: fwd mb_i then bwd mb_i; async dispatch overlaps
-            # stage s of mb_{i+1} with stage s+1 of mb_i
-            for mb_x, mb_y in zip(xs, ys):
-                loss, scaled = run_one(mb_x, mb_y)
-                scaled.backward()
-                losses.append(loss)
-        else:  # FThenB / GPipe
-            pending = []
-            for mb_x, mb_y in zip(xs, ys):
-                loss, scaled = run_one(mb_x, mb_y)
-                pending.append(scaled)
-                losses.append(loss)
-            for scaled in pending:
-                scaled.backward()
+        for op in self._build_schedule(num_micro):
+            m, c = op.micro, op.chunk
+            if op.kind == FWD:
+                if c == 0:
+                    inp = xs[m]
+                else:
+                    # chunk boundary: detach into a leaf so each chunk's
+                    # backward runs independently (the eager analog of the
+                    # reference's p2p activation handoff)
+                    prev_out = state[(m, c - 1)]["out"]
+                    inp = prev_out.detach()
+                    inp.stop_gradient = False
+                out = self._layers.forward_chunk(inp, c)
+                ent = {"in": inp, "out": out}
+                if c == last_chunk:
+                    loss = self._layers.loss_fn(out, ys[m])
+                    scaled = loss / num_micro
+                    if scaler is not None:
+                        scaled = scaler.scale(scaled)
+                    ent["scaled"] = scaled
+                    losses[m] = loss.detach()
+                state[(m, c)] = ent
+            elif op.kind in (BWD, BWD_INPUT):
+                # BWD_INPUT (zero-bubble Bx) runs the combined backward here:
+                # under single-controller SPMD, XLA's latency-hiding scheduler
+                # floats the weight-grad matmuls into bubbles on its own, so
+                # the Bx/Bw split survives as schedule order, not split kernels
+                ent = state.pop((m, c))
+                if c == last_chunk:
+                    ent["scaled"].backward()
+                elif ent["in"] is not None:
+                    down_cot = ent.pop("_cot", None)
+                    if down_cot is None:
+                        raise RuntimeError(
+                            f"pipeline schedule ran B({m},{c}) before its "
+                            f"downstream chunk's backward")
+                    tape.run_backward([ent["out"]], [down_cot], accumulate_leaf=True)
+                # hand this chunk's input-grad up to the previous chunk
+                if c > 0:
+                    g = ent["in"].grad
+                    if g is not None and (m, c - 1) in state:
+                        state[(m, c - 1)]["_cot"] = g._value
+            elif op.kind == BWD_WEIGHT:
+                pass  # folded into BWD_INPUT (see above)
 
         from ....tensor.manipulation import stack
         from ....tensor.math import mean
 
         with __import__("paddle_tpu").no_grad():
-            self.total_loss = mean(stack([l.detach() for l in losses]))
+            self.total_loss = mean(stack(losses))
         return self.total_loss
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):

@@ -94,10 +94,15 @@ class PipelineLayer(Layer):
         if num_stages is None:
             num_stages = hcg.axis_size("pp") if hcg is not None else 1
         self._num_stages = num_stages
+        # interleaved VPP: each device owns num_chunks virtual stages; global
+        # segment g lives on device g % num_stages (Megatron assignment,
+        # reference pp_layers.py num_virtual_pipeline_stage)
+        self._num_chunks = num_virtual_pipeline_stages or 1
+        num_segments = num_stages * self._num_chunks
         self._loss_fn = loss_fn
         self._recompute_interval = recompute_interval
         self._descs = list(layers)
-        seg = SegmentLayers(self._descs, num_stages, seg_method)
+        seg = SegmentLayers(self._descs, num_segments, seg_method)
         self.segment_parts = seg.do_segment()
         self._shared_layers: Dict[str, Layer] = {}
         self._stage_layers: List[List] = []
@@ -105,7 +110,7 @@ class PipelineLayer(Layer):
         from ....nn.layer.container import LayerList
 
         all_built = []
-        for s in range(num_stages):
+        for s in range(num_segments):
             stage = []
             fwd_funcs = []
             for i in range(self.segment_parts[s], self.segment_parts[s + 1]):
@@ -133,7 +138,9 @@ class PipelineLayer(Layer):
             built = LayerList([l for l in stage if isinstance(l, Layer)])
             all_built.append(built)
             self.add_sublayer(f"stage_{s}", built)
-        self._submeshes = [self._stage_submesh(s) for s in range(num_stages)]
+        # segment g -> device (g % num_stages)'s submesh
+        self._submeshes = [self._stage_submesh(s % num_stages) for s in range(num_segments)]
+        self._num_segments = num_segments
         self._place_stages()
 
     # ---------------------------------------------------------------- place
@@ -148,7 +155,7 @@ class PipelineLayer(Layer):
         return Mesh(devs, names)
 
     def _place_stages(self):
-        for s in range(self._num_stages):
+        for s in range(self._num_segments):
             sub = self._submeshes[s]
             if sub is None:
                 continue
@@ -176,11 +183,26 @@ class PipelineLayer(Layer):
     def num_stages(self) -> int:
         return self._num_stages
 
+    @property
+    def num_chunks(self) -> int:
+        return self._num_chunks
+
+    @property
+    def num_segments(self) -> int:
+        return self._num_segments
+
     def get_stage_from_index(self, layer_idx: int) -> int:
-        for s in range(self._num_stages):
+        for s in range(self._num_segments):
             if self.segment_parts[s] <= layer_idx < self.segment_parts[s + 1]:
-                return s
+                return s % self._num_stages
         raise IndexError(layer_idx)
+
+    def forward_chunk(self, x, chunk: int):
+        """Run virtual chunk ``chunk`` = global segments
+        [chunk*p, (chunk+1)*p) across all p devices in order."""
+        for seg in range(chunk * self._num_stages, (chunk + 1) * self._num_stages):
+            x = self.forward_stage(x, seg)
+        return x
 
     def forward_stage(self, x, stage: int):
         """Run one stage's chain; input is moved onto the stage submesh by a
@@ -209,7 +231,7 @@ class PipelineLayer(Layer):
         return x
 
     def forward(self, x):
-        for s in range(self._num_stages):
+        for s in range(self._num_segments):
             x = self.forward_stage(x, s)
         return x
 

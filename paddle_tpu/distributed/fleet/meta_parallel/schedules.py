@@ -1,0 +1,140 @@
+"""Pipeline schedules as explicit op programs (parity:
+/root/reference/python/paddle/distributed/passes/pipeline_scheduler_pass/
+pipeline_1f1b.py, pipeline_vpp.py, pipeline_zero_bubble.py and the dygraph
+engine python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py:229
+(1F1B), :1136 (interleaved VPP)).
+
+A schedule is a list of ``ScheduleOp(kind, micro, chunk)`` in global dispatch
+order. The reference encodes schedules twice (eager per-rank loops AND static
+pass-generated programs); here one explicit program drives the
+single-controller SPMD engine: XLA async dispatch overlaps consecutive ops
+that touch different pp-stage submeshes, so ordering is the whole schedule.
+
+Zero-bubble (ZB-H1) splits the backward into input-grad (BWD_INPUT) and
+weight-grad (BWD_WEIGHT) phases; weight-grad ops are fillers that commute
+with pipeline-critical ops, which is what removes the bubble.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List
+
+__all__ = [
+    "ScheduleOp", "FWD", "BWD", "BWD_INPUT", "BWD_WEIGHT",
+    "fthenb_schedule", "one_f_one_b_schedule", "interleaved_1f1b_schedule",
+    "zero_bubble_schedule", "max_live_activations",
+]
+
+FWD = "F"
+BWD = "B"
+BWD_INPUT = "Bx"   # zero-bubble: dL/d(input) only — on the critical path
+BWD_WEIGHT = "Bw"  # zero-bubble: dL/d(weights) — bubble filler
+
+
+@dataclass(frozen=True)
+class ScheduleOp:
+    kind: str
+    micro: int
+    chunk: int = 0  # virtual-stage chunk (VPP); 0 for flat schedules
+
+    def __repr__(self):
+        c = f"c{self.chunk}" if self.chunk else ""
+        return f"{self.kind}{self.micro}{c}"
+
+
+def fthenb_schedule(num_micro: int, num_stages: int) -> List[ScheduleOp]:
+    """GPipe: all forwards, then all backwards. Peak activation liveness =
+    num_micro (every microbatch's activations held before the first B)."""
+    return [ScheduleOp(FWD, m) for m in range(num_micro)] + \
+           [ScheduleOp(BWD, m) for m in range(num_micro)]
+
+
+def one_f_one_b_schedule(num_micro: int, num_stages: int) -> List[ScheduleOp]:
+    """1F1B (reference pipeline_parallel.py:229): warmup ``num_stages``
+    forwards, then steady-state B/F pairs, then drain. Peak liveness =
+    min(num_stages, num_micro) instead of num_micro."""
+    warmup = min(num_stages, num_micro)
+    ops: List[ScheduleOp] = [ScheduleOp(FWD, m) for m in range(warmup)]
+    next_f = warmup
+    for m in range(num_micro):
+        ops.append(ScheduleOp(BWD, m))
+        if next_f < num_micro:
+            ops.append(ScheduleOp(FWD, next_f))
+            next_f += 1
+    return ops
+
+
+def interleaved_1f1b_schedule(num_micro: int, num_stages: int,
+                              num_chunks: int) -> List[ScheduleOp]:
+    """Interleaved VPP (reference pipeline_parallel.py:1136 /
+    pipeline_vpp.py): each device owns ``num_chunks`` virtual stages; the
+    forward of micro group g runs chunk-major so the pipeline fills
+    ``num_stages``-sized micro groups across chunks, shrinking the bubble by
+    ~1/num_chunks. Requires num_micro % num_stages == 0 (Megatron contract)."""
+    if num_chunks <= 1:
+        return one_f_one_b_schedule(num_micro, num_stages)
+    if num_micro % num_stages != 0:
+        raise ValueError(
+            f"interleaved VPP requires num_micro ({num_micro}) divisible by "
+            f"num_stages ({num_stages})")
+
+    # forward unit order: groups of num_stages micros, chunk-major inside
+    fwd_units: List[ScheduleOp] = []
+    for g in range(0, num_micro, num_stages):
+        for c in range(num_chunks):
+            for m in range(g, g + num_stages):
+                fwd_units.append(ScheduleOp(FWD, m, c))
+    # backward unit order: reverse micro groups, reverse chunk-major
+    bwd_units: List[ScheduleOp] = []
+    for g in range(0, num_micro, num_stages):
+        for c in range(num_chunks - 1, -1, -1):
+            for m in range(g, g + num_stages):
+                bwd_units.append(ScheduleOp(BWD, m, c))
+
+    # 1F1B interleave over units: warmup = one full wave of chunks
+    warmup = min(len(fwd_units), num_stages * num_chunks)
+    ops = list(fwd_units[:warmup])
+    fi = warmup
+    for bi in range(len(bwd_units)):
+        ops.append(bwd_units[bi])
+        if fi < len(fwd_units):
+            ops.append(fwd_units[fi])
+            fi += 1
+    return ops
+
+
+def zero_bubble_schedule(num_micro: int, num_stages: int) -> List[ScheduleOp]:
+    """ZB-H1 (reference pipeline_zero_bubble.py): like 1F1B but the backward
+    is split; BWD_INPUT stays on the critical path while BWD_WEIGHT ops are
+    deferred into what would otherwise be pipeline bubbles, then flushed."""
+    warmup = min(num_stages, num_micro)
+    ops: List[ScheduleOp] = [ScheduleOp(FWD, m) for m in range(warmup)]
+    next_f = warmup
+    pending_w: List[int] = []
+    for m in range(num_micro):
+        ops.append(ScheduleOp(BWD_INPUT, m))
+        pending_w.append(m)
+        if next_f < num_micro:
+            ops.append(ScheduleOp(FWD, next_f))
+            next_f += 1
+        else:
+            # drain phase: bubbles appear — fill them with weight grads
+            if pending_w:
+                ops.append(ScheduleOp(BWD_WEIGHT, pending_w.pop(0)))
+    for m in pending_w:
+        ops.append(ScheduleOp(BWD_WEIGHT, m))
+    return ops
+
+
+def max_live_activations(ops: List[ScheduleOp], num_chunks: int = 1) -> int:
+    """Peak number of microbatch-chunk activations held at once — the memory
+    property that distinguishes 1F1B from GPipe."""
+    live = set()
+    peak = 0
+    for op in ops:
+        if op.kind == FWD:
+            live.add((op.micro, op.chunk))
+            peak = max(peak, len(live))
+        elif op.kind in (BWD, BWD_INPUT):
+            live.discard((op.micro, op.chunk))
+    return peak

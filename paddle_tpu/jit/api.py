@@ -346,6 +346,13 @@ class TrainStep:
                 nonfinite = sum(jnp.sum(~jnp.isfinite(g)) for g in grads)
                 found_inf = nonfinite > 0
 
+            # ZeRO stage >= 2: constrain grads to the sharding axis so XLA
+            # emits reduce-scatter instead of all-reduce (auto_parallel
+            # ShardingStage2/3.shard_grad)
+            shard_grad = getattr(opt, "_shard_grad", None)
+            if shard_grad is not None:
+                grads = [shard_grad(p, g) for p, g in zip(params, grads)]
+
             # ---- optimizer update: trace the framework's own _update_param.
             # Install traced state into the optimizer's dicts for the duration
             # of the trace, then restore the concrete values.

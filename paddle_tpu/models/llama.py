@@ -46,6 +46,7 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = False
     use_flash_attention: bool = True
+    recompute: bool = False  # activation checkpointing per decoder layer
     dtype: str = "float32"
 
     def __post_init__(self):
@@ -229,10 +230,18 @@ class LlamaModel(nn.Layer):
                            hidden, op_name="sep_shard")
         cos, sin = self._buffers["rope_cos"], self._buffers["rope_sin"]
         new_caches = []
+        use_recompute = self.config.recompute and caches is None and self.training
         for i, layer in enumerate(self.layers):
             if caches is not None:
                 hidden, c = layer(hidden, cos, sin, attn_mask, caches[i])
                 new_caches.append(c)
+            elif use_recompute:
+                from ..distributed.fleet.utils.recompute import recompute
+
+                if attn_mask is None:
+                    hidden = recompute(layer, hidden, cos, sin)
+                else:
+                    hidden = recompute(layer, hidden, cos, sin, attn_mask)
             else:
                 hidden = layer(hidden, cos, sin, attn_mask)
         hidden = self.norm(hidden)

@@ -49,6 +49,13 @@ def _ref_attention(q, k, v, *, causal: bool, scale, mask=None, dropout: float = 
 
 
 def _use_pallas(q_val) -> bool:
+    import os
+
+    force = os.environ.get("PADDLE_TPU_ATTN")
+    if force == "ref":
+        return False
+    if force == "pallas":
+        return True
     try:
         plat = q_val.devices() if hasattr(q_val, "devices") else None
         if plat:

@@ -139,7 +139,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bo
     acc, m, l = jax.lax.fori_loop(0, hi, body, (acc0, m0, l0))  # noqa: E741
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l_safe)
+    lse_ref[0] = (m + jnp.log(l_safe))[:, None]  # [block_q, 1] lane-broadcastable
 
 
 def _pallas_fwd(q, k, v, causal: bool, scale: float, block_q: int, block_k: int, interpret: bool):
@@ -151,7 +151,7 @@ def _pallas_fwd(q, k, v, causal: bool, scale: float, block_q: int, block_k: int,
         _fwd_kernel, block_k=block_k, causal=causal, scale=scale, seq_k=sk,
         causal_offset=sk - sq,
     )
-    return pl.pallas_call(
+    out, lse3 = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -161,14 +161,15 @@ def _pallas_fwd(q, k, v, causal: bool, scale: float, block_q: int, block_k: int,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v)
+    return out, lse3[..., 0]
 
 
 # ------------------------------------------------------------ backward: dQ
@@ -176,7 +177,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
                block_k: int, causal: bool, scale: float, seq_k: int, causal_offset: int):
     q = q_ref[0].astype(jnp.float32)  # [block_q, D]
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]  # [block_q]
+    lse = lse_ref[0]  # [block_q, 1] — broadcasts over the lane (k) dim
     delta = delta_ref[0]
     block_q, d = q.shape
     q_idx = pl.program_id(1)
@@ -195,14 +196,14 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
             cols = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             valid = rows >= cols
             s = jnp.where(valid, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])  # [block_q, block_k]
+        p = jnp.exp(s - lse)  # [block_q, block_k]
         if valid is not None:
             # fully-masked rows carry a sentinel lse; zero p explicitly
             p = jnp.where(valid, p, 0.0)
         dp = jax.lax.dot_general(
             do, v_tile, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta[:, None])
+        ds = p * (dp - delta)
         return dq_acc + jax.lax.dot_general(
             ds, k_tile, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -229,8 +230,8 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         dk_acc, dv_acc = carry
         q_tile = q_ref[0, pl.dslice(qb * block_q, block_q), :].astype(jnp.float32)
         do_tile = do_ref[0, pl.dslice(qb * block_q, block_q), :].astype(jnp.float32)
-        lse_tile = lse_ref[0, pl.dslice(qb * block_q, block_q)]
-        delta_tile = delta_ref[0, pl.dslice(qb * block_q, block_q)]
+        lse_tile = lse_ref[0, pl.dslice(qb * block_q, block_q), :]   # [block_q, 1]
+        delta_tile = delta_ref[0, pl.dslice(qb * block_q, block_q), :]
         s = jax.lax.dot_general(
             q_tile, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [block_q, block_k]
@@ -242,7 +243,7 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
             cols = k_offset + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             valid = rows >= cols
             s = jnp.where(valid, s, NEG_INF)
-        p = jnp.exp(s - lse_tile[:, None])
+        p = jnp.exp(s - lse_tile)
         if valid is not None:
             p = jnp.where(valid, p, 0.0)
         dv_acc = dv_acc + jax.lax.dot_general(
@@ -251,7 +252,7 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         dp = jax.lax.dot_general(
             do_tile, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta_tile[:, None])
+        ds = p * (dp - delta_tile)
         dk_acc = dk_acc + jax.lax.dot_general(
             ds, q_tile, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )  # dSᵀ·Q : [block_k, D]
@@ -274,6 +275,8 @@ def _pallas_bwd(q, k, v, o, lse, g, causal: bool, scale: float,
     sk = k.shape[1]
     off = sk - sq
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [BH, Sq]
+    lse3 = lse[..., None]      # trailing singleton lane dim for TPU tiling
+    delta3 = delta[..., None]
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, block_k=block_k, causal=causal, scale=scale,
@@ -284,13 +287,13 @@ def _pallas_bwd(q, k, v, o, lse, g, causal: bool, scale: float,
             pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),        # k
             pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),        # v
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),   # do
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),         # lse
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),         # delta
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),   # lse
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),   # delta
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         interpret=interpret,
-    )(q, k, v, g, lse, delta)
+    )(q, k, v, g, lse3, delta3)
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, block_q=block_q, causal=causal, scale=scale,
@@ -301,8 +304,8 @@ def _pallas_bwd(q, k, v, o, lse, g, causal: bool, scale: float,
             pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),   # v
             pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0)),        # q
             pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0)),        # do
-            pl.BlockSpec((1, sq), lambda b, j: (b, 0)),              # lse
-            pl.BlockSpec((1, sq), lambda b, j: (b, 0)),              # delta
+            pl.BlockSpec((1, sq, 1), lambda b, j: (b, 0, 0)),        # lse
+            pl.BlockSpec((1, sq, 1), lambda b, j: (b, 0, 0)),        # delta
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
@@ -313,7 +316,7 @@ def _pallas_bwd(q, k, v, o, lse, g, causal: bool, scale: float,
             jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
         ],
         interpret=interpret,
-    )(k, v, q, g, lse, delta)
+    )(k, v, q, g, lse3, delta3)
     return dq, dk, dv
 
 

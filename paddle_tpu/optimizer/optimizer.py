@@ -96,6 +96,11 @@ class Optimizer:
     # ----------------------------------------------------------- step
     @tape.no_grad()
     def step(self):
+        shard_grad = getattr(self, "_shard_grad", None)
+        if shard_grad is not None:  # ZeRO stage >= 2: grads live sharded
+            for p in self._parameter_list:
+                if p.grad is not None:
+                    p.grad._value = shard_grad(p, p.grad._value)
         for group in self._param_groups:
             params_grads = [(p, p.grad) for p in group["params"] if p.grad is not None and p.trainable]
             if self._grad_clip is not None:

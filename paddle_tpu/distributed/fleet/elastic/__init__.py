@@ -1,0 +1,8 @@
+"""Elastic training (parity:
+/root/reference/python/paddle/distributed/fleet/elastic/)."""
+from .manager import (  # noqa: F401
+    ELASTIC_AUTO_PARALLEL_EXIT_CODE,
+    ELASTIC_EXIT_CODE,
+    ElasticManager,
+    ElasticStatus,
+)

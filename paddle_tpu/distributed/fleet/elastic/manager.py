@@ -1,0 +1,101 @@
+"""Elastic manager (parity:
+/root/reference/python/paddle/distributed/fleet/elastic/manager.py:124
+ElasticManager; exit-code contract :32-33; fault-tolerance level env :176).
+
+TPU reality (SURVEY §7.3): slice failures are all-or-nothing, so elasticity
+is membership-change detection + whole-job restart with checkpoint resume —
+the same recovery model the reference implements (restart, not in-flight
+replay). Heartbeats ride the launcher's KV master instead of etcd: each
+node PUTs a timestamped key; the manager watches the key set and requests a
+restart (ELASTIC_EXIT_CODE) when membership changes.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from enum import Enum
+from typing import Callable, Optional
+
+__all__ = ["ELASTIC_EXIT_CODE", "ELASTIC_AUTO_PARALLEL_EXIT_CODE",
+           "ElasticStatus", "ElasticManager"]
+
+# reference manager.py:32-33
+ELASTIC_EXIT_CODE = 101
+ELASTIC_AUTO_PARALLEL_EXIT_CODE = 102
+
+# reference manager.py:39 — heartbeat TTL seconds
+ELASTIC_TTL = int(os.environ.get("PADDLE_ELASTIC_TTL", 60))
+
+
+class ElasticStatus(Enum):
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """Watches cluster membership via the launch KV master and decides
+    HOLD / RESTART / EXIT, mirroring the reference's etcd watcher."""
+
+    def __init__(self, kv_client=None, job_id: str = "default",
+                 np: Optional[int] = None, heartbeat_interval: float = 2.0):
+        self.kv = kv_client
+        self.job_id = job_id
+        self.np = np or int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+        self.node_id = os.environ.get("PADDLE_TRAINER_ID", "0")
+        self.interval = heartbeat_interval
+        self.enabled = self.kv is not None
+        self.fault_tolerance_level = int(
+            os.environ.get("PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL", 0))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ---------------------------------------------------------- heartbeat
+    def _hb_key(self, node_id=None) -> str:
+        return f"/elastic/{self.job_id}/hb/{node_id or self.node_id}"
+
+    def _beat_loop(self):
+        while not self._stop.is_set():
+            self.kv.put(self._hb_key(), str(time.time()))
+            self._stop.wait(self.interval)
+
+    def start(self):
+        if not self.enabled:
+            return self
+        self._thread = threading.Thread(target=self._beat_loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # ---------------------------------------------------------- membership
+    def alive_nodes(self) -> int:
+        if not self.enabled:
+            return self.np
+        now = time.time()
+        beats = self.kv.get_prefix(f"/elastic/{self.job_id}/hb/")
+        return sum(1 for v in beats.values()
+                   if now - float(v) < ELASTIC_TTL)
+
+    def watch(self) -> ElasticStatus:
+        """One membership check (reference manager.py watch loop body)."""
+        if not self.enabled:
+            return ElasticStatus.HOLD
+        alive = self.alive_nodes()
+        if alive == self.np:
+            return ElasticStatus.HOLD
+        if alive == 0:
+            return ElasticStatus.EXIT
+        return ElasticStatus.RESTART
+
+    # ---------------------------------------------------------- exit hook
+    @staticmethod
+    def request_restart():
+        """A worker calls this to trigger the elastic restart contract."""
+        os._exit(ELASTIC_EXIT_CODE)

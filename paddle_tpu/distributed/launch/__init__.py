@@ -1,0 +1,3 @@
+"""Distributed launcher (parity:
+/root/reference/python/paddle/distributed/launch/)."""
+from .main import launch, main  # noqa: F401

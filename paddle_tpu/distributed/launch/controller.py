@@ -1,0 +1,168 @@
+"""Pod/process controller (parity:
+/root/reference/python/paddle/distributed/launch/controllers/collective.py
+rank-env setup, job/pod.py process management, and the elastic restart loop
+of fleet/elastic/manager.py:124).
+
+The controller spawns ``nproc_per_node`` child processes with the
+``PADDLE_TRAINER_*`` env contract, reaps them, and — when restarts remain —
+relaunches the whole pod on failure, relying on the training script's
+checkpoint-resume (the reference's recovery model: restart, not replay).
+Exit code 101 (ELASTIC_EXIT_CODE) always triggers a restart regardless of
+the budget: it is the membership-change contract.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+from ..fleet.elastic.manager import ELASTIC_AUTO_PARALLEL_EXIT_CODE, ELASTIC_EXIT_CODE
+from .master import KVClient, KVServer
+
+__all__ = ["Controller"]
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _hostname_ip() -> str:
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "127.0.0.1"
+
+
+class Controller:
+    def __init__(self, args):
+        self.args = args
+        self.nnodes = int(args.nnodes)
+        self.nproc = int(args.nproc_per_node)
+        self.node_rank = int(args.rank)
+        self.max_restart = int(args.max_restart)
+        self.log_dir = args.log_dir
+        self._procs: List[subprocess.Popen] = []
+        self._logs = []
+        self._master_server: Optional[KVServer] = None
+        self.restarts = 0
+
+    # ------------------------------------------------------------ rendezvous
+    def _rendezvous(self) -> Dict[str, str]:
+        """Returns {PADDLE env updates}; single-node short-circuits."""
+        ip = _hostname_ip()
+        local_eps = [f"{ip}:{_free_port()}" for _ in range(self.nproc)]
+        if self.nnodes <= 1:
+            return {
+                "PADDLE_TRAINER_ENDPOINTS": ",".join(local_eps),
+                "_LOCAL_EPS": local_eps,
+                "_RANK_OFFSET": 0,
+            }
+        master = self.args.master
+        if not master:
+            raise ValueError("--master host:port is required for nnodes > 1")
+        host, port = master.rsplit(":", 1)
+        if self.node_rank == 0 and self._master_server is None:
+            self._master_server = KVServer(int(port)).start()
+        kv = KVClient(master)
+        epoch = self.restarts  # new namespace per restart round
+        kv.put(f"/rdzv/{epoch}/node/{self.node_rank}", ",".join(local_eps))
+        nodes = kv.wait_n(f"/rdzv/{epoch}/node/", self.nnodes)
+        ordered = [nodes[f"/rdzv/{epoch}/node/{i}"] for i in range(self.nnodes)]
+        all_eps: List[str] = []
+        for eps in ordered:
+            all_eps.extend(eps.split(","))
+        return {
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(all_eps),
+            "_LOCAL_EPS": local_eps,
+            "_RANK_OFFSET": self.node_rank * self.nproc,
+        }
+
+    # ------------------------------------------------------------ processes
+    def _spawn(self):
+        rdzv = self._rendezvous()
+        eps = rdzv["PADDLE_TRAINER_ENDPOINTS"]
+        local_eps = rdzv["_LOCAL_EPS"]
+        offset = rdzv["_RANK_OFFSET"]
+        world = self.nnodes * self.nproc
+        if self.log_dir:
+            os.makedirs(self.log_dir, exist_ok=True)
+        for i in range(self.nproc):
+            env = dict(os.environ)
+            env.update({
+                "PADDLE_TRAINER_ID": str(offset + i),
+                "PADDLE_TRAINERS_NUM": str(world),
+                "PADDLE_TRAINER_ENDPOINTS": eps,
+                "PADDLE_CURRENT_ENDPOINT": local_eps[i],
+                "PADDLE_LOCAL_RANK": str(i),
+                "PADDLE_MASTER": eps.split(",")[0],
+                "PADDLE_RESTART_COUNT": str(self.restarts),
+            })
+            log = None
+            if self.log_dir:
+                log = open(os.path.join(self.log_dir, f"workerlog.{offset + i}"), "ab")
+                self._logs.append(log)
+            cmd = [sys.executable, "-u", self.args.training_script, *self.args.script_args]
+            self._procs.append(subprocess.Popen(cmd, env=env, stdout=log, stderr=log))
+
+    def _kill_all(self):
+        for p in self._procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.time() + 10
+        for p in self._procs:
+            try:
+                p.wait(max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for f in self._logs:
+            f.close()
+        self._procs, self._logs = [], []
+
+    def _check_procs(self) -> Optional[int]:
+        """None while healthy/running; 0 when all exited cleanly; else the
+        first failing exit code (parity: LauncherInterface._check_procs)."""
+        codes = [p.poll() for p in self._procs]
+        for c in codes:
+            if c is not None and c != 0:
+                return c
+        if all(c == 0 for c in codes):
+            return 0
+        return None
+
+    # ------------------------------------------------------------ run loop
+    def run(self) -> int:
+        self._install_signals()
+        while True:
+            self._spawn()
+            rc = None
+            while rc is None:
+                time.sleep(0.2)
+                rc = self._check_procs()
+            if rc == 0:
+                return 0
+            elastic_rc = rc in (ELASTIC_EXIT_CODE, ELASTIC_AUTO_PARALLEL_EXIT_CODE)
+            if elastic_rc or self.restarts < self.max_restart:
+                self.restarts += 1
+                print(f"[launch] worker failed rc={rc}; restart "
+                      f"{self.restarts}/{self.max_restart if not elastic_rc else 'elastic'}",
+                      file=sys.stderr, flush=True)
+                self._kill_all()
+                continue
+            self._kill_all()
+            return rc
+
+    def _install_signals(self):
+        def handler(signum, frame):
+            self._kill_all()
+            if self._master_server is not None:
+                self._master_server.stop()
+            sys.exit(128 + signum)
+
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, handler)

@@ -1,0 +1,56 @@
+"""``python -m paddle_tpu.distributed.launch`` — the launcher CLI (parity:
+/root/reference/python/paddle/distributed/launch/main.py:21).
+
+Single node:
+    python -m paddle_tpu.distributed.launch --nproc_per_node 4 train.py
+
+Multi node (run on every node; node 0 hosts the rendezvous master):
+    python -m paddle_tpu.distributed.launch --nnodes 2 --rank 0 \
+        --master node0:8765 --nproc_per_node 4 train.py
+
+Children receive the reference's PADDLE_TRAINER_* env contract; fault
+handling is restart-with-checkpoint-resume (--max_restart), with exit code
+101 reserved for elastic membership changes (fleet/elastic/manager.py:32).
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .controller import Controller
+
+__all__ = ["launch", "main"]
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="paddle_tpu distributed launcher",
+    )
+    p.add_argument("--nnodes", type=int, default=1, help="number of nodes")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="worker processes per node")
+    p.add_argument("--rank", type=int, default=0, help="this node's rank")
+    p.add_argument("--master", type=str, default=None,
+                   help="rendezvous master host:port (required for nnodes>1)")
+    p.add_argument("--max_restart", type=int, default=0,
+                   help="restart budget on worker failure (checkpoint-resume)")
+    p.add_argument("--log_dir", type=str, default=None,
+                   help="per-worker log directory (workerlog.N)")
+    p.add_argument("--job_id", type=str, default="default", help="job name")
+    p.add_argument("training_script", type=str)
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p
+
+
+def launch(argv=None) -> int:
+    args = _parser().parse_args(argv)
+    return Controller(args).run()
+
+
+def main():
+    sys.exit(launch())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,119 @@
+"""Rendezvous master — in-process HTTP KV store (parity:
+/root/reference/python/paddle/distributed/launch/controllers/master.py:73
+HTTPMaster; the ETCDMaster:186 role is covered by the same KV contract).
+
+Node 0 serves a tiny threaded KV over HTTP; every node signs in with its
+endpoint list; once all nodes are present the global rank order is the
+sorted sign-in order. On TPU pods the JAX coordination service takes over
+after this bootstrap (SURVEY §5: TCPStore-equivalent via coordination
+service).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+__all__ = ["KVServer", "KVClient"]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    kv: Dict[str, bytes] = {}
+    lock = threading.Lock()
+
+    def log_message(self, *args):  # silence default stderr logging
+        pass
+
+    def do_PUT(self):
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        with self.lock:
+            self.kv[self.path] = body
+        self.send_response(200)
+        self.end_headers()
+
+    def do_GET(self):
+        if self.path.startswith("/prefix"):
+            prefix = self.path[len("/prefix"):]
+            with self.lock:
+                out = {k: v.decode() for k, v in self.kv.items() if k.startswith(prefix)}
+            body = json.dumps(out).encode()
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        with self.lock:
+            body = self.kv.get(self.path)
+        if body is None:
+            self.send_response(404)
+            self.end_headers()
+        else:
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(body)
+
+    def do_DELETE(self):
+        with self.lock:
+            self.kv.pop(self.path, None)
+        self.send_response(200)
+        self.end_headers()
+
+
+class KVServer:
+    """The master-side store; runs in a daemon thread on node 0."""
+
+    def __init__(self, port: int):
+        # fresh class-level store per server instance
+        handler = type("Handler", (_Handler,), {"kv": {}, "lock": threading.Lock()})
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", port), handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+
+
+class KVClient:
+    def __init__(self, endpoint: str):
+        self.base = f"http://{endpoint}"
+
+    def put(self, key: str, value: str) -> bool:
+        req = urllib.request.Request(f"{self.base}{key}", data=value.encode(), method="PUT")
+        try:
+            with urllib.request.urlopen(req, timeout=5) as r:
+                return r.status == 200
+        except OSError:
+            return False
+
+    def get(self, key: str) -> Optional[str]:
+        try:
+            with urllib.request.urlopen(f"{self.base}{key}", timeout=5) as r:
+                if r.status == 200:
+                    return r.read().decode()
+        except OSError:
+            return None
+        return None
+
+    def get_prefix(self, prefix: str) -> Dict[str, str]:
+        try:
+            with urllib.request.urlopen(f"{self.base}/prefix{prefix}", timeout=5) as r:
+                return json.loads(r.read().decode())
+        except OSError:
+            return {}
+
+    def wait_n(self, prefix: str, n: int, timeout: float = 300.0) -> Dict[str, str]:
+        """Block until ``n`` keys exist under ``prefix`` (node sign-in barrier)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            got = self.get_prefix(prefix)
+            if len(got) >= n:
+                return got
+            time.sleep(0.2)
+        raise TimeoutError(f"rendezvous: waited {timeout}s for {n} keys under {prefix}")

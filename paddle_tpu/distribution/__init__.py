@@ -1,0 +1,59 @@
+"""paddle.distribution parity (reference:
+/root/reference/python/paddle/distribution/__init__.py — ~20 distributions,
+transforms, TransformedDistribution, KL registry)."""
+from .distribution import Distribution  # noqa: F401
+from .distributions import (  # noqa: F401
+    Bernoulli,
+    Beta,
+    Binomial,
+    Categorical,
+    Cauchy,
+    Chi2,
+    ContinuousBernoulli,
+    Dirichlet,
+    Exponential,
+    ExponentialFamily,
+    Gamma,
+    Geometric,
+    Gumbel,
+    Independent,
+    Laplace,
+    LogNormal,
+    Multinomial,
+    MultivariateNormal,
+    Normal,
+    Poisson,
+    StudentT,
+    Uniform,
+)
+from .kl import kl_divergence, register_kl  # noqa: F401
+from .transform import (  # noqa: F401
+    AbsTransform,
+    AffineTransform,
+    ChainTransform,
+    ExpTransform,
+    IndependentTransform,
+    PowerTransform,
+    ReshapeTransform,
+    SigmoidTransform,
+    SoftmaxTransform,
+    StackTransform,
+    StickBreakingTransform,
+    TanhTransform,
+    Transform,
+    TransformedDistribution,
+)
+
+__all__ = [
+    "Distribution", "ExponentialFamily",
+    "Bernoulli", "Beta", "Binomial", "Categorical", "Cauchy", "Chi2",
+    "ContinuousBernoulli", "Dirichlet", "Exponential", "Gamma", "Geometric",
+    "Gumbel", "Independent", "Laplace", "LogNormal", "Multinomial",
+    "MultivariateNormal", "Normal", "Poisson", "StudentT", "Uniform",
+    "kl_divergence", "register_kl",
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+    "TransformedDistribution",
+]

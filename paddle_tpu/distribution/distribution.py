@@ -1,0 +1,103 @@
+"""Distribution base + shared helpers (parity:
+/root/reference/python/paddle/distribution/distribution.py).
+
+TPU-native: parameters are Tensors; all math runs through ops.dispatch.apply
+so log_prob/entropy/rsample are differentiable w.r.t. parameters on the
+eager tape and traceable under jit; sampling uses the framework's threefry
+Generator (framework/random.py) rather than a mutable global RNG state.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.random import default_generator
+from ..ops.dispatch import apply
+from ..tensor.tensor import Tensor
+
+__all__ = ["Distribution"]
+
+
+def _t(x) -> Tensor:
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(jnp.asarray(x, jnp.result_type(float) if not hasattr(x, "dtype") else None))
+
+
+def _shape(shape) -> Tuple[int, ...]:
+    if shape is None:
+        return ()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = _shape(batch_shape)
+        self._event_shape = _shape(event_shape)
+
+    @property
+    def batch_shape(self) -> Tuple[int, ...]:
+        return self._batch_shape
+
+    @property
+    def event_shape(self) -> Tuple[int, ...]:
+        return self._event_shape
+
+    @property
+    def mean(self) -> Tensor:
+        raise NotImplementedError
+
+    @property
+    def variance(self) -> Tensor:
+        raise NotImplementedError
+
+    @property
+    def stddev(self) -> Tensor:
+        from ..tensor.math import sqrt
+
+        return sqrt(self.variance)
+
+    def sample(self, shape=()):
+        """Draw (non-reparameterized) samples; gradients do not flow."""
+        with __import__("paddle_tpu").no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value) -> Tensor:
+        raise NotImplementedError
+
+    def prob(self, value) -> Tensor:
+        from ..tensor.math import exp
+
+        return exp(self.log_prob(value))
+
+    def entropy(self) -> Tensor:
+        raise NotImplementedError
+
+    def kl_divergence(self, other) -> Tensor:
+        from .kl import kl_divergence
+
+        return kl_divergence(self, other)
+
+    # ------------------------------------------------------------ helpers
+    @staticmethod
+    def _key():
+        return default_generator().next_key()
+
+    @staticmethod
+    def _apply(fn, *tensors, op_name=""):
+        return apply(fn, *tensors, op_name=op_name)
+
+    def _extend_shape(self, sample_shape) -> Tuple[int, ...]:
+        return _shape(sample_shape) + self._batch_shape + self._event_shape
+
+    def __repr__(self):
+        return f"{type(self).__name__}(batch_shape={self._batch_shape}, event_shape={self._event_shape})"

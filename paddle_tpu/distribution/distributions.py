@@ -1,0 +1,873 @@
+"""Concrete distributions (parity: /root/reference/python/paddle/distribution/
+normal.py, uniform.py, bernoulli.py, beta.py, binomial.py, categorical.py,
+cauchy.py, chi2.py, dirichlet.py, exponential.py, gamma.py, geometry.py,
+gumbel.py, laplace.py, lognormal.py, multinomial.py, multivariate_normal.py,
+poisson.py, student_t.py, independent.py).
+
+Math rides jnp / jax.scipy.special; sampling rides jax.random with threefry
+keys from the framework Generator; everything is taped through dispatch so
+parameters receive gradients (reparameterized where the reference is)."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from ..tensor.tensor import Tensor
+from .distribution import Distribution, _shape, _t
+
+__all__ = [
+    "Normal", "Uniform", "Bernoulli", "Beta", "Binomial", "Categorical",
+    "Cauchy", "Chi2", "ContinuousBernoulli", "Dirichlet", "Exponential",
+    "ExponentialFamily", "Gamma", "Geometric", "Gumbel", "Laplace",
+    "LogNormal", "Multinomial", "MultivariateNormal", "Poisson", "StudentT",
+    "Independent",
+]
+
+_HALF_LOG_2PI = 0.5 * math.log(2 * math.pi)
+
+
+def _bshape(*vals) -> tuple:
+    return jnp.broadcast_shapes(*(jnp.shape(v._value) for v in vals))
+
+
+class ExponentialFamily(Distribution):
+    """Marker base (parity: exponential_family.py); closed-form KLs are
+    registered pairwise in kl.py instead of via Bregman divergences."""
+
+
+class Normal(ExponentialFamily):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(batch_shape=_bshape(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return self._apply(lambda s: jnp.broadcast_to(s * s, self.batch_shape), self.scale)
+
+    def rsample(self, shape=()):
+        shp = self._extend_shape(shape)
+        key = self._key()
+        return self._apply(
+            lambda l, s: l + s * jax.random.normal(key, shp, jnp.result_type(l)),
+            self.loc, self.scale, op_name="normal_rsample")
+
+    def log_prob(self, value):
+        value = _t(value)
+        return self._apply(
+            lambda v, l, s: -((v - l) ** 2) / (2 * s * s) - jnp.log(s) - _HALF_LOG_2PI,
+            value, self.loc, self.scale, op_name="normal_log_prob")
+
+    def entropy(self):
+        return self._apply(
+            lambda s: jnp.broadcast_to(0.5 + _HALF_LOG_2PI + jnp.log(s), self.batch_shape),
+            self.scale)
+
+    def cdf(self, value):
+        value = _t(value)
+        return self._apply(
+            lambda v, l, s: 0.5 * (1 + jsp.erf((v - l) / (s * jnp.sqrt(2.0)))),
+            value, self.loc, self.scale)
+
+    def icdf(self, value):
+        value = _t(value)
+        return self._apply(
+            lambda v, l, s: l + s * jnp.sqrt(2.0) * jsp.erfinv(2 * v - 1),
+            value, self.loc, self.scale)
+
+    def probs(self, value):
+        return self.prob(value)
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        self._base = Normal(self.loc, self.scale)
+        super().__init__(batch_shape=self._base.batch_shape)
+
+    @property
+    def mean(self):
+        return self._apply(lambda l, s: jnp.exp(l + s * s / 2), self.loc, self.scale)
+
+    @property
+    def variance(self):
+        return self._apply(
+            lambda l, s: (jnp.exp(s * s) - 1) * jnp.exp(2 * l + s * s), self.loc, self.scale)
+
+    def rsample(self, shape=()):
+        from ..tensor.math import exp
+
+        return exp(self._base.rsample(shape))
+
+    def log_prob(self, value):
+        value = _t(value)
+        return self._apply(
+            lambda v, l, s: -((jnp.log(v) - l) ** 2) / (2 * s * s) - jnp.log(v * s) - _HALF_LOG_2PI,
+            value, self.loc, self.scale)
+
+    def entropy(self):
+        return self._apply(
+            lambda l, s: jnp.broadcast_to(0.5 + _HALF_LOG_2PI + jnp.log(s) + l, self.batch_shape),
+            self.loc, self.scale)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+        super().__init__(batch_shape=_bshape(self.low, self.high))
+
+    @property
+    def mean(self):
+        return self._apply(lambda a, b: (a + b) / 2, self.low, self.high)
+
+    @property
+    def variance(self):
+        return self._apply(lambda a, b: (b - a) ** 2 / 12, self.low, self.high)
+
+    def rsample(self, shape=()):
+        shp = self._extend_shape(shape)
+        key = self._key()
+        return self._apply(
+            lambda a, b: a + (b - a) * jax.random.uniform(key, shp, jnp.result_type(a)),
+            self.low, self.high, op_name="uniform_rsample")
+
+    def log_prob(self, value):
+        value = _t(value)
+        return self._apply(
+            lambda v, a, b: jnp.where((v >= a) & (v < b), -jnp.log(b - a), -jnp.inf),
+            value, self.low, self.high)
+
+    def entropy(self):
+        return self._apply(lambda a, b: jnp.log(b - a), self.low, self.high)
+
+
+class Bernoulli(ExponentialFamily):
+    def __init__(self, probs=None, logits=None, name=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("pass exactly one of probs/logits")
+        if probs is not None:
+            self.probs = _t(probs)
+            self.logits = self._apply(
+                lambda p: jnp.log(p) - jnp.log1p(-p), self.probs)
+        else:
+            self.logits = _t(logits)
+            self.probs = self._apply(jax.nn.sigmoid, self.logits)
+        super().__init__(batch_shape=jnp.shape(self.probs._value))
+
+    @property
+    def mean(self):
+        return self.probs
+
+    @property
+    def variance(self):
+        return self._apply(lambda p: p * (1 - p), self.probs)
+
+    def sample(self, shape=()):
+        shp = self._extend_shape(shape)
+        key = self._key()
+        with __import__("paddle_tpu").no_grad():
+            return self._apply(
+                lambda p: jax.random.bernoulli(key, p, shp).astype(jnp.result_type(p)),
+                self.probs)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        value = _t(value)
+        return self._apply(
+            lambda v, lg: v * jax.nn.log_sigmoid(lg) + (1 - v) * jax.nn.log_sigmoid(-lg),
+            value, self.logits)
+
+    def entropy(self):
+        return self._apply(
+            lambda p: -(p * jnp.log(jnp.clip(p, 1e-30)) + (1 - p) * jnp.log(jnp.clip(1 - p, 1e-30))),
+            self.probs)
+
+
+class ContinuousBernoulli(Distribution):
+    """parity: continuous_bernoulli.py (lims handling simplified)."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = _t(probs)
+        self._lims = lims
+        super().__init__(batch_shape=jnp.shape(self.probs._value))
+
+    def _const(self, p):
+        # normalizing constant C(p) = 2 atanh(1-2p) / (1-2p), -> 2 near p=.5
+        near = (p > self._lims[0]) & (p < self._lims[1])
+        safe = jnp.where(near, 0.4, p)
+        c = 2 * jnp.arctanh(1 - 2 * safe) / (1 - 2 * safe)
+        taylor = 2.0 + (1 - 2 * p) ** 2 * 4 / 3
+        return jnp.where(near, taylor, c)
+
+    @property
+    def mean(self):
+        def f(p):
+            near = (p > self._lims[0]) & (p < self._lims[1])
+            safe = jnp.where(near, 0.4, p)
+            m = safe / (2 * safe - 1) + 1 / (2 * jnp.arctanh(1 - 2 * safe))
+            return jnp.where(near, 0.5, m)
+
+        return self._apply(f, self.probs)
+
+    @property
+    def variance(self):
+        def f(p):
+            near = (p > self._lims[0]) & (p < self._lims[1])
+            safe = jnp.where(near, 0.4, p)
+            x = jnp.arctanh(1 - 2 * safe)
+            v = safe * (safe - 1) / (1 - 2 * safe) ** 2 + 1 / (4 * x * x)
+            return jnp.where(near, 1.0 / 12, v)
+
+        return self._apply(f, self.probs)
+
+    def log_prob(self, value):
+        value = _t(value)
+        return self._apply(
+            lambda v, p: v * jnp.log(jnp.clip(p, 1e-30)) + (1 - v) * jnp.log(jnp.clip(1 - p, 1e-30))
+            + jnp.log(self._const(p)),
+            value, self.probs)
+
+    def rsample(self, shape=()):
+        shp = self._extend_shape(shape)
+        key = self._key()
+
+        def icdf(p):
+            u = jax.random.uniform(key, shp, jnp.result_type(p))
+            near = (p > self._lims[0]) & (p < self._lims[1])
+            safe = jnp.where(near, 0.4, p)
+            s = (jnp.log1p(u * (2 * safe - 1) / (1 - safe)) /
+                 (jnp.log(safe) - jnp.log1p(-safe)))
+            return jnp.where(near, u, s)
+
+        return self._apply(icdf, self.probs, op_name="cb_rsample")
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        # paddle's Categorical(logits) treats input as unnormalized log-probs
+        # only if negative/unnormalized; we follow torch/paddle: logits arg
+        if logits is None and probs is None:
+            raise ValueError("pass logits or probs")
+        if logits is not None:
+            self.logits = _t(logits)
+            self.probs = self._apply(lambda lg: jax.nn.softmax(lg, -1), self.logits)
+        else:
+            self.probs = _t(probs)
+            self.logits = self._apply(lambda p: jnp.log(jnp.clip(p / p.sum(-1, keepdims=True), 1e-30)), self.probs)
+        shape = jnp.shape(self.probs._value)
+        super().__init__(batch_shape=shape[:-1])
+        self._num_events = shape[-1]
+
+    def sample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        key = self._key()
+        with __import__("paddle_tpu").no_grad():
+            return self._apply(
+                lambda lg: jax.random.categorical(key, lg, -1, shape=shp), self.logits)
+
+    def log_prob(self, value):
+        value = _t(value)
+        return self._apply(
+            lambda v, lg: jnp.take_along_axis(
+                jax.nn.log_softmax(lg, -1), v[..., None].astype(jnp.int32), -1)[..., 0],
+            value, self.logits)
+
+    def probs_of(self, value):
+        return self.prob(value)
+
+    def entropy(self):
+        return self._apply(
+            lambda lg: -jnp.sum(jax.nn.softmax(lg, -1) * jax.nn.log_softmax(lg, -1), -1),
+            self.logits)
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _t(probs)
+        shape = jnp.shape(self.probs._value)
+        super().__init__(batch_shape=shape[:-1], event_shape=shape[-1:])
+
+    @property
+    def mean(self):
+        return self._apply(lambda p: self.total_count * p / p.sum(-1, keepdims=True), self.probs)
+
+    @property
+    def variance(self):
+        return self._apply(
+            lambda p: self.total_count * (p / p.sum(-1, keepdims=True)) * (1 - p / p.sum(-1, keepdims=True)),
+            self.probs)
+
+    def sample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        key = self._key()
+
+        def f(p):
+            p = p / p.sum(-1, keepdims=True)
+            idx = jax.random.categorical(key, jnp.log(p), -1,
+                                         shape=(self.total_count,) + shp)
+            onehot = jax.nn.one_hot(idx, p.shape[-1], dtype=jnp.result_type(p))
+            return onehot.sum(0)
+
+        with __import__("paddle_tpu").no_grad():
+            return self._apply(f, self.probs)
+
+    def log_prob(self, value):
+        value = _t(value)
+
+        def f(v, p):
+            p = p / p.sum(-1, keepdims=True)
+            logc = (jsp.gammaln(self.total_count + 1.0)
+                    - jnp.sum(jsp.gammaln(v + 1.0), -1))
+            return logc + jnp.sum(v * jnp.log(jnp.clip(p, 1e-30)), -1)
+
+        return self._apply(f, value, self.probs)
+
+
+class Dirichlet(ExponentialFamily):
+    def __init__(self, concentration, name=None):
+        self.concentration = _t(concentration)
+        shape = jnp.shape(self.concentration._value)
+        super().__init__(batch_shape=shape[:-1], event_shape=shape[-1:])
+
+    @property
+    def mean(self):
+        return self._apply(lambda c: c / c.sum(-1, keepdims=True), self.concentration)
+
+    @property
+    def variance(self):
+        def f(c):
+            a0 = c.sum(-1, keepdims=True)
+            m = c / a0
+            return m * (1 - m) / (a0 + 1)
+
+        return self._apply(f, self.concentration)
+
+    def rsample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape + self.event_shape
+        key = self._key()
+        return self._apply(
+            lambda c: jax.random.dirichlet(key, jnp.broadcast_to(c, shp), shape=shp[:-1]),
+            self.concentration, op_name="dirichlet_rsample")
+
+    def log_prob(self, value):
+        value = _t(value)
+        return self._apply(
+            lambda v, c: jnp.sum((c - 1) * jnp.log(v), -1)
+            + jsp.gammaln(c.sum(-1)) - jnp.sum(jsp.gammaln(c), -1),
+            value, self.concentration)
+
+    def entropy(self):
+        def f(c):
+            a0 = c.sum(-1)
+            k = c.shape[-1]
+            return (jnp.sum(jsp.gammaln(c), -1) - jsp.gammaln(a0)
+                    + (a0 - k) * jsp.digamma(a0)
+                    - jnp.sum((c - 1) * jsp.digamma(c), -1))
+
+        return self._apply(f, self.concentration)
+
+
+class Beta(ExponentialFamily):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+        super().__init__(batch_shape=_bshape(self.alpha, self.beta))
+
+    @property
+    def mean(self):
+        return self._apply(lambda a, b: a / (a + b), self.alpha, self.beta)
+
+    @property
+    def variance(self):
+        return self._apply(
+            lambda a, b: a * b / ((a + b) ** 2 * (a + b + 1)), self.alpha, self.beta)
+
+    def rsample(self, shape=()):
+        shp = self._extend_shape(shape)
+        key = self._key()
+        return self._apply(
+            lambda a, b: jax.random.beta(key, a, b, shp), self.alpha, self.beta,
+            op_name="beta_rsample")
+
+    sample_shapeable = True
+
+    def log_prob(self, value):
+        value = _t(value)
+        return self._apply(
+            lambda v, a, b: (a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+            - (jsp.gammaln(a) + jsp.gammaln(b) - jsp.gammaln(a + b)),
+            value, self.alpha, self.beta)
+
+    def entropy(self):
+        def f(a, b):
+            ab = a + b
+            logB = jsp.gammaln(a) + jsp.gammaln(b) - jsp.gammaln(ab)
+            return (logB - (a - 1) * jsp.digamma(a) - (b - 1) * jsp.digamma(b)
+                    + (ab - 2) * jsp.digamma(ab))
+
+        return self._apply(f, self.alpha, self.beta)
+
+
+class Gamma(ExponentialFamily):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _t(concentration)
+        self.rate = _t(rate)
+        super().__init__(batch_shape=_bshape(self.concentration, self.rate))
+
+    @property
+    def mean(self):
+        return self._apply(lambda c, r: c / r, self.concentration, self.rate)
+
+    @property
+    def variance(self):
+        return self._apply(lambda c, r: c / (r * r), self.concentration, self.rate)
+
+    def rsample(self, shape=()):
+        shp = self._extend_shape(shape)
+        key = self._key()
+        return self._apply(
+            lambda c, r: jax.random.gamma(key, jnp.broadcast_to(c, shp)) / r,
+            self.concentration, self.rate, op_name="gamma_rsample")
+
+    def log_prob(self, value):
+        value = _t(value)
+        return self._apply(
+            lambda v, c, r: c * jnp.log(r) + (c - 1) * jnp.log(v) - r * v - jsp.gammaln(c),
+            value, self.concentration, self.rate)
+
+    def entropy(self):
+        return self._apply(
+            lambda c, r: c - jnp.log(r) + jsp.gammaln(c) + (1 - c) * jsp.digamma(c),
+            self.concentration, self.rate)
+
+
+class Chi2(Gamma):
+    def __init__(self, df, name=None):
+        df_t = _t(df)
+        half = Tensor(jnp.asarray(0.5, jnp.result_type(df_t._value)))
+        from ..tensor.math import multiply
+
+        super().__init__(multiply(df_t, half), Tensor(jnp.broadcast_to(jnp.asarray(0.5), jnp.shape(df_t._value))))
+        self.df = df_t
+
+
+class Exponential(ExponentialFamily):
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(batch_shape=jnp.shape(self.rate._value))
+
+    @property
+    def mean(self):
+        return self._apply(lambda r: 1 / r, self.rate)
+
+    @property
+    def variance(self):
+        return self._apply(lambda r: 1 / (r * r), self.rate)
+
+    def rsample(self, shape=()):
+        shp = self._extend_shape(shape)
+        key = self._key()
+        return self._apply(
+            lambda r: jax.random.exponential(key, shp, jnp.result_type(r)) / r,
+            self.rate, op_name="exponential_rsample")
+
+    def log_prob(self, value):
+        value = _t(value)
+        return self._apply(lambda v, r: jnp.log(r) - r * v, value, self.rate)
+
+    def entropy(self):
+        return self._apply(lambda r: 1 - jnp.log(r), self.rate)
+
+    def cdf(self, value):
+        value = _t(value)
+        return self._apply(lambda v, r: 1 - jnp.exp(-r * v), value, self.rate)
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k = 0, 1, ... (paddle convention)."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _t(probs)
+        super().__init__(batch_shape=jnp.shape(self.probs._value))
+
+    @property
+    def mean(self):
+        return self._apply(lambda p: (1 - p) / p, self.probs)
+
+    @property
+    def variance(self):
+        return self._apply(lambda p: (1 - p) / (p * p), self.probs)
+
+    def sample(self, shape=()):
+        shp = self._extend_shape(shape)
+        key = self._key()
+        with __import__("paddle_tpu").no_grad():
+            return self._apply(
+                lambda p: jnp.floor(jnp.log1p(-jax.random.uniform(key, shp, jnp.result_type(p)))
+                                    / jnp.log1p(-p)),
+                self.probs)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        value = _t(value)
+        return self._apply(
+            lambda v, p: v * jnp.log1p(-p) + jnp.log(p), value, self.probs)
+
+    def entropy(self):
+        return self._apply(
+            lambda p: (-(1 - p) * jnp.log1p(-p) - p * jnp.log(p)) / p, self.probs)
+
+    def cdf(self, value):
+        value = _t(value)
+        return self._apply(lambda v, p: 1 - (1 - p) ** (v + 1), value, self.probs)
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _t(total_count)
+        self.probs = _t(probs)
+        super().__init__(batch_shape=_bshape(self.total_count, self.probs))
+
+    @property
+    def mean(self):
+        return self._apply(lambda n, p: n * p, self.total_count, self.probs)
+
+    @property
+    def variance(self):
+        return self._apply(lambda n, p: n * p * (1 - p), self.total_count, self.probs)
+
+    def sample(self, shape=()):
+        shp = self._extend_shape(shape)
+        key = self._key()
+        with __import__("paddle_tpu").no_grad():
+            return self._apply(
+                lambda n, p: jax.random.binomial(key, jnp.broadcast_to(n, shp).astype(jnp.float32),
+                                                 jnp.broadcast_to(p, shp)),
+                self.total_count, self.probs)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        value = _t(value)
+        return self._apply(
+            lambda v, n, p: (jsp.gammaln(n + 1.0) - jsp.gammaln(v + 1.0)
+                             - jsp.gammaln(n - v + 1.0)
+                             + v * jnp.log(jnp.clip(p, 1e-30))
+                             + (n - v) * jnp.log(jnp.clip(1 - p, 1e-30))),
+            value, self.total_count, self.probs)
+
+
+class Poisson(ExponentialFamily):
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(batch_shape=jnp.shape(self.rate._value))
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+    def sample(self, shape=()):
+        shp = self._extend_shape(shape)
+        key = self._key()
+        with __import__("paddle_tpu").no_grad():
+            return self._apply(
+                lambda r: jax.random.poisson(key, jnp.broadcast_to(r, shp)).astype(jnp.result_type(r)),
+                self.rate)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        value = _t(value)
+        return self._apply(
+            lambda v, r: v * jnp.log(r) - r - jsp.gammaln(v + 1.0), value, self.rate)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(batch_shape=_bshape(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return self._apply(lambda s: 2 * s * s, self.scale)
+
+    def rsample(self, shape=()):
+        shp = self._extend_shape(shape)
+        key = self._key()
+
+        def f(l, s):
+            u = jax.random.uniform(key, shp, jnp.result_type(l), minval=-0.5 + 1e-7, maxval=0.5)
+            return l - s * jnp.sign(u) * jnp.log1p(-2 * jnp.abs(u))
+
+        return self._apply(f, self.loc, self.scale, op_name="laplace_rsample")
+
+    def log_prob(self, value):
+        value = _t(value)
+        return self._apply(
+            lambda v, l, s: -jnp.abs(v - l) / s - jnp.log(2 * s),
+            value, self.loc, self.scale)
+
+    def entropy(self):
+        return self._apply(
+            lambda s: jnp.broadcast_to(1 + jnp.log(2 * s), self.batch_shape), self.scale)
+
+    def cdf(self, value):
+        value = _t(value)
+        return self._apply(
+            lambda v, l, s: 0.5 - 0.5 * jnp.sign(v - l) * jnp.expm1(-jnp.abs(v - l) / s),
+            value, self.loc, self.scale)
+
+    def icdf(self, value):
+        value = _t(value)
+        return self._apply(
+            lambda q, l, s: l - s * jnp.sign(q - 0.5) * jnp.log1p(-2 * jnp.abs(q - 0.5)),
+            value, self.loc, self.scale)
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(batch_shape=_bshape(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return self._apply(lambda l, s: l + s * np.euler_gamma, self.loc, self.scale)
+
+    @property
+    def variance(self):
+        return self._apply(lambda s: (math.pi ** 2 / 6) * s * s, self.scale)
+
+    @property
+    def stddev(self):
+        from ..tensor.math import sqrt
+
+        return sqrt(self.variance)
+
+    def rsample(self, shape=()):
+        shp = self._extend_shape(shape)
+        key = self._key()
+        return self._apply(
+            lambda l, s: l + s * jax.random.gumbel(key, shp, jnp.result_type(l)),
+            self.loc, self.scale, op_name="gumbel_rsample")
+
+    def log_prob(self, value):
+        value = _t(value)
+
+        def f(v, l, s):
+            z = (v - l) / s
+            return -(z + jnp.exp(-z)) - jnp.log(s)
+
+        return self._apply(f, value, self.loc, self.scale)
+
+    def entropy(self):
+        return self._apply(
+            lambda s: jnp.broadcast_to(jnp.log(s) + 1 + np.euler_gamma, self.batch_shape),
+            self.scale)
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(batch_shape=_bshape(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        raise ValueError("Cauchy distribution has no mean")
+
+    @property
+    def variance(self):
+        raise ValueError("Cauchy distribution has no variance")
+
+    def rsample(self, shape=()):
+        shp = self._extend_shape(shape)
+        key = self._key()
+        return self._apply(
+            lambda l, s: l + s * jax.random.cauchy(key, shp, jnp.result_type(l)),
+            self.loc, self.scale, op_name="cauchy_rsample")
+
+    def log_prob(self, value):
+        value = _t(value)
+        return self._apply(
+            lambda v, l, s: -jnp.log(math.pi) - jnp.log(s) - jnp.log1p(((v - l) / s) ** 2),
+            value, self.loc, self.scale)
+
+    def entropy(self):
+        return self._apply(
+            lambda s: jnp.broadcast_to(jnp.log(4 * math.pi * s), self.batch_shape), self.scale)
+
+    def cdf(self, value):
+        value = _t(value)
+        return self._apply(
+            lambda v, l, s: jnp.arctan((v - l) / s) / math.pi + 0.5,
+            value, self.loc, self.scale)
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _t(df)
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(batch_shape=_bshape(self.df, self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return self._apply(
+            lambda df, l: jnp.where(df > 1, jnp.broadcast_to(l, self.batch_shape), jnp.nan),
+            self.df, self.loc)
+
+    @property
+    def variance(self):
+        return self._apply(
+            lambda df, s: jnp.where(df > 2, s * s * df / (df - 2), jnp.nan),
+            self.df, self.scale)
+
+    def rsample(self, shape=()):
+        shp = self._extend_shape(shape)
+        key = self._key()
+        return self._apply(
+            lambda df, l, s: l + s * jax.random.t(key, jnp.broadcast_to(df, shp)),
+            self.df, self.loc, self.scale, op_name="studentt_rsample")
+
+    def log_prob(self, value):
+        value = _t(value)
+
+        def f(v, df, l, s):
+            z = (v - l) / s
+            return (jsp.gammaln((df + 1) / 2) - jsp.gammaln(df / 2)
+                    - 0.5 * jnp.log(df * math.pi) - jnp.log(s)
+                    - (df + 1) / 2 * jnp.log1p(z * z / df))
+
+        return self._apply(f, value, self.df, self.loc, self.scale)
+
+    def entropy(self):
+        def f(df, s):
+            return ((df + 1) / 2 * (jsp.digamma((df + 1) / 2) - jsp.digamma(df / 2))
+                    + 0.5 * jnp.log(df) + jsp.betaln(df / 2, jnp.asarray(0.5, df.dtype))
+                    + jnp.log(s))
+
+        return self._apply(f, self.df, self.scale)
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        self.loc = _t(loc)
+        given = [x for x in (covariance_matrix, precision_matrix, scale_tril) if x is not None]
+        if len(given) != 1:
+            raise ValueError("pass exactly one of covariance_matrix / precision_matrix / scale_tril")
+        if scale_tril is not None:
+            self.scale_tril = _t(scale_tril)
+        elif covariance_matrix is not None:
+            cov = _t(covariance_matrix)
+            self.scale_tril = self._apply(jnp.linalg.cholesky, cov)
+            self.covariance_matrix = cov
+        else:
+            prec = _t(precision_matrix)
+            self.scale_tril = self._apply(
+                lambda pm: jnp.linalg.cholesky(jnp.linalg.inv(pm)), prec)
+        d = jnp.shape(self.loc._value)[-1]
+        super().__init__(
+            batch_shape=jnp.broadcast_shapes(jnp.shape(self.loc._value)[:-1],
+                                             jnp.shape(self.scale_tril._value)[:-2]),
+            event_shape=(d,))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return self._apply(lambda st: jnp.sum(st * st, -1), self.scale_tril)
+
+    def rsample(self, shape=()):
+        shp = self._extend_shape(shape)
+        key = self._key()
+        return self._apply(
+            lambda l, st: l + jnp.einsum("...ij,...j->...i",
+                                         st, jax.random.normal(key, shp, jnp.result_type(l))),
+            self.loc, self.scale_tril, op_name="mvn_rsample")
+
+    def log_prob(self, value):
+        value = _t(value)
+        d = self.event_shape[0]
+
+        def f(v, l, st):
+            diff = v - l
+            sol = jax.scipy.linalg.solve_triangular(st, diff[..., None], lower=True)[..., 0]
+            m = jnp.sum(sol * sol, -1)
+            logdet = jnp.sum(jnp.log(jnp.diagonal(st, axis1=-2, axis2=-1)), -1)
+            return -0.5 * (d * math.log(2 * math.pi) + m) - logdet
+
+        return self._apply(f, value, self.loc, self.scale_tril)
+
+    def entropy(self):
+        d = self.event_shape[0]
+        return self._apply(
+            lambda st: 0.5 * d * (1 + math.log(2 * math.pi))
+            + jnp.sum(jnp.log(jnp.diagonal(st, axis1=-2, axis2=-1)), -1),
+            self.scale_tril)
+
+
+class Independent(Distribution):
+    """parity: independent.py — reinterpret batch dims as event dims."""
+
+    def __init__(self, base, reinterpreted_batch_ndims=None, reinterpreted_batch_rank=None):
+        n = reinterpreted_batch_ndims if reinterpreted_batch_ndims is not None else reinterpreted_batch_rank
+        if n is None:
+            raise ValueError("pass reinterpreted_batch_rank")
+        self.base = base
+        self._n = int(n)
+        bs = base.batch_shape
+        super().__init__(batch_shape=bs[:len(bs) - self._n],
+                         event_shape=bs[len(bs) - self._n:] + base.event_shape)
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        from ..tensor.math import sum as psum  # noqa: A004
+
+        return psum(lp, axis=list(range(lp.ndim - self._n, lp.ndim))) if self._n else lp
+
+    def entropy(self):
+        ent = self.base.entropy()
+        from ..tensor.math import sum as psum  # noqa: A004
+
+        return psum(ent, axis=list(range(ent.ndim - self._n, ent.ndim))) if self._n else ent

@@ -1,0 +1,167 @@
+"""KL divergence registry (parity:
+/root/reference/python/paddle/distribution/kl.py:52 kl_divergence, :84
+register_kl — same multi-dispatch-with-MRO-resolution contract)."""
+from __future__ import annotations
+
+import math
+from functools import total_ordering
+
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from ..ops.dispatch import apply
+from .distribution import Distribution
+from .distributions import (
+    Bernoulli, Beta, Binomial, Categorical, Dirichlet, Exponential, Gamma,
+    Geometric, Laplace, LogNormal, Normal, Poisson, Uniform,
+)
+
+__all__ = ["register_kl", "kl_divergence"]
+
+_REGISTRY = {}
+
+
+@total_ordering
+class _Match:
+    def __init__(self, *types):
+        self.types = types
+
+    def __eq__(self, other):
+        return self.types == other.types
+
+    def __le__(self, other):
+        return all(issubclass(a, b) for a, b in zip(self.types, other.types))
+
+
+def register_kl(cls_p, cls_q):
+    def decorator(fn):
+        _REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+
+    return decorator
+
+
+def _dispatch(cls_p, cls_q):
+    matches = [(p, q) for (p, q) in _REGISTRY
+               if issubclass(cls_p, p) and issubclass(cls_q, q)]
+    if not matches:
+        raise NotImplementedError(
+            f"kl_divergence({cls_p.__name__}, {cls_q.__name__}) is not registered")
+    left = min(_Match(p, q) for p, q in matches)
+    return _REGISTRY[left.types]
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    return _dispatch(type(p), type(q))(p, q)
+
+
+# ----------------------------------------------------------------- pairs
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    return apply(
+        lambda pl, ps, ql, qs: (jnp.log(qs / ps) + (ps * ps + (pl - ql) ** 2) / (2 * qs * qs) - 0.5),
+        p.loc, p.scale, q.loc, q.scale, op_name="kl_normal")
+
+
+@register_kl(LogNormal, LogNormal)
+def _kl_lognormal(p, q):
+    return _kl_normal_normal(p._base, q._base)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return apply(
+        lambda pa, pb, qa, qb: jnp.where(
+            (qa <= pa) & (pb <= qb), jnp.log((qb - qa) / (pb - pa)), jnp.inf),
+        p.low, p.high, q.low, q.high)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    def f(pp, qp):
+        t1 = pp * (jnp.log(jnp.clip(pp, 1e-30)) - jnp.log(jnp.clip(qp, 1e-30)))
+        t2 = (1 - pp) * (jnp.log(jnp.clip(1 - pp, 1e-30)) - jnp.log(jnp.clip(1 - qp, 1e-30)))
+        return t1 + t2
+
+    return apply(f, p.probs, q.probs, op_name="kl_bernoulli")
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric(p, q):
+    return apply(
+        lambda pp, qp: (-(-pp * jnp.log(pp) - (1 - pp) * jnp.log1p(-pp)) / pp)
+        + (-jnp.log(qp) - (1 - pp) / pp * jnp.log1p(-qp)),
+        p.probs, q.probs)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    import jax
+
+    return apply(
+        lambda pl, ql: jnp.sum(
+            jax.nn.softmax(pl, -1) * (jax.nn.log_softmax(pl, -1) - jax.nn.log_softmax(ql, -1)), -1),
+        p.logits, q.logits, op_name="kl_categorical")
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    def f(pa, pb, qa, qb):
+        pt = pa + pb
+        return (jsp.gammaln(pt) - jsp.gammaln(pa) - jsp.gammaln(pb)
+                - jsp.gammaln(qa + qb) + jsp.gammaln(qa) + jsp.gammaln(qb)
+                + (pa - qa) * jsp.digamma(pa) + (pb - qb) * jsp.digamma(pb)
+                + (qa + qb - pt) * jsp.digamma(pt))
+
+    return apply(f, p.alpha, p.beta, q.alpha, q.beta, op_name="kl_beta")
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p, q):
+    def f(pc, qc):
+        p0 = pc.sum(-1)
+        return (jsp.gammaln(p0) - jnp.sum(jsp.gammaln(pc), -1)
+                - jsp.gammaln(qc.sum(-1)) + jnp.sum(jsp.gammaln(qc), -1)
+                + jnp.sum((pc - qc) * (jsp.digamma(pc) - jsp.digamma(p0)[..., None]), -1))
+
+    return apply(f, p.concentration, q.concentration, op_name="kl_dirichlet")
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    return apply(
+        lambda pr, qr: jnp.log(pr) - jnp.log(qr) + qr / pr - 1, p.rate, q.rate)
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p, q):
+    def f(pc, pr, qc, qr):
+        return ((pc - qc) * jsp.digamma(pc) - jsp.gammaln(pc) + jsp.gammaln(qc)
+                + qc * (jnp.log(pr) - jnp.log(qr)) + pc * (qr - pr) / pr)
+
+    return apply(f, p.concentration, p.rate, q.concentration, q.rate)
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    def f(pl, ps, ql, qs):
+        d = jnp.abs(pl - ql)
+        return (jnp.log(qs / ps) + d / qs
+                + ps / qs * jnp.exp(-d / ps) - 1)
+
+    return apply(f, p.loc, p.scale, q.loc, q.scale)
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson(p, q):
+    return apply(
+        lambda pr, qr: pr * (jnp.log(pr) - jnp.log(qr)) - pr + qr, p.rate, q.rate)
+
+
+@register_kl(Binomial, Binomial)
+def _kl_binomial(p, q):
+    def f(n, pp, qp):
+        return n * (pp * (jnp.log(pp) - jnp.log(qp))
+                    + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qp)))
+
+    return apply(f, p.total_count, p.probs, q.probs)

@@ -1,0 +1,326 @@
+"""Probability transforms + TransformedDistribution (parity:
+/root/reference/python/paddle/distribution/transform.py,
+transformed_distribution.py). Pure jnp bijector algebra taped through
+dispatch."""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.dispatch import apply
+from ..tensor.tensor import Tensor
+from .distribution import Distribution, _shape, _t
+
+__all__ = [
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+    "TransformedDistribution",
+]
+
+
+class Transform:
+    _event_rank = 0  # rank consumed by the jacobian determinant
+
+    def forward(self, x):
+        return apply(self._forward, _t(x), op_name=f"{type(self).__name__}.fwd")
+
+    def inverse(self, y):
+        return apply(self._inverse, _t(y), op_name=f"{type(self).__name__}.inv")
+
+    def forward_log_det_jacobian(self, x):
+        return apply(self._fldj, _t(x), op_name=f"{type(self).__name__}.fldj")
+
+    def inverse_log_det_jacobian(self, y):
+        return apply(lambda v: -self._fldj(self._inverse(v)), _t(y))
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    # raw-jnp hooks
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _fldj(self, x):
+        raise NotImplementedError
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class AbsTransform(Transform):
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y  # one branch of the two-valued preimage (paddle convention)
+
+    def _fldj(self, x):
+        raise NotImplementedError("AbsTransform is not bijective")
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def _forward(self, x):
+        return self.loc._value + self.scale._value * x
+
+    def _inverse(self, y):
+        return (y - self.loc._value) / self.scale._value
+
+    def _fldj(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale._value)), jnp.shape(x))
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _t(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power._value)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power._value)
+
+    def _fldj(self, x):
+        p = self.power._value
+        return jnp.log(jnp.abs(p * jnp.power(x, p - 1)))
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        return jax.nn.log_sigmoid(x) + jax.nn.log_sigmoid(-x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _fldj(self, x):
+        # log(1 - tanh(x)^2) = 2(log2 - x - softplus(-2x))
+        return 2.0 * (jnp.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    _event_rank = 1
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, -1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        raise NotImplementedError("softmax is not bijective; no log-det")
+
+
+class StickBreakingTransform(Transform):
+    _event_rank = 1
+
+    def _forward(self, x):
+        # R^{K-1} -> K-simplex
+        offset = jnp.cumsum(jnp.ones_like(x)[..., ::-1], -1)[..., ::-1]
+        z = jax.nn.sigmoid(x - jnp.log(offset))
+        zpad = jnp.concatenate([z, jnp.ones_like(z[..., :1])], -1)
+        rem = jnp.concatenate([jnp.ones_like(z[..., :1]),
+                               jnp.cumprod(1 - z, -1)], -1)
+        return zpad * rem
+
+    def _inverse(self, y):
+        ycum = jnp.cumsum(y[..., :-1], -1)
+        rem = 1 - jnp.concatenate([jnp.zeros_like(ycum[..., :1]), ycum[..., :-1]], -1)
+        z = y[..., :-1] / rem
+        offset = jnp.cumsum(jnp.ones_like(z)[..., ::-1], -1)[..., ::-1]
+        return jnp.log(z) - jnp.log1p(-z) + jnp.log(offset)
+
+    def _fldj(self, x):
+        offset = jnp.cumsum(jnp.ones_like(x)[..., ::-1], -1)[..., ::-1]
+        z = jax.nn.sigmoid(x - jnp.log(offset))
+        rem = jnp.concatenate([jnp.ones_like(z[..., :1]),
+                               jnp.cumprod(1 - z, -1)[..., :-1]], -1)
+        return jnp.sum(jnp.log(z) + jnp.log1p(-z) + jnp.log(rem), -1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = _shape(in_event_shape)
+        self.out_event_shape = _shape(out_event_shape)
+        self._event_rank = len(self.in_event_shape)
+
+    def _forward(self, x):
+        batch = jnp.shape(x)[: jnp.ndim(x) - len(self.in_event_shape)]
+        return jnp.reshape(x, batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = jnp.shape(y)[: jnp.ndim(y) - len(self.out_event_shape)]
+        return jnp.reshape(y, batch + self.in_event_shape)
+
+    def _fldj(self, x):
+        batch = jnp.shape(x)[: jnp.ndim(x) - len(self.in_event_shape)]
+        return jnp.zeros(batch, jnp.result_type(x))
+
+    def forward_shape(self, shape):
+        n = len(self.in_event_shape)
+        return tuple(shape[:-n] if n else shape) + self.out_event_shape
+
+    def inverse_shape(self, shape):
+        n = len(self.out_event_shape)
+        return tuple(shape[:-n] if n else shape) + self.in_event_shape
+
+
+class IndependentTransform(Transform):
+    def __init__(self, base: Transform, reinterpreted_batch_rank: int):
+        self.base = base
+        self._n = int(reinterpreted_batch_rank)
+        self._event_rank = base._event_rank + self._n
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _fldj(self, x):
+        ld = self.base._fldj(x)
+        return jnp.sum(ld, axis=tuple(range(jnp.ndim(ld) - self._n, jnp.ndim(ld))))
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms: Sequence[Transform]):
+        self.transforms = list(transforms)
+        self._event_rank = max((t._event_rank for t in self.transforms), default=0)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _fldj(self, x):
+        total = None
+        for t in self.transforms:
+            ld = t._fldj(x)
+            # reduce lower-rank jacobians to this chain's event rank
+            extra = self._event_rank - t._event_rank
+            if extra > 0:
+                ld = jnp.sum(ld, axis=tuple(range(jnp.ndim(ld) - extra, jnp.ndim(ld))))
+            total = ld if total is None else total + ld
+            x = t._forward(x)
+        return total
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return tuple(shape)
+
+
+class StackTransform(Transform):
+    def __init__(self, transforms: Sequence[Transform], axis: int = 0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def _split(self, x):
+        return [jnp.squeeze(s, self.axis)
+                for s in jnp.split(x, len(self.transforms), self.axis)]
+
+    def _forward(self, x):
+        return jnp.stack([t._forward(p) for t, p in zip(self.transforms, self._split(x))],
+                         self.axis)
+
+    def _inverse(self, y):
+        return jnp.stack([t._inverse(p) for t, p in zip(self.transforms, self._split(y))],
+                         self.axis)
+
+    def _fldj(self, x):
+        return jnp.stack([t._fldj(p) for t, p in zip(self.transforms, self._split(x))],
+                         self.axis)
+
+
+class TransformedDistribution(Distribution):
+    """parity: transformed_distribution.py — base dist pushed through a
+    transform chain; log_prob via the change-of-variables formula."""
+
+    def __init__(self, base: Distribution, transforms: Sequence[Transform]):
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.base = base
+        self.transforms = list(transforms)
+        chain = ChainTransform(self.transforms)
+        shape = base.batch_shape + base.event_shape
+        out_shape = chain.forward_shape(shape)
+        er = chain._event_rank
+        super().__init__(batch_shape=out_shape[: len(out_shape) - er],
+                         event_shape=out_shape[len(out_shape) - er:])
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def sample(self, shape=()):
+        with __import__("paddle_tpu").no_grad():
+            return self.rsample(shape)
+
+    def log_prob(self, value):
+        value = _t(value)
+        chain = ChainTransform(self.transforms)
+
+        def f(v, *base_params):
+            x = chain._inverse(v)
+            ildj = -chain._fldj(x)
+            base_lp = self.base.log_prob(Tensor(x))._value
+            # reduce base log_prob over dims the chain promoted to event dims
+            extra = chain._event_rank - len(self.base.event_shape)
+            if extra > 0:
+                base_lp = jnp.sum(
+                    base_lp, axis=tuple(range(jnp.ndim(base_lp) - extra, jnp.ndim(base_lp))))
+            return base_lp + ildj
+
+        return apply(f, value, op_name="transformed_log_prob")

@@ -50,7 +50,38 @@ class Controller:
         self._procs: List[subprocess.Popen] = []
         self._logs = []
         self._master_server: Optional[KVServer] = None
-        self.restarts = 0
+        self._kv: Optional[KVClient] = None
+        self.restarts = 0  # == the cluster-wide rendezvous epoch
+
+    # -------------------------------------------------- restart coordination
+    def _shared_epoch(self) -> int:
+        """Cluster-wide restart epoch from the master KV (multi-node only)."""
+        if self._kv is None:
+            return self.restarts
+        v = self._kv.get("/restart/epoch")
+        return int(v) if v else 0
+
+    def _signal_restart(self, epoch: int):
+        """Broadcast 'everyone re-rendezvous at `epoch`' to the other nodes."""
+        if self._kv is not None and self._shared_epoch() < epoch:
+            self._kv.put("/restart/epoch", str(epoch))
+
+    def _broadcast_terminal(self, rc: int):
+        """Mark the job dead. If we host the KV master, linger until the other
+        nodes have acked (else our exit kills the server before they see it)."""
+        if self._kv is None:
+            return
+        self._kv.put("/fail/terminal", str(rc))
+        if self._master_server is not None and self.nnodes > 1:
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                if len(self._kv.get_prefix("/fail/ack/")) >= self.nnodes - 1:
+                    break
+                time.sleep(0.5)
+
+    def _ack_terminal(self):
+        if self._kv is not None:
+            self._kv.put(f"/fail/ack/{self.node_rank}", "1")
 
     # ------------------------------------------------------------ rendezvous
     def _rendezvous(self) -> Dict[str, str]:
@@ -69,7 +100,9 @@ class Controller:
         host, port = master.rsplit(":", 1)
         if self.node_rank == 0 and self._master_server is None:
             self._master_server = KVServer(int(port)).start()
-        kv = KVClient(master)
+        if self._kv is None:
+            self._kv = KVClient(master)
+        kv = self._kv
         epoch = self.restarts  # new namespace per restart round
         kv.put(f"/rdzv/{epoch}/node/{self.node_rank}", ",".join(local_eps))
         nodes = kv.wait_n(f"/rdzv/{epoch}/node/", self.nnodes)
@@ -139,23 +172,80 @@ class Controller:
     def run(self) -> int:
         self._install_signals()
         while True:
-            self._spawn()
+            try:
+                self._spawn()
+            except (TimeoutError, ValueError, OSError) as e:
+                print(f"[launch] rendezvous failed: {e}", file=sys.stderr, flush=True)
+                self._broadcast_terminal(1)  # don't leave peers blocked in wait_n
+                self._kill_all()
+                return 1
             rc = None
-            while rc is None:
+            rejoin = False  # peer requested a new rendezvous epoch
+            ticks = 0
+            while rc is None and not rejoin:
                 time.sleep(0.2)
+                ticks += 1
                 rc = self._check_procs()
+                if rc is None and self._kv is not None and ticks % 5 == 0:
+                    terminal = self._kv.get("/fail/terminal")
+                    if terminal is not None:
+                        print("[launch] peer failed terminally; aborting",
+                              file=sys.stderr, flush=True)
+                        self._ack_terminal()
+                        self._kill_all()
+                        return int(terminal) or 1
+                    peer_epoch = self._shared_epoch()
+                    if peer_epoch > self.restarts:
+                        print(f"[launch] peer requested restart epoch {peer_epoch}; "
+                              "re-rendezvousing", file=sys.stderr, flush=True)
+                        self._kill_all()
+                        self.restarts = peer_epoch
+                        rejoin = True
+            if rejoin:
+                continue
             if rc == 0:
+                status = self._await_cluster_done()
+                if status == "rejoin":
+                    self._kill_all()  # reap exited procs, close log handles
+                    continue
+                if status == "failed":
+                    self._ack_terminal()
+                    return 1
                 return 0
             elastic_rc = rc in (ELASTIC_EXIT_CODE, ELASTIC_AUTO_PARALLEL_EXIT_CODE)
             if elastic_rc or self.restarts < self.max_restart:
-                self.restarts += 1
+                self.restarts = max(self.restarts + 1, self._shared_epoch())
+                self._signal_restart(self.restarts)
                 print(f"[launch] worker failed rc={rc}; restart "
                       f"{self.restarts}/{self.max_restart if not elastic_rc else 'elastic'}",
                       file=sys.stderr, flush=True)
                 self._kill_all()
                 continue
+            # restart budget exhausted: tell the peers the job is dead so
+            # cleanly-finished nodes don't report success for a failed job
+            self._broadcast_terminal(rc)
             self._kill_all()
             return rc
+
+    def _await_cluster_done(self, timeout: float = 60.0) -> str:
+        """After a clean local exit, wait for every node to finish. Returns
+        "done" | "rejoin" (a peer bumped the epoch; self.restarts updated) |
+        "failed" (a peer gave up terminally). Single-node: trivially done."""
+        if self._kv is None:
+            return "done"
+        self._kv.put(f"/done/{self.restarts}/node/{self.node_rank}", "0")
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if len(self._kv.get_prefix(f"/done/{self.restarts}/node/")) >= self.nnodes:
+                return "done"
+            if self._kv.get("/fail/terminal") is not None:
+                return "failed"
+            peer_epoch = self._shared_epoch()
+            if peer_epoch > self.restarts:
+                self.restarts = peer_epoch
+                return "rejoin"
+            time.sleep(0.5)
+        return "done"  # peers unreachable after our clean exit: don't hang the pod
 
     def _install_signals(self):
         def handler(signum, frame):

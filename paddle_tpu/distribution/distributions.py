@@ -208,7 +208,8 @@ class ContinuousBernoulli(Distribution):
         near = (p > self._lims[0]) & (p < self._lims[1])
         safe = jnp.where(near, 0.4, p)
         c = 2 * jnp.arctanh(1 - 2 * safe) / (1 - 2 * safe)
-        taylor = 2.0 + (1 - 2 * p) ** 2 * 4 / 3
+        # 2*atanh(y)/y = 2*(1 + y^2/3 + ...) = 2 + (2/3) y^2 for y = 1-2p
+        taylor = 2.0 + (1 - 2 * p) ** 2 * 2 / 3
         return jnp.where(near, taylor, c)
 
     @property
@@ -279,10 +280,15 @@ class Categorical(Distribution):
 
     def log_prob(self, value):
         value = _t(value)
-        return self._apply(
-            lambda v, lg: jnp.take_along_axis(
-                jax.nn.log_softmax(lg, -1), v[..., None].astype(jnp.int32), -1)[..., 0],
-            value, self.logits)
+        def _lp(v, lg):
+            lp = jax.nn.log_softmax(lg, -1)
+            batch = jnp.broadcast_shapes(jnp.shape(v), lp.shape[:-1])
+            lp = jnp.broadcast_to(lp, batch + lp.shape[-1:])
+            v = jnp.broadcast_to(v, batch)
+            return jnp.take_along_axis(
+                lp, v[..., None].astype(jnp.int32), -1)[..., 0]
+
+        return self._apply(_lp, value, self.logits)
 
     def probs_of(self, value):
         return self.prob(value)
@@ -401,8 +407,6 @@ class Beta(ExponentialFamily):
         return self._apply(
             lambda a, b: jax.random.beta(key, a, b, shp), self.alpha, self.beta,
             op_name="beta_rsample")
-
-    sample_shapeable = True
 
     def log_prob(self, value):
         value = _t(value)

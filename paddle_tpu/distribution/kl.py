@@ -6,6 +6,7 @@ from __future__ import annotations
 import math
 from functools import total_ordering
 
+import jax
 import jax.numpy as jnp
 from jax.scipy import special as jsp
 
@@ -160,8 +161,20 @@ def _kl_poisson(p, q):
 
 @register_kl(Binomial, Binomial)
 def _kl_binomial(p, q):
-    def f(n, pp, qp):
-        return n * (pp * (jnp.log(pp) - jnp.log(qp))
-                    + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qp)))
+    # p.total_count < q.total_count has a finite KL the closed form below
+    # doesn't cover; fail loudly rather than return a wrong value (torch
+    # parity). Only checkable on concrete counts.
+    pn_v, qn_v = p.total_count._value, q.total_count._value
+    if not isinstance(pn_v, jax.core.Tracer) and not isinstance(qn_v, jax.core.Tracer):
+        if bool(jnp.any(pn_v < qn_v)):
+            raise NotImplementedError(
+                "KL(Binomial||Binomial) with p.total_count < q.total_count "
+                "is finite but not implemented")
 
-    return apply(f, p.total_count, p.probs, q.probs)
+    def f(pn, qn, pp, qp):
+        kl = pn * (pp * (jnp.log(pp) - jnp.log(qp))
+                   + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qp)))
+        # pn > qn: p's support exceeds q's -> KL is +inf
+        return jnp.where(pn == qn, kl, jnp.inf)
+
+    return apply(f, p.total_count, q.total_count, p.probs, q.probs)

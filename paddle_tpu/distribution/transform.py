@@ -311,11 +311,24 @@ class TransformedDistribution(Distribution):
     def log_prob(self, value):
         value = _t(value)
         chain = ChainTransform(self.transforms)
+        # thread the base distribution's AND the transforms' parameter
+        # Tensors through the outer apply so gradients reach them (e.g.
+        # training loc/scale of the base or of an AffineTransform)
+        params = [v for v in vars(self.base).values() if isinstance(v, Tensor)]
+        for t in self.transforms:
+            params.extend(v for v in vars(t).values() if isinstance(v, Tensor))
 
-        def f(v, *base_params):
-            x = chain._inverse(v)
-            ildj = -chain._fldj(x)
-            base_lp = self.base.log_prob(Tensor(x))._value
+        def f(v, *pvals):
+            saved = [p._value for p in params]
+            for p, pv in zip(params, pvals):
+                p._value = pv
+            try:
+                x = chain._inverse(v)
+                ildj = -chain._fldj(x)
+                base_lp = self.base.log_prob(Tensor(x))._value
+            finally:
+                for p, s in zip(params, saved):
+                    p._value = s
             # reduce base log_prob over dims the chain promoted to event dims
             extra = chain._event_rank - len(self.base.event_shape)
             if extra > 0:
@@ -323,4 +336,4 @@ class TransformedDistribution(Distribution):
                     base_lp, axis=tuple(range(jnp.ndim(base_lp) - extra, jnp.ndim(base_lp))))
             return base_lp + ildj
 
-        return apply(f, value, op_name="transformed_log_prob")
+        return apply(f, value, *params, op_name="transformed_log_prob")

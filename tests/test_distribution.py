@@ -187,3 +187,40 @@ class TestTransforms:
         assert lp.shape == [3]
         np.testing.assert_allclose(np.asarray(lp._value),
                                    4 * st.norm.logpdf(0.0) * np.ones(3), rtol=1e-5)
+
+    def test_transformed_distribution_param_grad(self):
+        """Gradients must reach the base distribution's parameters through
+        TransformedDistribution.log_prob (review regression)."""
+        loc = P.to_tensor(np.float32(0.3))
+        loc.stop_gradient = False
+        td = D.TransformedDistribution(D.Normal(loc, 1.0), [D.ExpTransform()])
+        lp = td.log_prob(P.to_tensor(np.float32(2.0)))
+        lp.backward()
+        assert loc.grad is not None
+        np.testing.assert_allclose(
+            float(np.asarray(loc.grad._value)), float(np.log(2.0) - 0.3), rtol=1e-5)
+
+    def test_binomial_kl_mismatched_counts(self):
+        # p wider than q: support not nested -> +inf
+        kl = D.kl_divergence(D.Binomial(20.0, 0.3), D.Binomial(10.0, 0.3))
+        assert np.isinf(float(np.asarray(kl._value)))
+        # p narrower than q: finite but not implemented -> loud failure
+        with pytest.raises(NotImplementedError):
+            D.kl_divergence(D.Binomial(10.0, 0.3), D.Binomial(20.0, 0.3))
+        kl2 = D.kl_divergence(D.Binomial(10.0, 0.3), D.Binomial(10.0, 0.4))
+        v = float(np.asarray(kl2._value))
+        assert np.isfinite(v) and v > 0
+
+    def test_categorical_scalar_value_batched_logits(self):
+        d = D.Categorical(logits=np.ones((2, 3), np.float32))
+        lp = d.log_prob(P.to_tensor(np.float32(1.0)))
+        assert tuple(lp.shape) == (2,)
+        np.testing.assert_allclose(np.asarray(lp._value), np.log(1 / 3) * np.ones(2), rtol=1e-5)
+
+    def test_transform_param_grad(self):
+        loc = P.to_tensor(np.float32(1.0))
+        loc.stop_gradient = False
+        td = D.TransformedDistribution(D.Normal(0.0, 1.0), [D.AffineTransform(loc, 2.0)])
+        td.log_prob(P.to_tensor(np.float32(2.0))).backward()
+        assert loc.grad is not None
+        np.testing.assert_allclose(float(np.asarray(loc.grad._value)), 0.25, rtol=1e-5)

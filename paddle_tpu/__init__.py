@@ -74,7 +74,7 @@ from .device import (  # noqa: F401
 import importlib as _importlib
 
 for _sub in ("nn", "optimizer", "metric", "amp", "io", "jit", "vision", "distributed",
-             "models", "profiler", "hapi", "regularizer", "distribution"):
+             "models", "profiler", "hapi", "regularizer", "distribution", "fft"):
     try:
         globals()[_sub] = _importlib.import_module(f".{_sub}", __name__)
     except ModuleNotFoundError as _e:

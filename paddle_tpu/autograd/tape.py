@@ -118,18 +118,29 @@ class GradNode:
     ``vjp_fn`` maps a tuple of output cotangents to input cotangents.
     ``inputs`` are the Tensor operands (kept alive until backward, like the
     reference's TensorWrapper saves). ``out_metas`` are ShapeDtypeStructs used
-    to materialize zero cotangents for unused outputs.
+    to materialize zero cotangents for unused outputs. ``fn`` is the op's
+    primal pure function of the raw input values; when present, higher-order
+    backward (``create_graph=True``) re-derives the vjp *through the tape*
+    (the GeneralGrad capability, reference
+    /root/reference/paddle/fluid/eager/general_grad.h).
     """
 
-    __slots__ = ("id", "vjp_fn", "inputs", "out_metas", "name", "n_outs")
+    __slots__ = ("id", "vjp_fn", "inputs", "out_metas", "name", "n_outs", "fn",
+                 "out_struct")
 
-    def __init__(self, vjp_fn: Callable, inputs: Sequence[Any], outs: Sequence[Any], name: str = ""):
+    def __init__(self, vjp_fn: Callable, inputs: Sequence[Any], outs: Sequence[Any],
+                 name: str = "", fn: Optional[Callable] = None,
+                 out_struct: Optional[str] = None):
         self.id = next(_node_counter)
         self.vjp_fn = vjp_fn
         self.inputs = list(inputs)
         self.out_metas = [jax.ShapeDtypeStruct(jnp.shape(o), jnp.result_type(o)) for o in outs]
         self.n_outs = len(self.out_metas)
         self.name = name
+        self.fn = fn
+        # pytree structure of the primal output ('single'|'tuple'|'list') —
+        # the cotangent passed to vjp_fn must mirror it exactly
+        self.out_struct = out_struct or ("single" if self.n_outs == 1 else "tuple")
 
     def __repr__(self):
         return f"GradNode({self.name or 'op'}#{self.id})"
@@ -139,11 +150,14 @@ def _ones_like_val(v):
     return jnp.ones(jnp.shape(v), jnp.result_type(v))
 
 
-def _accumulate(tensor, g):
-    """Accumulate cotangent ``g`` (a raw jax array) into tensor.grad."""
+def _accumulate(tensor, g, keep_graph: bool = False):
+    """Accumulate cotangent ``g`` into tensor.grad. ``g`` is a raw jax array
+    normally, a tape-connected Tensor under ``create_graph=True``."""
     from ..tensor.tensor import Tensor  # local import to avoid cycle
 
-    if tensor.grad is None:
+    if keep_graph:
+        tensor.grad = g if tensor.grad is None else tensor.grad + g
+    elif tensor.grad is None:
         tensor.grad = Tensor(g, stop_gradient=True)
     else:
         tensor.grad = Tensor(tensor.grad._value + g, stop_gradient=True)
@@ -174,6 +188,7 @@ def run_backward(
     *,
     targets: Optional[Sequence[Any]] = None,
     accumulate_leaf: bool = True,
+    create_graph: bool = False,
 ):
     """Core backward sweep.
 
@@ -181,8 +196,18 @@ def run_backward(
     ``paddle.grad`` path, mirrors GeneralGrad,
     /root/reference/paddle/fluid/eager/general_grad.h) and, if
     ``accumulate_leaf`` is False, leaves ``.grad`` untouched.
+
+    With ``create_graph=True`` cotangents flow as *Tensors* and each node's
+    vjp is re-derived from its primal ``fn`` through ``ops.dispatch.apply``,
+    so the computed gradients are themselves on the tape and support another
+    ``backward()`` (double grad). Implies retaining the primal graph.
     """
     from ..tensor.tensor import Tensor
+
+    if create_graph:
+        from ..ops.dispatch import apply as _taped_apply
+
+        retain_graph = True
 
     if grad_tensors is None:
         grad_tensors = [None] * len(tensors)
@@ -206,7 +231,13 @@ def run_backward(
         """Deliver cotangent g to ``tensor``'s producer (or accumulate)."""
         if tensor.stop_gradient:
             return
-        g = _apply_hooks(tensor, g)
+        if create_graph:
+            for hook in getattr(tensor, "_hooks", ()):
+                out = hook(g)
+                if out is not None:
+                    g = out
+        else:
+            g = _apply_hooks(tensor, g)
         if g is None:
             return
         if id(tensor) in target_grads:
@@ -215,38 +246,81 @@ def run_backward(
         node = tensor._grad_node
         if node is None:
             if accumulate_leaf:
-                _accumulate(tensor, g)
+                _accumulate(tensor, g, keep_graph=create_graph)
         else:
             if accumulate_leaf and getattr(tensor, "_retain_grads", False):
-                _accumulate(tensor, g)
+                _accumulate(tensor, g, keep_graph=create_graph)
             seed(node, tensor._out_index, g)
 
     for t, gt in zip(tensors, grad_tensors):
         if t.stop_gradient and t._grad_node is None:
             continue
-        g = gt._value if isinstance(gt, Tensor) else (gt if gt is not None else _ones_like_val(t._value))
+        if create_graph:
+            if isinstance(gt, Tensor):
+                g = gt
+            elif gt is not None:
+                g = Tensor(gt, stop_gradient=True)
+            else:
+                g = Tensor(_ones_like_val(t._value), stop_gradient=True)
+        else:
+            g = gt._value if isinstance(gt, Tensor) else (gt if gt is not None else _ones_like_val(t._value))
         route(t, g)
 
     while heap:
         nid = -heapq.heappop(heap)
         node = nodes.pop(nid)
         slots = slot_grads.pop(nid)
-        cots = tuple(
-            s if s is not None else jnp.zeros(m.shape, m.dtype) for s, m in zip(slots, node.out_metas)
-        )
-        if node.n_outs == 1:
-            cots = cots[0]
         if node.vjp_fn is None:
             raise RuntimeError(
                 f"Trying to backward through {node} a second time. "
                 "Set retain_graph=True if you need to backward twice."
             )
-        in_grads = node.vjp_fn(cots)
-        if not retain_graph:
-            node.vjp_fn = None
-            node.inputs, inputs = [], node.inputs
-        else:
+        if create_graph:
+            if node.fn is None:
+                raise RuntimeError(
+                    f"create_graph=True: {node} has no primal function recorded "
+                    "(op not routed through ops.dispatch.apply); higher-order "
+                    "gradient through it is unsupported."
+                )
+            cot_tensors = [
+                s if s is not None else Tensor(jnp.zeros(m.shape, m.dtype), stop_gradient=True)
+                for s, m in zip(slots, node.out_metas)
+            ]
+            n_in = len(node.inputs)
+            primal_fn = node.fn
+
+            def _vjp_op(*vals, _fn=primal_fn, _n_in=n_in):
+                primals = vals[:_n_in]
+                outs, vjp_fn = jax.vjp(_fn, *primals)
+                cts = vals[_n_in:]
+                # cotangent structure must match the primal output structure
+                if isinstance(outs, tuple):
+                    ct = tuple(cts)
+                elif isinstance(outs, list):
+                    ct = list(cts)
+                else:
+                    ct = cts[0]
+                return list(vjp_fn(ct))
+
+            in_grads = _taped_apply(
+                _vjp_op, *node.inputs, *cot_tensors, op_name=f"grad::{node.name or 'op'}")
+            if not isinstance(in_grads, list):
+                in_grads = [in_grads]
             inputs = node.inputs
+        else:
+            cots = tuple(
+                s if s is not None else jnp.zeros(m.shape, m.dtype) for s, m in zip(slots, node.out_metas)
+            )
+            if node.out_struct == "single":
+                cots = cots[0]
+            elif node.out_struct == "list":
+                cots = list(cots)
+            in_grads = node.vjp_fn(cots)
+            if not retain_graph:
+                node.vjp_fn = None
+                node.inputs, inputs = [], node.inputs
+            else:
+                inputs = node.inputs
         for tensor, g in zip(inputs, in_grads):
             if g is not None:
                 route(tensor, g)
@@ -268,26 +342,22 @@ def grad(
 ):
     """paddle.grad parity (python/paddle/autograd/__init__.py surface).
 
-    ``create_graph=True`` (double grad) is supported through composed
-    ``jax.vjp`` only in the compiled path for now; eager raises.
+    ``create_graph=True`` (double grad) re-derives each node's vjp through
+    the tape so the returned gradients support another backward.
     """
     from ..tensor.tensor import Tensor
 
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True in eager mode is not supported yet; "
-            "use paddle_tpu.jit.to_static + jax-level grad composition."
-        )
     outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
     inputs = [inputs] if isinstance(inputs, Tensor) else list(inputs)
     if retain_graph is None:
-        retain_graph = False
+        retain_graph = create_graph
     gs = run_backward(
         outputs,
         grad_outputs,
         retain_graph=retain_graph,
         targets=inputs,
         accumulate_leaf=False,
+        create_graph=create_graph,
     )
     result = []
     for t, g in zip(inputs, gs):
@@ -298,6 +368,8 @@ def grad(
                     "pass allow_unused=True to return None for it."
                 )
             result.append(None)
+        elif create_graph:
+            result.append(g)  # already a tape-connected Tensor
         else:
             result.append(Tensor(g, stop_gradient=True))
     return result

@@ -105,7 +105,7 @@ class Controller:
         kv = self._kv
         epoch = self.restarts  # new namespace per restart round
         kv.put(f"/rdzv/{epoch}/node/{self.node_rank}", ",".join(local_eps))
-        nodes = kv.wait_n(f"/rdzv/{epoch}/node/", self.nnodes)
+        nodes = kv.wait_n(f"/rdzv/{epoch}/node/", self.nnodes, abort_key="/fail/terminal")
         ordered = [nodes[f"/rdzv/{epoch}/node/{i}"] for i in range(self.nnodes)]
         all_eps: List[str] = []
         for eps in ordered:

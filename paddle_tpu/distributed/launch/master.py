@@ -103,17 +103,35 @@ class KVClient:
 
     def get_prefix(self, prefix: str) -> Dict[str, str]:
         try:
-            with urllib.request.urlopen(f"{self.base}/prefix{prefix}", timeout=5) as r:
-                return json.loads(r.read().decode())
+            return self._get_prefix_raw(prefix)
         except OSError:
             return {}
 
-    def wait_n(self, prefix: str, n: int, timeout: float = 300.0) -> Dict[str, str]:
-        """Block until ``n`` keys exist under ``prefix`` (node sign-in barrier)."""
+    def _get_prefix_raw(self, prefix: str) -> Dict[str, str]:
+        with urllib.request.urlopen(f"{self.base}/prefix{prefix}", timeout=5) as r:
+            return json.loads(r.read().decode())
+
+    def wait_n(self, prefix: str, n: int, timeout: float = 300.0,
+               abort_key: Optional[str] = None) -> Dict[str, str]:
+        """Block until ``n`` keys exist under ``prefix`` (node sign-in barrier).
+
+        ``abort_key``: fail fast if that key appears (a peer declared the job
+        dead). A master that stays unreachable for ~20 consecutive polls also
+        aborts — its controller has exited."""
         deadline = time.time() + timeout
+        conn_errors = 0
         while time.time() < deadline:
-            got = self.get_prefix(prefix)
+            try:
+                got = self._get_prefix_raw(prefix)
+                conn_errors = 0
+            except OSError:
+                conn_errors += 1
+                if conn_errors >= 20:
+                    raise TimeoutError("rendezvous: master unreachable (peer controller exited?)")
+                got = {}
             if len(got) >= n:
                 return got
+            if abort_key is not None and self.get(abort_key) is not None:
+                raise TimeoutError(f"rendezvous: aborted — a peer marked the job failed ({abort_key})")
             time.sleep(0.2)
         raise TimeoutError(f"rendezvous: waited {timeout}s for {n} keys under {prefix}")

@@ -174,7 +174,9 @@ def _kl_binomial(p, q):
     def f(pn, qn, pp, qp):
         kl = pn * (pp * (jnp.log(pp) - jnp.log(qp))
                    + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qp)))
-        # pn > qn: p's support exceeds q's -> KL is +inf
-        return jnp.where(pn == qn, kl, jnp.inf)
+        # pn > qn: p's support exceeds q's -> KL is +inf. pn < qn is finite
+        # but uncomputed here: under tracing (where the eager guard above
+        # can't fire) surface NaN, never a silently wrong finite/inf value.
+        return jnp.where(pn == qn, kl, jnp.where(pn > qn, jnp.inf, jnp.nan))
 
     return apply(f, p.total_count, q.total_count, p.probs, q.probs)

@@ -282,6 +282,32 @@ class StackTransform(Transform):
                          self.axis)
 
 
+def _collect_param_tensors(objs):
+    """All Tensor attributes reachable from ``objs`` (Distributions and
+    Transforms, recursively through nested bases / chain members)."""
+    out, seen = [], set()
+
+    def walk(o):
+        if id(o) in seen:
+            return
+        seen.add(id(o))
+        if isinstance(o, Tensor):
+            if id(o) not in {id(p) for p in out}:
+                out.append(o)
+            return
+        if isinstance(o, (list, tuple)):
+            for item in o:
+                walk(item)
+            return
+        if isinstance(o, (Distribution, Transform)):
+            for v in vars(o).values():
+                walk(v)
+
+    for o in objs:
+        walk(o)
+    return out
+
+
 class TransformedDistribution(Distribution):
     """parity: transformed_distribution.py — base dist pushed through a
     transform chain; log_prob via the change-of-variables formula."""
@@ -294,7 +320,10 @@ class TransformedDistribution(Distribution):
         chain = ChainTransform(self.transforms)
         shape = base.batch_shape + base.event_shape
         out_shape = chain.forward_shape(shape)
-        er = chain._event_rank
+        # event rank of the result: the transform's event rank, never below
+        # the base's (an elementwise transform of an Independent base keeps
+        # the base's event dims) — torch/paddle semantics
+        er = max(chain._event_rank, len(base.event_shape))
         super().__init__(batch_shape=out_shape[: len(out_shape) - er],
                          event_shape=out_shape[len(out_shape) - er:])
 
@@ -311,12 +340,10 @@ class TransformedDistribution(Distribution):
     def log_prob(self, value):
         value = _t(value)
         chain = ChainTransform(self.transforms)
-        # thread the base distribution's AND the transforms' parameter
-        # Tensors through the outer apply so gradients reach them (e.g.
-        # training loc/scale of the base or of an AffineTransform)
-        params = [v for v in vars(self.base).values() if isinstance(v, Tensor)]
-        for t in self.transforms:
-            params.extend(v for v in vars(t).values() if isinstance(v, Tensor))
+        # thread every parameter Tensor reachable from the base distribution
+        # and the transforms (including nested Independent/Transformed bases
+        # and chain members) through the outer apply so gradients reach them
+        params = _collect_param_tensors([self.base, *self.transforms])
 
         def f(v, *pvals):
             saved = [p._value for p in params]
@@ -329,11 +356,17 @@ class TransformedDistribution(Distribution):
             finally:
                 for p, s in zip(params, saved):
                     p._value = s
-            # reduce base log_prob over dims the chain promoted to event dims
             extra = chain._event_rank - len(self.base.event_shape)
             if extra > 0:
+                # chain promoted batch dims to event dims: reduce base_lp
                 base_lp = jnp.sum(
                     base_lp, axis=tuple(range(jnp.ndim(base_lp) - extra, jnp.ndim(base_lp))))
+            elif extra < 0:
+                # base has higher event rank (e.g. Independent) than the
+                # elementwise chain: the per-element log-dets belong to one
+                # event — reduce ildj over the base's extra event dims
+                ildj = jnp.sum(
+                    ildj, axis=tuple(range(jnp.ndim(ildj) + extra, jnp.ndim(ildj))))
             return base_lp + ildj
 
         return apply(f, value, *params, op_name="transformed_log_prob")

@@ -1,0 +1,180 @@
+"""Discrete Fourier transforms (parity: /root/reference/python/paddle/fft.py
+fft/ifft/rfft/irfft/hfft/ihfft + n-d/2-d variants + helpers).
+
+TPU-native: every transform lowers to the XLA FFT HLO through ``jnp.fft`` and
+is routed through ``ops.dispatch.apply`` so forward and gradient both run on
+the tape (the reference binds cuFFT/onemkl through fft_c2c/r2c/c2r kernels —
+here XLA owns the kernel choice).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .ops.dispatch import apply
+from .tensor.tensor import Tensor
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+    "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+_NORMS = ("backward", "forward", "ortho")
+
+
+def _t(x) -> Tensor:
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _norm(norm):
+    if norm is None:
+        return "backward"
+    if norm not in _NORMS:
+        raise ValueError(
+            f"Unexpected norm: {norm!r}. Norm should be forward, backward or ortho")
+    return norm
+
+
+def _op1(jfn, x, n, axis, norm, name):
+    x = _t(x)
+    norm = _norm(norm)
+    return apply(lambda v: jfn(v, n=n, axis=axis, norm=norm), x, op_name=name)
+
+
+def _opn(jfn, x, s, axes, norm, name):
+    x = _t(x)
+    norm = _norm(norm)
+    return apply(lambda v: jfn(v, s=s, axes=axes, norm=norm), x, op_name=name)
+
+
+# ------------------------------------------------------------------ 1-d
+def fft(x, n=None, axis=-1, norm="backward", name=None):
+    return _op1(jnp.fft.fft, x, n, axis, norm, "fft")
+
+
+def ifft(x, n=None, axis=-1, norm="backward", name=None):
+    return _op1(jnp.fft.ifft, x, n, axis, norm, "ifft")
+
+
+def rfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _op1(jnp.fft.rfft, x, n, axis, norm, "rfft")
+
+
+def irfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _op1(jnp.fft.irfft, x, n, axis, norm, "irfft")
+
+
+def hfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _op1(jnp.fft.hfft, x, n, axis, norm, "hfft")
+
+
+def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _op1(jnp.fft.ihfft, x, n, axis, norm, "ihfft")
+
+
+# ------------------------------------------------------------------ n-d
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    return _opn(jnp.fft.fftn, x, s, axes, norm, "fftn")
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    return _opn(jnp.fft.ifftn, x, s, axes, norm, "ifftn")
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _opn(jnp.fft.rfftn, x, s, axes, norm, "rfftn")
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _opn(jnp.fft.irfftn, x, s, axes, norm, "irfftn")
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    """Hermitian-input n-d FFT (real output). jnp has no hfftn; compose a
+    forward c2c FFT over the leading axes with hfft along the last axis —
+    matches scipy.fft.hfftn (paddle fftn_c2r parity)."""
+    x = _t(x)
+    norm = _norm(norm)
+
+    def f(v):
+        ax = tuple(range(v.ndim)) if axes is None else tuple(axes)
+        lead, last = ax[:-1], ax[-1]
+        n_last = None if s is None else s[-1]
+        if lead:
+            s_lead = None if s is None else tuple(s[:-1])
+            v = jnp.fft.fftn(v, s=s_lead, axes=lead, norm=norm)
+        return jnp.fft.hfft(v, n=n_last, axis=last, norm=norm)
+
+    return apply(f, x, op_name="hfftn")
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    """Inverse of hfftn: ihfft along the last axis, inverse c2c over the
+    leading axes — matches scipy.fft.ihfftn."""
+    x = _t(x)
+    norm = _norm(norm)
+
+    def f(v):
+        ax = tuple(range(v.ndim)) if axes is None else tuple(axes)
+        lead, last = ax[:-1], ax[-1]
+        n_last = None if s is None else s[-1]
+        out = jnp.fft.ihfft(v, n=n_last, axis=last, norm=norm)
+        if lead:
+            s_lead = None if s is None else tuple(s[:-1])
+            out = jnp.fft.ifftn(out, s=s_lead, axes=lead, norm=norm)
+        return out
+
+    return apply(f, x, op_name="ihfftn")
+
+
+# ------------------------------------------------------------------ 2-d
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _opn(jnp.fft.fft2, x, s, axes, norm, "fft2")
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _opn(jnp.fft.ifft2, x, s, axes, norm, "ifft2")
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _opn(jnp.fft.rfft2, x, s, axes, norm, "rfft2")
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _opn(jnp.fft.irfft2, x, s, axes, norm, "irfft2")
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return hfftn(x, s, axes, norm, name)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ihfftn(x, s, axes, norm, name)
+
+
+# ------------------------------------------------------------------ helpers
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.fftfreq(n, d=d)
+    if dtype is not None:
+        from .framework.dtype import to_jax_dtype
+
+        out = out.astype(to_jax_dtype(dtype))
+    return Tensor(out)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.rfftfreq(n, d=d)
+    if dtype is not None:
+        from .framework.dtype import to_jax_dtype
+
+        out = out.astype(to_jax_dtype(dtype))
+    return Tensor(out)
+
+
+def fftshift(x, axes=None, name=None):
+    return apply(lambda v: jnp.fft.fftshift(v, axes=axes), _t(x), op_name="fftshift")
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply(lambda v: jnp.fft.ifftshift(v, axes=axes), _t(x), op_name="ifftshift")

@@ -219,7 +219,11 @@ class StaticFunction:
                 grads = jit_bwd(rng_key, flat_vals, cot_full)
                 return tuple(grads)
 
-            node = tape.GradNode(vjp_fn, all_tensors, out_vals, name="to_static")
+            def primal_fn(*vals, _fwd=entry["fwd"], _key=rng_key, _n=n_real):
+                return list(_fwd(_key, list(vals))[:_n])
+
+            node = tape.GradNode(vjp_fn, all_tensors, out_vals, name="to_static",
+                                 fn=primal_fn)
             out_tensors = []
             for i, v in enumerate(out_vals):
                 t = Tensor(v, stop_gradient=False)

@@ -112,6 +112,10 @@ def _norm_pad(pad_arg, ndim, data_format):
         spatial_axes = list(range(2, ndim))
     else:
         spatial_axes = list(range(1, ndim - 1))
+    if len(pairs) > len(spatial_axes):
+        # rank-1/2 input (no batch/channel axes to skip): pairs pad the
+        # trailing dims directly, torch/paddle low-rank semantics
+        spatial_axes = list(range(ndim))
     for i, (lo, hi) in enumerate(pairs):
         cfg[spatial_axes[-1 - i]] = (lo, hi)
     return cfg

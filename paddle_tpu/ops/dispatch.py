@@ -71,7 +71,19 @@ def apply(fn: Callable, *inputs, op_name: str = "", n_outs: int = 1):
         outs, vjp_fn = jax.vjp(fn, *vals)
         multi = isinstance(outs, (tuple, list))
         outs_seq = list(outs) if multi else [outs]
-        node = tape.GradNode(vjp_fn, inputs, outs_seq, name=op_name)
+        # primal fn (with this call's amp casts baked in) enables
+        # create_graph=True to re-derive the vjp through the tape
+        dtypes = tuple(getattr(v, "dtype", None) for v in vals)
+
+        def primal_fn(*raw, _fn=fn, _dts=dtypes):
+            cast = tuple(
+                r.astype(d) if d is not None and getattr(r, "dtype", None) != d else r
+                for r, d in zip(raw, _dts))
+            return _fn(*cast)
+
+        struct = "list" if isinstance(outs, list) else ("tuple" if multi else "single")
+        node = tape.GradNode(vjp_fn, inputs, outs_seq, name=op_name, fn=primal_fn,
+                             out_struct=struct)
         results = [_wrap(o, node, i, False) for i, o in enumerate(outs_seq)]
     else:
         outs = fn(*vals)

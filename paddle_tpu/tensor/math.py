@@ -57,6 +57,7 @@ _UNARY = {
     "sgn": jnp.sign,
     "sign": jnp.sign,
     "sin": jnp.sin,
+    "sinc": jnp.sinc,
     "sinh": jnp.sinh,
     "sqrt": jnp.sqrt,
     "square": jnp.square,

@@ -186,8 +186,10 @@ class Tensor:
         )
 
     # ---------------- autograd ----------------
-    def backward(self, grad_tensor: Optional["Tensor"] = None, retain_graph: bool = False):
-        tape.run_backward([self], [grad_tensor], retain_graph=retain_graph)
+    def backward(self, grad_tensor: Optional["Tensor"] = None, retain_graph: bool = False,
+                 create_graph: bool = False):
+        tape.run_backward([self], [grad_tensor], retain_graph=retain_graph,
+                          create_graph=create_graph)
 
     def clear_grad(self):
         self.grad = None

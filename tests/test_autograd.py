@@ -206,3 +206,54 @@ class TestPyLayer:
         b = P.to_tensor([5.0], stop_gradient=False)
         Mul.apply(a, b).backward()
         assert a.grad.item() == 5.0 and b.grad.item() == 2.0
+
+
+class TestDoubleGrad:
+    """create_graph=True: vjp-of-vjp through the tape (VERDICT r1 item 10)."""
+
+    def test_second_derivative_scalar(self):
+        x = P.to_tensor(np.float32(2.0))
+        x.stop_gradient = False
+        y = x * x * x
+        (g,) = P.grad(y, x, create_graph=True)
+        np.testing.assert_allclose(float(np.asarray(g._value)), 12.0, rtol=1e-5)
+        (g2,) = P.grad(g, x)
+        np.testing.assert_allclose(float(np.asarray(g2._value)), 12.0, rtol=1e-5)
+
+    def test_grad_penalty(self):
+        import jax
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(0)
+        w = P.to_tensor(rng.randn(4, 4).astype(np.float32))
+        w.stop_gradient = False
+        x = P.to_tensor(rng.randn(2, 4).astype(np.float32))
+        x.stop_gradient = False
+        loss = P.mean(P.matmul(x, w) ** 2)
+        (gx,) = P.grad(loss, x, create_graph=True)
+        P.sum(gx * gx).backward()
+        assert w.grad is not None
+
+        def ref_fn(wv, xv):
+            gxv = jax.grad(lambda x_: jnp.mean((x_ @ wv) ** 2))(xv)
+            return jnp.sum(gxv * gxv)
+
+        ref = jax.grad(ref_fn)(w._value, x._value)
+        np.testing.assert_allclose(np.asarray(w.grad._value), np.asarray(ref), rtol=1e-4)
+
+    def test_third_order(self):
+        x = P.to_tensor(np.float32(1.5))
+        x.stop_gradient = False
+        y = x ** 4
+        (g1,) = P.grad(y, x, create_graph=True)
+        (g2,) = P.grad(g1, x, create_graph=True)
+        (g3,) = P.grad(g2, x)
+        np.testing.assert_allclose(float(np.asarray(g3._value)), 24 * 1.5, rtol=1e-5)
+
+    def test_backward_create_graph_accumulates(self):
+        x = P.to_tensor(np.float32(3.0))
+        x.stop_gradient = False
+        (x ** 3).backward(create_graph=True)
+        g = x.grad  # 27, tape-connected
+        (g * 2.0).backward()  # adds d(2*3x^2)/dx = 12x = 36
+        np.testing.assert_allclose(float(np.asarray(x.grad._value)), 63.0, rtol=1e-5)

@@ -224,3 +224,15 @@ class TestTransforms:
         td.log_prob(P.to_tensor(np.float32(2.0))).backward()
         assert loc.grad is not None
         np.testing.assert_allclose(float(np.asarray(loc.grad._value)), 0.25, rtol=1e-5)
+
+    def test_nested_base_param_grad(self):
+        """Params of a nested Independent base must get grads (review regression)."""
+        loc = P.to_tensor(np.array([0.3, 0.1], np.float32))
+        loc.stop_gradient = False
+        td = D.TransformedDistribution(
+            D.Independent(D.Normal(loc, 1.0), 1), [D.ExpTransform()])
+        td.log_prob(P.to_tensor(np.array([2.0, 1.0], np.float32))).backward()
+        assert loc.grad is not None
+        np.testing.assert_allclose(
+            np.asarray(loc.grad._value),
+            np.log([2.0, 1.0]) - np.array([0.3, 0.1]), rtol=1e-4, atol=1e-5)

@@ -74,7 +74,8 @@ from .device import (  # noqa: F401
 import importlib as _importlib
 
 for _sub in ("nn", "optimizer", "metric", "amp", "io", "jit", "vision", "distributed",
-             "models", "profiler", "hapi", "regularizer", "distribution", "fft"):
+             "models", "profiler", "hapi", "regularizer", "distribution", "fft",
+             "sparse", "static"):
     try:
         globals()[_sub] = _importlib.import_module(f".{_sub}", __name__)
     except ModuleNotFoundError as _e:
@@ -93,19 +94,30 @@ try:
 except ModuleNotFoundError:
     pass
 
-# paddle-style disable of signature-checking global
-in_dynamic_mode = lambda: True  # noqa: E731  (single execution world: eager-over-XLA)
+# ---------------------------------------------------------- execution mode
+# dynamic (eager-over-XLA) by default; enable_static() switches the dispatch
+# chokepoint into lazy Program capture (see paddle_tpu.static)
+_dynamic_mode = True
 
 
-def disable_static(place=None):
-    return None
+def in_dynamic_mode() -> bool:
+    return _dynamic_mode
 
 
 def enable_static():
-    raise NotImplementedError(
-        "paddle_tpu has a single execution world (eager + jit tracing); "
-        "use paddle_tpu.jit.to_static for compiled execution."
-    )
+    global _dynamic_mode
+    _dynamic_mode = False
+    from .ops import dispatch as _dispatch
+
+    _dispatch._static_capture = True
+
+
+def disable_static():
+    global _dynamic_mode
+    _dynamic_mode = True
+    from .ops import dispatch as _dispatch
+
+    _dispatch._static_capture = False
 
 
 def is_grad_enabled_():

@@ -21,6 +21,11 @@ from ..framework import flags
 # op-call counter sink for amp.debugging.collect_operator_stats
 _stats_sink = None
 
+# paddle.enable_static() flips this: ops whose inputs include a symbolic
+# (ShapeDtypeStruct-valued) tensor are recorded into the current Program
+# instead of executing (see paddle_tpu.static)
+_static_capture = False
+
 
 def _wrap(val, node, index, stop_gradient):
     from ..tensor.tensor import Tensor
@@ -64,6 +69,10 @@ def apply(fn: Callable, *inputs, op_name: str = "", n_outs: int = 1):
     """
     if _stats_sink is not None:
         _stats_sink[op_name or "<anonymous>"] = _stats_sink.get(op_name or "<anonymous>", 0) + 1
+    if _static_capture and any(isinstance(t._value, jax.ShapeDtypeStruct) for t in inputs):
+        from ..static import _capture
+
+        return _capture(fn, inputs, op_name)
     vals = tuple(t._value for t in inputs)
     vals = _amp_cast_vals(op_name, vals)
     needs_grad = tape.grad_enabled() and any(not t.stop_gradient for t in inputs)

@@ -140,6 +140,16 @@ class Optimizer:
     clear_gradients = clear_grad
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        import jax
+
+        if isinstance(loss._value, jax.ShapeDtypeStruct):
+            # static-graph capture: mark the program for Executor training
+            from ..static import default_main_program
+
+            prog = default_main_program()
+            prog._loss = loss
+            prog._optimizer = self
+            return None, None
         loss.backward()
         self.step()
         return None, None

@@ -1,0 +1,507 @@
+"""paddle.sparse parity (/root/reference/python/paddle/sparse/__init__.py:57
+API surface: COO/CSR creation, unary/binary value ops, matmul tier).
+
+TPU-native design: a sparse tensor is (static index arrays + a dense
+``values`` Tensor on the autograd tape). Elementwise ops map values through
+``ops.dispatch.apply`` so gradients flow exactly like dense ops; spmm/sddmm
+lower to gather + segment-sum/scatter-add — the XLA-friendly formulation
+(contiguous gathers feed the MXU; no CPU-style CSR loops). The reference
+binds cuSPARSE kernels (paddle/phi/kernels/sparse/); XLA owns the kernels
+here.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.dispatch import apply
+from ..tensor.tensor import Tensor
+
+__all__ = [
+    "sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor", "SparseCsrTensor",
+    "sin", "tan", "asin", "atan", "sinh", "tanh", "asinh", "atanh",
+    "sqrt", "square", "log1p", "abs", "pow", "cast", "neg", "deg2rad",
+    "rad2deg", "expm1", "isnan",
+    "mv", "matmul", "masked_matmul", "addmm", "mask_as",
+    "add", "subtract", "multiply", "divide",
+    "transpose", "sum", "coalesce", "is_same_shape", "reshape", "slice",
+    "pca_lowrank",
+]
+
+
+def _t(x) -> Tensor:
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _idx(x) -> jnp.ndarray:
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return v.astype(jnp.int32)
+
+
+class SparseCooTensor:
+    """COO sparse tensor: ``indices`` [sparse_dim, nnz] (static), ``values``
+    [nnz, *dense_dims] (tape-connected Tensor)."""
+
+    is_sparse_coo = True
+    is_sparse_csr = False
+
+    def __init__(self, indices, values: Tensor, shape):
+        self._indices = _idx(indices)
+        self._values = values if isinstance(values, Tensor) else _t(values)
+        self.shape = list(int(s) for s in shape)
+
+    # ------------------------------------------------------------- accessors
+    def indices(self):
+        return Tensor(self._indices)
+
+    def values(self):
+        return self._values
+
+    @property
+    def nnz(self):
+        return int(self._indices.shape[1])
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def stop_gradient(self):
+        return self._values.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self._values.stop_gradient = v
+
+    def to_dense(self) -> Tensor:
+        idx = self._indices
+        shape = tuple(self.shape)
+
+        def f(vals):
+            dense = jnp.zeros(shape, vals.dtype)
+            return dense.at[tuple(idx)].add(vals)
+
+        return apply(f, self._values, op_name="sparse_to_dense")
+
+    def to_sparse_coo(self, sparse_dim=None) -> "SparseCooTensor":
+        return self
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        if len(self.shape) != 2:
+            raise ValueError("to_sparse_csr supports 2-D tensors")
+        co = self.coalesce()
+        rows = np.asarray(co._indices[0])
+        crows = np.zeros(self.shape[0] + 1, np.int32)
+        np.add.at(crows[1:], rows, 1)
+        crows = np.cumsum(crows).astype(np.int32)
+        return SparseCsrTensor(crows, co._indices[1], co._values, self.shape)
+
+    def coalesce(self) -> "SparseCooTensor":
+        """Merge duplicate indices (sorted row-major). Index bookkeeping is
+        host-side numpy (static structure); value summation stays on-tape."""
+        idx = np.asarray(self._indices)
+        flat = np.ravel_multi_index(tuple(idx), tuple(self.shape[: idx.shape[0]]))
+        uniq, inv = np.unique(flat, return_inverse=True)
+        if uniq.size == idx.shape[1]:
+            order = np.argsort(flat, kind="stable")
+            new_idx = idx[:, order]
+            perm = jnp.asarray(order, jnp.int32)
+            vals = apply(lambda v: v[perm], self._values, op_name="coo_sort")
+            return SparseCooTensor(new_idx, vals, self.shape)
+        seg = jnp.asarray(inv, jnp.int32)
+        n = int(uniq.size)
+        vals = apply(lambda v: jax.ops.segment_sum(v, seg, num_segments=n),
+                     self._values, op_name="coo_coalesce")
+        new_idx = np.stack(np.unravel_index(uniq, tuple(self.shape[: idx.shape[0]])))
+        return SparseCooTensor(new_idx, vals, self.shape)
+
+    def __repr__(self):
+        return f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, dtype={self.dtype})"
+
+
+class SparseCsrTensor:
+    """CSR sparse tensor: ``crows`` [rows+1], ``cols`` [nnz] (static),
+    ``values`` [nnz] on the tape."""
+
+    is_sparse_coo = False
+    is_sparse_csr = True
+
+    def __init__(self, crows, cols, values: Tensor, shape):
+        self._crows = _idx(crows)
+        self._cols = _idx(cols)
+        self._values = values if isinstance(values, Tensor) else _t(values)
+        self.shape = list(int(s) for s in shape)
+
+    def crows(self):
+        return Tensor(self._crows)
+
+    def cols(self):
+        return Tensor(self._cols)
+
+    def values(self):
+        return self._values
+
+    @property
+    def nnz(self):
+        return int(self._cols.shape[0])
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def stop_gradient(self):
+        return self._values.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self._values.stop_gradient = v
+
+    def _rows(self) -> np.ndarray:
+        crows = np.asarray(self._crows)
+        return np.repeat(np.arange(len(crows) - 1), np.diff(crows)).astype(np.int32)
+
+    def to_sparse_coo(self, sparse_dim=2) -> SparseCooTensor:
+        rows = self._rows()
+        idx = np.stack([rows, np.asarray(self._cols)])
+        return SparseCooTensor(idx, self._values, self.shape)
+
+    def to_dense(self) -> Tensor:
+        return self.to_sparse_coo().to_dense()
+
+    def __repr__(self):
+        return f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz}, dtype={self.dtype})"
+
+
+# ----------------------------------------------------------------- creation
+def _creation_values(values, dtype, stop_gradient):
+    """Normalize creation values WITHOUT mutating a caller-owned Tensor's
+    stop_gradient (a trainable tensor must not be silently detached)."""
+    was_tensor = isinstance(values, Tensor)
+    values = _t(values)
+    if dtype is not None:
+        from ..framework.dtype import to_jax_dtype
+
+        values = Tensor(values._value.astype(to_jax_dtype(dtype)),
+                        stop_gradient=values.stop_gradient)
+        was_tensor = False
+    if not was_tensor:
+        values.stop_gradient = stop_gradient
+    return values
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    indices = _idx(indices)
+    values = _creation_values(values, dtype, stop_gradient)
+    if shape is None:
+        sp = np.asarray(jnp.max(indices, axis=1)) + 1
+        shape = list(sp.astype(int)) + list(values._value.shape[1:])
+    return SparseCooTensor(indices, values, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    values = _creation_values(values, dtype, stop_gradient)
+    return SparseCsrTensor(crows, cols, values, shape)
+
+
+# ------------------------------------------------------------- unary ops
+def _unary(jfn, name):
+    def op(x, name_=None):
+        vals = apply(jfn, x._values, op_name=f"sparse_{name}")
+        if isinstance(x, SparseCooTensor):
+            return SparseCooTensor(x._indices, vals, x.shape)
+        return SparseCsrTensor(x._crows, x._cols, vals, x.shape)
+
+    op.__name__ = name
+    return op
+
+
+sin = _unary(jnp.sin, "sin")
+tan = _unary(jnp.tan, "tan")
+asin = _unary(jnp.arcsin, "asin")
+atan = _unary(jnp.arctan, "atan")
+sinh = _unary(jnp.sinh, "sinh")
+tanh = _unary(jnp.tanh, "tanh")
+asinh = _unary(jnp.arcsinh, "asinh")
+atanh = _unary(jnp.arctanh, "atanh")
+sqrt = _unary(jnp.sqrt, "sqrt")
+square = _unary(jnp.square, "square")
+log1p = _unary(jnp.log1p, "log1p")
+abs = _unary(jnp.abs, "abs")  # noqa: A001
+neg = _unary(jnp.negative, "neg")
+deg2rad = _unary(jnp.deg2rad, "deg2rad")
+rad2deg = _unary(jnp.rad2deg, "rad2deg")
+expm1 = _unary(jnp.expm1, "expm1")
+isnan = _unary(jnp.isnan, "isnan")
+
+
+def pow(x, factor, name=None):  # noqa: A001
+    vals = apply(lambda v: jnp.power(v, factor), x._values, op_name="sparse_pow")
+    if isinstance(x, SparseCooTensor):
+        return SparseCooTensor(x._indices, vals, x.shape)
+    return SparseCsrTensor(x._crows, x._cols, vals, x.shape)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    from ..framework.dtype import to_jax_dtype
+
+    vals = x._values
+    if value_dtype is not None:
+        vals = apply(lambda v: v.astype(to_jax_dtype(value_dtype)), vals,
+                     op_name="sparse_cast")
+    if isinstance(x, SparseCooTensor):
+        idx = x._indices.astype(to_jax_dtype(index_dtype)) if index_dtype else x._indices
+        return SparseCooTensor(idx, vals, x.shape)
+    if index_dtype:
+        return SparseCsrTensor(x._crows.astype(to_jax_dtype(index_dtype)),
+                               x._cols.astype(to_jax_dtype(index_dtype)), vals, x.shape)
+    return SparseCsrTensor(x._crows, x._cols, vals, x.shape)
+
+
+# ------------------------------------------------------------- binary ops
+def _coo_binary(jfn, name):
+    """Elementwise op on two COO tensors with the same sparsity pattern, or
+    general pattern union via coalesce of the stacked tensors."""
+
+    def op(x, y, name_=None):
+        if isinstance(x, SparseCsrTensor) or isinstance(y, SparseCsrTensor):
+            out = op(x.to_sparse_coo(), y.to_sparse_coo())
+            # result format follows x (paddle semantics)
+            return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) else out
+        if x.shape != y.shape:
+            raise ValueError(f"shape mismatch {x.shape} vs {y.shape}")
+        xi, yi = np.asarray(x._indices), np.asarray(y._indices)
+        if xi.shape == yi.shape and (xi == yi).all():
+            vals = apply(jfn, x._values, y._values, op_name=f"sparse_{name}")
+            return SparseCooTensor(x._indices, vals, x.shape)
+        # pattern union: merge index sets host-side, scatter both value sets
+        fx = np.ravel_multi_index(tuple(xi), tuple(x.shape[: xi.shape[0]]))
+        fy = np.ravel_multi_index(tuple(yi), tuple(y.shape[: yi.shape[0]]))
+        uniq = np.union1d(fx, fy)
+        px = jnp.asarray(np.searchsorted(uniq, fx), jnp.int32)
+        py = jnp.asarray(np.searchsorted(uniq, fy), jnp.int32)
+        n = int(uniq.size)
+
+        def f(xv, yv):
+            xs = jnp.zeros((n,) + xv.shape[1:], xv.dtype).at[px].add(xv)
+            ys = jnp.zeros((n,) + yv.shape[1:], yv.dtype).at[py].add(yv)
+            return jfn(xs, ys)
+
+        vals = apply(f, x._values, y._values, op_name=f"sparse_{name}")
+        new_idx = np.stack(np.unravel_index(uniq, tuple(x.shape[: xi.shape[0]])))
+        return SparseCooTensor(new_idx, vals, x.shape)
+
+    op.__name__ = name
+    return op
+
+
+add = _coo_binary(jnp.add, "add")
+subtract = _coo_binary(jnp.subtract, "subtract")
+multiply = _coo_binary(jnp.multiply, "multiply")
+divide = _coo_binary(jnp.divide, "divide")
+
+
+# ------------------------------------------------------------- matmul tier
+def _coo_rows_cols(x: SparseCooTensor):
+    return x._indices[0], x._indices[1]
+
+
+def matmul(x, y, name=None):
+    """sparse @ dense -> dense (spmm). gather rows of y by col index, scale
+    by values, segment-sum into output rows — the XLA scatter-add spmm."""
+    if isinstance(x, SparseCsrTensor):
+        x = x.to_sparse_coo()
+    y = _t(y) if not isinstance(y, (SparseCooTensor, SparseCsrTensor)) else y
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        # sparse @ sparse: fall back through dense rhs (XLA densifies well)
+        y = y.to_dense()
+    rows, cols = _coo_rows_cols(x)
+    m = x.shape[0]
+
+    def f(vals, dense):
+        gathered = dense[cols] * vals.reshape((-1,) + (1,) * (dense.ndim - 1))
+        return jax.ops.segment_sum(gathered, rows, num_segments=m)
+
+    return apply(f, x._values, y, op_name="sparse_matmul")
+
+
+def mv(x, vec, name=None):
+    if isinstance(x, SparseCsrTensor):
+        x = x.to_sparse_coo()
+    vec = _t(vec)
+    rows, cols = _coo_rows_cols(x)
+    m = x.shape[0]
+
+    def f(vals, v):
+        return jax.ops.segment_sum(vals * v[cols], rows, num_segments=m)
+
+    return apply(f, x._values, vec, op_name="sparse_mv")
+
+
+def masked_matmul(x, y, mask, name=None):
+    """(dense @ dense) sampled at mask's sparsity pattern (SDDMM)."""
+    x, y = _t(x), _t(y)
+    if isinstance(mask, SparseCsrTensor):
+        coo = mask.to_sparse_coo()
+        rows, cols = _coo_rows_cols(coo)
+
+        def f(xa, ya):
+            return jnp.sum(xa[rows] * ya.T[cols], axis=-1)
+
+        vals = apply(f, x, y, op_name="sparse_sddmm")
+        return SparseCsrTensor(mask._crows, mask._cols, vals, mask.shape)
+    rows, cols = _coo_rows_cols(mask)
+
+    def f(xa, ya):
+        return jnp.sum(xa[rows] * ya.T[cols], axis=-1)
+
+    vals = apply(f, x, y, op_name="sparse_sddmm")
+    return SparseCooTensor(mask._indices, vals, mask.shape)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
+    """beta * input + alpha * (x @ y) with sparse x."""
+    out = matmul(x, y)
+    inp = input.to_dense() if isinstance(input, (SparseCooTensor, SparseCsrTensor)) else _t(input)
+    from ..tensor import math as _m
+
+    return _m.add(_m.scale(inp, beta), _m.scale(out, alpha))
+
+
+def mask_as(x, mask, name=None):
+    """Sample dense ``x`` at ``mask``'s sparsity pattern."""
+    x = _t(x)
+    if isinstance(mask, SparseCsrTensor):
+        coo = mask.to_sparse_coo()
+        idx = coo._indices
+        vals = apply(lambda d: d[tuple(idx)], x, op_name="sparse_mask_as")
+        return SparseCsrTensor(mask._crows, mask._cols, vals, mask.shape)
+    idx = mask._indices
+    vals = apply(lambda d: d[tuple(idx)], x, op_name="sparse_mask_as")
+    return SparseCooTensor(idx, vals, mask.shape)
+
+
+# ------------------------------------------------------------- structure ops
+def transpose(x, perm, name=None):
+    if isinstance(x, SparseCsrTensor):
+        return transpose(x.to_sparse_coo(), perm).to_sparse_csr()
+    sd = x._indices.shape[0]
+    if sorted(perm) != list(range(len(x.shape))):
+        raise ValueError(f"bad perm {perm}")
+    if any(p >= sd for p in perm[:sd]):
+        if perm[:sd] != sorted(perm[:sd]) or max(perm[:sd]) >= sd:
+            raise NotImplementedError("transpose mixing sparse and dense dims")
+    new_idx = x._indices[jnp.asarray(perm[:sd])]
+    new_shape = [x.shape[p] for p in perm]
+    dense_perm = [0] + [p - sd + 1 for p in perm[sd:]]
+    vals = x._values
+    if dense_perm != list(range(len(dense_perm))):
+        vals = apply(lambda v: jnp.transpose(v, dense_perm), vals, op_name="sparse_transpose")
+    return SparseCooTensor(new_idx, vals, new_shape).coalesce()
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    """Reduce a sparse tensor. axis=None -> dense scalar; otherwise reduce
+    over the given sparse axis and return COO."""
+    from ..tensor import math as _m
+
+    if axis is None:
+        return _m.sum(x._values)
+    if isinstance(x, SparseCsrTensor):
+        out = sum(x.to_sparse_coo(), axis, dtype, keepdim)
+        # CSR requires 2-D; an axis-reduce without keepdim yields 1-D -> COO
+        return out.to_sparse_csr() if len(out.shape) == 2 else out
+    nd = len(x.shape)
+    ax = axis + nd if axis < 0 else axis
+    sd = x._indices.shape[0]
+    if ax >= sd:
+        vals = apply(lambda v: jnp.sum(v, axis=ax - sd + 1, keepdims=keepdim),
+                     x._values, op_name="sparse_sum")
+        shape = list(x.shape)
+        if keepdim:
+            shape[ax] = 1
+        else:
+            shape.pop(ax)
+        return SparseCooTensor(x._indices, vals, shape)
+    keep = [i for i in range(sd) if i != ax]
+    new_idx = x._indices[jnp.asarray(keep)]
+    if keepdim:
+        new_idx = jnp.insert(new_idx, ax, 0, axis=0)
+        shape = list(x.shape)
+        shape[ax] = 1
+    else:
+        shape = [s for i, s in enumerate(x.shape) if i != ax]
+    return SparseCooTensor(new_idx, x._values, shape).coalesce()
+
+
+def coalesce(x, name=None):
+    return x.coalesce()
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)
+
+
+def reshape(x, shape, name=None):
+    if isinstance(x, SparseCsrTensor):
+        return reshape(x.to_sparse_coo(), shape).to_sparse_csr()
+    old = tuple(x.shape)
+    shape = list(shape)
+    neg = [i for i, s in enumerate(shape) if s == -1]
+    if neg:
+        known = int(np.prod([s for s in shape if s != -1]))
+        shape[neg[0]] = int(np.prod(old)) // known
+    sd = x._indices.shape[0]
+    if sd != len(old):
+        raise NotImplementedError("reshape with dense dims")
+    flat = np.ravel_multi_index(tuple(np.asarray(x._indices)), old)
+    new_idx = np.stack(np.unravel_index(flat, tuple(shape)))
+    return SparseCooTensor(new_idx, x._values, shape)
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001
+    if isinstance(x, SparseCsrTensor):
+        return slice(x.to_sparse_coo(), axes, starts, ends).to_sparse_csr()
+    idx = np.asarray(x._indices)
+    shape = list(x.shape)
+    mask = np.ones(idx.shape[1], bool)
+    offs = np.zeros(idx.shape[0], np.int64)
+    for ax, st, en in zip(axes, starts, ends):
+        ax = ax + len(shape) if ax < 0 else ax
+        st = max(0, st + shape[ax] if st < 0 else st)
+        en = min(shape[ax], en + shape[ax] if en < 0 else en)
+        mask &= (idx[ax] >= st) & (idx[ax] < en)
+        offs[ax] = st
+        shape[ax] = en - st
+    keep = np.nonzero(mask)[0]
+    new_idx = idx[:, keep] - offs[:, None]
+    sel = jnp.asarray(keep, jnp.int32)
+    vals = apply(lambda v: v[sel], x._values, op_name="sparse_slice")
+    return SparseCooTensor(new_idx, vals, shape)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized PCA (parity: sparse.pca_lowrank). Dense math over the
+    sparse operand's dense view — XLA/TPU does this on the MXU."""
+    from ..tensor import linalg as _la
+    from ..tensor import math as _m
+
+    dense = x.to_dense() if isinstance(x, (SparseCooTensor, SparseCsrTensor)) else _t(x)
+    m, n = dense.shape[-2], dense.shape[-1]
+    if q is None:
+        q = min(6, m, n)
+    if center:
+        mean = _m.mean(dense, axis=-2, keepdim=True)
+        dense = _m.subtract(dense, mean)
+    u, s, vt = _la.svd(dense, full_matrices=False)
+    from ..tensor.manipulation import slice as _slice
+
+    return (_slice(u, [-1], [0], [q]), _slice(s, [-1], [0], [q]),
+            _la.transpose(_slice(vt, [-2], [0], [q]), [1, 0]))
+
+
+from . import nn  # noqa: E402,F401

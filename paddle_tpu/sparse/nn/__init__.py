@@ -1,0 +1,210 @@
+"""paddle.sparse.nn parity (/root/reference/python/paddle/sparse/nn):
+activations on sparse values, BatchNorm over the dense feature axis, and
+conv layers.
+
+TPU stance: submanifold convs keep the input's sparsity pattern — computed
+as a dense XLA conv sampled back at the active sites (on TPU the MXU path
+for a dense conv beats CPU-style gather loops at these densities; the
+reference uses rulebook-based cuSPARSE kernels, paddle/phi/kernels/sparse/conv_kernel.h).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...nn import functional as F
+from ...nn.layer.layers import Layer
+from ...ops.dispatch import apply
+from ...tensor.tensor import Tensor
+from .. import SparseCooTensor, SparseCsrTensor, mask_as
+
+__all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax", "BatchNorm", "SyncBatchNorm",
+           "Conv2D", "Conv3D", "SubmConv2D", "SubmConv3D", "MaxPool3D"]
+
+
+def _map_values(x, fn, name):
+    vals = apply(fn, x._values, op_name=name)
+    if isinstance(x, SparseCooTensor):
+        return SparseCooTensor(x._indices, vals, x.shape)
+    return SparseCsrTensor(x._crows, x._cols, vals, x.shape)
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return _map_values(x, lambda v: jnp.maximum(v, 0), "sparse_relu")
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return _map_values(x, lambda v: jnp.clip(v, 0, 6), "sparse_relu6")
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        a = self.negative_slope
+        return _map_values(x, lambda v: jnp.where(v >= 0, v, a * v), "sparse_leaky_relu")
+
+
+class Softmax(Layer):
+    """Row-wise softmax over the stored nonzeros (CSR semantics)."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+        if axis != -1:
+            raise NotImplementedError("sparse Softmax supports axis=-1")
+
+    def forward(self, x):
+        import numpy as np
+
+        import jax
+
+        csr = x if isinstance(x, SparseCsrTensor) else x.to_sparse_csr()
+        rows = jnp.asarray(csr._rows(), jnp.int32)
+        nrows = csr.shape[0]
+
+        def f(v):
+            rmax = jax.ops.segment_max(v, rows, num_segments=nrows)
+            e = jnp.exp(v - rmax[rows])
+            denom = jax.ops.segment_sum(e, rows, num_segments=nrows)
+            return e / denom[rows]
+
+        vals = apply(f, csr._values, op_name="sparse_softmax")
+        out = SparseCsrTensor(csr._crows, csr._cols, vals, csr.shape)
+        return out if isinstance(x, SparseCsrTensor) else out.to_sparse_coo()
+
+
+class BatchNorm(Layer):
+    """BatchNorm over the trailing feature axis of COO values (NDHWC-style
+    sparse input: values are [nnz, C])."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC", name=None):
+        super().__init__()
+        from ...nn.layer.norm import BatchNorm1D
+
+        self._bn = BatchNorm1D(num_features, momentum=momentum, epsilon=epsilon,
+                               weight_attr=weight_attr, bias_attr=bias_attr)
+
+    def forward(self, x):
+        out_vals = self._bn(x._values)
+        return SparseCooTensor(x._indices, out_vals, x.shape)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Under SPMD the (sharded) batch statistics are computed by the same
+    program on every device — GSPMD inserts the cross-device reductions, so
+    sync-BN is plain BN here (reference: sync_batch_norm distributed op)."""
+
+
+class _DenseFallbackConv(Layer):
+    def __init__(self, conv_cls, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, subm=False, bias_attr=None,
+                 data_format=None):
+        super().__init__()
+        self._subm = subm
+        self._conv = conv_cls(in_channels, out_channels, kernel_size, stride=stride,
+                              padding=padding, dilation=dilation, groups=groups,
+                              bias_attr=bias_attr)
+
+    @property
+    def weight(self):
+        return self._conv.weight
+
+    @property
+    def bias(self):
+        return self._conv.bias
+
+    def forward(self, x: SparseCooTensor):
+        # channels-last sparse layout -> dense NC... conv -> back
+        dense = x.to_dense()  # [N, *spatial, C]
+        nd = len(x.shape) - 2
+        perm_in = [0, nd + 1] + list(range(1, nd + 1))
+        perm_out = [0] + list(range(2, nd + 2)) + [1]
+        from ...tensor import linalg as _la
+
+        out = self._conv(_la.transpose(dense, perm_in))
+        out = _la.transpose(out, perm_out)
+        if self._subm:
+            # keep the input's sparsity pattern; channel count changes
+            idx = x._indices
+            vals = apply(lambda d: d[tuple(idx)], out, op_name="subm_conv_gather")
+            return SparseCooTensor(idx, vals, list(out.shape))
+        # new sparsity pattern: keep sites with any nonzero channel
+        import numpy as np
+
+        arr = np.asarray(out._value)
+        idx = np.stack(np.nonzero((arr != 0).any(-1)))
+        full_idx = idx
+        vals = apply(lambda d: d[tuple(jnp.asarray(full_idx))], out, op_name="sparse_conv_gather")
+        shape = list(out.shape)
+        return SparseCooTensor(full_idx, vals, shape)
+
+
+class Conv2D(_DenseFallbackConv):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
+                 bias_attr=None, data_format="NHWC"):
+        from ...nn.layer.conv import Conv2D as DenseConv2D
+
+        super().__init__(DenseConv2D, in_channels, out_channels, kernel_size,
+                         stride, padding, dilation, groups, subm=False,
+                         bias_attr=bias_attr)
+
+
+class Conv3D(_DenseFallbackConv):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
+                 bias_attr=None, data_format="NDHWC"):
+        from ...nn.layer.conv import Conv3D as DenseConv3D
+
+        super().__init__(DenseConv3D, in_channels, out_channels, kernel_size,
+                         stride, padding, dilation, groups, subm=False,
+                         bias_attr=bias_attr)
+
+
+class SubmConv2D(_DenseFallbackConv):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", key=None,
+                 weight_attr=None, bias_attr=None, data_format="NHWC"):
+        from ...nn.layer.conv import Conv2D as DenseConv2D
+
+        super().__init__(DenseConv2D, in_channels, out_channels, kernel_size,
+                         stride, padding, dilation, groups, subm=True,
+                         bias_attr=bias_attr)
+
+
+class SubmConv3D(_DenseFallbackConv):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", key=None,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        from ...nn.layer.conv import Conv3D as DenseConv3D
+
+        super().__init__(DenseConv3D, in_channels, out_channels, kernel_size,
+                         stride, padding, dilation, groups, subm=True,
+                         bias_attr=bias_attr)
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 return_mask=False, data_format="NDHWC", name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride or kernel_size
+        self.padding = padding
+
+    def forward(self, x: SparseCooTensor):
+        dense = x.to_dense()  # [N, D, H, W, C]
+        from ...tensor import linalg as _la
+
+        nchw = _la.transpose(dense, [0, 4, 1, 2, 3])
+        out = F.max_pool3d(nchw, self.kernel_size, self.stride, self.padding)
+        out = _la.transpose(out, [0, 2, 3, 4, 1])
+        import numpy as np
+
+        arr = np.asarray(out._value)
+        idx = np.stack(np.nonzero((arr != 0).any(-1)))
+        vals = apply(lambda d: d[tuple(jnp.asarray(idx))], out, op_name="sparse_pool_gather")
+        return SparseCooTensor(idx, vals, list(out.shape))

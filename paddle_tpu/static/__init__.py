@@ -1,0 +1,405 @@
+"""paddle.static parity (/root/reference/python/paddle/static/__init__.py:
+Program/Executor/data/program_guard/save+load_inference_model surface).
+
+TPU-native collapse of the reference's Program->IR->Executor stack
+(static.Executor -> fluid C++ StandaloneExecutor): a Program is a *lazy op
+list* captured at the single eager-dispatch chokepoint (ops.dispatch.apply).
+Under ``paddle.enable_static()`` every op records (pure_fn, inputs, outputs)
+with abstract ShapeDtypeStruct values instead of executing; ``Executor.run``
+replays the list as ONE pure function and hands it to ``jax.jit`` — the
+whole Program becomes a single XLA computation (the reference needs a whole
+IR + pass + scheduler stack for this; XLA is that stack here).
+
+Training: ``optimizer.minimize(loss)`` marks the program; Executor.run
+computes grads of the replay with ``jax.grad`` and applies the framework
+optimizer's own update eagerly.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.dtype import to_jax_dtype
+from ..tensor.tensor import Tensor
+
+__all__ = [
+    "Program", "program_guard", "default_main_program", "default_startup_program",
+    "data", "Executor", "scope_guard", "global_scope", "name_scope",
+    "save_inference_model", "load_inference_model", "InputSpec", "Variable",
+    "cpu_places", "cuda_places", "xpu_places", "device_guard",
+]
+
+from ..jit.api import InputSpec  # noqa: E402  (shared spec type)
+
+Variable = Tensor  # static-graph "Variable" is the same symbolic Tensor
+
+
+class Program:
+    """A captured op list + feed/fetch bookkeeping (parity:
+    python/paddle/base/framework.py Program; block structure collapsed —
+    XLA control flow ops don't need sub-blocks)."""
+
+    _ids = itertools.count()
+
+    def __init__(self):
+        self.id = next(Program._ids)
+        self.ops: List[tuple] = []  # (fn, input_tensors, output_tensors, name)
+        self.feeds: List[Tensor] = []
+        self._loss: Optional[Tensor] = None
+        self._optimizer = None
+        self.random_seed = 0
+
+    # -- introspection parity helpers
+    def global_block(self):
+        return self
+
+    def all_parameters(self):
+        seen, out = set(), []
+        for _, ins, _, _ in self.ops:
+            for t in ins:
+                if getattr(t, "is_parameter", False) and id(t) not in seen:
+                    seen.add(id(t))
+                    out.append(t)
+        return out
+
+    def clone(self, for_test=False):
+        p = Program()
+        p.ops = list(self.ops)
+        p.feeds = list(self.feeds)
+        return p
+
+    def __repr__(self):
+        return f"Program(id={self.id}, ops={len(self.ops)}, feeds={len(self.feeds)})"
+
+
+_default_main = Program()
+_default_startup = Program()
+_prog_stack: List[Program] = []
+
+
+def default_main_program() -> Program:
+    return _prog_stack[-1] if _prog_stack else _default_main
+
+
+def default_startup_program() -> Program:
+    return _default_startup
+
+
+class program_guard:
+    def __init__(self, main_program: Program, startup_program: Optional[Program] = None):
+        self.main = main_program
+        self.startup = startup_program
+
+    def __enter__(self):
+        _prog_stack.append(self.main)
+        return self.main
+
+    def __exit__(self, *exc):
+        _prog_stack.pop()
+        return False
+
+
+# ------------------------------------------------------------- capture hooks
+def _static_enabled() -> bool:
+    import paddle_tpu
+
+    return not paddle_tpu.in_dynamic_mode()
+
+
+def _capture(fn, inputs, op_name, n_outs_hint=1):
+    """Record one op into the current program; return symbolic outputs."""
+    prog = default_main_program()
+    metas = [v._value if isinstance(v._value, jax.ShapeDtypeStruct)
+             else jax.ShapeDtypeStruct(jnp.shape(v._value), jnp.result_type(v._value))
+             for v in inputs]
+    out = jax.eval_shape(fn, *metas)
+    multi = isinstance(out, (tuple, list))
+    outs = list(out) if multi else [out]
+    out_tensors = [Tensor(o, stop_gradient=all(t.stop_gradient for t in inputs))
+                   for o in outs]
+    prog.ops.append((fn, list(inputs), out_tensors, op_name))
+    return (out_tensors if isinstance(out, list) else tuple(out_tensors)) if multi else out_tensors[0]
+
+
+def data(name: str, shape, dtype="float32", lod_level=0) -> Tensor:
+    """Feed placeholder (parity: static.data). Dim None/-1 -> batch dim;
+    materialized per-feed at run time (bucketed jit per concrete shape)."""
+    shape = [s if (s is not None and s != -1) else -1 for s in shape]
+    abstract = jax.ShapeDtypeStruct(tuple(1 if s == -1 else s for s in shape),
+                                    to_jax_dtype(dtype))
+    t = Tensor(abstract, stop_gradient=True, name=name)
+    default_main_program().feeds.append(t)
+    return t
+
+
+# ------------------------------------------------------------------ executor
+class Executor:
+    """Replays a Program as one jitted pure function (parity:
+    static.Executor over StandaloneExecutor,
+    /root/reference/paddle/fluid/framework/new_executor/standalone_executor.h)."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache: Dict[tuple, Any] = {}
+        self._analysis: Dict[tuple, Any] = {}
+
+    def _analyze(self, program: Program):
+        """(const tensors, placeholder tensors) the op list reads — computed
+        once per (program, op-count), not per step."""
+        key = (program.id, len(program.ops))
+        hit = self._analysis.get(key)
+        if hit is not None:
+            return hit
+        produced = set()
+        for _, _, outs, _ in program.ops:
+            produced.update(id(o) for o in outs)
+        placeholder_ids = {id(t): t for t in program.feeds}
+        const_ts, used_placeholders, seen = [], [], set()
+        for _, ins, _, _ in program.ops:
+            for t in ins:
+                if id(t) in produced or id(t) in seen:
+                    continue
+                seen.add(id(t))
+                if id(t) in placeholder_ids:
+                    used_placeholders.append(t)
+                elif not isinstance(t._value, jax.ShapeDtypeStruct):
+                    const_ts.append(t)
+        self._analysis[key] = (const_ts, used_placeholders)
+        return const_ts, used_placeholders
+
+    def run(self, program: Optional[Program] = None, feed: Optional[dict] = None,
+            fetch_list: Optional[Sequence] = None, return_numpy: bool = True):
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = list(fetch_list or [])
+        if program is _default_startup or not program.ops:
+            return []  # startup collapses: params are initialized eagerly
+
+        known = {t.name for t in program.feeds}
+        unknown = set(feed) - known
+        if unknown:
+            raise KeyError(
+                f"feed names {sorted(unknown)} match no placeholder in this "
+                f"program (placeholders: {sorted(known)})")
+        feed_ts = [t for t in program.feeds if t.name in feed]
+        feed_vals = [jnp.asarray(feed[t.name]) for t in feed_ts]
+        feed_ids = {id(t) for t in feed_ts}
+
+        const_ts, used_placeholders = self._analyze(program)
+        missing = [t.name for t in used_placeholders if id(t) not in feed_ids]
+        if missing:
+            raise KeyError(f"placeholders {missing} are read by the program "
+                           "but not fed")
+        if program._loss is not None and program._optimizer is not None:
+            return self._run_train(program, feed_ts, feed_vals, const_ts, fetch_list,
+                                   return_numpy)
+
+        key = (program.id, len(program.ops), tuple(t.name for t in feed_ts),
+               tuple(v.shape for v in feed_vals), tuple(id(t) for t in fetch_list))
+        compiled = self._cache.get(key)
+        if compiled is None:
+            fetch_ids = [id(t) for t in fetch_list]
+
+            def replay(feed_in, const_in):
+                env = {id(t): v for t, v in zip(feed_ts, feed_in)}
+                env.update({id(t): v for t, v in zip(const_ts, const_in)})
+                for fn, ins, outs, _ in program.ops:
+                    vals = [env[id(t)] if id(t) in env else t._value for t in ins]
+                    res = fn(*vals)
+                    rs = list(res) if isinstance(res, (tuple, list)) else [res]
+                    for o, r in zip(outs, rs):
+                        env[id(o)] = r
+                return [env[i] for i in fetch_ids]
+
+            compiled = jax.jit(replay)
+            self._cache[key] = compiled
+        outs = compiled(feed_vals, [t._value for t in const_ts])
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o) for o in outs]
+
+    def _run_train(self, program, feed_ts, feed_vals, const_ts, fetch_list,
+                   return_numpy):
+        """One train step: jitted loss+grads over the replay, then the
+        framework optimizer's own eager update."""
+        params = [t for t in const_ts if getattr(t, "is_parameter", False)
+                  and not t.stop_gradient]
+        param_ids = {id(t) for t in params}
+        rest = [t for t in const_ts if id(t) not in param_ids]
+        loss_t = program._loss
+        fetch_ids = [id(t) for t in fetch_list]
+
+        key = (program.id, "train", len(program.ops), tuple(t.name for t in feed_ts),
+               tuple(v.shape for v in feed_vals), tuple(fetch_ids))
+        compiled = self._cache.get(key)
+        if compiled is None:
+            def loss_and_fetch(param_in, feed_in, rest_in):
+                env = {id(t): v for t, v in zip(params, param_in)}
+                env.update({id(t): v for t, v in zip(feed_ts, feed_in)})
+                env.update({id(t): v for t, v in zip(rest, rest_in)})
+                for fn, ins, outs, _ in program.ops:
+                    vals = [env[id(t)] if id(t) in env else t._value for t in ins]
+                    res = fn(*vals)
+                    rs = list(res) if isinstance(res, (tuple, list)) else [res]
+                    for o, r in zip(outs, rs):
+                        env[id(o)] = r
+                loss = env[id(loss_t)]
+                return loss, [env[i] for i in fetch_ids]
+
+            compiled = jax.jit(jax.value_and_grad(loss_and_fetch, has_aux=True))
+            self._cache[key] = compiled
+        (loss, fetched), grads = compiled([t._value for t in params], feed_vals,
+                                          [t._value for t in rest])
+        for p, g in zip(params, grads):
+            p.grad = Tensor(g, stop_gradient=True)
+        program._optimizer.step()
+        program._optimizer.clear_grad()
+        if return_numpy:
+            return [np.asarray(o) for o in fetched]
+        return [Tensor(o) for o in fetched]
+
+
+# ------------------------------------------------------------------- scopes
+class _Scope:
+    def __init__(self):
+        self.vars = {}
+
+    def var(self, name):
+        return self.vars.setdefault(name, None)
+
+    def find_var(self, name):
+        return self.vars.get(name)
+
+
+_global_scope = _Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+class scope_guard:
+    def __init__(self, scope):
+        self.scope = scope
+
+    def __enter__(self):
+        return self.scope
+
+    def __exit__(self, *exc):
+        return False
+
+
+class name_scope:
+    def __init__(self, prefix=None):
+        self.prefix = prefix
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def cpu_places(device_count=None):
+    return ["cpu"] * (device_count or 1)
+
+
+def cuda_places(device_ids=None):
+    return []
+
+
+def xpu_places(device_ids=None):
+    return []
+
+
+class device_guard:
+    def __init__(self, device=None):
+        self.device = device
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+# ------------------------------------------------- inference model save/load
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Serialize the captured program as a jitted StableHLO artifact
+    (parity: static.save_inference_model -> __model__ + params; here the
+    jit.save path owns serialization)."""
+    from ..jit.api import save as jit_save
+    from ..jit.api import to_static
+
+    program = program or default_main_program()
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) else [fetch_vars]
+    feed_ids = [id(t) for t in feed_vars]
+    fetch_ids = [id(t) for t in fetch_vars]
+    produced = set()
+    for _, _, outs, _ in program.ops:
+        produced.update(id(o) for o in outs)
+    consts = {}
+    for _, ins, _, _ in program.ops:
+        for t in ins:
+            if id(t) not in produced and id(t) not in feed_ids and \
+                    not isinstance(t._value, jax.ShapeDtypeStruct):
+                consts[id(t)] = t._value
+
+    def fn(*feed_in):
+        env = dict(zip(feed_ids, [t._value for t in feed_in]))
+        env.update(consts)
+        for f, ins, outs, _ in program.ops:
+            vals = [env[id(t)] if id(t) in env else t._value for t in ins]
+            res = f(*vals)
+            rs = list(res) if isinstance(res, (tuple, list)) else [res]
+            for o, r in zip(outs, rs):
+                env[id(o)] = r
+        outs_ = [Tensor(env[i]) for i in fetch_ids]
+        return outs_ if len(outs_) > 1 else outs_[0]
+
+    example = [Tensor(jnp.zeros(t._value.shape, t._value.dtype)) for t in feed_vars]
+    static_fn = to_static(fn)
+    static_fn(*example)
+    jit_save(static_fn, path_prefix, input_spec=example)
+
+
+def load_inference_model(path_prefix: str, executor=None, **kwargs):
+    from ..jit.api import load as jit_load
+
+    loaded = jit_load(path_prefix)
+    return [loaded, [], []]
+
+
+# ------------------------------------------------------------- nn shims
+class _StaticNN:
+    """static.nn.* op builders (fc/conv are Layer calls under capture)."""
+
+    @staticmethod
+    def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+           activation=None, name=None):
+        from ..nn import Linear
+
+        lin = Linear(x.shape[-1], size)
+        out = lin(x)
+        if activation:
+            import paddle_tpu.nn.functional as F
+
+            out = getattr(F, activation)(out)
+        return out
+
+    @staticmethod
+    def batch_norm(input, **kwargs):  # noqa: A002
+        from ..nn import BatchNorm1D
+
+        bn = BatchNorm1D(input.shape[-1])
+        return bn(input)
+
+
+nn = _StaticNN()

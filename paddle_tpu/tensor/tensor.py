@@ -56,7 +56,9 @@ class Tensor:
     def __init__(self, value, stop_gradient: bool = True, name: Optional[str] = None, dtype=None):
         if isinstance(value, Tensor):
             value = value._value
-        if not isinstance(value, jax.Array) and not _is_tracer(value):
+        if isinstance(value, jax.ShapeDtypeStruct):
+            pass  # symbolic variable (static-graph capture): keep the abstract value
+        elif not isinstance(value, jax.Array) and not _is_tracer(value):
             jdt = dtype_mod.to_jax_dtype(dtype) if dtype is not None else None
             if jdt is None and isinstance(value, float):
                 jdt = dtype_mod.default_float_dtype().np_dtype

@@ -75,7 +75,7 @@ import importlib as _importlib
 
 for _sub in ("nn", "optimizer", "metric", "amp", "io", "jit", "vision", "distributed",
              "models", "profiler", "hapi", "regularizer", "distribution", "fft",
-             "sparse", "static"):
+             "sparse", "static", "quantization", "inference", "audio", "text"):
     try:
         globals()[_sub] = _importlib.import_module(f".{_sub}", __name__)
     except ModuleNotFoundError as _e:
@@ -112,7 +112,7 @@ def enable_static():
     _dispatch._static_capture = True
 
 
-def disable_static():
+def disable_static(place=None):
     global _dynamic_mode
     _dynamic_mode = True
     from .ops import dispatch as _dispatch

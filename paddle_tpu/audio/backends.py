@@ -1,0 +1,64 @@
+"""paddle.audio.backends parity: wav load/save via the stdlib ``wave``
+module (the reference binds soundfile; zero-dependency here)."""
+from __future__ import annotations
+
+import wave
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor
+
+__all__ = ["load", "save", "list_available_backends", "get_current_backend",
+           "set_backend"]
+
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True):
+    """-> (Tensor [C, T] (channels_first) float32 in [-1,1], sample_rate)."""
+    with wave.open(filepath, "rb") as w:
+        sr = w.getframerate()
+        n_ch = w.getnchannels()
+        sampwidth = w.getsampwidth()
+        w.setpos(frame_offset)
+        n = w.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = w.readframes(n)
+    dt = {1: np.uint8, 2: np.int16, 4: np.int32}[sampwidth]
+    data = np.frombuffer(raw, dtype=dt).reshape(-1, n_ch)
+    if sampwidth == 1:
+        data = data.astype(np.float32) / 128.0 - 1.0
+    elif normalize:
+        data = data.astype(np.float32) / float(2 ** (8 * sampwidth - 1))
+    arr = data.T if channels_first else data
+    return Tensor(jnp.asarray(arr.astype(np.float32))), sr
+
+
+def save(filepath: str, src, sample_rate: int, channels_first: bool = True,
+         encoding: str = "PCM_16", bits_per_sample: int = 16):
+    arr = np.asarray(src._value if isinstance(src, Tensor) else src)
+    if channels_first:
+        arr = arr.T  # -> [T, C]
+    if arr.ndim == 1:
+        arr = arr[:, None]
+    pcm = np.clip(arr, -1.0, 1.0)
+    pcm = (pcm * (2 ** (bits_per_sample - 1) - 1)).astype(
+        {8: np.int8, 16: np.int16, 32: np.int32}[bits_per_sample])
+    with wave.open(filepath, "wb") as w:
+        w.setnchannels(arr.shape[1])
+        w.setsampwidth(bits_per_sample // 8)
+        w.setframerate(sample_rate)
+        w.writeframes(pcm.tobytes())
+
+
+def list_available_backends():
+    return ["wave"]
+
+
+def get_current_backend():
+    return "wave"
+
+
+def set_backend(backend_name: str):
+    if backend_name != "wave":
+        raise ValueError("only the stdlib 'wave' backend is available")

@@ -1,0 +1,56 @@
+"""paddle.audio.datasets parity (TESS / ESC50 shapes). Downloads are
+impossible in a zero-egress environment: datasets read a local
+``data_dir`` the user provides; a missing dir raises with instructions."""
+from __future__ import annotations
+
+import os
+
+from ..io.dataset import Dataset
+from .backends import load
+
+__all__ = ["TESS", "ESC50"]
+
+
+class _LocalAudioFolder(Dataset):
+    label_of_file = staticmethod(lambda name: 0)
+
+    def __init__(self, data_dir, feat_type="raw", sample_rate=None, **kwargs):
+        if data_dir is None or not os.path.isdir(data_dir):
+            raise RuntimeError(
+                f"{type(self).__name__}: pass data_dir pointing at a local copy "
+                "of the dataset (no network access in this environment)")
+        self.files = sorted(
+            os.path.join(r, f)
+            for r, _, fs in os.walk(data_dir) for f in fs if f.endswith(".wav"))
+        self.feat_type = feat_type
+
+    def __len__(self):
+        return len(self.files)
+
+    def __getitem__(self, idx):
+        wav, sr = load(self.files[idx])
+        return wav, self.label_of_file(os.path.basename(self.files[idx]))
+
+
+class TESS(_LocalAudioFolder):
+    """Toronto emotional speech set (parity: audio/datasets/tess.py)."""
+
+    EMOTIONS = ["angry", "disgust", "fear", "happy", "neutral", "ps", "sad"]
+
+    @staticmethod
+    def label_of_file(name):
+        for i, e in enumerate(TESS.EMOTIONS):
+            if e in name.lower():
+                return i
+        return 0
+
+
+class ESC50(_LocalAudioFolder):
+    """ESC-50 environmental sounds (parity: audio/datasets/esc50.py)."""
+
+    @staticmethod
+    def label_of_file(name):
+        try:
+            return int(name.rsplit("-", 1)[-1].split(".")[0])
+        except ValueError:
+            return 0

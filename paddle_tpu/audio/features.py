@@ -1,0 +1,119 @@
+"""paddle.audio.features parity
+(/root/reference/python/paddle/audio/features/layers.py: Spectrogram,
+MelSpectrogram, LogMelSpectrogram, MFCC).
+
+STFT as framing (gather of a strided index grid) + windowed rfft — one
+fused XLA program per feature layer; gradients flow to the waveform.
+"""
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..nn.layer.layers import Layer
+from ..ops.dispatch import apply
+from ..tensor.tensor import Tensor
+from .functional import compute_fbank_matrix, create_dct, get_window, power_to_db
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+def _stft_mag(x, n_fft, hop_length, win, power, center, pad_mode):
+    """x: [..., T] -> [..., n_fft//2+1, frames]; |STFT|^power."""
+
+    def f(v, w):
+        if center:
+            pad = [(0, 0)] * (v.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            v = jnp.pad(v, pad, mode="reflect" if pad_mode == "reflect" else "constant")
+        T = v.shape[-1]
+        n_frames = 1 + (T - n_fft) // hop_length
+        starts = jnp.arange(n_frames) * hop_length
+        idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+        frames = v[..., idx]  # [..., frames, n_fft]
+        spec = jnp.fft.rfft(frames * w, axis=-1)  # [..., frames, bins]
+        mag = jnp.abs(spec) ** power
+        return jnp.swapaxes(mag, -1, -2)  # [..., bins, frames]
+
+    return apply(f, x, win, op_name="stft")
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft: int = 512, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True, pad_mode: str = "reflect",
+                 dtype: str = "float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        w = get_window(window, self.win_length)
+        if self.win_length < n_fft:  # center-pad window to n_fft
+            lpad = (n_fft - self.win_length) // 2
+            w = Tensor(jnp.pad(w._value, (lpad, n_fft - self.win_length - lpad)))
+        self.window = w
+
+    def forward(self, x):
+        return _stft_mag(x, self.n_fft, self.hop_length, self.window, self.power,
+                         self.center, self.pad_mode)
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann", power: float = 2.0,
+                 center: bool = True, pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None, htk: bool = False,
+                 norm: Union[str, float] = "slaney", dtype: str = "float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length, window, power,
+                                        center, pad_mode, dtype)
+        self.fbank = compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max, htk, norm)
+
+    def forward(self, x):
+        spec = self._spectrogram(x)  # [..., bins, frames]
+        return apply(lambda s, fb: jnp.einsum("mf,...ft->...mt", fb, s),
+                     spec, self.fbank, op_name="mel_fbank")
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann", power: float = 2.0,
+                 center: bool = True, pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None, htk: bool = False,
+                 norm: Union[str, float] = "slaney", ref_value: float = 1.0,
+                 amin: float = 1e-10, top_db: Optional[float] = None, dtype: str = "float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(sr, n_fft, hop_length, win_length, window,
+                                              power, center, pad_mode, n_mels, f_min,
+                                              f_max, htk, norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return power_to_db(self._melspectrogram(x), self.ref_value, self.amin, self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_fft: int = 512,
+                 hop_length: Optional[int] = None, win_length: Optional[int] = None,
+                 window: str = "hann", power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64, f_min: float = 50.0,
+                 f_max: Optional[float] = None, htk: bool = False,
+                 norm: Union[str, float] = "slaney", ref_value: float = 1.0,
+                 amin: float = 1e-10, top_db: Optional[float] = None, dtype: str = "float32"):
+        super().__init__()
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center, pad_mode,
+            n_mels, f_min, f_max, htk, norm, ref_value, amin, top_db, dtype)
+        self.dct = create_dct(n_mfcc, n_mels)
+
+    def forward(self, x):
+        logmel = self._log_melspectrogram(x)  # [..., n_mels, frames]
+        return apply(lambda m, d: jnp.einsum("mk,...mt->...kt", d, m),
+                     logmel, self.dct, op_name="mfcc_dct")

@@ -1,0 +1,160 @@
+"""paddle.audio.functional parity
+(/root/reference/python/paddle/audio/functional/functional.py: hz_to_mel /
+mel_to_hz / mel_frequencies / fft_frequencies / compute_fbank_matrix /
+power_to_db / create_dct, window.py: get_window).
+
+All filter-bank construction is host-side numpy (static constants); the
+per-frame math that touches signals runs through the tape.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Union
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..ops.dispatch import apply
+from ..tensor.tensor import Tensor
+
+__all__ = [
+    "hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+    "compute_fbank_matrix", "power_to_db", "create_dct", "get_window",
+]
+
+
+def hz_to_mel(freq, htk: bool = False):
+    scalar = not isinstance(freq, (Tensor, np.ndarray, list))
+    f = np.asarray(freq._value if isinstance(freq, Tensor) else freq, np.float64)
+    if htk:
+        mel = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mel = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mel = np.where(f >= min_log_hz, min_log_mel + np.log(np.maximum(f, 1e-10) / min_log_hz) / logstep, mel)
+    if isinstance(freq, Tensor):
+        return Tensor(jnp.asarray(mel, jnp.float32))
+    return float(mel) if scalar else mel
+
+
+def mel_to_hz(mel, htk: bool = False):
+    scalar = not isinstance(mel, (Tensor, np.ndarray, list))
+    m = np.asarray(mel._value if isinstance(mel, Tensor) else mel, np.float64)
+    if htk:
+        hz = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        hz = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        hz = np.where(m >= min_log_mel, min_log_hz * np.exp(logstep * (m - min_log_mel)), hz)
+    if isinstance(mel, Tensor):
+        return Tensor(jnp.asarray(hz, jnp.float32))
+    return float(hz) if scalar else hz
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0, f_max: float = 11025.0,
+                    htk: bool = False, dtype="float32"):
+    lo, hi = hz_to_mel(f_min, htk), hz_to_mel(f_max, htk)
+    mels = np.linspace(lo, hi, n_mels)
+    return Tensor(jnp.asarray(mel_to_hz(mels, htk), jnp.float32))
+
+
+def fft_frequencies(sr: int, n_fft: int, dtype="float32"):
+    return Tensor(jnp.linspace(0, float(sr) / 2, n_fft // 2 + 1).astype(jnp.float32))
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64, f_min: float = 0.0,
+                         f_max: Optional[float] = None, htk: bool = False,
+                         norm: Union[str, float] = "slaney", dtype="float32"):
+    """[n_mels, n_fft//2+1] triangular mel filter bank (librosa algorithm)."""
+    if f_max is None:
+        f_max = float(sr) / 2
+    fftfreqs = np.linspace(0, float(sr) / 2, n_fft // 2 + 1)
+    mel_f = np.asarray(mel_to_hz(np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk),
+                                             n_mels + 2), htk))
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = np.maximum(0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2: n_mels + 2] - mel_f[:n_mels])
+        weights *= enorm[:, None]
+    elif isinstance(norm, (int, float)):
+        weights = weights / np.maximum(np.linalg.norm(weights, ord=norm, axis=-1, keepdims=True), 1e-10)
+    return Tensor(jnp.asarray(weights, jnp.float32))
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: Optional[float] = 80.0):
+    spect = spect if isinstance(spect, Tensor) else Tensor(jnp.asarray(spect))
+
+    def f(s):
+        log_spec = 10.0 * jnp.log10(jnp.maximum(amin, s))
+        log_spec = log_spec - 10.0 * jnp.log10(jnp.maximum(amin, ref_value))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+        return log_spec
+
+    return apply(f, spect, op_name="power_to_db")
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho", dtype="float32"):
+    """[n_mels, n_mfcc] DCT-II matrix."""
+    n = np.arange(n_mels)
+    k = np.arange(n_mfcc)[None, :]
+    dct = np.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / math.sqrt(2)
+        dct *= math.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return Tensor(jnp.asarray(dct, jnp.float32))
+
+
+_WINDOWS = {
+    "hann": lambda M: 0.5 - 0.5 * np.cos(2 * math.pi * np.arange(M) / M),
+    "hamming": lambda M: 0.54 - 0.46 * np.cos(2 * math.pi * np.arange(M) / M),
+    "blackman": lambda M: (0.42 - 0.5 * np.cos(2 * math.pi * np.arange(M) / M)
+                           + 0.08 * np.cos(4 * math.pi * np.arange(M) / M)),
+    "bartlett": lambda M: 1 - np.abs(2 * np.arange(M) / M - 1),
+    "bohman": lambda M: _bohman(M),
+    "rectangular": lambda M: np.ones(M),
+    "boxcar": lambda M: np.ones(M),
+}
+
+
+def _bohman(M):
+    x = np.abs(2 * np.arange(M) / M - 1)
+    return (1 - x) * np.cos(math.pi * x) + np.sin(math.pi * x) / math.pi
+
+
+def get_window(window: Union[str, tuple], win_length: int, fftbins: bool = True,
+               dtype="float32"):
+    if isinstance(window, tuple):
+        name, *args = window
+        if name == "gaussian":
+            std = args[0]
+            n = np.arange(win_length) - (win_length - 1) / 2
+            w = np.exp(-0.5 * (n / std) ** 2)
+        elif name == "exponential":
+            center, tau = (args + [None, 1.0])[:2] if args else (None, 1.0)
+            center = (win_length - 1) / 2 if center is None else center
+            w = np.exp(-np.abs(np.arange(win_length) - center) / tau)
+        elif name == "kaiser":
+            w = np.kaiser(win_length, args[0])
+        else:
+            raise ValueError(f"unknown window {name}")
+    else:
+        fn = _WINDOWS.get(window)
+        if fn is None:
+            raise ValueError(f"unknown window {window!r}; supported: {sorted(_WINDOWS)}")
+        M = win_length if fftbins else win_length - 1
+        w = fn(M) if fftbins else np.append(fn(M), fn(M)[0] if M else 1.0)[:win_length]
+    return Tensor(jnp.asarray(w, jnp.float32))

@@ -1,0 +1,19 @@
+"""auto_parallel package: semi-auto dtensor API (api.py) + the static
+Engine (engine.py) + Strategy."""
+from . import api  # noqa: F401
+from .api import (  # noqa: F401
+    ShardingStage1,
+    ShardingStage2,
+    ShardingStage3,
+    dtensor_from_fn,
+    dtensor_from_local,
+    get_placements,
+    is_dist_tensor,
+    reshard,
+    shard_layer,
+    shard_optimizer,
+    shard_tensor,
+    sharding_specs_to_placements,
+    unshard_dtensor,
+)
+from .engine import Engine, Strategy  # noqa: F401

@@ -1,0 +1,180 @@
+"""Static auto-parallel Engine (parity:
+/root/reference/python/paddle/distributed/auto_parallel/static/engine.py:72
+Engine.fit/evaluate/predict/prepare/save/load — the high-level API the
+reference drives through Planner/Partitioner/passes).
+
+TPU-native collapse: the planner/partitioner stack IS GSPMD. The Engine
+applies the strategy's parallelism as sharding annotations (tensor-parallel
+layers + dp batch sharding over the hybrid mesh), compiles the whole train
+step with jit.TrainStep, and loops over the DataLoader. XLA's SPMD
+partitioner performs what Planner+Partitioner+passes do in the reference.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ...tensor.tensor import Tensor
+
+__all__ = ["Engine", "Strategy"]
+
+
+class Strategy:
+    """parity: auto_parallel Strategy — the knobs the Engine honors."""
+
+    def __init__(self):
+        self.auto_mode = "semi"
+        self.dp_degree = 1
+        self.mp_degree = 1
+        self.pp_degree = 1
+        self.sharding_degree = 1
+        self.sharding_stage = 1
+        self.amp = _Toggle()
+        self.recompute = _Toggle()
+        self.gradient_merge = _Toggle(k_steps=1)
+
+
+class _Toggle:
+    def __init__(self, **extra):
+        self.enable = False
+        for k, v in extra.items():
+            setattr(self, k, v)
+
+
+class Engine:
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 cluster=None, strategy: Optional[Strategy] = None):
+        self._model = model
+        self._loss = loss
+        self._optimizer = optimizer
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) else \
+            ([metrics] if metrics is not None else [])
+        self._strategy = strategy or Strategy()
+        self._prepared = False
+        self._train_step = None
+        self.history: List[float] = []
+
+    # ------------------------------------------------------------- prepare
+    def prepare(self, inputs_spec=None, labels_spec=None, mode: str = "train"):
+        """Apply the strategy: init the hybrid mesh via fleet, annotate the
+        model's parallel layers, build the compiled TrainStep."""
+        from .. import fleet
+
+        s = self._strategy
+        world = s.dp_degree * s.mp_degree * s.pp_degree * s.sharding_degree
+        import jax
+
+        if world > len(jax.devices()):
+            raise ValueError(f"strategy needs {world} devices, "
+                             f"{len(jax.devices())} visible")
+        fs = fleet.DistributedStrategy()
+        fs.hybrid_configs = {
+            "dp_degree": s.dp_degree,
+            "mp_degree": s.mp_degree,
+            "pp_degree": s.pp_degree,
+            "sharding_degree": s.sharding_degree,
+        }
+        if s.sharding_degree > 1:
+            fs.sharding_configs = {"stage": s.sharding_stage}
+        fleet.init(is_collective=True, strategy=fs)
+        if self._model is not None:
+            self._model = fleet.distributed_model(self._model)
+        if self._optimizer is not None and mode == "train":
+            from ...jit.api import TrainStep
+
+            model = self._model
+            loss_fn = self._loss
+
+            def step_loss(m, *batch):
+                x, y = batch[0], batch[1] if len(batch) > 1 else None
+                out = m(x)
+                if callable(loss_fn):
+                    return loss_fn(out, y) if y is not None else loss_fn(out)
+                return out
+
+            self._train_step = TrainStep(model, step_loss, self._optimizer)
+        self._prepared = True
+        return self
+
+    # ----------------------------------------------------------------- fit
+    def fit(self, train_data, train_sample_split=None, batch_size=1, epochs=1,
+            steps_per_epoch=None, log_freq=10, save_dir=None, verbose=1,
+            collate_fn=None, num_workers=0):
+        if not self._prepared:
+            self.prepare()
+        loader = self._as_loader(train_data, batch_size, collate_fn, num_workers)
+        for epoch in range(epochs):
+            for step, batch in enumerate(loader):
+                if steps_per_epoch is not None and step >= steps_per_epoch:
+                    break
+                xs = batch if isinstance(batch, (list, tuple)) else [batch]
+                loss = self._train_step(*xs)
+                lv = float(np.asarray(loss._value if isinstance(loss, Tensor) else loss))
+                self.history.append(lv)
+        return {"loss": self.history}
+
+    def evaluate(self, valid_data, valid_sample_split=None, batch_size=1,
+                 steps=None, log_freq=10, collate_fn=None, num_workers=0):
+        if not self._prepared:
+            self.prepare(mode="eval")
+        loader = self._as_loader(valid_data, batch_size, collate_fn, num_workers)
+        total, n = 0.0, 0
+        for m in self._metrics:
+            m.reset()
+        for step, batch in enumerate(loader):
+            if steps is not None and step >= steps:
+                break
+            xs = batch if isinstance(batch, (list, tuple)) else [batch]
+            out = self._model(xs[0])
+            if self._loss is not None and len(xs) > 1:
+                total += float(np.asarray(self._loss(out, xs[1])._value))
+                n += 1
+            for m in self._metrics:
+                m.update(np.asarray(m.compute(out, xs[1])._value)
+                         if hasattr(m, "compute") else out)
+        res = {"loss": total / max(n, 1)}
+        for m in self._metrics:
+            res[m.name() if callable(getattr(m, "name", None)) else "metric"] = m.accumulate()
+        return res
+
+    def predict(self, test_data, test_sample_split=None, batch_size=1, steps=None,
+                collate_fn=None, num_workers=0):
+        if not self._prepared:
+            self.prepare(mode="predict")
+        loader = self._as_loader(test_data, batch_size, collate_fn, num_workers)
+        outs = []
+        for step, batch in enumerate(loader):
+            if steps is not None and step >= steps:
+                break
+            xs = batch if isinstance(batch, (list, tuple)) else [batch]
+            outs.append(self._model(xs[0]))
+        return outs
+
+    # ---------------------------------------------------------- save/load
+    def save(self, path: str, training: bool = True):
+        from ... import framework_io
+
+        state = {"model": self._model.state_dict()}
+        if training and self._optimizer is not None:
+            state["optimizer"] = self._optimizer.state_dict()
+        framework_io.save(state, path + ".pdparams")
+
+    def load(self, path: str, strict: bool = True, load_optimizer: bool = True):
+        from ... import framework_io
+
+        state = framework_io.load(path + ".pdparams")
+        self._model.set_state_dict(state["model"])
+        if load_optimizer and self._optimizer is not None and "optimizer" in state:
+            self._optimizer.set_state_dict(state["optimizer"])
+
+    # ------------------------------------------------------------- helpers
+    def _as_loader(self, data, batch_size, collate_fn, num_workers):
+        from ...io.reader import DataLoader
+
+        if isinstance(data, DataLoader):
+            return data
+        if hasattr(data, "__getitem__") and hasattr(data, "__len__"):
+            return DataLoader(data, batch_size=batch_size, collate_fn=collate_fn,
+                              num_workers=num_workers)
+        return data  # assume iterable of batches

@@ -1,0 +1,207 @@
+"""Distributed auto-tuner (parity:
+/root/reference/python/paddle/distributed/auto_tuner/tuner.py:21 AutoTuner,
+search.py GridSearch, prune.py rules, memory_cost_model.py, recorder.py).
+
+TPU-native: candidates are factorizations of the chip count into
+dp/mp/pp/sharding degrees + micro-batch sizes; pruning uses an HBM memory
+model (params/grads/optimizer-state/activations per chip under the
+strategy); measurement runs the user's step function under each strategy
+and records throughput. On a virtual CPU mesh this measures *compilability*
+and relative overhead; on real chips, true tokens/s.
+"""
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["AutoTuner", "GridSearch", "Recorder", "default_candidates",
+           "MemoryCostModel", "prune_by_memory", "prune_by_mp"]
+
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def default_candidates(cfg: Dict) -> Dict[str, List[int]]:
+    """Degree candidates from the tuner config (parity: utils.py
+    default_candidates). Each axis: 'auto' -> all divisors of num_gpus,
+    an int -> fixed, a list -> as given."""
+    n = int(cfg.get("num_gpus", cfg.get("num_chips", 8)))
+
+    def axis(name, default="auto"):
+        v = cfg.get(name, default)
+        if v == "auto":
+            return _divisors(n)
+        if isinstance(v, int):
+            return [v]
+        return list(v)
+
+    gb = int(cfg.get("global_batch_size", 8))
+    mbs = cfg.get("micro_batch_size", "auto")
+    return {
+        "dp_degree": axis("dp_degree"),
+        "mp_degree": axis("mp_degree"),
+        "pp_degree": axis("pp_degree"),
+        "sharding_degree": axis("sharding_degree"),
+        "sharding_stage": cfg.get("sharding_stage", [1]) if isinstance(cfg.get("sharding_stage", [1]), list) else [cfg.get("sharding_stage")],
+        "micro_batch_size": _divisors(gb) if mbs == "auto" else ([mbs] if isinstance(mbs, int) else list(mbs)),
+        "use_recompute": cfg.get("use_recompute", [False]) if isinstance(cfg.get("use_recompute", [False]), list) else [cfg.get("use_recompute")],
+    }
+
+
+class MemoryCostModel:
+    """Per-chip HBM estimate in bytes (parity: memory_cost_model.py).
+
+    params: bf16 weights + fp32 master + fp32 m/v moments (AdamW), sharded by
+    (mp * pp * sharding-by-stage); activations: per-microbatch transformer
+    activation estimate, cut by recompute and mp/sep.
+    """
+
+    def __init__(self, n_params: float, hidden: int = 4096, layers: int = 32,
+                 seq_len: int = 2048, bytes_per_param: int = 2):
+        self.n_params = n_params
+        self.hidden = hidden
+        self.layers = layers
+        self.seq_len = seq_len
+        self.bytes_per_param = bytes_per_param
+
+    def estimate(self, cfg: Dict) -> float:
+        mp = cfg.get("mp_degree", 1)
+        pp = cfg.get("pp_degree", 1)
+        sh = cfg.get("sharding_degree", 1)
+        stage = cfg.get("sharding_stage", 1)
+        mbs = cfg.get("micro_batch_size", 1)
+        recompute = cfg.get("use_recompute", False)
+
+        shard_model = mp * pp
+        params_b = self.n_params * self.bytes_per_param / shard_model
+        grads_b = self.n_params * self.bytes_per_param / shard_model
+        # fp32 master + two moments
+        opt_b = self.n_params * 12.0 / shard_model
+        if stage >= 1:
+            opt_b /= sh
+        if stage >= 2:
+            grads_b /= sh
+        if stage >= 3:
+            params_b /= sh
+        # activation bytes/layer/token ~ 34*h (Megatron estimate), bf16
+        act_per_layer = 34.0 * self.hidden * self.seq_len * mbs * self.bytes_per_param / mp
+        layers_here = self.layers / pp
+        act_b = act_per_layer * (1.0 if recompute else layers_here)
+        return params_b + grads_b + opt_b + act_b
+
+
+def prune_by_memory(cfg: Dict, model: MemoryCostModel, hbm_bytes: float) -> bool:
+    """True -> prune (estimated to OOM)."""
+    return model.estimate(cfg) > hbm_bytes
+
+
+def prune_by_mp(cfg: Dict, num_attention_heads: Optional[int] = None,
+                vocab_size: Optional[int] = None) -> bool:
+    mp = cfg.get("mp_degree", 1)
+    if num_attention_heads and num_attention_heads % mp != 0:
+        return True
+    if vocab_size and vocab_size % mp != 0:
+        return True
+    return False
+
+
+class GridSearch:
+    """Exhaustive product of candidates, filtered to valid chip counts
+    (parity: search.py GridSearch)."""
+
+    def __init__(self, tuner_cfg: Dict):
+        self.cfg = tuner_cfg
+        cands = tuner_cfg["candidates"]
+        n = int(tuner_cfg.get("num_gpus", tuner_cfg.get("num_chips", 8)))
+        keys = list(cands)
+        self.all: List[Dict] = []
+        for combo in itertools.product(*(cands[k] for k in keys)):
+            c = dict(zip(keys, combo))
+            if c["dp_degree"] * c["mp_degree"] * c["pp_degree"] * c["sharding_degree"] != n:
+                continue
+            self.all.append(c)
+        self._i = 0
+
+    def search_once(self, history_cfgs: List[Dict]) -> Optional[Dict]:
+        while self._i < len(self.all):
+            c = self.all[self._i]
+            self._i += 1
+            return c
+        return None
+
+
+class Recorder:
+    """(cfg, metric) history + best lookup (parity: recorder.py)."""
+
+    def __init__(self, metric_name: str = "throughput", higher_is_better: bool = True):
+        self.metric = metric_name
+        self.higher = higher_is_better
+        self.history: List[Dict] = []
+
+    def add(self, cfg: Dict, metric: Optional[float], error: Optional[str] = None):
+        self.history.append({"cfg": cfg, self.metric: metric, "error": error})
+
+    def best(self) -> Optional[Dict]:
+        ok = [h for h in self.history if h[self.metric] is not None]
+        if not ok:
+            return None
+        return (max if self.higher else min)(ok, key=lambda h: h[self.metric])
+
+    def sort(self):
+        return sorted([h for h in self.history if h[self.metric] is not None],
+                      key=lambda h: h[self.metric], reverse=self.higher)
+
+
+class AutoTuner:
+    """parity: tuner.py:20 — iterate candidates, prune, measure, record."""
+
+    def __init__(self, tuner_cfg: Dict):
+        self.cfg = dict(tuner_cfg)
+        self.cfg.setdefault("candidates", default_candidates(self.cfg))
+        self.task_limit = int(self.cfg.get("task_limit", 100))
+        self.cur_task_id = 1
+        algo = self.cfg.get("search_algo", {"name": "grid"})
+        if (algo.get("name") if isinstance(algo, dict) else algo) != "grid":
+            raise NotImplementedError("search_algo: only grid is implemented")
+        self.algo = GridSearch(self.cfg)
+        self.recorder = Recorder(self.cfg.get("metric", "throughput"),
+                                 self.cfg.get("higher_is_better", True))
+        self.history_cfgs: List[Dict] = []
+        self._mem_model = self.cfg.get("memory_model")
+        self._hbm = float(self.cfg.get("hbm_bytes", 16e9))
+        self._heads = self.cfg.get("num_attention_heads")
+        self._vocab = self.cfg.get("vocab_size")
+
+    def search_once(self) -> Optional[Dict]:
+        while self.cur_task_id <= self.task_limit:
+            cfg = self.algo.search_once(self.history_cfgs)
+            if cfg is None:
+                return None
+            self.cur_task_id += 1
+            self.history_cfgs.append(cfg)
+            if prune_by_mp(cfg, self._heads, self._vocab):
+                continue
+            if self._mem_model is not None and prune_by_memory(cfg, self._mem_model, self._hbm):
+                self.recorder.add(cfg, None, error="pruned: memory model predicts OOM")
+                continue
+            return cfg
+        return None
+
+    def tune(self, run_fn: Callable[[Dict], float]) -> Optional[Dict]:
+        """Measure every surviving candidate with ``run_fn(cfg) -> metric``
+        (run_fn raises on failure); return the best history entry."""
+        while True:
+            cfg = self.search_once()
+            if cfg is None:
+                break
+            try:
+                t0 = time.time()
+                metric = run_fn(cfg)
+                if metric is None:
+                    metric = 1.0 / max(time.time() - t0, 1e-9)
+                self.recorder.add(cfg, float(metric))
+            except Exception as e:
+                self.recorder.add(cfg, None, error=str(e))
+        return self.recorder.best()

@@ -1,0 +1,227 @@
+"""Inference engine (parity:
+/root/reference/paddle/fluid/inference/api/analysis_predictor.h:105
+AnalysisPredictor + paddle_inference_api.h Config/create_predictor surface).
+
+TPU-native: the "analysis + IR passes + engine selection" stack collapses to
+XLA — a Predictor AOT-compiles the forward with ``jax.jit`` (or executes a
+``.jaxexport`` artifact saved by ``jit.save``), caches one executable per
+input-shape bucket, and optionally rewrites Linear layers to weight-only
+int8 (int8 HBM storage, bf16 MXU compute) before compilation.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor
+
+__all__ = ["Config", "create_predictor", "Predictor", "PredictorPool"]
+
+
+class Config:
+    """parity: paddle.inference.Config."""
+
+    def __init__(self, model_path: Optional[str] = None, params_path: Optional[str] = None):
+        # model_path is the jit.save path prefix (params_path kept for API parity)
+        self.model_path = model_path
+        self.params_path = params_path
+        self._weight_only = None
+        self._memory_optim = True
+        self._ir_optim = True
+        self._layer = None
+        self._batch_pad = False
+
+    # --- capability toggles (XLA owns these; kept for API parity) ---
+    def enable_memory_optim(self, flag=True):
+        self._memory_optim = flag
+
+    def switch_ir_optim(self, flag=True):
+        self._ir_optim = flag
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+    def disable_glog_info(self):
+        pass
+
+    def enable_use_gpu(self, *a, **k):
+        pass  # device residency is PJRT's concern
+
+    def enable_xpu(self, *a, **k):
+        pass
+
+    # --- real knobs ---
+    def enable_weight_only_quant(self, dtype="int8"):
+        if dtype != "int8":
+            raise NotImplementedError("weight-only quant supports int8")
+        self._weight_only = dtype
+
+    def enable_batch_padding(self, flag=True):
+        """Pad smaller batches up to the compiled batch instead of recompiling."""
+        self._batch_pad = flag
+
+    def set_layer(self, layer):
+        """Serve a live Layer (instead of a saved artifact)."""
+        self._layer = layer
+
+
+class _Handle:
+    """Input/output tensor handle (ZeroCopyTensor analog)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._val = None
+
+    def copy_from_cpu(self, arr):
+        self._val = jnp.asarray(arr)
+
+    def reshape(self, shape):
+        pass  # shape comes from the array itself
+
+    def copy_to_cpu(self):
+        return np.asarray(self._val)
+
+    def share_external_data(self, arr):
+        self.copy_from_cpu(arr)
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        self.config = config
+        self._cache: Dict[tuple, object] = {}
+        self._loaded = None
+        self._layer = config._layer
+        if config.model_path and self._layer is None:
+            from ..jit.serialization import load as jit_load
+
+            self._loaded = jit_load(config.model_path)
+        if self._layer is not None and config._weight_only == "int8":
+            self._layer = _rewrite_weight_only_int8(self._layer)
+        self._inputs: Dict[str, _Handle] = {}
+        self._outputs: List[np.ndarray] = []
+        self._input_names: List[str] = []
+        if self._loaded is not None and self._loaded.meta.get("input_spec"):
+            self._input_names = [f"x{i}" for i in range(len(self._loaded.meta["input_spec"]))]
+
+    # ----------------------------------------------------------- handles API
+    def get_input_names(self):
+        return self._input_names or sorted(self._inputs)
+
+    def get_input_handle(self, name):
+        h = self._inputs.get(name)
+        if h is None:
+            h = self._inputs[name] = _Handle(name)
+            if name not in self._input_names:
+                self._input_names.append(name)
+        return h
+
+    def get_output_names(self):
+        return [f"out{i}" for i in range(len(self._outputs))]
+
+    def get_output_handle(self, name):
+        i = int(name.replace("out", ""))
+        h = _Handle(name)
+        h._val = self._outputs[i]
+        return h
+
+    # ----------------------------------------------------------------- run
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        if inputs is None:
+            inputs = [self._inputs[n]._val for n in self._input_names]
+        vals = [jnp.asarray(v) for v in inputs]
+
+        if self._loaded is not None:
+            spec = self._loaded.meta.get("input_spec") or []
+            if self.config._batch_pad and spec:
+                vals, real_n = _pad_batch(vals, spec)
+                outs = self._loaded(*[Tensor(v) for v in vals])
+                outs = outs if isinstance(outs, list) else [outs]
+                self._outputs = [np.asarray(o._value)[:real_n] for o in outs]
+            else:
+                outs = self._loaded(*[Tensor(v) for v in vals])
+                outs = outs if isinstance(outs, list) else [outs]
+                self._outputs = [np.asarray(o._value) for o in outs]
+            return self._outputs
+
+        key = tuple((v.shape, str(v.dtype)) for v in vals)
+        compiled = self._cache.get(key)
+        if compiled is None:
+            layer = self._layer
+            layer.eval()
+            from ..autograd import tape
+            from ..jit.api import flatten_tensors
+
+            def fwd(*xs):
+                with tape.no_grad():
+                    out = layer(*[Tensor(x) for x in xs])
+                outs, _ = flatten_tensors(out)
+                return tuple(t._value for t in outs)
+
+            compiled = jax.jit(fwd)
+            self._cache[key] = compiled
+        outs = compiled(*vals)
+        self._outputs = [np.asarray(o) for o in outs]
+        return self._outputs
+
+
+def _pad_batch(vals, spec):
+    """Pad dim-0 of each input up to the exported batch; return real size."""
+    real_n = int(vals[0].shape[0])
+    out = []
+    for v, sm in zip(vals, spec):
+        want = sm["shape"][0] or 1
+        if v.shape[0] < want:
+            pad = [(0, want - v.shape[0])] + [(0, 0)] * (v.ndim - 1)
+            v = jnp.pad(v, pad)
+        elif v.shape[0] > want:
+            raise ValueError(f"batch {v.shape[0]} exceeds compiled batch {want}")
+        out.append(v)
+    return out, real_n
+
+
+def _rewrite_weight_only_int8(layer):
+    """Swap Linear sublayers for int8-storage equivalents."""
+    import copy as _copy
+
+    from ..nn import Linear
+    from ..nn.layer.layers import Layer as _Layer
+    from ..quantization import weight_only_linear, weight_quantize
+
+    layer = _copy.deepcopy(layer)
+
+    class Int8Linear(_Layer):
+        def __init__(self, lin):
+            super().__init__()
+            self.qweight, self.scale = weight_quantize(lin.weight)
+            self.bias = lin.bias
+
+        def forward(self, x):
+            return weight_only_linear(x, self.qweight, self.bias, self.scale)
+
+    def rewrite(parent):
+        for name, sub in list(parent._sub_layers.items()):
+            if isinstance(sub, Linear):
+                parent._sub_layers[name] = Int8Linear(sub)
+            else:
+                rewrite(sub)
+
+    rewrite(layer)
+    return layer
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
+
+
+class PredictorPool:
+    """parity: paddle_infer.PredictorPool — N predictors over one config."""
+
+    def __init__(self, config: Config, size: int = 1):
+        self._preds = [Predictor(config) for _ in range(size)]
+
+    def retrieve(self, idx: int) -> Predictor:
+        return self._preds[idx]

@@ -1,15 +1,22 @@
-"""DataLoader (parity: python/paddle/io/reader.py:266).
+"""DataLoader (parity: python/paddle/io/reader.py:266 and the process+shm
+worker pipeline of python/paddle/io/dataloader/dataloader_iter.py:370).
 
 Pipeline: index batches from the BatchSampler → worker pool fetches+collates
 numpy batches → bounded prefetch queue → main thread converts to device
-Tensors. Thread workers by default (numpy stacking releases the GIL); the
-reference's process+shm pipeline is the num_workers>0 analog and the planned
-native IO queue slots in behind the same interface.
+Tensors with one batch of device-transfer lookahead (PJRT transfers are
+async, so the next batch is in flight while the current one trains).
+``num_workers>0`` forks real worker processes (the reference's
+_worker_loop analog; batches ride a multiprocessing queue). ``num_workers=0``
+uses GIL-releasing prefetch threads. Unpicklable datasets fall back to
+threads with a warning.
 """
 from __future__ import annotations
 
+import multiprocessing as mp
+import pickle
 import queue
 import threading
+import warnings
 from typing import Callable, Optional
 
 import numpy as np
@@ -115,6 +122,107 @@ class _PrefetchIter:
         return _to_tensor(item)
 
 
+def _worker_loop(dataset, collate, idx_q, out_q, init_fn, wid):
+    """Runs in a forked worker process (parity: dataloader_iter._worker_loop)."""
+    if init_fn is not None:
+        init_fn(wid)
+    while True:
+        item = idx_q.get()
+        if item is None:
+            return
+        seq, indices = item
+        try:
+            out_q.put((seq, collate([dataset[i] for i in indices])))
+        except Exception as e:  # must cross the pickle boundary
+            import traceback
+
+            out_q.put((seq, RuntimeError(
+                f"DataLoader worker {wid} failed: {e}\n{traceback.format_exc()}")))
+
+
+class _ProcessIter:
+    """Process-worker pipeline: N forked workers pull tagged index batches
+    and push collated numpy batches; the parent restores order and overlaps
+    the host->device transfer one batch ahead."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        collate = loader.collate_fn or default_collate_fn
+        batches = list(loader.batch_sampler)
+        self._total = len(batches)
+        self._emitted = 0
+        self._next_out = 0
+        self._out_buf = {}
+        self._lookahead = None
+        method = "fork" if "fork" in mp.get_all_start_methods() else "spawn"
+        ctx = mp.get_context(method)
+        self._idx_q = ctx.Queue()
+        self._out_q = ctx.Queue(maxsize=max(2, loader.prefetch_factor) * max(1, loader.num_workers))
+        for i, b in enumerate(batches):
+            self._idx_q.put((i, list(b)))
+        self.workers = []
+        for wid in range(loader.num_workers):
+            self._idx_q.put(None)
+            p = ctx.Process(target=_worker_loop,
+                            args=(loader.dataset, collate, self._idx_q, self._out_q,
+                                  loader.worker_init_fn, wid), daemon=True)
+            p.start()
+            self.workers.append(p)
+
+    def _fetch(self):
+        import time as _time
+
+        deadline = (_time.time() + self.loader.timeout) if self.loader.timeout else None
+        while self._next_out not in self._out_buf:
+            try:
+                seq, item = self._out_q.get(timeout=1.0)
+            except queue.Empty:
+                # a dead worker (fork deadlock, OOM-kill) must surface as an
+                # error, not a permanent hang
+                if any(not p.is_alive() and p.exitcode not in (0, None)
+                       for p in self.workers):
+                    self._shutdown()
+                    raise RuntimeError(
+                        "DataLoader worker process died unexpectedly "
+                        "(killed or crashed before reporting an error)")
+                if deadline is not None and _time.time() > deadline:
+                    self._shutdown()
+                    raise RuntimeError(
+                        f"DataLoader timed out after {self.loader.timeout}s "
+                        "waiting for a worker batch")
+                continue
+            self._out_buf[seq] = item
+        item = self._out_buf.pop(self._next_out)
+        self._next_out += 1
+        if isinstance(item, Exception):
+            self._shutdown()
+            raise item
+        return _to_tensor(item)  # starts the async device transfer
+
+    def _shutdown(self):
+        for p in self.workers:
+            if p.is_alive():
+                p.terminate()
+        self.workers = []
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._emitted >= self._total:
+            self._shutdown()
+            raise StopIteration
+        if self._lookahead is None:
+            self._lookahead = self._fetch()
+        current = self._lookahead
+        self._lookahead = self._fetch() if self._next_out < self._total else None
+        self._emitted += 1
+        return current
+
+    def __del__(self):
+        self._shutdown()
+
+
 class _IterableIter:
     def __init__(self, loader):
         self.loader = loader
@@ -152,6 +260,8 @@ class DataLoader:
         self.prefetch_factor = prefetch_factor
         self.batch_size = batch_size
         self.drop_last = drop_last
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
         self._iterable = isinstance(dataset, IterableDataset)
         if not self._iterable:
             if batch_sampler is not None:
@@ -166,6 +276,21 @@ class DataLoader:
     def __iter__(self):
         if self._iterable:
             return _IterableIter(self)
+        if self.num_workers > 0:
+            # fork inherits the dataset without pickling; only a spawn-default
+            # platform needs the picklability probe (and there it's cheap to
+            # probe the class, not the data)
+            if "fork" in mp.get_all_start_methods():
+                return _ProcessIter(self)
+            try:
+                pickle.dumps(self.dataset)
+                if self.collate_fn is not None:
+                    pickle.dumps(self.collate_fn)
+                return _ProcessIter(self)
+            except Exception as e:
+                warnings.warn(
+                    f"DataLoader: dataset/collate_fn not picklable ({e}); "
+                    "falling back to thread workers")
         return _PrefetchIter(self)
 
     def __len__(self):

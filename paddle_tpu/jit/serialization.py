@@ -81,6 +81,13 @@ def save(layer, path: str, input_spec=None, **configs):
             lowered = jax.jit(fwd).lower(param_vals, *abstract)
             with open(path + ".stablehlo", "w") as f:
                 f.write(lowered.as_text())
+            # runnable artifact: params baked, deserializable by jit.load /
+            # the inference Predictor without the model class
+            from jax import export as jexport
+
+            exported = jexport.export(jax.jit(lambda *xs: fwd(param_vals, *xs)))(*abstract)
+            with open(path + ".jaxexport", "wb") as f:
+                f.write(exported.serialize())
             if was_training:
                 layer.train()
         except Exception as e:  # export is best-effort; params always saved
@@ -91,13 +98,22 @@ def save(layer, path: str, input_spec=None, **configs):
 
 
 class LoadedLayer:
-    """Inference callable restored by jit.load."""
+    """Inference callable restored by jit.load. When the save produced a
+    ``.jaxexport`` artifact (input_spec given), calling runs the compiled
+    forward directly — the load-and-run path (parity: AnalysisPredictor's
+    load of __model__, analysis_predictor.h:105)."""
 
     def __init__(self, path: str):
         self._path = path
         with open(path + ".pdmodel.json") as f:
             self.meta = json.load(f)
         self._arrays = dict(np.load(path + ".pdiparams.npz"))
+        self._exported = None
+        if os.path.exists(path + ".jaxexport"):
+            from jax import export as jexport
+
+            with open(path + ".jaxexport", "rb") as f:
+                self._exported = jexport.deserialize(bytearray(f.read()))
 
     def state_dict(self):
         from ..tensor.tensor import Tensor
@@ -109,10 +125,17 @@ class LoadedLayer:
         return layer
 
     def __call__(self, *args, **kwargs):
-        raise RuntimeError(
-            "LoadedLayer holds parameters + StableHLO only. Rebuild the model class and call "
-            "loaded.set_onto(model), or feed the .stablehlo artifact to a serving runtime."
-        )
+        if self._exported is None:
+            raise RuntimeError(
+                "This artifact was saved without input_spec, so no compiled forward "
+                "was exported. Rebuild the model class and call loaded.set_onto(model)."
+            )
+        from ..tensor.tensor import Tensor
+
+        vals = [a._value if isinstance(a, Tensor) else a for a in args]
+        out = self._exported.call(*vals)
+        outs = [Tensor(o) for o in (out if isinstance(out, (tuple, list)) else [out])]
+        return outs if len(outs) > 1 else outs[0]
 
 
 def load(path: str, **configs) -> LoadedLayer:

@@ -6,7 +6,7 @@ import jax.numpy as jnp
 from ..tensor.tensor import Tensor
 from .optimizer import Optimizer
 
-__all__ = ["SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad", "Adadelta", "RMSProp", "Lamb", "Lars"]
+__all__ = ["SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad", "Adadelta", "RMSProp", "Lamb", "Lars", "LBFGS"]
 
 
 class SGD(Optimizer):
@@ -275,3 +275,126 @@ class Lars(Momentum):
         v = self._momentum * v + eff_lr * grad
         self._set_acc("velocity", p, v)
         self._write_back(p, w - v.astype(w.dtype))
+
+
+class LBFGS(Optimizer):
+    """Limited-memory BFGS with strong-Wolfe line search (parity:
+    python/paddle/optimizer/lbfgs.py). Closure-based: ``step(closure)``
+    re-evaluates the loss during the line search; history lives as flat
+    vectors (the standard two-loop recursion)."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, False, name)
+        self.max_iter = max_iter
+        self.max_eval = max_eval if max_eval is not None else max_iter * 5 // 4
+        self.tolerance_grad = tolerance_grad
+        self.tolerance_change = tolerance_change
+        self.history_size = history_size
+        self.line_search_fn = line_search_fn
+        self._s_hist, self._y_hist, self._rho = [], [], []
+        self._prev_flat_grad = None
+
+    def _flat(self, grads=False):
+        parts = []
+        for p in self._parameter_list:
+            v = (p.grad._value if p.grad is not None else jnp.zeros_like(p._value)) if grads else p._value
+            parts.append(jnp.ravel(v).astype(jnp.float32))
+        return jnp.concatenate(parts)
+
+    def _assign(self, flat):
+        off = 0
+        for p in self._parameter_list:
+            n = int(jnp.size(p._value))
+            p._value = jnp.reshape(flat[off:off + n], p._value.shape).astype(p._value.dtype)
+            off += n
+
+    def _eval(self, closure, x):
+        self._assign(x)
+        self.clear_grad()
+        loss = closure()
+        return float(loss._value), self._flat(grads=True)
+
+    def _direction(self, g):
+        q = g
+        alphas = []
+        for s, y, rho in zip(reversed(self._s_hist), reversed(self._y_hist), reversed(self._rho)):
+            a = rho * jnp.dot(s, q)
+            alphas.append(a)
+            q = q - a * y
+        if self._y_hist:
+            y, s = self._y_hist[-1], self._s_hist[-1]
+            q = q * (jnp.dot(s, y) / jnp.dot(y, y))
+        for (s, y, rho), a in zip(zip(self._s_hist, self._y_hist, self._rho), reversed(alphas)):
+            b = rho * jnp.dot(y, q)
+            q = q + (a - b) * s
+        return -q
+
+    def step(self, closure=None):
+        if closure is None:
+            raise RuntimeError("LBFGS.step requires a closure that recomputes the loss")
+        from ..autograd import tape
+
+        self.clear_grad()  # stale grads from the previous step must not accumulate
+        with tape.enable_grad():
+            loss0 = closure()
+        loss = float(loss0._value)
+        x = self._flat()
+        g = self._flat(grads=True)
+        n_eval = 1
+        lr = self._base_lr()
+        for _ in range(self.max_iter):
+            if float(jnp.max(jnp.abs(g))) <= self.tolerance_grad:
+                break
+            d = self._direction(g)
+            gtd = float(jnp.dot(g, d))
+            if gtd > -1e-15:
+                self._s_hist, self._y_hist, self._rho = [], [], []
+                d = -g
+                gtd = float(jnp.dot(g, d))
+            # backtracking Armijo line search (strong_wolfe simplified)
+            t = lr
+            ok = False
+            for _ls in range(20):
+                new_loss, new_g = self._eval(closure, x + t * d)
+                n_eval += 1
+                if new_loss <= loss + 1e-4 * t * gtd:
+                    ok = True
+                    break
+                t *= 0.5
+                if n_eval >= self.max_eval:
+                    break
+            if not ok:
+                self._assign(x)
+                break
+            s = t * d
+            y = new_g - g
+            sy = float(jnp.dot(s, y))
+            if sy > 1e-10:
+                self._s_hist.append(s)
+                self._y_hist.append(y)
+                self._rho.append(1.0 / sy)
+                if len(self._s_hist) > self.history_size:
+                    self._s_hist.pop(0)
+                    self._y_hist.pop(0)
+                    self._rho.pop(0)
+            x = x + s
+            if abs(new_loss - loss) < self.tolerance_change:
+                loss, g = new_loss, new_g
+                break
+            loss, g = new_loss, new_g
+            if n_eval >= self.max_eval:
+                break
+        self._assign(x)
+        self._step_count += 1
+        from ..tensor.tensor import Tensor
+
+        return Tensor(jnp.float32(loss))
+
+    def _base_lr(self):
+        lr = self._learning_rate
+        from .lr import LRScheduler
+
+        return lr() if isinstance(lr, LRScheduler) else (lr.get_lr() if hasattr(lr, "get_lr") else float(lr))

@@ -1,0 +1,318 @@
+"""paddle.quantization parity (/root/reference/python/paddle/quantization:
+QuantConfig / BaseObserver / BaseQuanter / QAT / PTQ surface, observers/
+abs_max.py, quanters/abs_max.py).
+
+TPU-native: fake-quant runs through the tape with a straight-through
+estimator (x + stop_gradient(q(x) - x)) so QAT trains with plain autograd;
+weight-only int8 keeps int8 storage with per-channel scales and dequantizes
+into bf16 matmuls (the MXU path) — the reference's cuBLAS int8 GEMM tier
+(paddle/phi/kernels/fusion/cutlass) collapses to XLA's int8->bf16 fusion.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer.layers import Layer
+from ..ops.dispatch import apply
+from ..tensor.tensor import Tensor
+
+__all__ = [
+    "QuantConfig", "BaseQuanter", "BaseObserver", "quanter", "QAT", "PTQ",
+    "AbsmaxObserver", "GroupWiseWeightObserver", "FakeQuanterWithAbsMaxObserver",
+    "QuantedLinear", "weight_quantize", "weight_dequantize", "weight_only_linear",
+]
+
+
+# ------------------------------------------------------------ base classes
+class BaseObserver(Layer):
+    """Collects statistics during calibration; produces scales."""
+
+    def __init__(self):
+        super().__init__()
+        self._scale = None
+
+    def scales(self):
+        return self._scale
+
+    def forward(self, x):
+        raise NotImplementedError
+
+
+class BaseQuanter(BaseObserver):
+    """An observer that also simulates quantization in forward."""
+
+
+def quanter(name):
+    """Class decorator registering a quanter factory (parity:
+    quantization/factory.py quanter)."""
+
+    def deco(cls):
+        globals()[name] = cls
+        return cls
+
+    return deco
+
+
+class AbsmaxObserver(BaseObserver):
+    """Per-tensor abs-max calibration observer (observers/abs_max.py)."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self._absmax = 0.0
+
+    def forward(self, x):
+        self._absmax = max(self._absmax, float(jnp.max(jnp.abs(x._value))))
+        self._scale = self._absmax / (2 ** (self.quant_bits - 1) - 1)
+        return x
+
+
+class GroupWiseWeightObserver(BaseObserver):
+    """Per-group abs-max for weights (observers/groupwise.py)."""
+
+    def __init__(self, quant_bits=8, group_size=128):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.group_size = group_size
+
+    def forward(self, x):
+        v = np.asarray(x._value)
+        g = self.group_size
+        rows = v.reshape(-1, v.shape[-1])
+        pad = (-rows.shape[0]) % g
+        if pad:
+            rows = np.concatenate([rows, np.zeros((pad, rows.shape[1]), v.dtype)])
+        grouped = np.abs(rows.reshape(-1, g, rows.shape[1])).max(axis=1)
+        self._scale = grouped / (2 ** (self.quant_bits - 1) - 1)
+        return x
+
+
+class FakeQuanterWithAbsMaxObserver(BaseQuanter):
+    """Moving-average abs-max fake quantization with STE gradients
+    (quanters/abs_max.py FakeQuanterWithAbsMaxObserverLayer)."""
+
+    def __init__(self, moving_rate=0.9, quant_bits=8, dtype="float32", name=None):
+        super().__init__()
+        self.moving_rate = moving_rate
+        self.quant_bits = quant_bits
+        self._state = 1.0
+        self._accum = None
+
+    def forward(self, x):
+        qmax = 2 ** (self.quant_bits - 1) - 1
+        cur = float(jnp.max(jnp.abs(jax.lax.stop_gradient(x._value))))
+        if self.training:
+            r = self.moving_rate
+            self._accum = cur if self._accum is None else r * self._accum + (1 - r) * cur
+            self._state = r * self._state + (1 - r)
+            scale = self._accum / self._state
+        else:
+            scale = self._accum / self._state if self._accum is not None else cur
+        self._scale = scale / qmax if scale else 1.0 / qmax
+        s = max(self._scale, 1e-9)
+
+        def f(v):
+            q = jnp.clip(jnp.round(v / s), -qmax - 1, qmax) * s
+            return v + jax.lax.stop_gradient(q - v)  # straight-through
+
+        return apply(f, x, op_name="fake_quant_absmax")
+
+
+# --------------------------------------------------------------- QuantConfig
+class QuantConfig:
+    """parity: quantization/config.py — which quanter to apply to weights /
+    activations, with per-layer overrides."""
+
+    def __init__(self, activation: Optional[BaseQuanter] = None,
+                 weight: Optional[BaseQuanter] = None):
+        self.activation = activation
+        self.weight = weight
+        self._layer_configs = {}
+        self._type_configs = {}
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            self._layer_configs[id(l)] = (activation, weight)
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, (list, tuple)) else [layer_type]
+        for t in types:
+            self._type_configs[t] = (activation, weight)
+
+    def _for(self, layer):
+        if id(layer) in self._layer_configs:
+            return self._layer_configs[id(layer)]
+        for t, cfg in self._type_configs.items():
+            if isinstance(layer, t):
+                return cfg
+        return (self.activation, self.weight)
+
+
+def _fresh(q):
+    return copy.deepcopy(q) if q is not None else None
+
+
+class QuantedLinear(Layer):
+    """Linear wrapped with activation/weight quanters (wrapper.py analog)."""
+
+    def __init__(self, linear, act_quanter, weight_quanter):
+        super().__init__()
+        self.linear = linear
+        self.activation_quanter = act_quanter
+        self.weight_quanter = weight_quanter
+
+    @property
+    def weight(self):
+        return self.linear.weight
+
+    @property
+    def bias(self):
+        return self.linear.bias
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.linear.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        import paddle_tpu.nn.functional as F
+
+        return F.linear(x, w, self.linear.bias)
+
+
+class QuantedConv2D(Layer):
+    """Conv2D wrapped with quanters: the fake-quanted weight is swapped into
+    the conv's parameter dict for the call, so the conv's own forward (and
+    the tape through the quanter) are reused unchanged."""
+
+    def __init__(self, conv, act_quanter, weight_quanter):
+        super().__init__()
+        self.conv = conv
+        self.activation_quanter = act_quanter
+        self.weight_quanter = weight_quanter
+
+    @property
+    def weight(self):
+        return self.conv.weight
+
+    @property
+    def bias(self):
+        return self.conv.bias
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        if self.weight_quanter is None:
+            return self.conv(x)
+        q_w = self.weight_quanter(self.conv.weight)
+        saved = self.conv._parameters["weight"]
+        self.conv._parameters["weight"] = q_w
+        try:
+            return self.conv(x)
+        finally:
+            self.conv._parameters["weight"] = saved
+
+
+class Quantization:
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def _wrap_model(self, model: Layer, inplace: bool) -> Layer:
+        from ..nn import Conv2D, Linear
+
+        if not inplace:
+            model = copy.deepcopy(model)
+
+        def wrap(parent):
+            for name, sub in list(parent._sub_layers.items()):
+                if isinstance(sub, Linear):
+                    act_q, w_q = self._config._for(sub)
+                    parent._sub_layers[name] = QuantedLinear(
+                        sub, _fresh(act_q), _fresh(w_q))
+                elif isinstance(sub, Conv2D):
+                    act_q, w_q = self._config._for(sub)
+                    parent._sub_layers[name] = QuantedConv2D(
+                        sub, _fresh(act_q), _fresh(w_q))
+                else:
+                    wrap(sub)
+
+        wrap(model)
+        return model
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        """Bake observed scales: replace fake-quant wrappers with plain layers
+        whose weights are quantize->dequantize'd constants (deploy form)."""
+        if not inplace:
+            model = copy.deepcopy(model)
+
+        def unwrap(parent):
+            for name, sub in list(parent._sub_layers.items()):
+                if isinstance(sub, (QuantedLinear, QuantedConv2D)):
+                    lin = sub.linear if isinstance(sub, QuantedLinear) else sub.conv
+                    scales = (sub.weight_quanter.scales()
+                              if sub.weight_quanter is not None else None)
+                    if scales is not None and np.any(np.asarray(scales)):
+                        s = max(float(np.max(np.asarray(scales))), 1e-9)
+                        qmax = 127
+                        w = np.asarray(lin.weight._value)
+                        lin.weight.set_value(
+                            (np.clip(np.round(w / s), -128, qmax) * s).astype(w.dtype))
+                    parent._sub_layers[name] = lin
+                else:
+                    unwrap(sub)
+
+        unwrap(model)
+        return model
+
+
+class QAT(Quantization):
+    """quantization-aware training: insert fake quanters (qat.py:27)."""
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        return self._wrap_model(model, inplace)
+
+
+class PTQ(Quantization):
+    """post-training quantization: insert observers, calibrate, convert
+    (ptq.py:28)."""
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        return self._wrap_model(model, inplace)
+
+
+# ------------------------------------------------- weight-only int8 tier
+def weight_quantize(w, algo="weight_only_int8", group_size=-1):
+    """-> (int8 weight, per-out-channel fp scales). w: [in, out]."""
+    wv = np.asarray(w._value if isinstance(w, Tensor) else w)
+    scale = np.maximum(np.abs(wv).max(axis=0), 1e-9) / 127.0
+    q = np.clip(np.round(wv / scale), -128, 127).astype(np.int8)
+    return Tensor(jnp.asarray(q)), Tensor(jnp.asarray(scale.astype(np.float32)))
+
+
+def weight_dequantize(qw, scale, algo="weight_only_int8"):
+    def f(q, s):
+        return q.astype(jnp.float32) * s
+
+    return apply(f, qw, scale, op_name="weight_dequantize")
+
+
+def weight_only_linear(x, qweight, bias=None, weight_scale=None, weight_dtype="int8"):
+    """x @ dequant(qweight) + bias — int8 storage, bf16/fp32 MXU compute."""
+
+    def f(xv, q, s):
+        w = q.astype(xv.dtype) * s.astype(xv.dtype)
+        return xv @ w
+
+    out = apply(f, x, qweight, weight_scale, op_name="weight_only_linear")
+    if bias is not None:
+        from ..tensor import math as _m
+
+        out = _m.add(out, bias)
+    return out

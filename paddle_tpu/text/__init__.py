@@ -1,0 +1,153 @@
+"""paddle.text parity (/root/reference/python/paddle/text/__init__.py):
+viterbi_decode / ViterbiDecoder + dataset classes.
+
+viterbi is a lax.scan dynamic program — compiled control flow, no Python
+loop over time steps (reference: text/viterbi_decode.py:31 binding the
+viterbi_decode phi kernel).
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..io.dataset import Dataset
+from ..nn.layer.layers import Layer
+from ..ops.dispatch import apply
+from ..tensor.tensor import Tensor
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "UCIHousing", "Imdb"]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag: bool = True, name=None):
+    """-> (scores [B], paths [B, T]) — highest-scoring tag sequences.
+
+    potentials: [B, T, N] unary emission scores; transition_params: [N, N];
+    lengths: [B] actual sequence lengths.
+    """
+    potentials = potentials if isinstance(potentials, Tensor) else Tensor(jnp.asarray(potentials))
+    transition_params = transition_params if isinstance(transition_params, Tensor) \
+        else Tensor(jnp.asarray(transition_params))
+    lengths = lengths if isinstance(lengths, Tensor) else Tensor(jnp.asarray(lengths))
+
+    def f(pot, trans, lens):
+        B, T, N = pot.shape
+        lens = lens.astype(jnp.int32)
+        if include_bos_eos_tag:
+            # tags N-2 = BOS, N-1 = EOS (paddle convention): sequences start
+            # from BOS and end at EOS
+            init = pot[:, 0] + trans[N - 2][None, :]
+        else:
+            init = pot[:, 0]
+
+        def step(carry, inp):
+            alpha, t = carry
+            emit = inp  # [B, N]
+            scores = alpha[:, :, None] + trans[None, :, :]  # [B, from, to]
+            best_prev = jnp.argmax(scores, axis=1)  # [B, N]
+            new_alpha = jnp.max(scores, axis=1) + emit
+            active = (t < lens)[:, None]
+            alpha = jnp.where(active, new_alpha, alpha)
+            return (alpha, t + 1), jnp.where(active, best_prev, -1)
+
+        (alpha, _), backptrs = lax.scan(step, (init, jnp.ones((), jnp.int32)),
+                                        jnp.swapaxes(pot[:, 1:], 0, 1))
+        if include_bos_eos_tag:
+            alpha = alpha + trans[:, N - 1][None, :]
+        scores = jnp.max(alpha, axis=-1)
+        last_tag = jnp.argmax(alpha, axis=-1).astype(jnp.int32)  # [B]
+
+        # walk pointers backward (scan over reversed time)
+        def back(carry, bp_t):
+            tag, t = carry
+            # bp_t: [B, N] pointers for transition into step index t
+            prev = jnp.take_along_axis(bp_t, tag[:, None], axis=1)[:, 0]
+            use = (t < lens - 1)  # only steps inside the sequence
+            new_tag = jnp.where(use, prev, tag).astype(jnp.int32)
+            return (new_tag, t - 1), tag
+
+        (first_tag, _), rev_tags = lax.scan(
+            back, (last_tag, (jnp.zeros((), jnp.int32) + T - 2)),
+            backptrs, reverse=True)
+        # rev_tags[t] is the tag at position t+1; prepend the first tag
+        paths = jnp.concatenate([first_tag[None, :], rev_tags], axis=0)
+        paths = jnp.swapaxes(paths, 0, 1)  # [B, T]
+        # mask positions beyond each length with the last valid tag repeated
+        pos = jnp.arange(T)[None, :]
+        paths = jnp.where(pos < lens[:, None], paths, 0)
+        return scores, paths
+
+    return apply(f, potentials, transition_params, lengths, op_name="viterbi_decode", n_outs=2)
+
+
+class ViterbiDecoder(Layer):
+    """parity: paddle.text.ViterbiDecoder — holds transitions, decodes."""
+
+    def __init__(self, transitions, include_bos_eos_tag: bool = True, name=None):
+        super().__init__()
+        self.transitions = transitions if isinstance(transitions, Tensor) \
+            else Tensor(jnp.asarray(transitions))
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+# --------------------------------------------------------------- datasets
+class UCIHousing(Dataset):
+    """parity: text/datasets/uci_housing.py — reads a local housing.data
+    (whitespace table, 13 features + target); no network in this env."""
+
+    def __init__(self, data_file=None, mode="train"):
+        if data_file is None or not os.path.exists(data_file):
+            raise RuntimeError(
+                "UCIHousing: pass data_file pointing at a local housing.data "
+                "(no network access in this environment)")
+        raw = np.loadtxt(data_file).astype(np.float32)
+        feats, target = raw[:, :-1], raw[:, -1:]
+        mn, mx = feats.min(0), feats.max(0)
+        feats = (feats - mn) / np.maximum(mx - mn, 1e-8)
+        split = int(len(raw) * 0.8)
+        if mode == "train":
+            self.x, self.y = feats[:split], target[:split]
+        else:
+            self.x, self.y = feats[split:], target[split:]
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+class Imdb(Dataset):
+    """parity: text/datasets/imdb.py — reads a local aclImdb directory."""
+
+    def __init__(self, data_dir=None, mode="train", cutoff=150):
+        if data_dir is None or not os.path.isdir(data_dir):
+            raise RuntimeError(
+                "Imdb: pass data_dir pointing at a local aclImdb tree "
+                "(no network access in this environment)")
+        self.samples = []
+        for label, sub in ((0, "neg"), (1, "pos")):
+            d = os.path.join(data_dir, mode, sub)
+            if os.path.isdir(d):
+                for fn in sorted(os.listdir(d)):
+                    self.samples.append((os.path.join(d, fn), label))
+        self._vocab = None
+        self.cutoff = cutoff
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i):
+        path, label = self.samples[i]
+        with open(path, encoding="utf-8") as f:
+            text = f.read().lower().split()
+        return text, np.int64(label)

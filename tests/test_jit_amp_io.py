@@ -1,6 +1,7 @@
 """to_static / TrainStep / amp / DataLoader / save-load tests."""
 import os
 import tempfile
+import warnings
 
 import numpy as np
 import pytest
@@ -362,3 +363,57 @@ class TestTrainStepOptimizerParity:
         step(P.full([4, 4], np.nan), Y)
         np.testing.assert_array_equal(net.weight.numpy(), w_before)
         assert float(scaler.get_loss_scaling()) == 1024.0
+
+
+class _SquareDataset:
+    """Module-level (picklable) dataset for process workers."""
+
+    def __len__(self):
+        return 20
+
+    def __getitem__(self, i):
+        return np.full((3,), float(i), np.float32), np.int64(i)
+
+
+class TestProcessDataLoader:
+    def test_process_workers_order_and_values(self):
+        from paddle_tpu.io import DataLoader
+
+        dl = DataLoader(_SquareDataset(), batch_size=4, num_workers=2)
+        seen = []
+        for xb, yb in dl:
+            assert list(xb.shape) == [4, 3]
+            seen.extend(np.asarray(yb._value).tolist())
+        assert seen == list(range(20))  # order preserved across workers
+
+    def test_worker_exception_propagates(self):
+        from paddle_tpu.io import DataLoader
+
+        class Bad(_SquareDataset):
+            def __getitem__(self, i):
+                if i == 7:
+                    raise ValueError("boom at 7")
+                return super().__getitem__(i)
+
+        # Bad is a local class -> unpicklable -> thread fallback also must raise;
+        # use the module-level path via monkeypatching is overkill: check fallback
+        dl = DataLoader(Bad(), batch_size=4, num_workers=2)
+        with pytest.raises(Exception, match="boom|pickle"):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                for _ in dl:
+                    pass
+
+    def test_local_class_dataset_works_under_fork(self):
+        # fork inherits the dataset without pickling, so even a local class
+        # dataset rides the process-worker path
+        from paddle_tpu.io import DataLoader
+
+        class Local(_SquareDataset):
+            pass
+
+        dl = DataLoader(Local(), batch_size=5, num_workers=2)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out = [b for b in dl]
+        assert len(out) == 4

@@ -317,3 +317,48 @@ class TestOptimizers:
         for _ in range(12):
             warm.step()
         assert abs(warm() - 1.0) < 1e-6
+
+
+class TestLBFGS:
+    def test_quadratic_converges_to_closed_form(self):
+        rs = np.random.RandomState(0)
+        A = rs.randn(6, 6).astype(np.float32)
+        A = A @ A.T + 6 * np.eye(6, dtype=np.float32)
+        b = rs.randn(6).astype(np.float32)
+        x = P.to_tensor(np.zeros(6, np.float32))
+        x.stop_gradient = False
+        x.is_parameter = True
+        opt = P.optimizer.LBFGS(parameters=[x], learning_rate=1.0, max_iter=30)
+        At, bt = P.to_tensor(A), P.to_tensor(b)
+
+        def closure():
+            loss = 0.5 * P.sum(x * P.matmul(At, x)) - P.sum(bt * x)
+            loss.backward()
+            return loss
+
+        opt.step(closure)
+        x_star = np.linalg.solve(A, b)
+        assert np.abs(np.asarray(x._value) - x_star).max() < 1e-3
+
+    def test_rosenbrock(self):
+        w = P.to_tensor(np.array([-1.0, 1.5], np.float32))
+        w.stop_gradient = False
+        w.is_parameter = True
+        opt = P.optimizer.LBFGS(parameters=[w], max_iter=50)
+
+        def closure():
+            a, b = w[0], w[1]
+            loss = (1 - a) ** 2 + 100 * (b - a * a) ** 2
+            loss.backward()
+            return loss
+
+        for _ in range(10):
+            final = opt.step(closure)
+        assert float(np.asarray(final._value)) < 1e-3
+
+    def test_requires_closure(self):
+        x = P.to_tensor(np.zeros(2, np.float32))
+        x.stop_gradient = False
+        opt = P.optimizer.LBFGS(parameters=[x])
+        with pytest.raises(RuntimeError):
+            opt.step()

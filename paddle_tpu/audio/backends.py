@@ -42,8 +42,12 @@ def save(filepath: str, src, sample_rate: int, channels_first: bool = True,
     if arr.ndim == 1:
         arr = arr[:, None]
     pcm = np.clip(arr, -1.0, 1.0)
-    pcm = (pcm * (2 ** (bits_per_sample - 1) - 1)).astype(
-        {8: np.int8, 16: np.int16, 32: np.int32}[bits_per_sample])
+    if bits_per_sample == 8:
+        # WAV 8-bit PCM is UNSIGNED with a 128 offset
+        pcm = ((pcm * 127) + 128).clip(0, 255).astype(np.uint8)
+    else:
+        pcm = (pcm * (2 ** (bits_per_sample - 1) - 1)).astype(
+            {16: np.int16, 32: np.int32}[bits_per_sample])
     with wave.open(filepath, "wb") as w:
         w.setnchannels(arr.shape[1])
         w.setsampwidth(bits_per_sample // 8)

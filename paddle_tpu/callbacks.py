@@ -1,0 +1,13 @@
+"""paddle.callbacks namespace (parity: python/paddle/callbacks.py re-export
+of hapi callbacks)."""
+from .hapi.callbacks import (  # noqa: F401
+    Callback,
+    EarlyStopping,
+    LRScheduler,
+    ModelCheckpoint,
+    ProgBarLogger,
+    ReduceLROnPlateau,
+)
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
+           "LRScheduler", "ReduceLROnPlateau"]

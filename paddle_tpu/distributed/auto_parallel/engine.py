@@ -104,6 +104,7 @@ class Engine:
         if not self._prepared:
             self.prepare()
         loader = self._as_loader(train_data, batch_size, collate_fn, num_workers)
+        self.history = []  # fresh per fit(); returned copy below
         for epoch in range(epochs):
             for step, batch in enumerate(loader):
                 if steps_per_epoch is not None and step >= steps_per_epoch:
@@ -112,7 +113,7 @@ class Engine:
                 loss = self._train_step(*xs)
                 lv = float(np.asarray(loss._value if isinstance(loss, Tensor) else loss))
                 self.history.append(lv)
-        return {"loss": self.history}
+        return {"loss": list(self.history)}
 
     def evaluate(self, valid_data, valid_sample_split=None, batch_size=1,
                  steps=None, log_freq=10, collate_fn=None, num_workers=0):

@@ -71,17 +71,28 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1, eval_freq=1,
             log_freq=10, save_dir=None, save_freq=1, verbose=2, drop_last=False,
             shuffle=True, num_workers=0, callbacks=None, accumulate_grad_batches=1, num_iters=None):
+        from .callbacks import config_callbacks
+
         loader = train_data if isinstance(train_data, DataLoader) else DataLoader(
             train_data, batch_size=batch_size, shuffle=shuffle, drop_last=drop_last,
             num_workers=num_workers,
         )
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs,
+                                steps=len(loader) if hasattr(loader, "__len__") else None,
+                                log_freq=log_freq, verbose=verbose,
+                                save_freq=save_freq, save_dir=save_dir,
+                                metrics=[m.name() for m in self._metrics
+                                         if callable(getattr(m, "name", None))])
+        self.stop_training = False
         history = {"loss": []}
         it = 0
         accum = max(int(accumulate_grad_batches), 1)
+        cbks.on_train_begin()
         for epoch in range(epochs):
-            t0 = time.time()
+            cbks.on_epoch_begin(epoch)
             epoch_losses = []
             for bi, batch in enumerate(loader):
+                cbks.on_train_batch_begin(bi)
                 xs, y = batch[:-1], batch[-1]
                 if accum > 1:
                     # gradient accumulation rides the eager path: backward each
@@ -98,20 +109,26 @@ class Model:
                     loss = self.train_batch(xs, y)[0]
                 epoch_losses.append(loss)
                 it += 1
-                if verbose and log_freq and it % log_freq == 0:
-                    print(f"epoch {epoch} step {it}: loss {loss:.4f}")
+                cbks.on_train_batch_end(bi, {"loss": loss})
                 if num_iters is not None and it >= num_iters:
                     break
-            history["loss"].append(float(np.mean(epoch_losses)) if epoch_losses else None)
-            if verbose:
-                print(f"Epoch {epoch + 1}/{epochs}: loss {history['loss'][-1]:.4f} "
-                      f"({time.time() - t0:.1f}s)")
+            epoch_loss = float(np.mean(epoch_losses)) if epoch_losses else None
+            history["loss"].append(epoch_loss)
+            logs = {"loss": epoch_loss}
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
-                self.evaluate(eval_data, batch_size=batch_size, verbose=verbose)
-            if save_dir is not None and (epoch + 1) % save_freq == 0:
-                self.save(f"{save_dir}/epoch_{epoch}")
+                eval_res = self.evaluate(eval_data, batch_size=batch_size, verbose=verbose)
+                for k, v in eval_res.items():
+                    if isinstance(v, list):
+                        v = v[0] if v else None
+                    # eval loss lands as 'val_loss'; metric names verbatim —
+                    # what EarlyStopping/ReduceLROnPlateau monitor
+                    logs["val_loss" if k == "loss" else k] = v
+            cbks.on_epoch_end(epoch, logs)
+            if getattr(self, "stop_training", False):
+                break
             if num_iters is not None and it >= num_iters:
                 break
+        cbks.on_train_end({"loss": history["loss"][-1] if history["loss"] else None})
         if self._train_step is not None:
             self._train_step.sync_to_model()
         return history

@@ -1,0 +1,138 @@
+"""ASP — automatic structured (2:4) sparsity (parity:
+/root/reference/python/paddle/incubate/asp: decorate/prune_model/
+set_excluded_layers/calculate_density, supported_layers_and_prune_func_map).
+
+TPU-native: masks are computed host-side (static structure) and re-applied
+after each optimizer step by the ASPOptimizer wrapper — the same
+mask-after-update contract the reference implements in
+OptimizerWithSparsityGuarantee. The MXU has no 2:4 sparse tensor cores, so
+pruned weights buy model-compression/regularization capability, not FLOPs.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+__all__ = ["decorate", "prune_model", "set_excluded_layers",
+           "reset_excluded_layers", "calculate_density", "ASPHelper"]
+
+
+def calculate_density(x) -> float:
+    arr = np.asarray(x._value if hasattr(x, "_value") else x)
+    return float(np.count_nonzero(arr)) / max(arr.size, 1)
+
+
+def _mask_2on4_1d(flat: np.ndarray) -> np.ndarray:
+    """Keep the 2 largest-|w| of every 4 consecutive weights."""
+    n = flat.size
+    pad = (-n) % 4
+    v = np.abs(np.concatenate([flat, np.zeros(pad, flat.dtype)])).reshape(-1, 4)
+    order = np.argsort(-v, axis=1)
+    mask = np.zeros_like(v, dtype=bool)
+    rows = np.arange(v.shape[0])[:, None]
+    mask[rows, order[:, :2]] = True
+    return mask.reshape(-1)[:n]
+
+
+def _compute_mask(w: np.ndarray, n: int = 2, m: int = 4) -> np.ndarray:
+    if w.ndim < 2:
+        return np.ones_like(w, dtype=bool)
+    flat = w.reshape(-1, w.shape[-1])
+    # 2:4 along the input (reduction) dimension, row-major groups
+    return np.stack([_mask_2on4_1d(row) for row in flat]).reshape(w.shape)
+
+
+class ASPHelper:
+    """Per-model mask registry (reference asp/asp.py ASPHelper)."""
+
+    _excluded: Dict[int, set] = {}
+    _masks: Dict[int, np.ndarray] = {}
+
+    @classmethod
+    def is_supported(cls, layer) -> bool:
+        from ...nn import Conv2D, Linear
+
+        return isinstance(layer, (Linear, Conv2D))
+
+    @classmethod
+    def prunable_params(cls, model) -> List:
+        out = []
+        excluded = cls._excluded.get(id(model), set())
+        layers = [("", model)] if cls.is_supported(model) else list(_walk(model))
+        for name, layer in layers:
+            if not cls.is_supported(layer) or name in excluded:
+                continue
+            w = getattr(layer, "weight", None)
+            if w is not None and w._value.ndim >= 2:
+                out.append(w)
+        return out
+
+
+def _walk(layer, prefix=""):
+    for name, sub in layer._sub_layers.items():
+        full = f"{prefix}.{name}" if prefix else name
+        yield full, sub
+        yield from _walk(sub, full)
+
+
+def set_excluded_layers(model, layer_names: List[str]):
+    ASPHelper._excluded.setdefault(id(model), set()).update(layer_names)
+
+
+def reset_excluded_layers(model=None):
+    if model is None:
+        ASPHelper._excluded.clear()
+    else:
+        ASPHelper._excluded.pop(id(model), None)
+
+
+def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
+                with_mask: bool = True):
+    """Compute 2:4 masks for every supported layer and zero the pruned
+    weights. Returns {param_name: mask}."""
+    masks = {}
+    for p in ASPHelper.prunable_params(model):
+        w = np.asarray(p._value)
+        mask = _compute_mask(w, n, m)
+        p.set_value((w * mask).astype(w.dtype))
+        if with_mask:
+            ASPHelper._masks[id(p)] = mask
+            masks[p.name] = mask
+    return masks
+
+
+class _ASPOptimizer:
+    """Re-applies masks after every step (reference
+    OptimizerWithSparsityGuarantee)."""
+
+    def __init__(self, optimizer):
+        self._inner = optimizer
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def step(self, *args, **kwargs):
+        out = self._inner.step(*args, **kwargs)
+        for p in self._inner._parameter_list:
+            mask = ASPHelper._masks.get(id(p))
+            if mask is not None:
+                p._value = p._value * jnp.asarray(mask, p._value.dtype)
+        return out
+
+    def minimize(self, loss, *args, **kwargs):
+        res = self._inner.minimize(loss, *args, **kwargs)
+        self.step_masks_only()
+        return res
+
+    def step_masks_only(self):
+        for p in self._inner._parameter_list:
+            mask = ASPHelper._masks.get(id(p))
+            if mask is not None:
+                p._value = p._value * jnp.asarray(mask, p._value.dtype)
+
+
+def decorate(optimizer):
+    return _ASPOptimizer(optimizer)

@@ -99,6 +99,14 @@ class Predictor:
             from ..jit.serialization import load as jit_load
 
             self._loaded = jit_load(config.model_path)
+            if config._weight_only is not None:
+                import warnings
+
+                warnings.warn(
+                    "enable_weight_only_quant has no effect on a saved artifact "
+                    "(weights are baked into the compiled program); build the "
+                    "predictor from a live Layer via config.set_layer() to "
+                    "serve int8 weights")
         if self._layer is not None and config._weight_only == "int8":
             self._layer = _rewrite_weight_only_int8(self._layer)
         self._inputs: Dict[str, _Handle] = {}

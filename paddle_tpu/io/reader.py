@@ -13,6 +13,7 @@ threads with a warning.
 from __future__ import annotations
 
 import multiprocessing as mp
+import os
 import pickle
 import queue
 import threading
@@ -122,17 +123,81 @@ class _PrefetchIter:
         return _to_tensor(item)
 
 
-def _worker_loop(dataset, collate, idx_q, out_q, init_fn, wid):
-    """Runs in a forked worker process (parity: dataloader_iter._worker_loop)."""
+def _tree_flatten(obj):
+    """(arrays, spec) for nested list/tuple/dict of numpy arrays/scalars."""
+    arrays = []
+
+    def walk(o):
+        if isinstance(o, np.ndarray):
+            arrays.append(o)
+            return {"t": "a"}
+        if isinstance(o, (int, float, np.integer, np.floating, bool, np.bool_)):
+            arrays.append(np.asarray(o))
+            return {"t": "a"}
+        if isinstance(o, (list, tuple)):
+            return {"t": "l" if isinstance(o, list) else "u",
+                    "c": [walk(x) for x in o]}
+        if isinstance(o, dict):
+            keys = list(o)
+            return {"t": "d", "k": keys, "c": [walk(o[k]) for k in keys]}
+        raise TypeError(f"unsupported type for shm transport: {type(o)}")
+
+    spec = walk(obj)
+    return arrays, spec
+
+
+def _tree_unflatten(spec, arrays, pos=None):
+    pos = pos or [0]
+    t = spec["t"]
+    if t == "a":
+        a = arrays[pos[0]]
+        pos[0] += 1
+        return a
+    if t in ("l", "u"):
+        items = [_tree_unflatten(c, arrays, pos) for c in spec["c"]]
+        return items if t == "l" else tuple(items)
+    return {k: _tree_unflatten(c, arrays, pos)
+            for k, c in zip(spec["k"], spec["c"])}
+
+
+def _worker_loop(dataset, collate, idx_q, out_q, init_fn, wid, shm_name=None):
+    """Runs in a forked worker process (parity: dataloader_iter._worker_loop).
+
+    With ``shm_name`` the collated batch rides the native shared-memory ring
+    (paddle_tpu.native.ShmQueue) — no pickle; the mp queue carries only
+    errors and oversized/unsupported fallbacks."""
     if init_fn is not None:
         init_fn(wid)
+    shm = None
+    if shm_name is not None:
+        try:
+            from ..native import ShmQueue, encode_batch
+
+            shm = ShmQueue(shm_name, create=False)
+        except Exception:
+            shm = None
     while True:
         item = idx_q.get()
         if item is None:
+            if shm is not None:
+                shm.close()
             return
         seq, indices = item
         try:
-            out_q.put((seq, collate([dataset[i] for i in indices])))
+            batch = collate([dataset[i] for i in indices])
+            if shm is not None:
+                try:
+                    import json
+
+                    from ..native import encode_batch
+
+                    arrays, spec = _tree_flatten(batch)
+                    payload = json.dumps(spec).encode() + b"\x00" + encode_batch(arrays)
+                    shm.push(payload, seq)
+                    continue
+                except (TypeError, ValueError):
+                    pass  # unsupported structure / too big: fall back to mp queue
+            out_q.put((seq, batch))
         except Exception as e:  # must cross the pickle boundary
             import traceback
 
@@ -154,6 +219,18 @@ class _ProcessIter:
         self._next_out = 0
         self._out_buf = {}
         self._lookahead = None
+        self._shm = None
+        shm_name = None
+        if loader.use_shared_memory:
+            try:
+                from ..native import ShmQueue
+
+                shm_name = f"/pq_dl_{os.getpid()}_{id(self) & 0xFFFFFF:x}"
+                self._shm = ShmQueue(
+                    shm_name, slot_size=64 << 20,
+                    n_slots=max(2, loader.prefetch_factor) * max(1, loader.num_workers))
+            except Exception:
+                self._shm, shm_name = None, None
         method = "fork" if "fork" in mp.get_all_start_methods() else "spawn"
         ctx = mp.get_context(method)
         self._idx_q = ctx.Queue()
@@ -165,33 +242,61 @@ class _ProcessIter:
             self._idx_q.put(None)
             p = ctx.Process(target=_worker_loop,
                             args=(loader.dataset, collate, self._idx_q, self._out_q,
-                                  loader.worker_init_fn, wid), daemon=True)
+                                  loader.worker_init_fn, wid, shm_name), daemon=True)
             p.start()
             self.workers.append(p)
+
+    def _recv_one(self) -> bool:
+        """Pull one batch from either transport into _out_buf; False if none."""
+        if self._shm is not None:
+            # errors and oversized fallbacks on the mp queue first (cheap,
+            # non-blocking) so they aren't delayed behind the shm wait
+            try:
+                seq, item = self._out_q.get_nowait()
+                self._out_buf[seq] = item
+                return True
+            except queue.Empty:
+                pass
+            got = self._shm.pop(timeout_ms=200)
+            if got is not None:
+                import json
+
+                from ..native import decode_batch
+
+                seq, buf = got
+                sep = bytes(buf).index(b"\x00")
+                spec = json.loads(bytes(buf[:sep]).decode())
+                arrays = decode_batch(buf[sep + 1:])
+                self._out_buf[seq] = _tree_unflatten(spec, arrays)
+                return True
+            return False
+        try:
+            seq, item = self._out_q.get(timeout=1.0)
+        except queue.Empty:
+            return False
+        self._out_buf[seq] = item
+        return True
 
     def _fetch(self):
         import time as _time
 
         deadline = (_time.time() + self.loader.timeout) if self.loader.timeout else None
         while self._next_out not in self._out_buf:
-            try:
-                seq, item = self._out_q.get(timeout=1.0)
-            except queue.Empty:
-                # a dead worker (fork deadlock, OOM-kill) must surface as an
-                # error, not a permanent hang
-                if any(not p.is_alive() and p.exitcode not in (0, None)
-                       for p in self.workers):
-                    self._shutdown()
-                    raise RuntimeError(
-                        "DataLoader worker process died unexpectedly "
-                        "(killed or crashed before reporting an error)")
-                if deadline is not None and _time.time() > deadline:
-                    self._shutdown()
-                    raise RuntimeError(
-                        f"DataLoader timed out after {self.loader.timeout}s "
-                        "waiting for a worker batch")
+            if self._recv_one():
                 continue
-            self._out_buf[seq] = item
+            # a dead worker (fork deadlock, OOM-kill) must surface as an
+            # error, not a permanent hang
+            if any(not p.is_alive() and p.exitcode not in (0, None)
+                   for p in self.workers):
+                self._shutdown()
+                raise RuntimeError(
+                    "DataLoader worker process died unexpectedly "
+                    "(killed or crashed before reporting an error)")
+            if deadline is not None and _time.time() > deadline:
+                self._shutdown()
+                raise RuntimeError(
+                    f"DataLoader timed out after {self.loader.timeout}s "
+                    "waiting for a worker batch")
         item = self._out_buf.pop(self._next_out)
         self._next_out += 1
         if isinstance(item, Exception):
@@ -204,6 +309,9 @@ class _ProcessIter:
             if p.is_alive():
                 p.terminate()
         self.workers = []
+        if self._shm is not None:
+            self._shm.close()
+            self._shm = None
 
     def __iter__(self):
         return self
@@ -262,6 +370,7 @@ class DataLoader:
         self.drop_last = drop_last
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
+        self.use_shared_memory = use_shared_memory
         self._iterable = isinstance(dataset, IterableDataset)
         if not self._iterable:
             if batch_sampler is not None:
@@ -277,19 +386,19 @@ class DataLoader:
         if self._iterable:
             return _IterableIter(self)
         if self.num_workers > 0:
-            # fork inherits the dataset without pickling; only a spawn-default
-            # platform needs the picklability probe (and there it's cheap to
-            # probe the class, not the data)
+            # fork inherits the dataset without pickling; a spawn-only
+            # platform pickles for real, so probe only the cheap proxies
+            # (class + collate_fn), never the dataset payload
             if "fork" in mp.get_all_start_methods():
                 return _ProcessIter(self)
             try:
-                pickle.dumps(self.dataset)
+                pickle.dumps(type(self.dataset))
                 if self.collate_fn is not None:
                     pickle.dumps(self.collate_fn)
                 return _ProcessIter(self)
             except Exception as e:
                 warnings.warn(
-                    f"DataLoader: dataset/collate_fn not picklable ({e}); "
+                    f"DataLoader: dataset class/collate_fn not picklable ({e}); "
                     "falling back to thread workers")
         return _PrefetchIter(self)
 

@@ -109,7 +109,7 @@ class _SwapValues:
 
 class StaticFunction:
     def __init__(self, function: Callable, input_spec=None, build_strategy=None, backend=None,
-                 full_graph=True, donate_state=False):
+                 full_graph=True, donate_state=False, bucket_dynamic_batch=False):
         from ..nn.layer.layers import Layer
 
         self._layer: Optional[Layer] = None
@@ -122,6 +122,7 @@ class StaticFunction:
         else:
             self._fn = function
         self._input_spec = input_spec
+        self._bucket_dynamic_batch = bucket_dynamic_batch
         self._cache: Dict[Any, Any] = {}
         functools.update_wrapper(self, function if callable(function) else self._fn)
 
@@ -179,9 +180,56 @@ class StaticFunction:
         jit_bwd = jax.jit(fwd_bwd)
         return {"fwd": jit_fwd, "bwd": jit_bwd, "meta": meta}
 
+    # -------------------------------------------- dynamic-dim bucket policy
+    def _dynamic_batch_dims(self):
+        """Arg indices whose InputSpec marks dim 0 dynamic (None/-1).
+
+        Policy for SURVEY §7.3's dynamic-shape hard part: with
+        ``bucket_dynamic_batch=True`` the batch dim is zero-padded to the
+        next power of two and batch-mapped outputs sliced back, bounding the
+        compile cache to O(log max_batch) entries instead of one per batch
+        size. OPT-IN because padding asserts batch-row independence: models
+        with cross-batch coupling (train-mode BatchNorm, in-graph
+        mean-over-batch losses) would see the zero rows. Without the flag,
+        dynamic dims compile per exact shape — always correct."""
+        if not self._input_spec or not self._bucket_dynamic_batch:
+            return None
+        dyn = []
+        for i, s in enumerate(self._input_spec):
+            if isinstance(s, InputSpec) and s.shape and s.shape[0] in (None, -1):
+                dyn.append(i)
+        return dyn or None
+
+    @staticmethod
+    def _bucket(n: int) -> int:
+        b = 1
+        while b < n:
+            b <<= 1
+        return b
+
     def __call__(self, *args, **kwargs):
         training = self._layer.training if self._layer is not None else True
         arg_tensors, spec = flatten_tensors((args, kwargs))
+
+        dyn = self._dynamic_batch_dims()
+        real_n = None
+        if dyn and not kwargs and len(args) >= len(self._input_spec):
+            real_n = int(arg_tensors[dyn[0]]._value.shape[0])
+            bucket = self._bucket(real_n)
+            if bucket != real_n:
+                padded = []
+                for i, t in enumerate(arg_tensors):
+                    if i in dyn:
+                        v = t._value
+                        pad = [(0, bucket - v.shape[0])] + [(0, 0)] * (v.ndim - 1)
+                        pt = Tensor(jnp.pad(v, pad), stop_gradient=t.stop_gradient)
+                        padded.append(pt)
+                    else:
+                        padded.append(t)
+                arg_tensors = padded
+            else:
+                real_n = None  # exact bucket: nothing to slice back
+
         state_tensors = self._state_tensors()
         key = self._guards(arg_tensors, spec, training)
         entry = self._cache.get(key)
@@ -232,6 +280,17 @@ class StaticFunction:
                 out_tensors.append(t)
         else:
             out_tensors = [Tensor(v, stop_gradient=True) for v in out_vals]
+        if real_n is not None:
+            # slice padded batch rows back off every output that carries
+            # them — through the tape, so cotangents zero-pad on backward
+            from ..ops.dispatch import apply as _apply
+
+            bucket = arg_tensors[dyn[0]]._value.shape[0]
+            out_tensors = [
+                _apply(lambda v, _n=real_n: v[:_n], t, op_name="unbucket_slice")
+                if t._value.ndim >= 1 and t._value.shape[0] == bucket else t
+                for t in out_tensors
+            ]
         return unflatten_tensors(out_spec, out_tensors)
 
 
@@ -239,7 +298,9 @@ def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
     """Decorator/wrapper parity with paddle.jit.to_static."""
 
     def decorate(fn):
-        return StaticFunction(fn, input_spec=input_spec, build_strategy=build_strategy, backend=backend)
+        return StaticFunction(fn, input_spec=input_spec, build_strategy=build_strategy,
+                              backend=backend,
+                              bucket_dynamic_batch=kwargs.get("bucket_dynamic_batch", False))
 
     if function is not None:
         return decorate(function)

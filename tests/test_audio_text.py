@@ -158,3 +158,88 @@ class TestTextDatasets:
             text.UCIHousing()
         with pytest.raises(RuntimeError, match="no network"):
             audio.datasets.ESC50(data_dir=None)
+
+
+class TestHapiCallbacks:
+    def test_early_stopping_and_history(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.hapi import Model
+        from paddle_tpu.hapi.callbacks import EarlyStopping
+
+        class DS:
+            def __len__(self):
+                return 16
+
+            def __getitem__(self, i):
+                x = np.zeros(4, np.float32)
+                return x, np.zeros(1, np.float32)
+
+        net = nn.Linear(4, 1)
+        m = Model(net)
+        m.prepare(P.optimizer.SGD(parameters=net.parameters(), learning_rate=0.0),
+                  loss=lambda o, y: P.mean((o - y) ** 2))
+        es = EarlyStopping(monitor="loss", patience=1, min_delta=1e-9)
+        hist = m.fit(DS(), batch_size=8, epochs=10, verbose=0, callbacks=[es])
+        # zero LR -> loss never improves -> stops after ~2-3 epochs, not 10
+        assert len(hist["loss"]) < 10
+
+    def test_lr_scheduler_callback_steps(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.hapi import Model
+        from paddle_tpu.hapi.callbacks import LRScheduler as LRCb
+
+        class DS:
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                return np.zeros(4, np.float32), np.zeros(1, np.float32)
+
+        net = nn.Linear(4, 1)
+        sched = P.optimizer.lr.StepDecay(learning_rate=0.1, step_size=2, gamma=0.5)
+        opt = P.optimizer.SGD(parameters=net.parameters(), learning_rate=sched)
+        m = Model(net)
+        m.prepare(opt, loss=lambda o, y: P.mean((o - y) ** 2))
+        m.fit(DS(), batch_size=4, epochs=1, verbose=0, callbacks=[LRCb(by_step=True)])
+        assert sched.last_lr < 0.1  # stepped twice -> decayed at step 4
+
+
+class TestASP:
+    def test_prune_model_2of4(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.incubate import asp
+
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        asp.prune_model(net)
+        w = np.asarray(net[0].weight._value)
+        assert abs(asp.calculate_density(net[0].weight) - 0.5) < 1e-6
+        # every group of 4 along rows has exactly 2 nonzeros
+        groups = w.reshape(-1)[: (w.size // 4) * 4].reshape(-1, 4)
+        assert ((groups != 0).sum(1) == 2).all()
+
+    def test_decorated_optimizer_keeps_sparsity(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.incubate import asp
+
+        net = nn.Linear(8, 8)
+        masks = asp.prune_model(net)
+        assert masks
+        opt = asp.decorate(P.optimizer.SGD(parameters=net.parameters(), learning_rate=0.1))
+        x = P.to_tensor(np.random.randn(4, 8).astype(np.float32))
+        for _ in range(3):
+            loss = P.mean(net(x) ** 2)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert abs(asp.calculate_density(net.weight) - 0.5) < 1e-6
+
+    def test_excluded_layers(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.incubate import asp
+
+        net = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 8))
+        asp.set_excluded_layers(net, ["0"])
+        asp.prune_model(net)
+        asp.reset_excluded_layers(net)
+        assert asp.calculate_density(net[0].weight) == 1.0
+        assert abs(asp.calculate_density(net[1].weight) - 0.5) < 1e-6

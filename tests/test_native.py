@@ -1,0 +1,104 @@
+"""Native shm queue + DataLoader shared-memory transport tests
+(SURVEY §2.1: MemoryMapAllocation / shm DataLoader IPC analog)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu import native
+
+
+pytestmark = pytest.mark.skipif(not native.shm_queue_available(),
+                                reason=f"no native toolchain: {native.build_error()}")
+
+
+class TestShmQueue:
+    def test_roundtrip(self):
+        q = native.ShmQueue("/pq_t_rt", slot_size=1 << 20, n_slots=4)
+        try:
+            arrs = [np.random.randn(16, 8).astype(np.float32),
+                    np.arange(5, dtype=np.int64)]
+            q.push(native.encode_batch(arrs), seq=3)
+            seq, buf = q.pop()
+            assert seq == 3
+            back = native.decode_batch(buf)
+            np.testing.assert_array_equal(back[0], arrs[0])
+            np.testing.assert_array_equal(back[1], arrs[1])
+        finally:
+            q.close()
+
+    def test_pop_timeout(self):
+        q = native.ShmQueue("/pq_t_to", slot_size=1024, n_slots=2)
+        try:
+            assert q.pop(timeout_ms=50) is None
+        finally:
+            q.close()
+
+    def test_oversize_payload_raises(self):
+        q = native.ShmQueue("/pq_t_big", slot_size=64, n_slots=2)
+        try:
+            with pytest.raises(ValueError, match="slot size"):
+                q.push(b"x" * 128, seq=0)
+        finally:
+            q.close()
+
+    def test_ring_wraps(self):
+        q = native.ShmQueue("/pq_t_wrap", slot_size=256, n_slots=2)
+        try:
+            for i in range(6):  # 3x the slot count
+                q.push(np.uint64(i).tobytes(), seq=i)
+                seq, buf = q.pop()
+                assert seq == i
+        finally:
+            q.close()
+
+    def test_cross_process(self):
+        import multiprocessing as mp
+
+        q = native.ShmQueue("/pq_t_xp", slot_size=1 << 16, n_slots=4)
+
+        def child(name):
+            from paddle_tpu import native as nv
+
+            q2 = nv.ShmQueue(name, create=False)
+            for i in range(8):
+                q2.push(nv.encode_batch([np.full((3,), i, np.float32)]), seq=i)
+            q2.close()
+
+        p = mp.get_context("fork").Process(target=child, args=("/pq_t_xp",))
+        p.start()
+        got = sorted(q.pop()[0] for _ in range(8))
+        p.join()
+        q.close()
+        assert got == list(range(8))
+
+
+class _DS:
+    def __len__(self):
+        return 24
+
+    def __getitem__(self, i):
+        return np.full((4,), float(i), np.float32), np.int64(i)
+
+
+class TestDataLoaderShmTransport:
+    def test_loader_uses_shm(self):
+        from paddle_tpu.io import DataLoader
+
+        dl = DataLoader(_DS(), batch_size=4, num_workers=2, use_shared_memory=True)
+        it = iter(dl)
+        assert it._shm is not None  # native transport active
+        seen = []
+        for xb, yb in it:
+            seen.extend(np.asarray(yb._value).tolist())
+        assert seen == list(range(24))
+
+    def test_loader_without_shm_matches(self):
+        from paddle_tpu.io import DataLoader
+
+        dl = DataLoader(_DS(), batch_size=4, num_workers=2, use_shared_memory=False)
+        it = iter(dl)
+        assert it._shm is None
+        seen = []
+        for xb, yb in it:
+            seen.extend(np.asarray(yb._value).tolist())
+        assert seen == list(range(24))

@@ -25,31 +25,45 @@ def calculate_density(x) -> float:
     return float(np.count_nonzero(arr)) / max(arr.size, 1)
 
 
-def _mask_2on4_1d(flat: np.ndarray) -> np.ndarray:
-    """Keep the 2 largest-|w| of every 4 consecutive weights."""
-    n = flat.size
-    pad = (-n) % 4
-    v = np.abs(np.concatenate([flat, np.zeros(pad, flat.dtype)])).reshape(-1, 4)
+def _mask_n_of_m_1d(flat: np.ndarray, n: int, m: int) -> np.ndarray:
+    """Keep the n largest-|w| of every m consecutive weights."""
+    sz = flat.size
+    pad = (-sz) % m
+    v = np.abs(np.concatenate([flat, np.zeros(pad, flat.dtype)])).reshape(-1, m)
     order = np.argsort(-v, axis=1)
     mask = np.zeros_like(v, dtype=bool)
     rows = np.arange(v.shape[0])[:, None]
-    mask[rows, order[:, :2]] = True
-    return mask.reshape(-1)[:n]
+    mask[rows, order[:, :n]] = True
+    return mask.reshape(-1)[:sz]
 
 
 def _compute_mask(w: np.ndarray, n: int = 2, m: int = 4) -> np.ndarray:
     if w.ndim < 2:
         return np.ones_like(w, dtype=bool)
     flat = w.reshape(-1, w.shape[-1])
-    # 2:4 along the input (reduction) dimension, row-major groups
-    return np.stack([_mask_2on4_1d(row) for row in flat]).reshape(w.shape)
+    # n:m along the input (reduction) dimension, row-major groups
+    return np.stack([_mask_n_of_m_1d(row, n, m) for row in flat]).reshape(w.shape)
 
 
 class ASPHelper:
-    """Per-model mask registry (reference asp/asp.py ASPHelper)."""
+    """Per-model exclusion registry; masks live ON the parameter
+    (``_optimize_attrs``), so nothing leaks or collides on id() reuse
+    (reference asp/asp.py ASPHelper)."""
 
-    _excluded: Dict[int, set] = {}
-    _masks: Dict[int, np.ndarray] = {}
+    import weakref as _weakref
+
+    _excluded: "ASPHelper._weakref.WeakKeyDictionary" = _weakref.WeakKeyDictionary()
+
+    @staticmethod
+    def get_mask(p):
+        attrs = getattr(p, "_optimize_attrs", None)
+        return attrs.get("asp_mask") if attrs else None
+
+    @staticmethod
+    def set_mask(p, mask):
+        if p._optimize_attrs is None:
+            p._optimize_attrs = {}
+        p._optimize_attrs["asp_mask"] = mask
 
     @classmethod
     def is_supported(cls, layer) -> bool:
@@ -60,7 +74,7 @@ class ASPHelper:
     @classmethod
     def prunable_params(cls, model) -> List:
         out = []
-        excluded = cls._excluded.get(id(model), set())
+        excluded = cls._excluded.get(model, set())
         layers = [("", model)] if cls.is_supported(model) else list(_walk(model))
         for name, layer in layers:
             if not cls.is_supported(layer) or name in excluded:
@@ -79,14 +93,14 @@ def _walk(layer, prefix=""):
 
 
 def set_excluded_layers(model, layer_names: List[str]):
-    ASPHelper._excluded.setdefault(id(model), set()).update(layer_names)
+    ASPHelper._excluded.setdefault(model, set()).update(layer_names)
 
 
 def reset_excluded_layers(model=None):
     if model is None:
         ASPHelper._excluded.clear()
     else:
-        ASPHelper._excluded.pop(id(model), None)
+        ASPHelper._excluded.pop(model, None)
 
 
 def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
@@ -99,7 +113,7 @@ def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
         mask = _compute_mask(w, n, m)
         p.set_value((w * mask).astype(w.dtype))
         if with_mask:
-            ASPHelper._masks[id(p)] = mask
+            ASPHelper.set_mask(p, mask)
             masks[p.name] = mask
     return masks
 
@@ -116,10 +130,7 @@ class _ASPOptimizer:
 
     def step(self, *args, **kwargs):
         out = self._inner.step(*args, **kwargs)
-        for p in self._inner._parameter_list:
-            mask = ASPHelper._masks.get(id(p))
-            if mask is not None:
-                p._value = p._value * jnp.asarray(mask, p._value.dtype)
+        self.step_masks_only()
         return out
 
     def minimize(self, loss, *args, **kwargs):
@@ -129,7 +140,7 @@ class _ASPOptimizer:
 
     def step_masks_only(self):
         for p in self._inner._parameter_list:
-            mask = ASPHelper._masks.get(id(p))
+            mask = ASPHelper.get_mask(p)
             if mask is not None:
                 p._value = p._value * jnp.asarray(mask, p._value.dtype)
 

@@ -48,6 +48,29 @@ def default_collate_fn(batch):
     return batch
 
 
+class _ProbeBigButFine(Exception):
+    pass
+
+
+def _probe_picklable(obj, cap: int = 1 << 20):
+    """Raise if ``obj`` is unpicklable; succeed early (without serializing
+    everything) once ``cap`` bytes prove it pickles fine so far."""
+
+    class _Sink:
+        def __init__(self):
+            self.n = 0
+
+        def write(self, b):
+            self.n += len(b)
+            if self.n > cap:
+                raise _ProbeBigButFine
+
+    try:
+        pickle.Pickler(_Sink()).dump(obj)
+    except _ProbeBigButFine:
+        pass
+
+
 def _to_tensor(obj):
     if isinstance(obj, Tensor):
         return obj
@@ -387,18 +410,18 @@ class DataLoader:
             return _IterableIter(self)
         if self.num_workers > 0:
             # fork inherits the dataset without pickling; a spawn-only
-            # platform pickles for real, so probe only the cheap proxies
-            # (class + collate_fn), never the dataset payload
+            # platform pickles for real, so probe the instance — but cap the
+            # probe at 1MB so a huge in-memory dataset isn't serialized twice
             if "fork" in mp.get_all_start_methods():
                 return _ProcessIter(self)
             try:
-                pickle.dumps(type(self.dataset))
+                _probe_picklable(self.dataset)
                 if self.collate_fn is not None:
-                    pickle.dumps(self.collate_fn)
+                    _probe_picklable(self.collate_fn)
                 return _ProcessIter(self)
             except Exception as e:
                 warnings.warn(
-                    f"DataLoader: dataset class/collate_fn not picklable ({e}); "
+                    f"DataLoader: dataset/collate_fn not picklable ({e}); "
                     "falling back to thread workers")
         return _PrefetchIter(self)
 

@@ -190,8 +190,11 @@ class StaticFunction:
         compile cache to O(log max_batch) entries instead of one per batch
         size. OPT-IN because padding asserts batch-row independence: models
         with cross-batch coupling (train-mode BatchNorm, in-graph
-        mean-over-batch losses) would see the zero rows. Without the flag,
-        dynamic dims compile per exact shape — always correct."""
+        mean-over-batch losses) would see the zero rows, and every output
+        whose LEADING dim equals the padded batch is treated as batch-major
+        and sliced (an aux output that coincidentally matches is truncated).
+        Without the flag, dynamic dims compile per exact shape — always
+        correct."""
         if not self._input_spec or not self._bucket_dynamic_batch:
             return None
         dyn = []

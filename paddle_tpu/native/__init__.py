@@ -119,6 +119,9 @@ class ShmQueue:
         if not self._h:
             raise RuntimeError(f"shm_queue {'create' if create else 'open'} failed for {name}")
         self.slot_size = lib.shmq_slot_size(self._h)
+        # one reusable receive buffer — pop() runs in a poll loop and must
+        # not allocate+memset slot_size bytes per call
+        self._rx = ctypes.create_string_buffer(int(self.slot_size))
 
     def push(self, payload: bytes, seq: int, timeout_ms: int = -1) -> bool:
         rc = self._lib.shmq_push(self._h, payload, len(payload), seq, timeout_ms)
@@ -128,15 +131,15 @@ class ShmQueue:
         return rc == 0
 
     def pop(self, timeout_ms: int = -1):
-        """-> (seq, bytes) or None on timeout."""
-        buf = ctypes.create_string_buffer(int(self.slot_size))
+        """-> (seq, memoryview) or None on timeout. The view aliases the
+        shared receive buffer: consume it before the next pop()."""
         seq = ctypes.c_uint64()
-        n = self._lib.shmq_pop(self._h, buf, self.slot_size, ctypes.byref(seq), timeout_ms)
+        n = self._lib.shmq_pop(self._h, self._rx, self.slot_size, ctypes.byref(seq), timeout_ms)
         if n == 0:
             return None
         if n < 0:
             raise RuntimeError("shm_queue pop failed")
-        return int(seq.value), memoryview(buf)[:n]
+        return int(seq.value), memoryview(self._rx)[:n]
 
     def close(self):
         if self._h:

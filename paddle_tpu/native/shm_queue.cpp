@@ -181,15 +181,16 @@ int64_t shmq_pop(void* handle, void* out, uint64_t cap, uint64_t* seq_out,
     }
   }
   if (lock_robust(&h->mu) != 0) return -1;
-  uint32_t i = h->head;
-  h->head = (h->head + 1) % h->n_slots;
-  Slot* s = reinterpret_cast<Slot*>(slot_at(h, i));
+  Slot* s = reinterpret_cast<Slot*>(slot_at(h, h->head));
   uint64_t len = s->len;
   if (len > cap) {
+    // head NOT advanced: the slot stays at the front for a retry with a
+    // bigger buffer, and its filled token is returned
     pthread_mutex_unlock(&h->mu);
-    sem_post(&h->filled_slots);  // leave it for a retry with a bigger buffer
+    sem_post(&h->filled_slots);
     return -1;
   }
+  h->head = (h->head + 1) % h->n_slots;
   *seq_out = s->seq;
   memcpy(out, reinterpret_cast<char*>(s) + sizeof(Slot), len);
   pthread_mutex_unlock(&h->mu);

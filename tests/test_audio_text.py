@@ -243,3 +243,11 @@ class TestASP:
         asp.reset_excluded_layers(net)
         assert asp.calculate_density(net[0].weight) == 1.0
         assert abs(asp.calculate_density(net[1].weight) - 0.5) < 1e-6
+
+    def test_asp_custom_nm(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.incubate import asp
+
+        net = nn.Linear(8, 8)
+        asp.prune_model(net, n=1, m=4)
+        assert abs(asp.calculate_density(net.weight) - 0.25) < 1e-6

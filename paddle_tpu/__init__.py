@@ -28,6 +28,8 @@ else:
 from .framework import (  # noqa: F401
     bfloat16,
     bool_,
+    float8_e4m3fn,
+    float8_e5m2,
     complex64,
     complex128,
     float16,
@@ -89,6 +91,13 @@ except ModuleNotFoundError:
     pass
 
 from .base.param_attr import ParamAttr  # noqa: F401
+
+bool = bool_  # noqa: A001  (paddle exports the dtype as paddle.bool)
+
+
+def tolist(x):
+    """paddle.tolist parity."""
+    return x.tolist() if hasattr(x, "tolist") else list(x)
 
 try:
     from .hapi import Model, summary  # noqa: F401

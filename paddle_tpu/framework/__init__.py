@@ -3,6 +3,8 @@ from .dtype import (  # noqa: F401
     DType,
     bfloat16,
     bool_,
+    float8_e4m3fn,
+    float8_e5m2,
     complex128,
     complex64,
     convert_dtype,

@@ -94,6 +94,14 @@ float64 = DType("float64", np.float64)
 complex64 = DType("complex64", np.complex64)
 complex128 = DType("complex128", np.complex128)
 
+try:  # fp8 tier (reference: phi float8_e4m3fn/e5m2 types)
+    import ml_dtypes as _mld
+
+    float8_e4m3fn = DType("float8_e4m3fn", _mld.float8_e4m3fn)
+    float8_e5m2 = DType("float8_e5m2", _mld.float8_e5m2)
+except ImportError:  # pragma: no cover
+    float8_e4m3fn = float8_e5m2 = None
+
 # canonical aliases accepted from user code
 _ALIASES = {
     "bool": "bool",
@@ -113,6 +121,8 @@ _ALIASES = {
     "double": "float64",
     "complex64": "complex64",
     "complex128": "complex128",
+    "float8_e4m3fn": "float8_e4m3fn",
+    "float8_e5m2": "float8_e5m2",
 }
 
 

@@ -119,9 +119,7 @@ class ShmQueue:
         if not self._h:
             raise RuntimeError(f"shm_queue {'create' if create else 'open'} failed for {name}")
         self.slot_size = lib.shmq_slot_size(self._h)
-        # one reusable receive buffer — pop() runs in a poll loop and must
-        # not allocate+memset slot_size bytes per call
-        self._rx = ctypes.create_string_buffer(int(self.slot_size))
+        self._rx = None  # lazily allocated: push-only workers never pay for it
 
     def push(self, payload: bytes, seq: int, timeout_ms: int = -1) -> bool:
         rc = self._lib.shmq_push(self._h, payload, len(payload), seq, timeout_ms)
@@ -133,6 +131,10 @@ class ShmQueue:
     def pop(self, timeout_ms: int = -1):
         """-> (seq, memoryview) or None on timeout. The view aliases the
         shared receive buffer: consume it before the next pop()."""
+        if self._rx is None:
+            # one reusable receive buffer — pop() runs in a poll loop and
+            # must not allocate+memset slot_size bytes per call
+            self._rx = ctypes.create_string_buffer(int(self.slot_size))
         seq = ctypes.c_uint64()
         n = self._lib.shmq_pop(self._h, self._rx, self.slot_size, ctypes.byref(seq), timeout_ms)
         if n == 0:

@@ -11,10 +11,11 @@ import jax.numpy as jnp
 from .tensor import Tensor
 from ..ops.dispatch import apply
 from ._helpers import unary
-from . import creation, einsum as einsum_mod, linalg, logic, manipulation, math, random, search, stat
+from . import creation, einsum as einsum_mod, extras, linalg, logic, manipulation, math, random, search, stat
 
 # re-export everything into paddle_tpu.tensor namespace
 from .creation import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
@@ -23,6 +24,83 @@ from .random import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
 from .stat import *  # noqa: F401,F403
 from .einsum import einsum  # noqa: F401
+
+
+def add_n(inputs, name=None):
+    """Sum a list of tensors (paddle.add_n)."""
+    import functools as _ft
+    import operator as _op
+
+    ts = [t if isinstance(t, Tensor) else Tensor(jnp.asarray(t)) for t in
+          (inputs if isinstance(inputs, (list, tuple)) else [inputs])]
+    return apply(lambda *vs: _ft.reduce(_op.add, vs), *ts, op_name="add_n")
+
+
+# ------------------------------------------------------- in-place alias tail
+# every `<op>_` the reference exports whose base op exists here gets the
+# standard compute-then-adopt in-place form (math._make_inplace pattern)
+_INPLACE_TAIL = [
+    "acos", "addmm", "atan", "bitwise_and", "bitwise_not", "bitwise_or",
+    "bitwise_xor", "bitwise_left_shift", "bitwise_right_shift", "copysign",
+    "cos", "cumprod", "cumsum", "digamma", "equal", "erf", "expm1",
+    "floor_divide", "floor_mod", "frac", "gammainc", "gammaincc", "gammaln",
+    "gcd", "greater_equal", "greater_than", "hypot", "i0", "lcm", "ldexp",
+    "less_equal", "less_than", "lgamma", "log", "log2", "log10", "logical_and",
+    "logical_not", "logical_or", "logit", "masked_fill", "masked_scatter",
+    "mod", "multigammaln", "nan_to_num", "polygamma", "renorm", "sin", "sinc",
+    "sinh", "square", "t", "tan", "transpose", "trunc",
+]
+
+
+def _make_inplace_tail():
+    g = globals()
+    made = []
+    for base in _INPLACE_TAIL:
+        fn = g.get(base)
+        if fn is None or f"{base}_" in g:
+            continue
+
+        def op_(x, *args, _fn=fn, **kwargs):
+            return x._inplace_adopt(_fn(x, *args, **kwargs))
+
+        op_.__name__ = f"{base}_"
+        g[f"{base}_"] = op_
+        made.append(f"{base}_")
+    return made
+
+
+_made_inplace = _make_inplace_tail()
+
+
+def where_(condition, x, y, name=None):
+    """In-place on ``x`` (paddle.where_ semantics — NOT on the condition)."""
+    from .search import where as _where
+
+    return x._inplace_adopt(_where(condition, x, y))
+
+
+def bernoulli_(x, p=0.5, name=None):
+    """In-place bernoulli fill (paddle.bernoulli_)."""
+    from ..framework.random import default_generator
+
+    import jax
+
+    key = default_generator().next_key()
+    x._value = jax.random.bernoulli(key, p, x._value.shape).astype(x._value.dtype)
+    x._version += 1
+    return x
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    from ..framework.random import default_generator
+
+    import jax
+
+    key = default_generator().next_key()
+    x._value = jnp.exp(
+        mean + std * jax.random.normal(key, x._value.shape)).astype(x._value.dtype)
+    x._version += 1
+    return x
 
 
 def real(x, name=None):

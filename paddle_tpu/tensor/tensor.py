@@ -271,6 +271,16 @@ class Tensor:
 
     # ---------------- inplace machinery ----------------
     def _inplace_adopt(self, result: "Tensor") -> "Tensor":
+        node = result._grad_node
+        if node is not None and any(t is self for t in node.inputs):
+            # in-place op over a taped tensor: the node must reference the
+            # PRE-update value/history, not the object being overwritten
+            # (else backward loops through the node into itself)
+            old = Tensor(self._value, stop_gradient=self.stop_gradient)
+            old._grad_node = self._grad_node
+            old._out_index = self._out_index
+            old._hooks = self._hooks
+            node.inputs = [old if t is self else t for t in node.inputs]
         self._value = result._value
         self._grad_node = result._grad_node
         self._out_index = result._out_index

@@ -1,0 +1,235 @@
+"""Op-tail (tensor.extras + in-place alias tier) tests — closes the
+paddle.__init__ export surface to 0 missing of 409."""
+import re
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+
+
+RNG = np.random.RandomState(13)
+
+
+def _v(t):
+    return np.asarray(t._value)
+
+
+class TestNamespaceComplete:
+    def test_zero_missing_vs_reference_exports(self):
+        import os
+
+        ref_init = "/root/reference/python/paddle/__init__.py"
+        if not os.path.exists(ref_init):
+            pytest.skip("reference tree not mounted")
+        names = set(re.findall(r"^\s+'([a-z_0-9]+)',\s*$", open(ref_init).read(), re.M))
+        missing = sorted(n for n in names if not hasattr(P, n))
+        assert missing == [], f"missing exports: {missing}"
+
+
+class TestConstructions:
+    def test_block_diag(self):
+        out = _v(P.block_diag([np.eye(2, dtype=np.float32), 3 * np.eye(3, dtype=np.float32)]))
+        assert out.shape == (5, 5)
+        np.testing.assert_allclose(out[:2, :2], np.eye(2))
+        np.testing.assert_allclose(out[2:, 2:], 3 * np.eye(3))
+        assert out[:2, 2:].sum() == 0
+
+    def test_cartesian_prod_combinations(self):
+        cp = _v(P.cartesian_prod([np.array([1.0, 2.0]), np.array([3.0, 4.0])]))
+        assert cp.shape == (4, 2)
+        cb = _v(P.combinations(P.to_tensor(np.array([1.0, 2.0, 3.0]))))
+        assert cb.shape == (3, 2)
+
+    def test_vander(self):
+        out = _v(P.vander(P.to_tensor(np.array([1.0, 2.0, 3.0])), 3))
+        np.testing.assert_allclose(out, np.vander([1, 2, 3], 3))
+
+    def test_column_row_stack(self):
+        a, b = np.arange(3, dtype=np.float32), np.arange(3, 6).astype(np.float32)
+        np.testing.assert_allclose(_v(P.column_stack([a, b])), np.column_stack([a, b]))
+        np.testing.assert_allclose(_v(P.row_stack([a, b])), np.vstack([a, b]))
+
+
+class TestDistances:
+    def test_cdist_matches_scipy(self):
+        from scipy.spatial.distance import cdist as sp_cdist
+
+        x = RNG.randn(5, 3).astype(np.float32)
+        y = RNG.randn(4, 3).astype(np.float32)
+        np.testing.assert_allclose(_v(P.cdist(P.to_tensor(x), P.to_tensor(y))),
+                                   sp_cdist(x, y), rtol=1e-4, atol=1e-5)
+
+    def test_pdist_matches_scipy(self):
+        from scipy.spatial.distance import pdist as sp_pdist
+
+        x = RNG.randn(6, 3).astype(np.float32)
+        np.testing.assert_allclose(_v(P.pdist(P.to_tensor(x))), sp_pdist(x),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_cdist_grad(self):
+        x = P.to_tensor(RNG.randn(4, 3).astype(np.float32))
+        x.stop_gradient = False
+        P.sum(P.cdist(x, P.to_tensor(RNG.randn(3, 3).astype(np.float32)))).backward()
+        assert x.grad is not None and np.isfinite(_v(x.grad)).all()
+
+
+class TestCumulativeAndScatter:
+    def test_cummin(self):
+        v, i = P.cummin(P.to_tensor(np.array([3.0, 1.0, 2.0, 0.5])))
+        np.testing.assert_allclose(_v(v), [3, 1, 1, 0.5])
+        assert _v(i).tolist() == [0, 1, 1, 3]
+
+    def test_trapezoid(self):
+        y = np.array([1.0, 2.0, 3.0], np.float32)
+        np.testing.assert_allclose(float(_v(P.trapezoid(P.to_tensor(y)))), np.trapezoid(y))
+        ct = _v(P.cumulative_trapezoid(P.to_tensor(y)))
+        np.testing.assert_allclose(ct, [1.5, 4.0])
+
+    def test_diagonal_scatter(self):
+        x = np.zeros((3, 3), np.float32)
+        out = _v(P.diagonal_scatter(P.to_tensor(x), P.to_tensor(np.array([1.0, 2.0, 3.0]))))
+        np.testing.assert_allclose(np.diag(out), [1, 2, 3])
+
+    def test_slice_scatter(self):
+        x = np.zeros((4, 4), np.float32)
+        v = np.ones((2, 4), np.float32)
+        out = _v(P.slice_scatter(P.to_tensor(x), P.to_tensor(v), [0], [1], [3], [1]))
+        np.testing.assert_allclose(out[1:3], 1.0)
+        assert out[0].sum() == 0 and out[3].sum() == 0
+
+    def test_as_strided(self):
+        x = np.arange(12, dtype=np.float32)
+        out = _v(P.as_strided(P.to_tensor(x), [3, 4], [4, 1]))
+        np.testing.assert_allclose(out, x.reshape(3, 4))
+        # overlapping windows
+        win = _v(P.as_strided(P.to_tensor(x), [5, 4], [2, 1]))
+        np.testing.assert_allclose(win[1], x[2:6])
+
+    def test_unflatten(self):
+        x = P.to_tensor(RNG.randn(2, 12).astype(np.float32))
+        assert P.unflatten(x, 1, [3, 4]).shape == [2, 3, 4]
+        assert P.unflatten(x, 1, [-1, 4]).shape == [2, 3, 4]
+
+
+class TestSpecialFunctions:
+    def test_bessel_vs_scipy(self):
+        import scipy.special as sp
+
+        x = np.abs(RNG.randn(8)).astype(np.float32) + 0.1
+        np.testing.assert_allclose(_v(P.i0e(P.to_tensor(x))), sp.i0e(x), rtol=1e-4)
+        np.testing.assert_allclose(_v(P.i1(P.to_tensor(x))), sp.i1(x), rtol=1e-4)
+        np.testing.assert_allclose(_v(P.i1e(P.to_tensor(x))), sp.i1e(x), rtol=1e-4)
+
+    def test_gamma_family(self):
+        import scipy.special as sp
+
+        x = np.abs(RNG.randn(6)).astype(np.float32) + 0.5
+        y = np.abs(RNG.randn(6)).astype(np.float32) + 0.5
+        np.testing.assert_allclose(_v(P.gammaln(P.to_tensor(x))), sp.gammaln(x), rtol=1e-4)
+        np.testing.assert_allclose(_v(P.gammainc(P.to_tensor(x), P.to_tensor(y))),
+                                   sp.gammainc(x, y), rtol=1e-3, atol=1e-5)
+        np.testing.assert_allclose(_v(P.gammaincc(P.to_tensor(x), P.to_tensor(y))),
+                                   sp.gammaincc(x, y), rtol=1e-3, atol=1e-5)
+        xm = x + 1.5  # multigammaln domain: a > (p-1)/2
+        np.testing.assert_allclose(_v(P.multigammaln(P.to_tensor(xm), 3)),
+                                   sp.multigammaln(xm, 3), rtol=1e-4)
+
+    def test_polygamma(self):
+        import scipy.special as sp
+
+        x = np.abs(RNG.randn(5)).astype(np.float32) + 1.0
+        np.testing.assert_allclose(_v(P.polygamma(P.to_tensor(x), 1)),
+                                   sp.polygamma(1, x), rtol=1e-3)
+
+    def test_frexp_signbit(self):
+        x = np.array([8.0, -3.0, 0.5], np.float32)
+        m, e = P.frexp(P.to_tensor(x))
+        np.testing.assert_allclose(_v(m) * 2.0 ** _v(e), x)
+        assert _v(P.signbit(P.to_tensor(x))).tolist() == [False, True, False]
+
+
+class TestAlgebraAndMeta:
+    def test_renorm(self):
+        x = RNG.randn(4, 8).astype(np.float32) * 3
+        out = _v(P.renorm(P.to_tensor(x), 2.0, 0, 1.0))
+        assert (np.linalg.norm(out, axis=1) <= 1.0001).all()
+
+    def test_reduce_as(self):
+        x = P.to_tensor(RNG.randn(4, 3).astype(np.float32))
+        t = P.to_tensor(np.zeros((1, 3), np.float32))
+        np.testing.assert_allclose(_v(P.reduce_as(x, t)), _v(x).sum(0, keepdims=True),
+                                   rtol=1e-5)
+
+    def test_rank_shape_isin(self):
+        x = P.to_tensor(RNG.randn(2, 5).astype(np.float32))
+        assert int(_v(P.rank(x))) == 2
+        assert _v(P.shape(x)).tolist() == [2, 5]
+        out = _v(P.isin(P.to_tensor(np.array([1, 2, 3])), P.to_tensor(np.array([2]))))
+        assert out.tolist() == [False, True, False]
+
+    def test_finfo_iinfo_predicates(self):
+        assert P.finfo(P.float32).bits == 32
+        assert P.iinfo(P.int8).max == 127
+        x = P.to_tensor(np.zeros(2, np.float32))
+        assert P.is_floating_point(x) and not P.is_integer(x) and not P.is_complex(x)
+
+    def test_histogramdd(self):
+        x = RNG.randn(100, 2).astype(np.float32)
+        hist, edges = P.histogramdd(P.to_tensor(x), bins=5)
+        assert _v(hist).shape == (5, 5) and len(edges) == 2
+        assert _v(hist).sum() == 100
+
+    def test_add_n(self):
+        a = P.to_tensor(np.ones(3, np.float32))
+        out = P.add_n([a, a, a])
+        np.testing.assert_allclose(_v(out), 3.0)
+
+
+class TestInplaceTail:
+    def test_inplace_math(self):
+        x = P.to_tensor(np.array([1.0, 2.0], np.float32))
+        P.sin_(x)
+        np.testing.assert_allclose(_v(x), np.sin([1, 2]), rtol=1e-6)
+        P.square_(x)
+        np.testing.assert_allclose(_v(x), np.sin([1, 2]) ** 2, rtol=1e-6)
+
+    def test_inplace_preserves_identity_and_grad(self):
+        x = P.to_tensor(np.array([2.0], np.float32))
+        x.stop_gradient = False
+        y = x * 3.0
+        P.log_(y)
+        y.backward()
+        np.testing.assert_allclose(float(_v(x.grad)), 1.0 / 2.0, rtol=1e-5)
+
+    def test_bernoulli_and_lognormal_fill(self):
+        from paddle_tpu.tensor import bernoulli_, log_normal_
+
+        P.seed(0)
+        x = P.to_tensor(np.zeros(1000, np.float32))
+        bernoulli_(x, p=0.3)
+        assert abs(float(_v(x).mean()) - 0.3) < 0.06
+        log_normal_(x, mean=0.0, std=0.25)
+        assert abs(np.log(_v(x)).mean()) < 0.1
+
+
+class TestReviewRegressions:
+    def test_shard_index_ceil_division(self):
+        out = _v(P.shard_index(P.to_tensor(np.array([19], np.int64)),
+                               index_num=20, nshards=3, shard_id=2))
+        assert out.tolist() == [5]  # shard_size = ceil(20/3) = 7; 19 // 7 == 2
+
+    def test_cummin_first_occurrence_on_ties(self):
+        v, i = P.cummin(P.to_tensor(np.array([2.0, 1.0, 1.0])))
+        assert _v(i).tolist() == [0, 1, 1]
+
+    def test_where_inplace_on_x(self):
+        c = P.to_tensor(np.array([True, False]))
+        x = P.to_tensor(np.array([1.0, 2.0], np.float32))
+        y = P.to_tensor(np.array([8.0, 9.0], np.float32))
+        from paddle_tpu.tensor import where_
+
+        out = where_(c, x, y)
+        assert out is x
+        np.testing.assert_allclose(_v(x), [1.0, 9.0])
+        assert _v(c).dtype == np.bool_  # condition untouched

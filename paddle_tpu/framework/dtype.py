@@ -121,9 +121,11 @@ _ALIASES = {
     "double": "float64",
     "complex64": "complex64",
     "complex128": "complex128",
-    "float8_e4m3fn": "float8_e4m3fn",
-    "float8_e5m2": "float8_e5m2",
 }
+
+if float8_e4m3fn is not None:
+    _ALIASES["float8_e4m3fn"] = "float8_e4m3fn"
+    _ALIASES["float8_e5m2"] = "float8_e5m2"
 
 
 def convert_dtype(d) -> DType:

@@ -53,18 +53,15 @@ _INPLACE_TAIL = [
 
 
 def _make_inplace_tail():
+    from .math import _make_inplace
+
     g = globals()
     made = []
     for base in _INPLACE_TAIL:
         fn = g.get(base)
         if fn is None or f"{base}_" in g:
             continue
-
-        def op_(x, *args, _fn=fn, **kwargs):
-            return x._inplace_adopt(_fn(x, *args, **kwargs))
-
-        op_.__name__ = f"{base}_"
-        g[f"{base}_"] = op_
+        g[f"{base}_"] = _make_inplace(fn, base)
         made.append(f"{base}_")
     return made
 
@@ -87,6 +84,7 @@ def bernoulli_(x, p=0.5, name=None):
 
     key = default_generator().next_key()
     x._value = jax.random.bernoulli(key, p, x._value.shape).astype(x._value.dtype)
+    x._grad_node = None  # value destroyed: no gradient path survives the fill
     x._version += 1
     return x
 
@@ -99,6 +97,7 @@ def log_normal_(x, mean=1.0, std=2.0, name=None):
     key = default_generator().next_key()
     x._value = jnp.exp(
         mean + std * jax.random.normal(key, x._value.shape)).astype(x._value.dtype)
+    x._grad_node = None  # value destroyed: no gradient path survives the fill
     x._version += 1
     return x
 

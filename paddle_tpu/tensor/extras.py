@@ -198,9 +198,10 @@ def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
         rows, cols = rows[:n], cols[:n]
         if axis1 == 0 and axis2 == 1:
             return xv.at[rows, cols].set(yv)
-        perm = list(range(xv.ndim))
-        perm[0], perm[axis1] = perm[axis1], perm[0]
-        perm[1], perm[axis2] = perm[axis2], perm[1]
+        # bring (axis1, axis2) to the front without the two-swap alias bug:
+        # build the permutation wholesale
+        rest = [d for d in range(xv.ndim) if d not in (axis1, axis2)]
+        perm = [axis1, axis2] + rest
         moved = jnp.transpose(xv, perm)
         moved = moved.at[rows, cols].set(yv)
         return jnp.transpose(moved, np.argsort(perm))

@@ -1,0 +1,92 @@
+"""DenseNet (parity: vision/models/densenet.py)."""
+from __future__ import annotations
+
+from ... import nn
+from ...tensor.manipulation import concat, flatten
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169", "densenet201",
+           "densenet264"]
+
+_CFG = {
+    121: (64, 32, (6, 12, 24, 16)),
+    161: (96, 48, (6, 12, 36, 24)),
+    169: (64, 32, (6, 12, 32, 32)),
+    201: (64, 32, (6, 12, 48, 32)),
+    264: (64, 32, (6, 12, 64, 48)),
+}
+
+
+class DenseLayer(nn.Layer):
+    def __init__(self, inp, growth, bn_size=4, dropout=0.0):
+        super().__init__()
+        self.norm1 = nn.BatchNorm2D(inp)
+        self.relu = nn.ReLU()
+        self.conv1 = nn.Conv2D(inp, bn_size * growth, 1, bias_attr=False)
+        self.norm2 = nn.BatchNorm2D(bn_size * growth)
+        self.conv2 = nn.Conv2D(bn_size * growth, growth, 3, padding=1, bias_attr=False)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.norm1(x)))
+        out = self.conv2(self.relu(self.norm2(out)))
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return concat([x, out], axis=1)
+
+
+class Transition(nn.Layer):
+    def __init__(self, inp, out):
+        super().__init__()
+        self.norm = nn.BatchNorm2D(inp)
+        self.relu = nn.ReLU()
+        self.conv = nn.Conv2D(inp, out, 1, bias_attr=False)
+        self.pool = nn.AvgPool2D(2, stride=2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.norm(x))))
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        init_c, growth, blocks = _CFG[layers]
+        self.num_classes = num_classes
+        feats = [nn.Conv2D(3, init_c, 7, stride=2, padding=3, bias_attr=False),
+                 nn.BatchNorm2D(init_c), nn.ReLU(), nn.MaxPool2D(3, stride=2, padding=1)]
+        ch = init_c
+        for bi, reps in enumerate(blocks):
+            for _ in range(reps):
+                feats.append(DenseLayer(ch, growth, bn_size, dropout))
+                ch += growth
+            if bi != len(blocks) - 1:
+                feats.append(Transition(ch, ch // 2))
+                ch //= 2
+        feats += [nn.BatchNorm2D(ch), nn.ReLU()]
+        self.features = nn.Sequential(*feats)
+        self.pool = nn.AdaptiveAvgPool2D(1) if with_pool else None
+        if num_classes > 0:
+            self.fc = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.pool is not None:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+def _factory(n):
+    def f(pretrained=False, **kwargs):
+        if pretrained:
+            raise NotImplementedError("no pretrained weights in this environment")
+        return DenseNet(layers=n, **kwargs)
+
+    return f
+
+
+densenet121 = _factory(121)
+densenet161 = _factory(161)
+densenet169 = _factory(169)
+densenet201 = _factory(201)
+densenet264 = _factory(264)

@@ -1,0 +1,55 @@
+"""MobileNetV1 (parity: vision/models/mobilenetv1.py) — depthwise-separable
+conv stacks; depthwise = grouped conv, which XLA maps to MXU-friendly
+batch-grouped contractions."""
+from __future__ import annotations
+
+from ... import nn
+from ...tensor.manipulation import flatten
+
+__all__ = ["MobileNetV1", "mobilenet_v1"]
+
+
+def _dw_sep(inp, out, stride):
+    return nn.Sequential(
+        nn.Conv2D(inp, inp, 3, stride=stride, padding=1, groups=inp, bias_attr=False),
+        nn.BatchNorm2D(inp), nn.ReLU(),
+        nn.Conv2D(inp, out, 1, bias_attr=False),
+        nn.BatchNorm2D(out), nn.ReLU(),
+    )
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+
+        def c(ch):
+            return max(int(ch * scale), 8)
+
+        self.features = nn.Sequential(
+            nn.Conv2D(3, c(32), 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(c(32)), nn.ReLU(),
+            _dw_sep(c(32), c(64), 1),
+            _dw_sep(c(64), c(128), 2), _dw_sep(c(128), c(128), 1),
+            _dw_sep(c(128), c(256), 2), _dw_sep(c(256), c(256), 1),
+            _dw_sep(c(256), c(512), 2),
+            *[_dw_sep(c(512), c(512), 1) for _ in range(5)],
+            _dw_sep(c(512), c(1024), 2), _dw_sep(c(1024), c(1024), 1),
+        )
+        self.pool = nn.AdaptiveAvgPool2D(1) if with_pool else None
+        if num_classes > 0:
+            self.fc = nn.Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.pool is not None:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("no pretrained weights in this environment")
+    return MobileNetV1(scale=scale, **kwargs)

@@ -1,0 +1,102 @@
+"""ShuffleNetV2 (parity: vision/models/shufflenetv2.py)."""
+from __future__ import annotations
+
+from ... import nn
+from ...tensor.manipulation import concat, flatten, split
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_5",
+           "shufflenet_v2_x1_0", "shufflenet_v2_x1_5", "shufflenet_v2_x2_0"]
+
+_STAGE_OUT = {
+    0.25: [24, 24, 48, 96, 512],
+    0.5: [24, 48, 96, 192, 1024],
+    1.0: [24, 116, 232, 464, 1024],
+    1.5: [24, 176, 352, 704, 1024],
+    2.0: [24, 244, 488, 976, 2048],
+}
+
+
+def _conv_bn(inp, out, k, stride=1, groups=1, act=True):
+    layers = [nn.Conv2D(inp, out, k, stride=stride, padding=k // 2, groups=groups,
+                        bias_attr=False), nn.BatchNorm2D(out)]
+    if act:
+        layers.append(nn.ReLU())
+    return nn.Sequential(*layers)
+
+
+class ShuffleUnit(nn.Layer):
+    def __init__(self, inp, out, stride):
+        super().__init__()
+        self.stride = stride
+        branch = out // 2
+        if stride == 1:
+            self.branch2 = nn.Sequential(
+                _conv_bn(inp // 2, branch, 1),
+                _conv_bn(branch, branch, 3, groups=branch, act=False),
+                _conv_bn(branch, branch, 1),
+            )
+            self.branch1 = None
+        else:
+            self.branch1 = nn.Sequential(
+                _conv_bn(inp, inp, 3, stride=2, groups=inp, act=False),
+                _conv_bn(inp, branch, 1),
+            )
+            self.branch2 = nn.Sequential(
+                _conv_bn(inp, branch, 1),
+                _conv_bn(branch, branch, 3, stride=2, groups=branch, act=False),
+                _conv_bn(branch, branch, 1),
+            )
+        self.shuffle = nn.ChannelShuffle(2)
+
+    def forward(self, x):
+        if self.stride == 1:
+            x1, x2 = split(x, 2, axis=1)
+            out = concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return self.shuffle(out)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000, with_pool=True):
+        super().__init__()
+        chans = _STAGE_OUT[scale]
+        self.num_classes = num_classes
+        self.conv1 = _conv_bn(3, chans[0], 3, stride=2)
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        inp = chans[0]
+        for out, reps in zip(chans[1:4], (4, 8, 4)):
+            units = [ShuffleUnit(inp, out, 2)]
+            units += [ShuffleUnit(out, out, 1) for _ in range(reps - 1)]
+            stages.append(nn.Sequential(*units))
+            inp = out
+        self.stages = nn.Sequential(*stages)
+        self.conv_last = _conv_bn(inp, chans[4], 1)
+        self.pool = nn.AdaptiveAvgPool2D(1) if with_pool else None
+        if num_classes > 0:
+            self.fc = nn.Linear(chans[4], num_classes)
+
+    def forward(self, x):
+        x = self.conv_last(self.stages(self.maxpool(self.conv1(x))))
+        if self.pool is not None:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+def _factory(scale):
+    def f(pretrained=False, **kwargs):
+        if pretrained:
+            raise NotImplementedError("no pretrained weights in this environment")
+        return ShuffleNetV2(scale=scale, **kwargs)
+
+    return f
+
+
+shufflenet_v2_x0_25 = _factory(0.25)
+shufflenet_v2_x0_5 = _factory(0.5)
+shufflenet_v2_x1_0 = _factory(1.0)
+shufflenet_v2_x1_5 = _factory(1.5)
+shufflenet_v2_x2_0 = _factory(2.0)

@@ -1,0 +1,564 @@
+"""paddle.vision.ops parity (/root/reference/python/paddle/vision/ops.py:47
+export surface: nms/matrix_nms/roi_align/roi_pool/psroi_pool/box_coder/
+prior_box/deform_conv2d/yolo_box/distribute_fpn_proposals).
+
+TPU-native formulations: NMS as a fixed-iteration lax.scan over a
+score-sorted IoU matrix (no data-dependent loops), RoI ops as bilinear
+gathers (XLA batch-gather), deformable conv as an im2col of offset bilinear
+samples followed by one MXU matmul — replacing the reference's CUDA kernels
+(paddle/phi/kernels/{nms_kernel,roi_align_kernel,deformable_conv_kernel}.h).
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import nn
+from ..ops.dispatch import apply
+from ..tensor._helpers import to_tensor_like as _t
+from ..tensor.tensor import Tensor
+
+__all__ = [
+    "nms", "matrix_nms", "roi_align", "RoIAlign", "roi_pool", "RoIPool",
+    "psroi_pool", "PSRoIPool", "box_coder", "prior_box", "deform_conv2d",
+    "DeformConv2D", "yolo_box", "yolo_loss", "distribute_fpn_proposals",
+    "generate_proposals", "read_file", "decode_jpeg",
+]
+
+
+def _iou_matrix(boxes):
+    """[N,4] xyxy -> [N,N] IoU."""
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+    xx1 = jnp.maximum(x1[:, None], x1[None, :])
+    yy1 = jnp.maximum(y1[:, None], y1[None, :])
+    xx2 = jnp.minimum(x2[:, None], x2[None, :])
+    yy2 = jnp.minimum(y2[:, None], y2[None, :])
+    inter = jnp.maximum(xx2 - xx1, 0) * jnp.maximum(yy2 - yy1, 0)
+    return inter / jnp.maximum(area[:, None] + area[None, :] - inter, 1e-9)
+
+
+def nms(boxes, iou_threshold: float = 0.3, scores=None, category_idxs=None,
+        categories=None, top_k: Optional[int] = None):
+    """Greedy hard-NMS. Compiled form: sort by score, one pass of a scan
+    suppressing boxes with IoU > thr against any earlier KEPT box."""
+    boxes = _t(boxes)
+    n = boxes._value.shape[0]
+    if scores is None:
+        scores_v = jnp.arange(n, 0, -1, dtype=jnp.float32)  # keep input order
+    else:
+        scores_v = _t(scores)._value.astype(jnp.float32)
+    if category_idxs is not None:
+        # category-aware: offset boxes per category by more than the max
+        # coordinate so cross-class IoU is exactly 0 at any image size
+        cat = _t(category_idxs)._value.astype(jnp.float32)
+        span = float(jnp.max(jnp.abs(boxes._value))) + 1.0
+        off = (cat * span)[:, None]
+        shifted = boxes._value + off
+    else:
+        shifted = boxes._value
+
+    def f(bv):
+        order = jnp.argsort(-scores_v)
+        b = bv[order]
+        iou = _iou_matrix(b)
+
+        def body(keep, i):
+            # suppressed if any kept earlier (higher-score) box overlaps it
+            earlier = jnp.where(jnp.arange(n) < i, iou[i] * keep, 0.0)
+            sup = jnp.any(earlier > iou_threshold)
+            keep = keep.at[i].set(jnp.where(sup, 0.0, 1.0))
+            return keep, None
+
+        keep, _ = lax.scan(body, jnp.ones((n,), jnp.float32), jnp.arange(n))
+        return order, keep
+
+    order, keep = f(shifted)  # single scan pass; jit would retrace per call
+    order_np = np.asarray(order)
+    keep_np = np.asarray(keep) > 0  # keep[j] refers to sorted position j
+    kept = order_np[keep_np]  # original indices, score-descending
+    if top_k is not None:
+        kept = kept[:top_k]
+    return Tensor(jnp.asarray(kept.astype(np.int64)))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (SOLOv2): soft decay by the min pairwise-IoU statistic."""
+    bb = _t(bboxes)._value
+    sc = _t(scores)._value
+    if bb.ndim == 3:
+        bb, sc = bb[0], sc[0]
+    out_boxes, out_idx = [], []
+    for cls in range(sc.shape[0]):
+        if cls == background_label:
+            continue
+        s = np.asarray(sc[cls])
+        sel = np.where(s > score_threshold)[0]
+        if sel.size == 0:
+            continue
+        order = sel[np.argsort(-s[sel])][:nms_top_k]
+        b = np.asarray(bb[order])
+        iou = np.asarray(_iou_matrix(jnp.asarray(b)))
+        n = len(order)
+        decay = np.ones(n)
+        for i in range(1, n):
+            ious_i = iou[i, :i]
+            max_iou = ious_i.max() if i else 0.0
+            if use_gaussian:
+                decay[i] = np.exp(-(max_iou ** 2) / gaussian_sigma)
+            else:
+                decay[i] = 1 - max_iou
+        dec_scores = s[order] * decay
+        keep = dec_scores > post_threshold
+        for j in np.where(keep)[0]:
+            out_boxes.append([cls, dec_scores[j], *b[j]])
+            out_idx.append(order[j])
+    if not out_boxes:
+        outs = [Tensor(jnp.zeros((0, 6), jnp.float32))]
+        if return_index:
+            outs.append(Tensor(jnp.zeros((0,), jnp.int64)))
+        if return_rois_num:
+            outs.append(Tensor(jnp.asarray([0])))
+        return tuple(outs) if len(outs) > 1 else outs[0]
+    arr = np.asarray(out_boxes, np.float32)
+    order = np.argsort(-arr[:, 1])[:keep_top_k]
+    res = Tensor(jnp.asarray(arr[order]))
+    outs = [res]
+    if return_index:
+        outs.append(Tensor(jnp.asarray(np.asarray(out_idx)[order].astype(np.int64))))
+    if return_rois_num:
+        outs.append(Tensor(jnp.asarray([len(order)])))
+    return tuple(outs) if len(outs) > 1 else res
+
+
+def _bilinear_sample(feat, y, x):
+    """feat [C,H,W]; y/x arbitrary-shape coords -> [C, *coords.shape]."""
+    H, W = feat.shape[-2], feat.shape[-1]
+    y0 = jnp.clip(jnp.floor(y), 0, H - 1)
+    x0 = jnp.clip(jnp.floor(x), 0, W - 1)
+    y1 = jnp.clip(y0 + 1, 0, H - 1)
+    x1 = jnp.clip(x0 + 1, 0, W - 1)
+    wy = jnp.clip(y - y0, 0, 1)
+    wx = jnp.clip(x - x0, 0, 1)
+    y0i, y1i, x0i, x1i = (v.astype(jnp.int32) for v in (y0, y1, x0, x1))
+    v00 = feat[:, y0i, x0i]
+    v01 = feat[:, y0i, x1i]
+    v10 = feat[:, y1i, x0i]
+    v11 = feat[:, y1i, x1i]
+    return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+            + v10 * wy * (1 - wx) + v11 * wy * wx)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign via bilinear gathers (reference roi_align_kernel.h)."""
+    x = _t(x)
+    boxes = _t(boxes)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    bn = np.asarray(_t(boxes_num)._value)
+    batch_of_roi = np.repeat(np.arange(len(bn)), bn).astype(np.int32)
+    ratio = 2 if sampling_ratio <= 0 else sampling_ratio
+    off = 0.5 if aligned else 0.0
+
+    def f(feat, bxs):
+        def one_roi(bi, box):
+            fm = feat[bi]
+            x1, y1, x2, y2 = box * spatial_scale - off
+            rh = jnp.maximum((y2 - y1) / ph, 1e-6)
+            rw = jnp.maximum((x2 - x1) / pw, 1e-6)
+            iy = y1 + (jnp.arange(ph)[:, None, None, None] + 0.0) * rh + \
+                rh * (jnp.arange(ratio)[None, None, :, None] + 0.5) / ratio
+            ix = x1 + (jnp.arange(pw)[None, :, None, None] + 0.0) * rw + \
+                rw * (jnp.arange(ratio)[None, None, None, :] + 0.5) / ratio
+            iy = jnp.broadcast_to(iy, (ph, pw, ratio, ratio))
+            ix = jnp.broadcast_to(ix, (ph, pw, ratio, ratio))
+            vals = _bilinear_sample(fm, iy, ix)  # [C, ph, pw, r, r]
+            return jnp.mean(vals, axis=(-2, -1))
+
+        return jax.vmap(one_roi)(jnp.asarray(batch_of_roi), bxs)
+
+    return apply(f, x, boxes, op_name="roi_align")
+
+
+class RoIAlign(nn.Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self.output_size, self.spatial_scale)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """Max-pool RoI (reference roi_pool_kernel.h): dense sample grid + max."""
+    x = _t(x)
+    boxes = _t(boxes)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    bn = np.asarray(_t(boxes_num)._value)
+    batch_of_roi = np.repeat(np.arange(len(bn)), bn).astype(np.int32)
+    # dense integer sampling: every cell of a bin up to R px/bin is visited
+    # (bins larger than R px are max'd over an R-strided subsample)
+    R = 16
+
+    def f(feat, bxs):
+        def one_roi(bi, box):
+            fm = feat[bi]
+            x1, y1, x2, y2 = jnp.round(box * spatial_scale)
+            rh = jnp.maximum((y2 - y1 + 1) / ph, 1.0)
+            rw = jnp.maximum((x2 - x1 + 1) / pw, 1.0)
+            jgrid = jnp.arange(R).astype(jnp.float32)
+            iy = y1 + jnp.arange(ph)[:, None, None, None] * rh + \
+                jnp.minimum(jgrid * jnp.maximum(rh / R, 1.0), rh - 1)[None, None, :, None]
+            ix = x1 + jnp.arange(pw)[None, :, None, None] * rw + \
+                jnp.minimum(jgrid * jnp.maximum(rw / R, 1.0), rw - 1)[None, None, None, :]
+            iy = jnp.broadcast_to(iy, (ph, pw, R, R))
+            ix = jnp.broadcast_to(ix, (ph, pw, R, R))
+            H, W = fm.shape[-2:]
+            valid = (iy[None] <= y2) & (ix[None] <= x2)
+            vals = fm[:, jnp.clip(iy, 0, H - 1).astype(jnp.int32),
+                      jnp.clip(ix, 0, W - 1).astype(jnp.int32)]
+            vals = jnp.where(valid, vals, -jnp.inf)
+            return jnp.max(vals, axis=(-2, -1))
+
+        return jax.vmap(one_roi)(jnp.asarray(batch_of_roi), bxs)
+
+    return apply(f, x, boxes, op_name="roi_pool")
+
+
+class RoIPool(nn.Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size, self.spatial_scale)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """Position-sensitive RoI pool: channel group (i,j) feeds bin (i,j)."""
+    x = _t(x)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    C = x._value.shape[1]
+    if C % (ph * pw):
+        raise ValueError(f"channels {C} must be divisible by {ph}x{pw}")
+    co = C // (ph * pw)
+    pooled = roi_align(x, boxes, boxes_num, output_size, spatial_scale, aligned=False)
+
+    def _ps_gather(r, ph, pw):
+        outs = []
+        for i in range(ph):
+            row = []
+            for j in range(pw):
+                row.append(r[:, i, j, :, i, j])  # [N, co]
+            outs.append(jnp.stack(row, axis=-1))  # [N, co, pw]
+        return jnp.stack(outs, axis=-2)  # [N, co, ph, pw]
+
+    return apply(lambda p: _ps_gather(p.reshape(p.shape[0], ph, pw, co, ph, pw), ph, pw),
+                 pooled, op_name="psroi_pool")
+
+
+class PSRoIPool(nn.Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size, self.spatial_scale)
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, axis=0, name=None):
+    """Encode/decode boxes against priors (reference box_coder op)."""
+    pb = _t(prior_box)._value.astype(jnp.float32)
+    tb = _t(target_box)._value.astype(jnp.float32)
+    if prior_box_var is None:
+        var = jnp.ones((4,), jnp.float32)
+    elif isinstance(prior_box_var, (list, tuple)):
+        var = jnp.asarray(prior_box_var, jnp.float32)
+    else:
+        var = _t(prior_box_var)._value.astype(jnp.float32)
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph_ = pb[:, 3] - pb[:, 1] + norm
+    pcx = pb[:, 0] + pw * 0.5
+    pcy = pb[:, 1] + ph_ * 0.5
+    if code_type == "encode_center_size":
+        tw = tb[:, 2] - tb[:, 0] + norm
+        th = tb[:, 3] - tb[:, 1] + norm
+        tcx = tb[:, 0] + tw * 0.5
+        tcy = tb[:, 1] + th * 0.5
+        out = jnp.stack([
+            (tcx[:, None] - pcx[None, :]) / pw[None, :],
+            (tcy[:, None] - pcy[None, :]) / ph_[None, :],
+            jnp.log(tw[:, None] / pw[None, :]),
+            jnp.log(th[:, None] / ph_[None, :]),
+        ], axis=-1) / var
+        return Tensor(out)
+    # decode_center_size: tb [N, M, 4] deltas (axis selects broadcast dim)
+    if tb.ndim == 2:
+        tb = tb[:, None, :]
+    d = tb * var
+    if axis == 0:
+        cw, ch_, cx, cy = pw[None, :], ph_[None, :], pcx[None, :], pcy[None, :]
+    else:
+        cw, ch_, cx, cy = pw[:, None], ph_[:, None], pcx[:, None], pcy[:, None]
+    ocx = d[..., 0] * cw + cx
+    ocy = d[..., 1] * ch_ + cy
+    ow = jnp.exp(d[..., 2]) * cw
+    oh = jnp.exp(d[..., 3]) * ch_
+    out = jnp.stack([ocx - ow / 2, ocy - oh / 2,
+                     ocx + ow / 2 - norm, ocy + oh / 2 - norm], axis=-1)
+    return Tensor(out)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),  # noqa: A002
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior boxes (reference prior_box op) — host-side static grid."""
+    fh, fw = _t(input)._value.shape[-2:]
+    ih, iw = _t(image)._value.shape[-2:]
+    step_h = steps[1] or ih / fh
+    step_w = steps[0] or iw / fw
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if ar != 1.0:
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    boxes = []
+    for i in range(fh):
+        for j in range(fw):
+            cx = (j + offset) * step_w
+            cy = (i + offset) * step_h
+            cell = []
+            for k, ms in enumerate(min_sizes):
+                cell.append((cx, cy, ms, ms))
+                if max_sizes:
+                    bs = math.sqrt(ms * max_sizes[k])
+                    cell.append((cx, cy, bs, bs))
+                for ar in ars:
+                    if ar == 1.0:
+                        continue
+                    cell.append((cx, cy, ms * math.sqrt(ar), ms / math.sqrt(ar)))
+            for cx_, cy_, bw, bh in cell:
+                boxes.append([(cx_ - bw / 2) / iw, (cy_ - bh / 2) / ih,
+                              (cx_ + bw / 2) / iw, (cy_ + bh / 2) / ih])
+    arr = np.asarray(boxes, np.float32).reshape(fh, fw, -1, 4)
+    if clip:
+        arr = arr.clip(0, 1)
+    var = np.broadcast_to(np.asarray(variance, np.float32), arr.shape).copy()
+    return Tensor(jnp.asarray(arr)), Tensor(jnp.asarray(var))
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0, dilation=1,
+                  deformable_groups=1, groups=1, mask=None, name=None):
+    """Deformable conv v1/v2: bilinear-sample the input at offset positions
+    (im2col of deformed samples), then one dense matmul — the MXU mapping of
+    the reference's deformable_conv CUDA kernel."""
+    x, offset, weight = _t(x), _t(offset), _t(weight)
+    stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    padding = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dilation = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    kh, kw = weight._value.shape[-2:]
+    args = [x, offset, weight] + ([_t(mask)] if mask is not None else []) + \
+        ([_t(bias)] if bias is not None else [])
+    has_mask = mask is not None
+    has_bias = bias is not None
+
+    def f(xv, ov, wv, *rest):
+        mv = rest[0] if has_mask else None
+        bv = rest[-1] if has_bias else None
+        N, C, H, W = xv.shape
+        ph_, pw_ = padding
+        xp = jnp.pad(xv, ((0, 0), (0, 0), (ph_, ph_), (pw_, pw_)))
+        Hp, Wp = xp.shape[-2:]
+        oh = (H + 2 * ph_ - dilation[0] * (kh - 1) - 1) // stride[0] + 1
+        ow = (W + 2 * pw_ - dilation[1] * (kw - 1) - 1) // stride[1] + 1
+        # base sampling grid [oh, ow, kh, kw]
+        by = (jnp.arange(oh) * stride[0])[:, None, None, None] + \
+            (jnp.arange(kh) * dilation[0])[None, None, :, None]
+        bx = (jnp.arange(ow) * stride[1])[None, :, None, None] + \
+            (jnp.arange(kw) * dilation[1])[None, None, None, :]
+        by = jnp.broadcast_to(by, (oh, ow, kh, kw)).astype(jnp.float32)
+        bx = jnp.broadcast_to(bx, (oh, ow, kh, kw)).astype(jnp.float32)
+        # offsets: [N, 2*dg*kh*kw, oh, ow] (y then x per kernel point)
+        off = ov.reshape(N, deformable_groups, kh * kw, 2, oh, ow)
+        oy = jnp.transpose(off[:, :, :, 0], (0, 1, 3, 4, 2)).reshape(
+            N, deformable_groups, oh, ow, kh, kw)
+        ox = jnp.transpose(off[:, :, :, 1], (0, 1, 3, 4, 2)).reshape(
+            N, deformable_groups, oh, ow, kh, kw)
+
+        cg = C // deformable_groups
+
+        def sample_one(xp_n, oy_n, ox_n, m_n=None):
+            cols = []
+            for g in range(deformable_groups):
+                yy = by + oy_n[g]
+                xx = bx + ox_n[g]
+                v = _bilinear_sample(xp_n[g * cg:(g + 1) * cg], yy, xx)
+                if m_n is not None:
+                    v = v * m_n[g]
+                cols.append(v)
+            return jnp.concatenate(cols, axis=0)  # [C, oh, ow, kh, kw]
+
+        if mv is not None:
+            mm = jnp.transpose(
+                mv.reshape(N, deformable_groups, kh * kw, oh, ow), (0, 1, 3, 4, 2)
+            ).reshape(N, deformable_groups, oh, ow, kh, kw)
+            cols = jax.vmap(sample_one)(xp, oy, ox, mm)
+        else:
+            cols = jax.vmap(lambda a, b, c: sample_one(a, b, c))(xp, oy, ox)
+        # cols: [N, C, oh, ow, kh, kw] -> matmul with weight [O, C/groups, kh, kw]
+        O = wv.shape[0]
+        wflat = wv.reshape(O, -1)  # groups==1 path
+        cflat = jnp.transpose(cols, (0, 2, 3, 1, 4, 5)).reshape(N, oh, ow, -1)
+        out = jnp.einsum("nhwc,oc->nohw", cflat, wflat)
+        if bv is not None:
+            out = out + bv[None, :, None, None]
+        return out
+
+    if groups != 1:
+        raise NotImplementedError("deform_conv2d: groups>1 not supported yet")
+    return apply(f, *args, op_name="deform_conv2d")
+
+
+class DeformConv2D(nn.Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, deformable_groups=1, groups=1, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        k = (kernel_size, kernel_size) if isinstance(kernel_size, int) else kernel_size
+        import jax.numpy as jnp2
+
+        from ..nn.initializer import XavierNormal
+
+        w = Tensor(jnp2.zeros((out_channels, in_channels // groups, *k), jnp2.float32),
+                   stop_gradient=False)
+        XavierNormal()(w)
+        w.is_parameter = True
+        self.weight = w
+        self.add_parameter("weight", w)
+        if bias_attr is not False:
+            b = Tensor(jnp2.zeros((out_channels,), jnp2.float32), stop_gradient=False)
+            b.is_parameter = True
+            self.bias = b
+            self.add_parameter("bias", b)
+        else:
+            self.bias = None
+        self.stride, self.padding, self.dilation = stride, padding, dilation
+        self.deformable_groups, self.groups = deformable_groups, groups
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias, self.stride,
+                             self.padding, self.dilation, self.deformable_groups,
+                             self.groups, mask)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, name=None, scale_x_y=1.0, iou_aware=False,
+             iou_aware_factor=0.5):
+    """Decode YOLOv3 head outputs to boxes+scores (reference yolo_box op)."""
+    xv = _t(x)._value
+    N, _, H, W = xv.shape
+    na = len(anchors) // 2
+    an = np.asarray(anchors, np.float32).reshape(na, 2)
+    pred = jnp.transpose(xv.reshape(N, na, 5 + class_num, H, W), (0, 1, 3, 4, 2))
+    gx = (jax.nn.sigmoid(pred[..., 0]) * scale_x_y - (scale_x_y - 1) / 2
+          + jnp.arange(W)[None, None, None, :]) / W
+    gy = (jax.nn.sigmoid(pred[..., 1]) * scale_x_y - (scale_x_y - 1) / 2
+          + jnp.arange(H)[None, None, :, None]) / H
+    input_h = downsample_ratio * H
+    input_w = downsample_ratio * W
+    bw = jnp.exp(pred[..., 2]) * an[None, :, None, None, 0] / input_w
+    bh = jnp.exp(pred[..., 3]) * an[None, :, None, None, 1] / input_h
+    conf = jax.nn.sigmoid(pred[..., 4])
+    probs = jax.nn.sigmoid(pred[..., 5:]) * conf[..., None]
+    imgs = _t(img_size)._value.astype(jnp.float32)  # [N, 2] (h, w)
+    ih = imgs[:, 0][:, None, None, None]
+    iw = imgs[:, 1][:, None, None, None]
+    x1 = (gx - bw / 2) * iw
+    y1 = (gy - bh / 2) * ih
+    x2 = (gx + bw / 2) * iw
+    y2 = (gy + bh / 2) * ih
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, iw - 1)
+        y1 = jnp.clip(y1, 0, ih - 1)
+        x2 = jnp.clip(x2, 0, iw - 1)
+        y2 = jnp.clip(y2, 0, ih - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], -1).reshape(N, -1, 4)
+    scores = probs.reshape(N, -1, class_num)
+    mask = conf.reshape(N, -1) > conf_thresh
+    boxes = jnp.where(mask[..., None], boxes, 0.0)
+    scores = jnp.where(mask[..., None], scores, 0.0)
+    return Tensor(boxes), Tensor(scores)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    raise NotImplementedError(
+        "yolo_loss: compose yolo_box decoding with the standard detection "
+        "losses (bce/iou) in model code; the fused CUDA loss kernel is not "
+        "replicated")
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    """Assign RoIs to FPN levels by scale (reference op)."""
+    rois = np.asarray(_t(fpn_rois)._value)
+    off = 1.0 if pixel_offset else 0.0
+    w = rois[:, 2] - rois[:, 0] + off
+    h = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.maximum(w * h, 1e-9))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-9)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(int)
+    outs, idxs = [], []
+    for level in range(min_level, max_level + 1):
+        sel = np.where(lvl == level)[0]
+        outs.append(Tensor(jnp.asarray(rois[sel])))
+        idxs.append(sel)
+    order = np.concatenate(idxs) if idxs else np.zeros(0, int)
+    restore = np.argsort(order).astype(np.int32)
+    nums = [Tensor(jnp.asarray([len(i)])) for i in idxs]
+    return outs, Tensor(jnp.asarray(restore)), nums
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000, nms_thresh=0.5,
+                       min_size=0.1, eta=1.0, pixel_offset=False,
+                       return_rois_num=False, name=None):
+    raise NotImplementedError(
+        "generate_proposals: compose box_coder + nms; the fused RPN kernel "
+        "is not replicated")
+
+
+def read_file(filename, name=None):
+    with open(filename, "rb") as f:
+        data = np.frombuffer(f.read(), np.uint8)
+    return Tensor(jnp.asarray(data))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    raise NotImplementedError(
+        "decode_jpeg needs an image codec; none is bundled in this "
+        "environment (reference binds nvjpeg)")

@@ -24,9 +24,32 @@ __all__ = [
 
 def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6, begin_norm_axis=-1,
                    bias=None, residual=None, quant_scale=-1, **kw):
-    out = F.rms_norm(x, norm_weight, epsilon)
+    """Pallas-fused RMSNorm (+residual): one HBM pass for add+norm on TPU
+    (ops/pallas/fused_norm.py); jnp fallback elsewhere. Returns
+    (out,) or (out, residual_out) matching the reference signature."""
+    from ....ops.pallas.fused_norm import rms_norm_fused, rms_norm_residual_fused
+
+    if quant_scale > 0:
+        raise NotImplementedError(
+            "fused_rms_norm: quantized output (quant_scale>0) is not implemented")
+    x = to_tensor_like(x)
+    if bias is not None:
+        # reference semantics: the pre-norm stream is x + bias (+ residual)
+        x = x + to_tensor_like(bias)
+    norm_weight = to_tensor_like(norm_weight)
+    if residual is not None:
+        residual = to_tensor_like(residual)
+        outs = apply(
+            lambda xv, rv, wv: list(rms_norm_residual_fused(xv, rv, wv, epsilon)),
+            x, residual, norm_weight, op_name="fused_rms_norm_residual")
+        out, res_out = outs[0], outs[1]
+        if norm_bias is not None:
+            out = out + to_tensor_like(norm_bias)
+        return (out, res_out)
+    out = apply(lambda xv, wv: rms_norm_fused(xv, wv, epsilon), x, norm_weight,
+                op_name="fused_rms_norm")
     if norm_bias is not None:
-        out = out + norm_bias
+        out = out + to_tensor_like(norm_bias)
     return (out,)
 
 

@@ -13,3 +13,5 @@ from .shufflenetv2 import (  # noqa: F401
     ShuffleNetV2, shufflenet_v2_x0_25, shufflenet_v2_x0_5, shufflenet_v2_x1_0,
     shufflenet_v2_x1_5, shufflenet_v2_x2_0)
 from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1  # noqa: F401
+from .googlenet import GoogLeNet, googlenet  # noqa: F401
+from .inceptionv3 import InceptionV3, inception_v3  # noqa: F401

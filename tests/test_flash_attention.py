@@ -92,3 +92,55 @@ class TestGQA:
         v = jnp.asarray(rng.randn(b, s, hk, d), jnp.float32)
         out = _ref_attention(q, k, v, causal=True, scale=None)
         assert out.shape == (b, s, h, d)
+
+
+class TestFusedRMSNorm:
+    """Pallas fused RMSNorm (+residual) kernel (interpret mode on CPU)."""
+
+    def test_kernel_matches_reference(self):
+        from paddle_tpu.ops.pallas.fused_norm import rms_norm_fused
+
+        rs = np.random.RandomState(0)
+        x = jnp.asarray(rs.randn(16, 128).astype(np.float32))
+        w = jnp.asarray(rs.randn(128).astype(np.float32))
+        inv = 1.0 / np.sqrt((np.asarray(x) ** 2).mean(-1, keepdims=True) + 1e-6)
+        ref = np.asarray(x) * inv * np.asarray(w)
+        np.testing.assert_allclose(np.asarray(rms_norm_fused(x, w, 1e-6, True)),
+                                   ref, rtol=1e-5, atol=1e-5)
+
+    def test_residual_variant_and_vjp(self):
+        import jax
+
+        from paddle_tpu.ops.pallas.fused_norm import (
+            rms_norm_fused, rms_norm_residual_fused)
+
+        rs = np.random.RandomState(1)
+        x = jnp.asarray(rs.randn(8, 64).astype(np.float32))
+        r = jnp.asarray(rs.randn(8, 64).astype(np.float32))
+        w = jnp.asarray(rs.randn(64).astype(np.float32))
+        out, res_out = rms_norm_residual_fused(x, r, w, 1e-6, True)
+        np.testing.assert_allclose(np.asarray(res_out), np.asarray(x + r), rtol=1e-6)
+
+        def plain(xv, wv):
+            inv = jax.lax.rsqrt(jnp.mean(xv * xv, -1, keepdims=True) + 1e-6)
+            return jnp.sum(jnp.sin(xv * inv * wv))
+
+        gx_ref, gw_ref = jax.grad(plain, argnums=(0, 1))(x, w)
+        gx, gw = jax.grad(lambda xv, wv: jnp.sum(jnp.sin(
+            rms_norm_fused(xv, wv, 1e-6, True))), argnums=(0, 1))(x, w)
+        np.testing.assert_allclose(np.asarray(gx), np.asarray(gx_ref), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(gw), np.asarray(gw_ref), rtol=1e-4, atol=1e-5)
+
+    def test_incubate_api_with_residual(self):
+        import paddle_tpu as P
+        import paddle_tpu.incubate.nn.functional as IF
+
+        rs = np.random.RandomState(2)
+        x = P.to_tensor(rs.randn(4, 32).astype(np.float32))
+        x.stop_gradient = False
+        w = P.to_tensor(np.ones(32, np.float32))
+        w.stop_gradient = False
+        r = P.to_tensor(rs.randn(4, 32).astype(np.float32))
+        out, res_out = IF.fused_rms_norm(x, w, residual=r)
+        P.sum(out).backward()
+        assert x.grad is not None and w.grad is not None

@@ -187,3 +187,13 @@ class TestReviewRegressions:
                              nms_top_k=5, keep_top_k=5,
                              return_index=False, return_rois_num=False)
         assert hasattr(out, "shape")  # bare Tensor, not a tuple
+
+    def test_googlenet_inception(self):
+        net = models.googlenet(num_classes=7)
+        net.eval()
+        out, aux1, aux2 = net(P.to_tensor(RNG.randn(1, 3, 64, 64).astype(np.float32)))
+        assert list(out.shape) == [1, 7]
+        inc = models.inception_v3(num_classes=7)
+        inc.eval()
+        out = inc(P.to_tensor(RNG.randn(1, 3, 128, 128).astype(np.float32)))
+        assert list(out.shape) == [1, 7]

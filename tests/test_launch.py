@@ -113,3 +113,59 @@ class TestElasticManager:
             ELASTIC_AUTO_PARALLEL_EXIT_CODE, ELASTIC_EXIT_CODE)
         assert ELASTIC_EXIT_CODE == 101
         assert ELASTIC_AUTO_PARALLEL_EXIT_CODE == 102
+
+
+MULTINODE_WORKER = textwrap.dedent("""
+    import json, os, sys
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    world = int(os.environ["PADDLE_TRAINERS_NUM"])
+    assert world == 2, world
+    workdir = sys.argv[1]
+    ckpt = os.path.join(workdir, f"ckpt_{rank}.json")
+    start = 0
+    if os.path.exists(ckpt):
+        start = json.load(open(ckpt))["step"] + 1
+    for step in range(start, 4):
+        json.dump({"step": step, "restart": os.environ.get("PADDLE_RESTART_COUNT")},
+                  open(ckpt, "w"))
+        if step == 2 and rank == 1 and not os.path.exists(os.path.join(workdir, "crashed")):
+            open(os.path.join(workdir, "crashed"), "w").write("1")
+            sys.exit(5)
+    open(os.path.join(workdir, f"done_{rank}"), "w").write("ok")
+""")
+
+
+class TestMultiNodeRestart:
+    def test_cross_node_epoch_coordination(self, tmp_path):
+        """Two controller processes (nnodes=2): a worker failure on node 1
+        must pull BOTH nodes into a new rendezvous epoch and both must
+        finish after resume (review regression: the restart epoch rides the
+        shared KV master, not per-node state)."""
+        import socket
+
+        script = tmp_path / "worker.py"
+        script.write_text(MULTINODE_WORKER)
+        with socket.socket() as s:
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+        master = f"127.0.0.1:{port}"
+
+        def launch(rank):
+            return subprocess.Popen(
+                [sys.executable, "-m", "paddle_tpu.distributed.launch",
+                 "--nnodes", "2", "--rank", str(rank), "--master", master,
+                 "--nproc_per_node", "1", "--max_restart", "2",
+                 str(script), str(tmp_path)],
+                cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+
+        p0, p1 = launch(0), launch(1)
+        out0 = p0.communicate(timeout=180)
+        out1 = p1.communicate(timeout=180)
+        assert p0.returncode == 0, (out0, out1)
+        assert p1.returncode == 0, (out0, out1)
+        assert (tmp_path / "done_0").exists() and (tmp_path / "done_1").exists()
+        # node 1 resumed under the bumped shared epoch; node 0 (which never
+        # crashed) exited 0 only because it rejoined that epoch — otherwise
+        # its second rendezvous would have timed out and failed the launch
+        ck = json.load(open(tmp_path / "ckpt_1.json"))
+        assert ck["step"] == 3 and ck["restart"] == "1"

@@ -28,3 +28,22 @@ from .sampler import (  # noqa: F401
     WeightedRandomSampler,
 )
 from .reader import DataLoader, default_collate_fn  # noqa: F401
+
+
+class WorkerInfo:
+    """paddle.io.get_worker_info parity: per-worker id/num/seed/dataset."""
+
+    def __init__(self, id, num_workers, dataset=None):  # noqa: A002
+        self.id = id
+        self.num_workers = num_workers
+        self.seed = id
+        self.dataset = dataset
+
+
+_worker_info = None
+
+
+def get_worker_info():
+    """Inside a DataLoader worker process returns its WorkerInfo; None in the
+    main process (parity: io/dataloader/worker.py get_worker_info)."""
+    return _worker_info

@@ -183,12 +183,18 @@ def _tree_unflatten(spec, arrays, pos=None):
             for k, c in zip(spec["k"], spec["c"])}
 
 
-def _worker_loop(dataset, collate, idx_q, out_q, init_fn, wid, shm_name=None):
+def _worker_loop(dataset, collate, idx_q, out_q, init_fn, wid, shm_name=None,
+                 num_workers=0, base_seed=0):
     """Runs in a forked worker process (parity: dataloader_iter._worker_loop).
 
     With ``shm_name`` the collated batch rides the native shared-memory ring
     (paddle_tpu.native.ShmQueue) — no pickle; the mp queue carries only
     errors and oversized/unsupported fallbacks."""
+    import paddle_tpu.io as _io
+
+    info = _io.WorkerInfo(wid, num_workers, dataset)
+    info.seed = base_seed + wid  # per-run seed, reference base_seed contract
+    _io._worker_info = info
     if init_fn is not None:
         init_fn(wid)
     shm = None
@@ -261,11 +267,13 @@ class _ProcessIter:
         for i, b in enumerate(batches):
             self._idx_q.put((i, list(b)))
         self.workers = []
+        base_seed = int(np.random.randint(0, 2**31 - 1))
         for wid in range(loader.num_workers):
             self._idx_q.put(None)
             p = ctx.Process(target=_worker_loop,
                             args=(loader.dataset, collate, self._idx_q, self._out_q,
-                                  loader.worker_init_fn, wid, shm_name), daemon=True)
+                                  loader.worker_init_fn, wid, shm_name,
+                                  loader.num_workers, base_seed), daemon=True)
             p.start()
             self.workers.append(p)
 

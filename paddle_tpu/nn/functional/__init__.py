@@ -2,6 +2,7 @@
 from .activation import *  # noqa: F401,F403
 from .common import *  # noqa: F401,F403
 from .conv import *  # noqa: F401,F403
+from .extra import *  # noqa: F401,F403
 from .flash_attention import (  # noqa: F401
     flash_attention,
     scaled_dot_product_attention,

@@ -1,0 +1,341 @@
+"""nn layer tail (parity: the remaining Layer exports of
+/root/reference/python/paddle/nn/__init__.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...tensor.tensor import Tensor
+from .. import functional as F
+from .layers import Layer
+
+__all__ = [
+    "Silu", "Softmax2D", "Unflatten", "ZeroPad1D", "ZeroPad3D",
+    "PairwiseDistance", "GaussianNLLLoss", "MultiMarginLoss",
+    "TripletMarginWithDistanceLoss", "HSigmoidLoss", "LPPool1D", "LPPool2D",
+    "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D", "FractionalMaxPool2D",
+    "FractionalMaxPool3D", "FeatureAlphaDropout", "AdaptiveLogSoftmaxWithLoss",
+    "RNNCellBase", "BiRNN", "BeamSearchDecoder", "dynamic_decode", "RNNTLoss",
+]
+
+from .rnn import _RNNCellBase as RNNCellBase  # noqa: E402
+
+
+class Silu(Layer):
+    def forward(self, x):
+        return F.silu(x)
+
+
+class Softmax2D(Layer):
+    """Softmax over channels for NCHW input (dim=-3)."""
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis = axis
+        self.shape = shape
+
+    def forward(self, x):
+        from ...tensor.extras import unflatten
+
+        return unflatten(x, self.axis, self.shape)
+
+
+class ZeroPad1D(Layer):
+    def __init__(self, padding, data_format="NCL", name=None):
+        super().__init__()
+        self.padding = [padding, padding] if isinstance(padding, int) else list(padding)
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pad(x, self.padding, mode="constant", value=0.0,
+                     data_format=self.data_format)
+
+
+class ZeroPad3D(Layer):
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__()
+        self.padding = [padding] * 6 if isinstance(padding, int) else list(padding)
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pad(x, self.padding, mode="constant", value=0.0,
+                     data_format=self.data_format)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, self.p, self.epsilon, self.keepdim)
+
+
+class GaussianNLLLoss(Layer):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean", name=None):
+        super().__init__()
+        self.full, self.epsilon, self.reduction = full, epsilon, reduction
+
+    def forward(self, input, label, variance):  # noqa: A002
+        return F.gaussian_nll_loss(input, label, variance, self.full,
+                                   self.epsilon, self.reduction)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self.p, self.margin, self.weight, self.reduction = p, margin, weight, reduction
+
+    def forward(self, input, label):  # noqa: A002
+        return F.multi_margin_loss(input, label, self.p, self.margin,
+                                   self.weight, self.reduction)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.distance_function = distance_function
+        self.margin, self.swap, self.reduction = margin, swap, reduction
+
+    def forward(self, input, positive, negative):  # noqa: A002
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative, self.distance_function, self.margin,
+            self.swap, self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    def __init__(self, feature_size, num_classes, weight_attr=None, bias_attr=None,
+                 is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        self.num_classes = num_classes
+        w = Tensor(jnp.asarray(
+            np.random.RandomState(0).randn(num_classes - 1, feature_size)
+            .astype(np.float32) * 0.01), stop_gradient=False)
+        w.is_parameter = True
+        self.add_parameter("weight", w)
+        if bias_attr is not False:
+            b = Tensor(jnp.zeros((num_classes - 1,), jnp.float32), stop_gradient=False)
+            b.is_parameter = True
+            self.add_parameter("bias", b)
+        else:
+            self.bias = None
+
+    def forward(self, input, label, path_table=None, path_code=None):  # noqa: A002
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias, path_table, path_code)
+
+
+class LPPool1D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCL", name=None):
+        super().__init__()
+        self.args = (norm_type, kernel_size, stride, padding, ceil_mode, data_format)
+
+    def forward(self, x):
+        return F.lp_pool1d(x, *self.args)
+
+
+class LPPool2D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__()
+        self.args = (norm_type, kernel_size, stride, padding, ceil_mode, data_format)
+
+    def forward(self, x):
+        return F.lp_pool2d(x, *self.args)
+
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCL",
+                 output_size=None, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        return F.max_unpool1d(x, indices, *self.args)
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCHW",
+                 output_size=None, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        return F.max_unpool2d(x, indices, *self.args)
+
+
+class MaxUnPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCDHW",
+                 output_size=None, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        return F.max_unpool3d(x, indices, *self.args)
+
+
+class FractionalMaxPool2D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.args = (output_size, kernel_size, random_u, return_mask)
+
+    def forward(self, x):
+        return F.fractional_max_pool2d(x, *self.args)
+
+
+class FractionalMaxPool3D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.args = (output_size, kernel_size, random_u, return_mask)
+
+    def forward(self, x):
+        return F.fractional_max_pool3d(x, *self.args)
+
+
+class FeatureAlphaDropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.feature_alpha_dropout(x, self.p, training=self.training)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        self.cutoffs = list(cutoffs)
+        self.n_classes = n_classes
+        self.n_clusters = len(self.cutoffs)
+        rs = np.random.RandomState(0)
+        head_size = self.cutoffs[0] + self.n_clusters
+        hw = Tensor(jnp.asarray(rs.randn(in_features, head_size).astype(np.float32) * 0.01),
+                    stop_gradient=False)
+        hw.is_parameter = True
+        self.add_parameter("head_weight", hw)
+        self.tail_weights = []
+        full = self.cutoffs + [n_classes]
+        for i in range(self.n_clusters):
+            proj_dim = max(1, int(in_features / (div_value ** (i + 1))))
+            sz = full[i + 1] - full[i]
+            p = Tensor(jnp.asarray(rs.randn(in_features, proj_dim).astype(np.float32) * 0.01),
+                       stop_gradient=False)
+            c = Tensor(jnp.asarray(rs.randn(proj_dim, sz).astype(np.float32) * 0.01),
+                       stop_gradient=False)
+            p.is_parameter = c.is_parameter = True
+            self.add_parameter(f"tail_proj_{i}", p)
+            self.add_parameter(f"tail_cls_{i}", c)
+            self.tail_weights.append([p, c])
+        if head_bias:
+            hb = Tensor(jnp.zeros((head_size,), jnp.float32), stop_gradient=False)
+            hb.is_parameter = True
+            self.add_parameter("head_bias", hb)
+        else:
+            self.head_bias = None
+
+    def forward(self, input, label):  # noqa: A002
+        return F.adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, self.tail_weights, self.cutoffs,
+            self.head_bias)
+
+    def log_prob(self, input):  # noqa: A002
+        import paddle_tpu as P
+
+        n = input.shape[0]
+        outs = []
+        # brute-force: evaluate log-prob of every class (debug/eval helper)
+        for cls in range(self.n_classes):
+            lbl = P.to_tensor(np.full((n,), cls, np.int64))
+            lp, _ = self.forward(input, lbl)
+            outs.append(lp)
+        from ...tensor.manipulation import stack
+
+        return stack(outs, axis=1)
+
+
+class BiRNN(Layer):
+    """Bidirectional wrapper over two RNN cells (paddle.nn.BiRNN)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        from .rnn import RNN
+
+        self.fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        s_fw, s_bw = (initial_states if initial_states is not None else (None, None))
+        out_fw, st_fw = self.fw(inputs, s_fw, sequence_length)
+        out_bw, st_bw = self.bw(inputs, s_bw, sequence_length)
+        from ...tensor.manipulation import concat
+
+        return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+class BeamSearchDecoder(Layer):
+    """Greedy/beam decode driver over an RNN cell (paddle BeamSearchDecoder;
+    the TPU build runs the loop eagerly — each step is compiled)."""
+
+    def __init__(self, cell, start_token, end_token, beam_size, embedding_fn=None,
+                 output_fn=None):
+        super().__init__()
+        self.cell = cell
+        self.start_token = start_token
+        self.end_token = end_token
+        self.beam_size = beam_size
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=20, **kwargs):
+    """Greedy decode loop (beam_size=1 semantics of the reference API)."""
+    import paddle_tpu as P
+
+    cell = decoder.cell
+    state = inits
+    token = decoder.start_token
+    outputs = []
+    batch = None
+    for _ in range(int(max_step_num)):
+        if decoder.embedding_fn is not None:
+            inp = decoder.embedding_fn(token)
+        else:
+            inp = token
+        if batch is None:
+            batch = inp.shape[0]
+        out, state = cell(inp, state)
+        logits = decoder.output_fn(out) if decoder.output_fn is not None else out
+        from ...tensor.search import argmax
+
+        token = argmax(logits, axis=-1)
+        outputs.append(token)
+        vals = np.asarray(token._value)
+        if (vals == decoder.end_token).all():
+            break
+    from ...tensor.manipulation import stack
+
+    return stack(outputs, axis=1), state
+
+
+class RNNTLoss(Layer):
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean", name=None):
+        super().__init__()
+        self.blank = blank
+        self.reduction = reduction
+        self.fastemit_lambda = fastemit_lambda
+
+    def forward(self, input, label, input_lengths, label_lengths):  # noqa: A002
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           self.blank, self.fastemit_lambda, self.reduction)

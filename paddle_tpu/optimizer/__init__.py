@@ -2,7 +2,11 @@
 from . import lr  # noqa: F401
 from .optimizer import Optimizer  # noqa: F401
 from .optimizers import (  # noqa: F401
+    ASGD,
     LBFGS,
+    NAdam,
+    RAdam,
+    Rprop,
     SGD,
     Adadelta,
     Adagrad,

@@ -6,7 +6,7 @@ import jax.numpy as jnp
 from ..tensor.tensor import Tensor
 from .optimizer import Optimizer
 
-__all__ = ["SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad", "Adadelta", "RMSProp", "Lamb", "Lars", "LBFGS"]
+__all__ = ["SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad", "Adadelta", "RMSProp", "Lamb", "Lars", "LBFGS", "ASGD", "Rprop", "NAdam", "RAdam"]
 
 
 class SGD(Optimizer):
@@ -398,3 +398,128 @@ class LBFGS(Optimizer):
         from .lr import LRScheduler
 
         return lr() if isinstance(lr, LRScheduler) else (lr.get_lr() if hasattr(lr, "get_lr") else float(lr))
+
+
+class ASGD(Optimizer):
+    """Averaged SGD (parity: optimizer/asgd.py) — keeps a running average of
+    the last n gradients."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._batch_num = max(int(batch_num), 1)
+
+    def _create_accumulators(self, p):
+        self._acc("d", p)  # running gradient sum
+
+    def _update_param(self, p, grad, lr, weight_decay):
+        w = self._master(p)
+        if weight_decay:
+            grad = grad + weight_decay * w
+        d = self._acc("d", p)
+        n = self._batch_num
+        d = d + (grad - d) / n
+        self._set_acc("d", p, d)
+        self._write_back(p, w - lr * d.astype(w.dtype))
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (parity: optimizer/rprop.py) — sign-based step-size
+    adaptation; full-batch semantics."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, multi_precision, name)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_neg, self._eta_pos = etas
+
+    def _init_step(self, p):
+        lr0 = self._learning_rate if not callable(self._learning_rate) else 0.001
+        return jnp.full_like(self._master(p), float(lr0))
+
+    def _create_accumulators(self, p):
+        self._acc("prev_grad", p)
+        self._acc("step_size", p, init=self._init_step(p))
+
+    def _update_param(self, p, grad, lr, weight_decay):
+        w = self._master(p)
+        prev = self._acc("prev_grad", p)
+        step = self._acc("step_size", p, init=self._init_step(p))
+        sign = jnp.sign(grad * prev)
+        step = jnp.where(sign > 0, jnp.minimum(step * self._eta_pos, self._lr_max),
+                         jnp.where(sign < 0, jnp.maximum(step * self._eta_neg, self._lr_min),
+                                   step))
+        grad_eff = jnp.where(sign < 0, 0.0, grad)
+        self._set_acc("prev_grad", p, grad_eff)
+        self._set_acc("step_size", p, step)
+        self._write_back(p, w - jnp.sign(grad_eff) * step)
+
+
+class NAdam(Adam):
+    """Nesterov Adam (parity: optimizer/nadam.py)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 momentum_decay=0.004, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, False, multi_precision, False, name)
+        self._momentum_decay = momentum_decay
+
+    def _create_accumulators(self, p):
+        super()._create_accumulators(p)
+        self._acc("mu_prod", p, init=jnp.ones((), jnp.float32))
+
+    def _update_param(self, p, grad, lr, weight_decay):
+        w = self._master(p)
+        if weight_decay:
+            grad = grad + weight_decay * w
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        # traced step + running mu-product: O(1) per step and correct under
+        # jit.TrainStep (a Python step count would freeze at trace time)
+        t = self._acc("beta_pow", p, init=jnp.zeros((), jnp.float32)) + 1
+        self._set_acc("beta_pow", p, t)
+        b1, b2 = self._beta1, self._beta2
+        psi = self._momentum_decay
+        mu_t = b1 * (1 - 0.5 * 0.96 ** (t * psi))
+        mu_t1 = b1 * (1 - 0.5 * 0.96 ** ((t + 1) * psi))
+        prod = self._acc("mu_prod", p, init=jnp.ones((), jnp.float32)) * mu_t
+        self._set_acc("mu_prod", p, prod)
+        m = b1 * m + (1 - b1) * grad
+        v = b2 * v + (1 - b2) * grad * grad
+        self._set_acc("moment1", p, m)
+        self._set_acc("moment2", p, v)
+        m_hat = mu_t1 * m / (1 - prod * mu_t1) + (1 - mu_t) * grad / (1 - prod)
+        v_hat = v / (1 - b2 ** t)
+        self._write_back(p, w - lr * m_hat / (jnp.sqrt(v_hat) + self._epsilon))
+
+
+class RAdam(Adam):
+    """Rectified Adam (parity: optimizer/radam.py)."""
+
+    def _update_param(self, p, grad, lr, weight_decay):
+        w = self._master(p)
+        if weight_decay:
+            grad = grad + weight_decay * w
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        # traced step count: the rectification branch must be a jnp.where so
+        # the compiled TrainStep crosses the rho threshold at runtime
+        t = self._acc("beta_pow", p, init=jnp.zeros((), jnp.float32)) + 1
+        self._set_acc("beta_pow", p, t)
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * m + (1 - b1) * grad
+        v = b2 * v + (1 - b2) * grad * grad
+        self._set_acc("moment1", p, m)
+        self._set_acc("moment2", p, v)
+        m_hat = m / (1 - b1 ** t)
+        rho_inf = 2.0 / (1 - b2) - 1
+        rho_t = rho_inf - 2 * t * b2 ** t / (1 - b2 ** t)
+        v_hat = jnp.sqrt(v / (1 - b2 ** t))
+        safe_rho = jnp.maximum(rho_t, 4.0 + 1e-3)
+        r = jnp.sqrt((safe_rho - 4) * (safe_rho - 2) * rho_inf
+                     / ((rho_inf - 4) * (rho_inf - 2) * safe_rho))
+        rect = lr * r * m_hat / (v_hat + self._epsilon)
+        plain = lr * m_hat
+        self._write_back(p, w - jnp.where(rho_t > 5.0, rect, plain))

@@ -260,3 +260,173 @@ def tensordot(x, y, axes=2, name=None):
     if isinstance(axes, Tensor):
         ax = axes.tolist()
     return apply(lambda a, b: jnp.tensordot(a, b, axes=ax), x, y, op_name="tensordot")
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    """Inverse of A from its Cholesky factor (reference cholesky_inverse)."""
+    x = to_tensor_like(x)
+
+    def f(l):  # noqa: E741
+        u = l.T if not upper else l
+        # A = U^T U  ->  A^-1 = U^-1 U^-T
+        ui = jax.scipy.linalg.solve_triangular(u, jnp.eye(u.shape[0], dtype=u.dtype),
+                                               lower=False)
+        return ui @ ui.T
+
+    return apply(f, x, op_name="cholesky_inverse")
+
+
+def cond(x, p=None, name=None):
+    x = to_tensor_like(x)
+    pp = 2 if p is None else p
+
+    def f(a):
+        if pp == 2:
+            s = jnp.linalg.svd(a, compute_uv=False)
+            return s[..., 0] / s[..., -1]
+        if pp == -2:
+            s = jnp.linalg.svd(a, compute_uv=False)
+            return s[..., -1] / s[..., 0]
+        return jnp.linalg.norm(a, ord=pp, axis=(-2, -1)) * \
+            jnp.linalg.norm(jnp.linalg.inv(a), ord=pp, axis=(-2, -1))
+
+    return apply(f, x, op_name="cond")
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack combined LU factors + pivots into (P, L, U)."""
+    lu_data, lu_pivots = to_tensor_like(lu_data), to_tensor_like(lu_pivots)
+
+    def one(lu, piv):
+        m, n = lu.shape[-2], lu.shape[-1]
+        k = min(m, n)
+        L = jnp.tril(lu[:, :k], -1) + jnp.eye(m, k, dtype=lu.dtype)
+        U = jnp.triu(lu[:k, :])
+        # pivots (1-based sequential row swaps) -> permutation matrix
+        perm = jnp.arange(m)
+        piv = piv.astype(jnp.int32) - 1
+
+        def swap(perm, i):
+            j = piv[i]
+            pi, pj = perm[i], perm[j]
+            return perm.at[i].set(pj).at[j].set(pi), None
+
+        perm, _ = jax.lax.scan(swap, perm, jnp.arange(piv.shape[-1]))
+        P = jnp.eye(m, dtype=lu.dtype)[perm].T
+        return P, L, U
+
+    def f(lu, piv):
+        if lu.ndim == 2:
+            return one(lu, piv)
+        batch = lu.shape[:-2]
+        lu2 = lu.reshape((-1,) + lu.shape[-2:])
+        piv2 = piv.reshape((-1, piv.shape[-1]))
+        P, L, U = jax.vmap(one)(lu2, piv2)
+        return (P.reshape(batch + P.shape[-2:]), L.reshape(batch + L.shape[-2:]),
+                U.reshape(batch + U.shape[-2:]))
+
+    out = apply(f, lu_data, lu_pivots, op_name="lu_unpack", n_outs=3)
+    return out[0], out[1], out[2]
+
+
+def matrix_exp(x, name=None):
+    x = to_tensor_like(x)
+    return apply(lambda a: jax.scipy.linalg.expm(a), x, op_name="matrix_exp")
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    x = to_tensor_like(x)
+    return apply(lambda a: jnp.linalg.norm(a, ord=p, axis=tuple(axis), keepdims=keepdim),
+                 x, op_name="matrix_norm")
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    x = to_tensor_like(x)
+
+    def f(a):
+        if axis is None:
+            a = a.reshape(-1)
+            return jnp.linalg.norm(a, ord=p, keepdims=keepdim)
+        return jnp.linalg.norm(a, ord=p, axis=axis, keepdims=keepdim)
+
+    return apply(f, x, op_name="vector_norm")
+
+
+def ormqr(x, tau, y, left=True, transpose=False, name=None):
+    """Multiply y by Q (from a QR's householder reflectors x, tau)."""
+    x, tau, y = to_tensor_like(x), to_tensor_like(tau), to_tensor_like(y)
+
+    def f(a, t, other):
+        q = _householder_q(a, t)
+        qm = q.T if transpose else q
+        return qm @ other if left else other @ qm
+
+    def _householder_q(a, t):
+        m = a.shape[-2]
+        q = jnp.eye(m, dtype=a.dtype)
+        for i in range(t.shape[-1]):
+            v = jnp.concatenate([jnp.zeros((i,), a.dtype), jnp.ones((1,), a.dtype),
+                                 a[i + 1:, i]])
+            q = q - t[i] * (q @ jnp.outer(v, v))
+        return q
+
+    return apply(f, x, tau, y, op_name="ormqr")
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized truncated SVD of x (or x - M when given)."""
+    x = to_tensor_like(x)
+    if M is not None:
+        from .math import subtract
+
+        x = subtract(x, to_tensor_like(M))
+
+    def f(a):
+        m, n = a.shape[-2], a.shape[-1]
+        k = min(q, m, n)
+        key = jax.random.key(0)
+        omega = jax.random.normal(key, (n, k), a.dtype)
+        y = a @ omega
+        for _ in range(niter):
+            y = a @ (a.T @ y)
+        qmat, _ = jnp.linalg.qr(y)
+        b = qmat.T @ a
+        u_b, s, vh = jnp.linalg.svd(b, full_matrices=False)
+        return qmat @ u_b, s, vh.T
+
+    out = apply(f, x, op_name="svd_lowrank", n_outs=3)
+    return out[0], out[1], out[2]
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    from ..sparse import pca_lowrank as _pca
+
+    return _pca(x, q=q, center=center, niter=niter)
+
+
+def fp8_fp8_half_gemm_fused(x, y, transpose_x=False, transpose_y=False,
+                            bias=None, scale=1.0, output_dtype="float16",
+                            activation_type="identity"):
+    """fp8 x fp8 -> half GEMM (reference cutlass fp8 kernel). XLA lowers
+    fp8 dots natively on supporting hardware; elsewhere it upcasts."""
+    x, y = to_tensor_like(x), to_tensor_like(y)
+    from ..framework.dtype import to_jax_dtype
+
+    out_dt = to_jax_dtype(output_dtype)
+    args = [x, y] + ([to_tensor_like(bias)] if bias is not None else [])
+
+    def f(a, b, *bb):
+        if transpose_x:
+            a = a.T
+        if transpose_y:
+            b = b.T
+        out = jnp.dot(a, b, preferred_element_type=jnp.float32) * scale
+        if bb:
+            out = out + bb[0]
+        if activation_type in ("gelu",):
+            out = jax.nn.gelu(out)
+        elif activation_type in ("relu",):
+            out = jnp.maximum(out, 0)
+        return out.astype(out_dt)
+
+    return apply(f, *args, op_name="fp8_gemm")

@@ -19,6 +19,10 @@ from ....tensor.tensor import Tensor
 __all__ = [
     "fused_rms_norm", "fused_layer_norm", "fused_rotary_position_embedding", "swiglu",
     "fused_linear", "fused_bias_act", "fused_dropout_add", "fused_multi_head_attention",
+    "fused_matmul_bias", "fused_linear_activation",
+    "fused_bias_dropout_residual_layer_norm", "fused_feedforward", "fused_moe",
+    "fused_ec_moe", "fused_multi_transformer",
+    "variable_length_memory_efficient_attention",
 ]
 
 
@@ -138,3 +142,159 @@ def fused_multi_head_attention(*args, **kwargs):
     raise NotImplementedError(
         "use paddle_tpu.nn.functional.flash_attention / MultiHeadAttention (fused on TPU)"
     )
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False, name=None):
+    """matmul+bias in one XLA fusion (reference cublasLt epilogue kernel)."""
+    from ....tensor.linalg import matmul
+
+    out = matmul(x, y, transpose_x=transpose_x, transpose_y=transpose_y)
+    if bias is not None:
+        out = out + to_tensor_like(bias)
+    return out
+
+
+def fused_linear_activation(x, y, bias=None, trans_x=False, trans_y=False,
+                            activation="gelu", name=None):
+    out = fused_matmul_bias(x, y, bias, trans_x, trans_y)
+    if activation in (None, "", "none", "identity"):
+        return out
+    return getattr(F, activation)(out)
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.5, ln_epsilon=1e-5,
+                                           training=True, mode="upscale_in_train",
+                                           name=None):
+    """(x + bias) -> dropout -> + residual -> layer_norm, one fusion chain
+    (reference fused_bias_dropout_residual_layer_norm op)."""
+    out = to_tensor_like(x)
+    if bias is not None:
+        out = out + to_tensor_like(bias)
+    out = F.dropout(out, p=dropout_rate, training=training, mode=mode)
+    out = out + to_tensor_like(residual)
+    h = out.shape[-1]
+    return F.layer_norm(out, [h], ln_scale, ln_bias, ln_epsilon)
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu", ln1_epsilon=1e-5,
+                      ln2_epsilon=1e-5, pre_layer_norm=False, training=True,
+                      mode="upscale_in_train", name=None):
+    """Transformer FFN block as one compiled chain (reference
+    fused_feedforward op): [pre-]LN -> linear1 -> act -> dropout -> linear2
+    -> dropout -> residual [-> post-LN]."""
+    x = to_tensor_like(x)
+    h = x.shape[-1]
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, [h], ln1_scale, ln1_bias, ln1_epsilon)
+    out = fused_matmul_bias(x, linear1_weight, linear1_bias)
+    out = getattr(F, activation)(out)
+    out = F.dropout(out, p=dropout1_rate, training=training, mode=mode)
+    out = fused_matmul_bias(out, linear2_weight, linear2_bias)
+    out = F.dropout(out, p=dropout2_rate, training=training, mode=mode)
+    out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, [h], ln2_scale, ln2_bias, ln2_epsilon)
+    return out
+
+
+def fused_moe(x, gate_weight, expert_weights1, expert_biases1, expert_weights2,
+              expert_biases2, quant_method="None", moe_topk=2, norm_topk_prob=True,
+              group_moe=False, name=None, act_type="gelu"):
+    """Dense-dispatch MoE FFN (reference fused_moe op; GShard-style einsum
+    dispatch — every expert computes every token, combine weights zero the
+    non-routed ones; the XLA/TPU-idiomatic formulation)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ....ops.dispatch import apply
+
+    x = to_tensor_like(x)
+    args = [x, to_tensor_like(gate_weight),
+            to_tensor_like(expert_weights1), to_tensor_like(expert_weights2)]
+    has_b1 = expert_biases1 is not None
+    has_b2 = expert_biases2 is not None
+    if has_b1:
+        args.append(to_tensor_like(expert_biases1))
+    if has_b2:
+        args.append(to_tensor_like(expert_biases2))
+
+    def f(xv, gw, w1, w2, *bs):
+        b1 = bs[0] if has_b1 else None
+        b2 = bs[-1] if has_b2 else None
+        orig = xv.shape
+        xt = xv.reshape(-1, orig[-1])  # [N, H]
+        logits = xt @ gw  # [N, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+        topv, topi = jax.lax.top_k(probs, moe_topk)
+        if norm_topk_prob:
+            topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+        combine = jnp.zeros_like(probs)
+        combine = jax.vmap(lambda c, i, v: c.at[i].set(v))(combine, topi, topv)  # [N, E]
+        h = jnp.einsum("nh,ehf->enf", xt, w1)
+        if b1 is not None:
+            h = h + b1[:, None, :]
+        h = getattr(jax.nn, act_type)(h)
+        y = jnp.einsum("enf,efh->enh", h, w2)
+        if b2 is not None:
+            y = y + b2[:, None, :]
+        out = jnp.einsum("enh,ne->nh", y, combine)
+        return out.reshape(orig)
+
+    return apply(f, *args, op_name="fused_moe")
+
+
+def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
+                 act_type="gelu", name=None):
+    """Expert-choice style fused MoE (reference fused_ec_moe) — mapped onto
+    the same dense-dispatch path."""
+    return fused_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
+                     act_type=act_type)
+
+
+def fused_multi_transformer(*args, **kwargs):
+    raise NotImplementedError(
+        "fused_multi_transformer is an inference mega-kernel; compose "
+        "paddle_tpu.nn.TransformerEncoder (XLA fuses the chain) or use the "
+        "models.llama stack for decoder inference")
+
+
+def variable_length_memory_efficient_attention(query, key, value, seq_lens=None,
+                                               kv_seq_lens=None, mask=None,
+                                               scale=None, causal=False, **kw):
+    """Memory-efficient attention with per-sequence KEY lengths: masked
+    attention over the padded batch (TPU kernels are static-shape).
+
+    Layout matches the reference op: q/k/v are [B, num_heads, S, D]; the key
+    axis is masked by ``kv_seq_lens`` (``seq_lens`` is the fallback when kv
+    lengths are not given)."""
+    import jax.numpy as jnp
+
+    from ....ops.dispatch import apply as _apply
+    from ....tensor.linalg import transpose as _tr
+
+    query = to_tensor_like(query)
+    key = to_tensor_like(key)
+    value = to_tensor_like(value)
+    lens = kv_seq_lens if kv_seq_lens is not None else seq_lens
+    if mask is None and lens is not None:
+        sk = key.shape[2]  # [B, H, S, D]
+        lens = to_tensor_like(lens)
+
+        def build_mask(l):  # noqa: E741
+            valid = jnp.arange(sk)[None, :] < l.reshape(-1, 1)
+            return jnp.where(valid, 0.0, -1e30)[:, None, None, :]
+
+        mask = _apply(build_mask, lens, op_name="varlen_mask")
+    # sdpa takes [B, S, H, D]
+    q_s = _tr(query, [0, 2, 1, 3])
+    k_s = _tr(key, [0, 2, 1, 3])
+    v_s = _tr(value, [0, 2, 1, 3])
+    out = F.scaled_dot_product_attention(q_s, k_s, v_s, attn_mask=mask,
+                                         is_causal=causal)
+    return _tr(out, [0, 2, 1, 3])

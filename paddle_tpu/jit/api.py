@@ -29,6 +29,9 @@ from . import trace_state
 
 __all__ = ["to_static", "not_to_static", "StaticFunction", "ignore_module", "TrainStep", "InputSpec"]
 
+# jit.enable_to_static(False) falls every StaticFunction back to eager
+_to_static_enabled = True
+
 
 class InputSpec:
     """paddle.static.InputSpec parity (shape with None for dynamic dims)."""
@@ -211,6 +214,8 @@ class StaticFunction:
         return b
 
     def __call__(self, *args, **kwargs):
+        if not _to_static_enabled:
+            return self._fn(*args, **kwargs)  # jit.enable_to_static(False)
         training = self._layer.training if self._layer is not None else True
         arg_tensors, spec = flatten_tensors((args, kwargs))
 

@@ -1,0 +1,108 @@
+"""paddle.signal parity (/root/reference/python/paddle/signal.py: stft/istft).
+
+Framing + windowed (r)fft through the tape — shares conventions with
+audio.features; istft reconstructs by weighted overlap-add with the
+window-power normalization (COLA)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .ops.dispatch import apply
+from .tensor.tensor import Tensor
+
+__all__ = ["stft", "istft"]
+
+
+def _t(x) -> Tensor:
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def stft(x, n_fft: int, hop_length: Optional[int] = None,
+         win_length: Optional[int] = None, window=None, center: bool = True,
+         pad_mode: str = "reflect", normalized: bool = False,
+         onesided: bool = True, name=None):
+    """x [..., T] -> complex [..., n_fft//2+1 (or n_fft), frames]."""
+    x = _t(x)
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+    if window is None:
+        wv = jnp.ones((wl,), jnp.float32)
+    else:
+        wv = _t(window)._value.astype(jnp.float32)
+    if wl < n_fft:
+        lpad = (n_fft - wl) // 2
+        wv = jnp.pad(wv, (lpad, n_fft - wl - lpad))
+    win = Tensor(wv)
+
+    def f(v, w):
+        if center:
+            padc = [(0, 0)] * (v.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            v = jnp.pad(v, padc, mode="reflect" if pad_mode == "reflect" else "constant")
+        T = v.shape[-1]
+        n_frames = 1 + (T - n_fft) // hop
+        starts = jnp.arange(n_frames) * hop
+        idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+        frames = v[..., idx] * w
+        if onesided and not jnp.iscomplexobj(v):
+            spec = jnp.fft.rfft(frames, axis=-1)
+        else:
+            spec = jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return jnp.swapaxes(spec, -1, -2)  # [..., bins, frames]
+
+    return apply(f, x, win, op_name="stft")
+
+
+def istft(x, n_fft: int, hop_length: Optional[int] = None,
+          win_length: Optional[int] = None, window=None, center: bool = True,
+          normalized: bool = False, onesided: bool = True,
+          length: Optional[int] = None, return_complex: bool = False, name=None):
+    """Inverse STFT by weighted overlap-add. x: [..., bins, frames]."""
+    x = _t(x)
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+    if window is None:
+        wv = jnp.ones((wl,), jnp.float32)
+    else:
+        wv = _t(window)._value.astype(jnp.float32)
+    if wl < n_fft:
+        lpad = (n_fft - wl) // 2
+        wv = jnp.pad(wv, (lpad, n_fft - wl - lpad))
+    win = Tensor(wv)
+
+    def f(spec, w):
+        spec = jnp.swapaxes(spec, -1, -2)  # [..., frames, bins]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(spec, axis=-1)
+            if not return_complex:
+                frames = frames.real
+        frames = frames * w
+        n_frames = frames.shape[-2]
+        T = n_fft + hop * (n_frames - 1)
+        lead = frames.shape[:-2]
+        out = jnp.zeros(lead + (T,), frames.dtype)
+        wsum = jnp.zeros((T,), jnp.float32)
+        idx = jnp.arange(n_frames)[:, None] * hop + jnp.arange(n_fft)[None, :]
+        flat_idx = idx.reshape(-1)
+        out = out.reshape((-1, T)).at[:, flat_idx].add(
+            frames.reshape((-1, n_frames * n_fft))).reshape(lead + (T,))
+        wsum = wsum.at[flat_idx].add(jnp.tile(w * w, n_frames))
+        out = out / jnp.maximum(wsum, 1e-10)
+        if center:
+            out = out[..., n_fft // 2:]
+        if length is not None:
+            out = out[..., :length]
+        elif center:
+            out = out[..., : T - n_fft]
+        return out
+
+    return apply(f, x, win, op_name="istft")

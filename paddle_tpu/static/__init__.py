@@ -32,6 +32,12 @@ __all__ = [
     "data", "Executor", "scope_guard", "global_scope", "name_scope",
     "save_inference_model", "load_inference_model", "InputSpec", "Variable",
     "cpu_places", "cuda_places", "xpu_places", "device_guard",
+    "BuildStrategy", "CompiledProgram", "ExponentialMovingAverage",
+    "create_global_var", "create_parameter", "gradients", "append_backward",
+    "accuracy", "auc", "Print", "save", "load", "load_program_state",
+    "set_program_state", "serialize_program", "serialize_persistables",
+    "deserialize_persistables", "load_from_file", "save_to_file",
+    "normalize_program", "WeightNormParamAttr",
 ]
 
 from ..jit.api import InputSpec  # noqa: E402  (shared spec type)
@@ -403,3 +409,285 @@ class _StaticNN:
 
 
 nn = _StaticNN()
+
+
+# -------------------------------------------------- legacy static surface
+class BuildStrategy:
+    """Knob bag (XLA owns fusion/memory decisions; kept for API parity)."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.memory_optimize = True
+        self.fuse_elewise_add_act_ops = True
+        self.build_cinn_pass = False
+
+
+class CompiledProgram:
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+        self.build_strategy = build_strategy or BuildStrategy()
+
+    def __getattr__(self, name):
+        return getattr(self.program, name)
+
+
+class IpuStrategy:
+    def __init__(self):
+        raise NotImplementedError("IPU backend is not part of the TPU build")
+
+
+def IpuCompiledProgram(*a, **k):
+    raise NotImplementedError("IPU backend is not part of the TPU build")
+
+
+class ipu_shard_guard:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("IPU backend is not part of the TPU build")
+
+
+class WeightNormParamAttr:
+    def __init__(self, dim=None, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=False,
+                 need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters with apply/restore guards (parity:
+    static.ExponentialMovingAverage)."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self.decay = decay
+        self._ema = {}
+        self._backup = {}
+        self._params = []
+
+    def update(self, parameters=None):
+        import numpy as np
+
+        params = parameters or self._params
+        if parameters is not None:
+            self._params = list(parameters)
+        for p in self._params:
+            cur = np.asarray(p._value, np.float32)
+            prev = self._ema.get(id(p))
+            self._ema[id(p)] = cur if prev is None else \
+                self.decay * prev + (1 - self.decay) * cur
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def guard():
+            import jax.numpy as jnp
+
+            for p in self._params:
+                self._backup[id(p)] = p._value
+                if id(p) in self._ema:
+                    p._value = jnp.asarray(self._ema[id(p)], p._value.dtype)
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+
+        return guard()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._value = self._backup.pop(id(p))
+
+
+def create_global_var(shape, value, dtype, persistable=False, force_cpu=False,
+                      name=None):
+    import jax.numpy as jnp
+
+    from ..framework.dtype import to_jax_dtype
+
+    t = Tensor(jnp.full(shape, value, to_jax_dtype(dtype)), name=name)
+    t.persistable = persistable
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..tensor.extras import create_parameter as _cp
+
+    return _cp(shape, dtype, name, attr, is_bias, default_initializer)
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None, name=None):
+    """Static-graph gradient op insertion collapses to taped autograd."""
+    from ..autograd.tape import grad as _grad
+
+    outs = targets if isinstance(targets, (list, tuple)) else [targets]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    return _grad(list(outs), list(ins), grad_outputs=target_gradients,
+                 retain_graph=True, allow_unused=True)
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None, callbacks=None,
+                    checkpoints=None):
+    """parity: static append_backward — marks the program for training via
+    optimizer.minimize; returns (param, grad-placeholder) pairs."""
+    prog = default_main_program()
+    prog._loss = loss
+    params = parameter_list or prog.all_parameters()
+    return [(p, None) for p in params]
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):  # noqa: A002
+    from ..metric import accuracy as _acc
+
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1, slide_steps=1):  # noqa: A002
+    from ..metric import Auc
+
+    m = Auc(num_thresholds=min(num_thresholds, 4095))
+    import numpy as np
+
+    preds = np.asarray(input._value)
+    if preds.ndim == 1 or preds.shape[-1] == 1:
+        preds = np.stack([1 - preds.reshape(-1), preds.reshape(-1)], axis=1)
+    m.update(preds, np.asarray(label._value))
+    return Tensor(jnp.asarray(m.accumulate(), jnp.float32)), None, None
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):  # noqa: A002
+    raise NotImplementedError("parameter-server CTR metrics are out of the TPU build")
+
+
+def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,  # noqa: A002
+          print_tensor_type=True, print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=False, print_phase="both"):
+    """Host-callback print (identity op)."""
+    import jax
+
+    def f(v):
+        jax.debug.print((message or "") + "{x}", x=v)
+        return v
+
+    from ..ops.dispatch import apply
+
+    return apply(f, input, op_name="Print")
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    raise NotImplementedError(
+        "py_func embeds host Python in the graph; use jax.pure_callback via "
+        "a custom op, or eager mode")
+
+
+# ------------------------------------------------ program state save/load
+def save(program, model_path, protocol=4, **configs):
+    """Save all parameters reachable from the program (npz)."""
+    import numpy as np
+
+    params = program.all_parameters()
+    arrays = {p.name or f"param_{i}": np.asarray(p._value)
+              for i, p in enumerate(params)}
+    np.savez(model_path + ".pdparams.npz", **arrays)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    import numpy as np
+
+    import jax.numpy as jnp_
+
+    arrays = dict(np.load(model_path + ".pdparams.npz"))
+    by_name = {p.name: p for p in program.all_parameters()}
+    for name, arr in arrays.items():
+        if name in by_name:
+            by_name[name]._value = jnp_.asarray(arr, by_name[name]._value.dtype)
+
+
+def save_inference_model_pir(*a, **k):
+    return save_inference_model(*a, **k)
+
+
+def load_program_state(model_path, var_list=None):
+    import numpy as np
+
+    return dict(np.load(model_path + ".pdparams.npz"))
+
+
+def set_program_state(program, state_dict):
+    import jax.numpy as jnp_
+
+    by_name = {p.name: p for p in program.all_parameters()}
+    for name, arr in state_dict.items():
+        if name in by_name:
+            by_name[name]._value = jnp_.asarray(arr, by_name[name]._value.dtype)
+
+
+def serialize_program(feed_vars, fetch_vars, **kwargs):
+    import json as _json
+
+    prog = default_main_program()
+    return _json.dumps({"ops": [name for _, _, _, name in prog.ops]}).encode()
+
+
+def deserialize_program(data):
+    raise NotImplementedError(
+        "programs are Python-captured op lists; use jit.save/load artifacts "
+        "for portable serialization")
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None, **kwargs):
+    import io as _io
+
+    import numpy as np
+
+    prog = default_main_program()
+    bio = _io.BytesIO()
+    np.savez(bio, **{p.name or f"p{i}": np.asarray(p._value)
+                     for i, p in enumerate(prog.all_parameters())})
+    return bio.getvalue()
+
+
+def deserialize_persistables(program, data, executor=None):
+    import io as _io
+
+    import numpy as np
+
+    import jax.numpy as jnp_
+
+    arrays = dict(np.load(_io.BytesIO(data)))
+    by_name = {p.name: p for p in program.all_parameters()}
+    for name, arr in arrays.items():
+        if name in by_name:
+            by_name[name]._value = jnp_.asarray(arr, by_name[name]._value.dtype)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    return program
+
+
+def default_startup_program_guard(*a, **k):
+    raise NotImplementedError
+
+
+def global_scope_guard(*a, **k):
+    raise NotImplementedError
+
+
+# nn alias for static.nn already defined above as `nn = _StaticNN()`
+
+
+def set_ipu_shard(*a, **k):
+    raise NotImplementedError("IPU backend is not part of the TPU build")

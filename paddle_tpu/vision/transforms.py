@@ -187,3 +187,385 @@ class ContrastTransform:
         factor = 1 + np.random.uniform(-self.value, self.value)
         mean = arr.mean()
         return np.clip((arr - mean) * factor + mean, 0, 255 if arr.max() > 1 else 1.0)
+
+
+# ---------------------------------------------------------------- functional
+def to_tensor(pic, data_format="CHW"):
+    raw = np.asarray(pic)
+    arr = raw.astype(np.float32)
+    if raw.dtype == np.uint8:  # dtype decides scaling, never image content
+        arr = arr / 255.0
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    from ..tensor.tensor import Tensor
+
+    return Tensor(arr)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    arr = np.asarray(img, np.float32)
+    mean = np.asarray(mean, np.float32).reshape(-1)
+    std = np.asarray(std, np.float32).reshape(-1)
+    if data_format == "CHW":
+        return (arr - mean[:, None, None]) / std[:, None, None]
+    return (arr - mean) / std
+
+
+def resize(img, size, interpolation="bilinear"):
+    return _resize_hwc(np.asarray(img), size)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    arr = np.asarray(img)
+    if isinstance(padding, numbers.Number):
+        padding = [padding] * 4
+    if len(padding) == 2:
+        padding = [padding[0], padding[1], padding[0], padding[1]]
+    l, t, r, b = padding  # noqa: E741
+    cfg = [(t, b), (l, r)] + [(0, 0)] * (arr.ndim - 2)
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    return np.pad(arr, cfg, mode=mode, **kw)
+
+
+def crop(img, top, left, height, width):
+    return np.asarray(img)[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    arr = np.asarray(img)
+    th, tw = (output_size, output_size) if isinstance(output_size, numbers.Number) \
+        else output_size
+    i = max((arr.shape[0] - th) // 2, 0)
+    j = max((arr.shape[1] - tw) // 2, 0)
+    return arr[i:i + th, j:j + tw]
+
+
+def hflip(img):
+    return np.asarray(img)[:, ::-1]
+
+
+def vflip(img):
+    return np.asarray(img)[::-1]
+
+
+def _inv_affine_sample(arr, mat, fill=0):
+    """Sample arr (HWC) at inverse-affine-mapped coordinates (nearest)."""
+    h, w = arr.shape[:2]
+    ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+    coords = np.stack([xs - cx, ys - cy, np.ones_like(xs)], -1) @ mat.T
+    sx = np.clip(np.round(coords[..., 0] + cx), 0, w - 1).astype(int)
+    sy = np.clip(np.round(coords[..., 1] + cy), 0, h - 1).astype(int)
+    valid = ((coords[..., 0] + cx >= 0) & (coords[..., 0] + cx <= w - 1)
+             & (coords[..., 1] + cy >= 0) & (coords[..., 1] + cy <= h - 1))
+    out = arr[sy, sx]
+    return np.where(valid[..., None] if arr.ndim == 3 else valid, out, fill)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None, fill=0):
+    arr = np.asarray(img)
+    a = np.deg2rad(angle)
+    mat = np.array([[np.cos(a), np.sin(a), 0], [-np.sin(a), np.cos(a), 0]], np.float64)
+    return _inv_affine_sample(arr, mat, fill)
+
+
+def affine(img, angle=0.0, translate=(0, 0), scale=1.0, shear=(0.0, 0.0),
+           interpolation="nearest", fill=0, center=None):
+    arr = np.asarray(img)
+    a = np.deg2rad(angle)
+    if isinstance(shear, numbers.Number):
+        shear = (shear, 0.0)
+    sx, sy = np.deg2rad(shear[0]), np.deg2rad(shear[1]) if len(shear) > 1 else 0.0
+    # forward matrix; invert for sampling
+    m = np.array([[np.cos(a + sx), -np.sin(a + sy), translate[0]],
+                  [np.sin(a + sx), np.cos(a + sy), translate[1]]], np.float64)
+    m[:2, :2] *= scale
+    full = np.vstack([m, [0, 0, 1]])
+    inv = np.linalg.inv(full)[:2]
+    return _inv_affine_sample(arr, inv, fill)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest", fill=0):
+    arr = np.asarray(img)
+    # solve the 8-dof homography endpoints -> startpoints (inverse mapping)
+    A, B = [], []
+    for (ex, ey), (sx, sy) in zip(endpoints, startpoints):
+        A.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        A.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        B.extend([sx, sy])
+    hvec = np.linalg.lstsq(np.asarray(A, np.float64), np.asarray(B, np.float64),
+                           rcond=None)[0]
+    H = np.append(hvec, 1.0).reshape(3, 3)
+    h, w = arr.shape[:2]
+    ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    pts = np.stack([xs, ys, np.ones_like(xs)], -1) @ H.T
+    px = pts[..., 0] / pts[..., 2]
+    py = pts[..., 1] / pts[..., 2]
+    sxc = np.clip(np.round(px), 0, w - 1).astype(int)
+    syc = np.clip(np.round(py), 0, h - 1).astype(int)
+    # half-pixel tolerance: nearest sampling + fp error must not void borders
+    valid = (px >= -0.5) & (px <= w - 0.5) & (py >= -0.5) & (py <= h - 0.5)
+    out = arr[syc, sxc]
+    return np.where(valid[..., None] if arr.ndim == 3 else valid, out, fill)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    from ..tensor.tensor import Tensor
+
+    if isinstance(img, Tensor):
+        import jax.numpy as jnp
+
+        val = jnp.asarray(v, img._value.dtype)
+        new = img._value.at[..., i:i + h, j:j + w].set(val)
+        if inplace:
+            img._value = new
+            return img
+        return Tensor(new)
+    arr = np.asarray(img).copy()
+    arr[..., i:i + h, j:j + w] = v
+    return arr
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = np.asarray(img, np.float32)
+    gray = arr[..., 0] * 0.299 + arr[..., 1] * 0.587 + arr[..., 2] * 0.114
+    return np.repeat(gray[..., None], num_output_channels, axis=-1)
+
+
+def adjust_brightness(img, brightness_factor):
+    arr = np.asarray(img, np.float32)
+    hi = 255 if arr.max() > 1 else 1.0
+    return np.clip(arr * brightness_factor, 0, hi)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr = np.asarray(img, np.float32)
+    hi = 255 if arr.max() > 1 else 1.0
+    mean = to_grayscale(arr).mean()
+    return np.clip((arr - mean) * contrast_factor + mean, 0, hi)
+
+
+def _rgb_hsv_roundtrip(arr, hue_shift):
+    """Vectorized RGB->HSV->RGB hue rotation (no per-pixel Python loop)."""
+    hi = 255.0 if arr.max() > 1 else 1.0
+    rgb = (arr / hi).astype(np.float64)
+    r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+    mx = rgb.max(-1)
+    mn = rgb.min(-1)
+    diff = mx - mn
+    safe = np.where(diff == 0, 1.0, diff)
+    h = np.zeros_like(mx)
+    h = np.where(mx == r, ((g - b) / safe) % 6.0, h)
+    h = np.where(mx == g, (b - r) / safe + 2.0, h)
+    h = np.where(mx == b, (r - g) / safe + 4.0, h)
+    h = np.where(diff == 0, 0.0, h / 6.0)
+    s = np.where(mx == 0, 0.0, diff / np.where(mx == 0, 1.0, mx))
+    v = mx
+    h = (h + hue_shift) % 1.0
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    t = v * (1 - (1 - f) * s)
+    i = i.astype(int) % 6
+    out = np.empty_like(rgb)
+    conds = [(v, t, p), (q, v, p), (p, v, t), (p, q, v), (t, p, v), (v, p, q)]
+    for k, (rr, gg, bb) in enumerate(conds):
+        m = i == k
+        out[..., 0] = np.where(m, rr, out[..., 0])
+        out[..., 1] = np.where(m, gg, out[..., 1])
+        out[..., 2] = np.where(m, bb, out[..., 2])
+    return (out * hi).astype(np.float32)
+
+
+def adjust_hue(img, hue_factor):
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    return _rgb_hsv_roundtrip(np.asarray(img, np.float32), hue_factor)
+
+
+# ------------------------------------------------------------------ classes
+class BaseTransform:
+    """parity: transforms.BaseTransform — keys-aware __call__."""
+
+    def __init__(self, keys=None):
+        self.keys = keys
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+    def __call__(self, inputs):
+        if self.keys is None:
+            return self._apply_image(inputs)
+        outs = []
+        for key, data in zip(self.keys, inputs):
+            outs.append(self._apply_image(data) if key == "image" else data)
+        return tuple(outs)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return np.asarray(img)
+        shift = np.random.uniform(-self.value, self.value)
+        return adjust_hue(img, shift)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        arr = np.asarray(img, np.float32)
+        factor = 1 + np.random.uniform(-self.value, self.value)
+        gray = to_grayscale(arr)
+        hi = 255 if arr.max() > 1 else 1.0
+        return np.clip(gray + (arr - gray) * factor, 0, hi)
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0, keys=None):
+        super().__init__(keys)
+        self.brightness, self.contrast = brightness, contrast
+        self.saturation, self.hue = saturation, hue
+
+    def _apply_image(self, img):
+        out = np.asarray(img, np.float32)
+        if self.brightness:
+            out = adjust_brightness(out, 1 + np.random.uniform(-self.brightness, self.brightness))
+        if self.contrast:
+            out = adjust_contrast(out, 1 + np.random.uniform(-self.contrast, self.contrast))
+        if self.saturation:
+            out = SaturationTransform(self.saturation)._apply_image(out)
+        if self.hue:
+            out = adjust_hue(out, np.random.uniform(-self.hue, self.hue))
+        return out
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        self.degrees = (-degrees, degrees) if isinstance(degrees, numbers.Number) else degrees
+        self.translate, self.scale_rng, self.shear = translate, scale, shear
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        angle = np.random.uniform(*self.degrees)
+        tx = ty = 0
+        if self.translate:
+            tx = np.random.uniform(-self.translate[0], self.translate[0]) * arr.shape[1]
+            ty = np.random.uniform(-self.translate[1], self.translate[1]) * arr.shape[0]
+        sc = np.random.uniform(*self.scale_rng) if self.scale_rng else 1.0
+        sh = (np.random.uniform(-self.shear, self.shear), 0.0) if isinstance(
+            self.shear, numbers.Number) else (self.shear or (0.0, 0.0))
+        return affine(arr, angle, (tx, ty), sc, sh)
+
+
+class RandomErasing(BaseTransform):
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3), value=0,
+                 inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob, self.scale, self.ratio, self.value = prob, scale, ratio, value
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        if np.random.rand() > self.prob:
+            return arr
+        # CHW or HWC: erase over the last two dims per the erase() contract
+        h, w = arr.shape[-2], arr.shape[-1]
+        if arr.ndim == 3 and arr.shape[-1] in (1, 3):  # HWC
+            h, w = arr.shape[0], arr.shape[1]
+            area = h * w
+            for _ in range(10):
+                ta = np.random.uniform(*self.scale) * area
+                ar = np.random.uniform(*self.ratio)
+                eh, ew = int(round(np.sqrt(ta * ar))), int(round(np.sqrt(ta / ar)))
+                if eh < h and ew < w:
+                    i = np.random.randint(0, h - eh)
+                    j = np.random.randint(0, w - ew)
+                    out = arr.copy()
+                    out[i:i + eh, j:j + ew] = self.value
+                    return out
+            return arr
+        area = h * w
+        for _ in range(10):
+            ta = np.random.uniform(*self.scale) * area
+            ar = np.random.uniform(*self.ratio)
+            eh, ew = int(round(np.sqrt(ta * ar))), int(round(np.sqrt(ta / ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh)
+                j = np.random.randint(0, w - ew)
+                return erase(arr, i, j, eh, ew, self.value)
+        return arr
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5, interpolation="nearest",
+                 fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        if np.random.rand() > self.prob:
+            return arr
+        h, w = arr.shape[:2]
+        d = self.distortion_scale
+        tl = (np.random.uniform(0, d * w / 2), np.random.uniform(0, d * h / 2))
+        tr = (w - 1 - np.random.uniform(0, d * w / 2), np.random.uniform(0, d * h / 2))
+        br = (w - 1 - np.random.uniform(0, d * w / 2), h - 1 - np.random.uniform(0, d * h / 2))
+        bl = (np.random.uniform(0, d * w / 2), h - 1 - np.random.uniform(0, d * h / 2))
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        return perspective(arr, start, [tl, tr, br, bl])
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, numbers.Number) else size
+        self.scale, self.ratio = scale, ratio
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            ta = np.random.uniform(*self.scale) * area
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]), np.log(self.ratio[1])))
+            ch = int(round(np.sqrt(ta / ar)))
+            cw = int(round(np.sqrt(ta * ar)))
+            if ch <= h and cw <= w:
+                i = np.random.randint(0, h - ch + 1)
+                j = np.random.randint(0, w - cw + 1)
+                return _resize_hwc(arr[i:i + ch, j:j + cw], self.size)
+        return _resize_hwc(center_crop(arr, min(h, w)), self.size)
+
+
+__all__ += [
+    "BaseTransform", "ColorJitter", "Grayscale", "HueTransform",
+    "SaturationTransform", "RandomAffine", "RandomErasing", "RandomPerspective",
+    "RandomResizedCrop", "to_tensor", "normalize", "resize", "pad", "crop",
+    "center_crop", "hflip", "vflip", "rotate", "affine", "perspective", "erase",
+    "to_grayscale", "adjust_brightness", "adjust_contrast", "adjust_hue",
+]

@@ -1,0 +1,161 @@
+"""signal/transforms/incubate-fused/static-tail tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.signal as signal
+from paddle_tpu.vision import transforms as T
+
+
+RNG = np.random.RandomState(51)
+
+
+def _v(t):
+    return np.asarray(t._value)
+
+
+class TestSignal:
+    def test_stft_matches_scipy(self):
+        import scipy.signal as ss
+
+        x = RNG.randn(2048).astype(np.float32)
+        w = P.audio.functional.get_window("hann", 256)
+        S = _v(signal.stft(P.to_tensor(x), 256, 64, window=w, center=False))
+        _, _, ref = ss.stft(x, window="hann", nperseg=256, noverlap=192,
+                            boundary=None, padded=False)
+        # scipy normalizes by window sum; compare up to that scale
+        scale = np.abs(S).max() / np.abs(ref).max()
+        np.testing.assert_allclose(np.abs(S), np.abs(ref) * scale, rtol=1e-2, atol=1e-3)
+
+    def test_roundtrip(self):
+        x = np.sin(np.arange(4096) * 0.05).astype(np.float32)
+        w = P.audio.functional.get_window("hann", 256)
+        S = signal.stft(P.to_tensor(x), 256, 64, window=w)
+        back = _v(signal.istft(S, 256, 64, window=w, length=4096))
+        np.testing.assert_allclose(back[200:-200], x[200:-200], atol=1e-3)
+
+    def test_grad_through_stft(self):
+        x = P.to_tensor(RNG.randn(1024).astype(np.float32))
+        x.stop_gradient = False
+        S = signal.stft(x, 128, 32)
+        P.sum(P.abs(S) ** 2).backward()
+        assert x.grad is not None and np.isfinite(_v(x.grad)).all()
+
+
+class TestTransformsTail:
+    def test_functional_round(self):
+        img = (RNG.rand(16, 16, 3) * 255).astype(np.uint8)
+        assert T.hflip(img).shape == (16, 16, 3)
+        np.testing.assert_array_equal(T.hflip(T.hflip(img)), img)
+        assert T.center_crop(img, 8).shape == (8, 8, 3)
+        assert T.crop(img, 2, 2, 5, 5).shape == (5, 5, 3)
+        assert T.pad(img, 2).shape == (20, 20, 3)
+        assert T.to_grayscale(img, 3).shape == (16, 16, 3)
+        t = T.to_tensor(img)
+        assert list(t.shape) == [3, 16, 16] and float(_v(t).max()) <= 1.0
+
+    def test_rotate_90_exact(self):
+        img = np.zeros((8, 8), np.float32)
+        img[1, 2] = 1.0
+        out = T.rotate(img, 90)
+        assert out.sum() == 1.0  # mass preserved under exact 90-degree turn
+
+    def test_color_ops(self):
+        img = (RNG.rand(8, 8, 3)).astype(np.float32)
+        b = T.adjust_brightness(img, 1.5)
+        assert b.max() <= 1.0
+        c = T.adjust_contrast(img, 0.5)
+        assert c.shape == img.shape
+        h = T.adjust_hue(img, 0.25)
+        assert h.shape == img.shape
+
+    def test_random_classes(self):
+        np.random.seed(0)
+        img = (RNG.rand(32, 32, 3) * 255).astype(np.uint8)
+        assert T.RandomResizedCrop(16)(img).shape == (16, 16, 3)
+        assert T.ColorJitter(0.2, 0.2, 0.2, 0.1)(img).shape == (32, 32, 3)
+        assert T.RandomAffine(15, translate=(0.1, 0.1))(img).shape == (32, 32, 3)
+        assert T.RandomPerspective(prob=1.0)(img).shape == (32, 32, 3)
+        er = T.RandomErasing(prob=1.0)(img.astype(np.float32))
+        assert er.shape == (32, 32, 3)
+        assert T.Grayscale(3)(img).shape == (32, 32, 3)
+
+    def test_perspective_identity(self):
+        img = (RNG.rand(10, 10, 1) * 255).astype(np.float32)
+        pts = [(0, 0), (9, 0), (9, 9), (0, 9)]
+        out = T.perspective(img, pts, pts)
+        np.testing.assert_allclose(out, img, atol=1e-3)
+
+
+class TestIncubateFusedTail:
+    def test_fused_feedforward_matches_composed(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        import paddle_tpu.nn.functional as F
+
+        x = P.to_tensor(RNG.randn(2, 8).astype(np.float32))
+        w1 = P.to_tensor(RNG.randn(8, 16).astype(np.float32))
+        w2 = P.to_tensor(RNG.randn(16, 8).astype(np.float32))
+        g = P.to_tensor(np.ones(8, np.float32))
+        b = P.to_tensor(np.zeros(8, np.float32))
+        out = IF.fused_feedforward(x, w1, w2, dropout1_rate=0.0, dropout2_rate=0.0,
+                                   ln2_scale=g, ln2_bias=b, training=False)
+        ref = F.layer_norm(x + P.matmul(F.relu(P.matmul(x, w1)), w2), [8], g, b, 1e-5)
+        np.testing.assert_allclose(_v(out), _v(ref), rtol=1e-4, atol=1e-5)
+
+    def test_fused_moe_mixes_experts(self):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        x = P.to_tensor(RNG.randn(4, 8).astype(np.float32))
+        gate = P.to_tensor(RNG.randn(8, 3).astype(np.float32))
+        w1 = P.to_tensor(RNG.randn(3, 8, 16).astype(np.float32))
+        w2 = P.to_tensor(RNG.randn(3, 16, 8).astype(np.float32))
+        out = IF.fused_moe(x, gate, w1, None, w2, None, moe_topk=2)
+        assert list(out.shape) == [4, 8]
+        assert np.isfinite(_v(out)).all()
+
+    def test_varlen_attention_masks_padding(self):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        # reference layout [B, num_heads, S, D]; keys masked by kv_seq_lens
+        q = P.to_tensor(RNG.randn(2, 4, 6, 8).astype(np.float32))
+        k = RNG.randn(2, 4, 6, 8).astype(np.float32)
+        v = RNG.randn(2, 4, 6, 8).astype(np.float32)
+        out = IF.variable_length_memory_efficient_attention(
+            q, P.to_tensor(k), P.to_tensor(v),
+            kv_seq_lens=P.to_tensor(np.array([6, 3])))
+        assert list(out.shape) == [2, 4, 6, 8]
+        # batch 1 attends only to its first 3 keys: garbage in keys 3..5
+        # must not change the output
+        k2, v2 = k.copy(), v.copy()
+        k2[1, :, 3:] = 99.0
+        v2[1, :, 3:] = -99.0
+        out2 = IF.variable_length_memory_efficient_attention(
+            q, P.to_tensor(k2), P.to_tensor(v2),
+            kv_seq_lens=P.to_tensor(np.array([6, 3])))
+        np.testing.assert_allclose(_v(out)[1], _v(out2)[1], rtol=1e-4, atol=1e-5)
+
+
+class TestStaticTail:
+    def test_ema(self):
+        net = P.nn.Linear(4, 2)
+        ema = P.static.ExponentialMovingAverage(0.5)
+        ema.update(net.parameters())
+        w0 = _v(net.weight).copy()
+        net.weight.set_value(w0 + 1.0)
+        ema.update()
+        with ema.apply():
+            np.testing.assert_allclose(_v(net.weight), w0 + 0.5, rtol=1e-5)
+        np.testing.assert_allclose(_v(net.weight), w0 + 1.0, rtol=1e-5)
+
+    def test_gradients_fn(self):
+        x = P.to_tensor(np.float32(2.0))
+        x.stop_gradient = False
+        y = x * x
+        (g,) = P.static.gradients(y, x)
+        np.testing.assert_allclose(float(_v(g)), 4.0, rtol=1e-5)
+
+    def test_accuracy_helper(self):
+        pred = P.to_tensor(np.array([[0.1, 0.9], [0.8, 0.2]], np.float32))
+        label = P.to_tensor(np.array([[1], [0]], np.int64))
+        acc = P.static.accuracy(pred, label)
+        np.testing.assert_allclose(float(_v(acc)), 1.0)

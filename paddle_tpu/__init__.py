@@ -91,6 +91,21 @@ except ModuleNotFoundError:
     pass
 
 from .base.param_attr import ParamAttr  # noqa: F401
+from .device import CUDAPinnedPlace  # noqa: F401
+from .distributed.parallel import DataParallel  # noqa: F401
+
+
+class LazyGuard:
+    """parity: paddle.LazyGuard — defers parameter materialization in the
+    reference (meta tensors). Host-side numpy init is cheap here, so layers
+    initialize eagerly; the guard exists for API compatibility."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
 
 bool = bool_  # noqa: A001  (paddle exports the dtype as paddle.bool)
 

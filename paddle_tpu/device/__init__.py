@@ -11,7 +11,8 @@ from typing import Optional
 import jax
 
 __all__ = [
-    "Place", "TPUPlace", "CPUPlace", "CUDAPlace", "get_device", "set_device",
+    "Place", "TPUPlace", "CPUPlace", "CUDAPlace", "CUDAPinnedPlace",
+    "get_device", "set_device",
     "get_all_devices", "device_count", "is_compiled_with_cuda", "is_compiled_with_xpu",
     "is_compiled_with_rocm", "is_compiled_with_custom_device", "synchronize",
 ]
@@ -44,6 +45,11 @@ def TPUPlace(idx: int = 0) -> Place:
 
 def CPUPlace() -> Place:
     return Place("cpu", 0)
+
+
+def CUDAPinnedPlace() -> Place:
+    """Pinned host memory place (PJRT manages host staging; alias of CPU)."""
+    return Place("cpu")
 
 
 def CUDAPlace(idx: int = 0) -> Place:

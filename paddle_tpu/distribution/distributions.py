@@ -25,7 +25,7 @@ __all__ = [
     "Cauchy", "Chi2", "ContinuousBernoulli", "Dirichlet", "Exponential",
     "ExponentialFamily", "Gamma", "Geometric", "Gumbel", "Laplace",
     "LogNormal", "Multinomial", "MultivariateNormal", "Poisson", "StudentT",
-    "Independent",
+    "Independent", "LKJCholesky",
 ]
 
 _HALF_LOG_2PI = 0.5 * math.log(2 * math.pi)
@@ -875,3 +875,68 @@ class Independent(Distribution):
         from ..tensor.math import sum as psum  # noqa: A004
 
         return psum(ent, axis=list(range(ent.ndim - self._n, ent.ndim))) if self._n else ent
+
+
+class LKJCholesky(Distribution):
+    """LKJ prior over Cholesky factors of correlation matrices (parity:
+    distribution/lkj_cholesky.py; onion-method sampling)."""
+
+    def __init__(self, dim=2, concentration=1.0, sample_method="onion", name=None):
+        if dim < 2:
+            raise ValueError("dim must be >= 2")
+        self.dim = dim
+        self.concentration = _t(concentration)
+        self.sample_method = sample_method
+        batch = jnp.shape(self.concentration._value)
+        super().__init__(batch_shape=batch, event_shape=(dim, dim))
+
+    def sample(self, shape=()):
+        import numpy as np
+
+        with __import__("paddle_tpu").no_grad():
+            key = self._key()
+            d = self.dim
+            eta = float(jnp.reshape(self.concentration._value, (-1,))[0])
+            shp = tuple(shape)
+            n = int(np.prod(shp)) if shp else 1
+
+            def one(k):
+                # onion method; radius and direction need INDEPENDENT keys
+                ks = jax.random.split(k, d)
+                L = jnp.zeros((d, d))
+                L = L.at[0, 0].set(1.0)
+                for i in range(1, d):
+                    beta_i = eta + (d - 1 - i) / 2.0
+                    ky, ku = jax.random.split(ks[i])
+                    y = jax.random.beta(ky, i / 2.0, beta_i)
+                    u = jax.random.normal(ku, (i,))
+                    u = u / jnp.linalg.norm(u)
+                    w = jnp.sqrt(y) * u
+                    L = L.at[i, :i].set(w)
+                    L = L.at[i, i].set(jnp.sqrt(jnp.maximum(1 - y, 1e-12)))
+                return L
+
+            keys = jax.random.split(key, n)
+            outs = jax.vmap(one)(keys)
+            outs = outs.reshape(shp + (d, d)) if shp else outs[0]
+            return Tensor(outs)
+
+    def log_prob(self, value):
+        value = _t(value)
+        d = self.dim
+
+        def f(L, eta):
+            diag = jnp.diagonal(L, axis1=-2, axis2=-1)[..., 1:]
+            orders = jnp.arange(2, d + 1, dtype=jnp.float32)
+            exponents = 2 * (eta - 1) + d - orders
+            unnorm = jnp.sum(exponents * jnp.log(jnp.maximum(diag, 1e-30)), axis=-1)
+            # normalization (Stan reference): product of beta normalizers
+            ks = jnp.arange(1, d, dtype=jnp.float32)
+            alpha = eta + (d - 1 - ks) / 2.0
+            lognorm = jnp.sum(
+                0.5 * ks * jnp.log(jnp.pi)
+                + jax.scipy.special.gammaln(alpha)
+                - jax.scipy.special.gammaln(alpha + ks / 2.0))
+            return unnorm - lognorm
+
+        return self._apply(f, value, self.concentration, op_name="lkj_log_prob")

@@ -1,3 +1,304 @@
 """paddle_tpu.incubate (parity: python/paddle/incubate — fused ops + MoE)."""
 from . import nn  # noqa: F401
 from . import distributed  # noqa: F401
+
+# ----------------------------------------------------- incubate op tail
+from . import asp  # noqa: F401,E402
+
+
+def segment_sum(data, segment_ids, name=None):
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.dispatch import apply
+    from ..tensor._helpers import to_tensor_like
+
+    data, segment_ids = to_tensor_like(data), to_tensor_like(segment_ids)
+    n = int(jnp.max(segment_ids._value)) + 1
+    return apply(lambda d, s: jax.ops.segment_sum(d, s.astype(jnp.int32), num_segments=n),
+                 data, segment_ids, op_name="segment_sum")
+
+
+def segment_mean(data, segment_ids, name=None):
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.dispatch import apply
+    from ..tensor._helpers import to_tensor_like
+
+    data, segment_ids = to_tensor_like(data), to_tensor_like(segment_ids)
+    n = int(jnp.max(segment_ids._value)) + 1
+
+    def f(d, s):
+        s = s.astype(jnp.int32)
+        tot = jax.ops.segment_sum(d, s, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones(s.shape + (1,) * (d.ndim - 1), d.dtype),
+                                  s, num_segments=n)
+        return tot / jnp.maximum(cnt, 1)
+
+    return apply(f, data, segment_ids, op_name="segment_mean")
+
+
+def segment_max(data, segment_ids, name=None):
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.dispatch import apply
+    from ..tensor._helpers import to_tensor_like
+
+    data, segment_ids = to_tensor_like(data), to_tensor_like(segment_ids)
+    n = int(jnp.max(segment_ids._value)) + 1
+    return apply(lambda d, s: jax.ops.segment_max(d, s.astype(jnp.int32), num_segments=n),
+                 data, segment_ids, op_name="segment_max")
+
+
+def segment_min(data, segment_ids, name=None):
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.dispatch import apply
+    from ..tensor._helpers import to_tensor_like
+
+    data, segment_ids = to_tensor_like(data), to_tensor_like(segment_ids)
+    n = int(jnp.max(segment_ids._value)) + 1
+    return apply(lambda d, s: jax.ops.segment_min(d, s.astype(jnp.int32), num_segments=n),
+                 data, segment_ids, op_name="segment_min")
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum", out_size=None, name=None):
+    """Gather messages from src nodes, reduce onto dst nodes (reference
+    incubate.graph_send_recv) — one gather + one segment reduction."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.dispatch import apply
+    from ..tensor._helpers import to_tensor_like
+
+    x = to_tensor_like(x)
+    src_index, dst_index = to_tensor_like(src_index), to_tensor_like(dst_index)
+    n = out_size or x.shape[0]
+    if pool_type not in ("sum", "max", "min", "mean"):
+        raise ValueError(f"pool_type must be sum/mean/max/min, got {pool_type!r}")
+    red = {"sum": jax.ops.segment_sum, "max": jax.ops.segment_max,
+           "min": jax.ops.segment_min}.get(pool_type)
+
+    def f(xv, si, di):
+        msgs = xv[si.astype(jnp.int32)]
+        if red is not None:
+            return red(msgs, di.astype(jnp.int32), num_segments=n)
+        tot = jax.ops.segment_sum(msgs, di.astype(jnp.int32), num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones(di.shape + (1,) * (xv.ndim - 1), xv.dtype),
+                                  di.astype(jnp.int32), num_segments=n)
+        return tot / jnp.maximum(cnt, 1)
+
+    return apply(f, x, src_index, dst_index, op_name="graph_send_recv")
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    """Reindex a neighborhood subgraph to contiguous local ids (host-side)."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from ..tensor.tensor import Tensor
+
+    xs = np.asarray(x._value).reshape(-1)
+    nb = np.asarray(neighbors._value).reshape(-1)
+    uniq = {}
+    for v in xs:
+        uniq.setdefault(int(v), len(uniq))
+    for v in nb:
+        uniq.setdefault(int(v), len(uniq))
+    reindex = np.asarray([uniq[int(v)] for v in nb], np.int64)
+    cnt = np.asarray(count._value).reshape(-1)
+    dst = np.repeat(np.arange(len(xs)), cnt).astype(np.int64)
+    nodes = np.asarray(sorted(uniq, key=uniq.get), np.int64)
+    return (Tensor(jnp.asarray(reindex)), Tensor(jnp.asarray(dst)),
+            Tensor(jnp.asarray(nodes)))
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None, perm_buffer=None,
+                           sample_size=-1, return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    """Sample up to sample_size neighbors per input node from CSC (host-side)."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from ..tensor.tensor import Tensor
+
+    rowv = np.asarray(row._value).reshape(-1)
+    cp = np.asarray(colptr._value).reshape(-1)
+    nodes = np.asarray(input_nodes._value).reshape(-1)
+    from ..framework.random import default_generator
+
+    import jax as _jax
+
+    seed = int(_jax.random.randint(default_generator().next_key(), (), 0, 2**31 - 1))
+    rs = np.random.RandomState(seed)
+    out_nb, out_cnt = [], []
+    for nd in nodes:
+        lo, hi = int(cp[nd]), int(cp[nd + 1])
+        nbrs = rowv[lo:hi]
+        if 0 <= sample_size < len(nbrs):
+            nbrs = rs.choice(nbrs, size=sample_size, replace=False)
+        out_nb.append(nbrs)
+        out_cnt.append(len(nbrs))
+    flat = np.concatenate(out_nb) if out_nb else np.zeros(0, rowv.dtype)
+    return Tensor(jnp.asarray(flat)), Tensor(jnp.asarray(np.asarray(out_cnt, np.int64)))
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes, sorted_eids=None,
+                       return_eids=False, name=None):
+    """K-hop sampling: repeated graph_sample_neighbors + reindex (host-side)."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from ..tensor.tensor import Tensor
+
+    frontier = np.asarray(input_nodes._value).reshape(-1)
+    frontiers, all_nb, all_cnt = [], [], []
+    cur = Tensor(jnp.asarray(frontier))
+    for k in sample_sizes:
+        frontiers.append(np.asarray(cur._value).reshape(-1))
+        nb, cnt = graph_sample_neighbors(row, colptr, cur, sample_size=k)
+        all_nb.append(np.asarray(nb._value))
+        all_cnt.append(np.asarray(cnt._value))
+        cur = nb
+    # reindex against the concatenated frontiers so len(x) == len(counts)
+    x_cat = np.concatenate(frontiers) if frontiers else np.zeros(0, np.int64)
+    nb_cat = np.concatenate(all_nb) if all_nb else np.zeros(0, np.int64)
+    cnt_cat = np.concatenate(all_cnt) if all_cnt else np.zeros(0, np.int64)
+    reindex, dst, nodes = graph_reindex(
+        Tensor(jnp.asarray(x_cat)), Tensor(jnp.asarray(nb_cat)),
+        Tensor(jnp.asarray(cnt_cat)))
+    return reindex, dst, nodes, Tensor(jnp.asarray(cnt_cat))
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) in one fusion (reference fused_softmax_mask)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.dispatch import apply
+    from ..tensor._helpers import to_tensor_like
+
+    return apply(lambda a, m: jax.nn.softmax(a + m.astype(a.dtype), axis=-1),
+                 to_tensor_like(x), to_tensor_like(mask), op_name="softmax_mask_fuse")
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.dispatch import apply
+    from ..tensor._helpers import to_tensor_like
+
+    def f(a):
+        s = a.shape[-1]
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        return jax.nn.softmax(jnp.where(mask, a, -1e30), axis=-1)
+
+    return apply(f, to_tensor_like(x), op_name="softmax_mask_fuse_upper_triangle")
+
+
+def identity_loss(x, reduction="none"):
+    from ..tensor import math as _m
+    from ..tensor._helpers import to_tensor_like
+
+    x = to_tensor_like(x)
+    if reduction in ("sum", 0):
+        return _m.sum(x)
+    if reduction in ("mean", 1):
+        return _m.mean(x)
+    if reduction in ("none", 2):
+        return x
+    raise ValueError(f"unsupported reduction: {reduction!r}")
+
+
+class LookAhead:
+    """Lookahead optimizer wrapper (reference incubate.LookAhead): every k
+    steps, slow weights <- slow + alpha (fast - slow); fast <- slow."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._slow = {}
+        self._steps = 0
+
+    def __getattr__(self, name):
+        return getattr(self.inner_optimizer, name)
+
+    def step(self):
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        self.inner_optimizer.step()
+        self._steps += 1
+        if self._steps % self.k == 0:
+            for p in self.inner_optimizer._parameter_list:
+                slow = self._slow.get(id(p))
+                if slow is None:
+                    slow = np.asarray(p._value)
+                slow = slow + self.alpha * (np.asarray(p._value, slow.dtype) - slow)
+                self._slow[id(p)] = slow
+                p._value = jnp.asarray(slow, p._value.dtype)
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        return None, None
+
+
+class ModelAverage:
+    """Running average of parameters with apply/restore (reference
+    incubate.ModelAverage)."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None, min_average_window=10000,
+                 max_average_window=10000000, name=None):
+        self._params = list(parameters or [])
+        self._sum = {}
+        self._cnt = 0
+        self._backup = {}
+
+    def step(self):
+        import numpy as np
+
+        for p in self._params:
+            cur = np.asarray(p._value, np.float32)
+            self._sum[id(p)] = self._sum.get(id(p), 0.0) + cur
+        self._cnt += 1
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def guard():
+            import jax.numpy as jnp
+
+            for p in self._params:
+                self._backup[id(p)] = p._value
+                if id(p) in self._sum and self._cnt:
+                    p._value = jnp.asarray(self._sum[id(p)] / self._cnt, p._value.dtype)
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+
+        return guard()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._value = self._backup.pop(id(p))
+
+
+def inference(*a, **k):
+    raise NotImplementedError(
+        "incubate.jit.inference decorator: use paddle_tpu.inference.Config + "
+        "create_predictor (AOT-compiled serving) instead")

@@ -245,3 +245,29 @@ class benchmark:
         if samples:
             rep["ips"] = sum(samples) / sum(dts)
         return rep
+
+
+class SortedKeys:
+    """Sort keys for summary tables (parity: profiler.SortedKeys)."""
+
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+def export_protobuf(path):
+    raise NotImplementedError(
+        "protobuf trace export: use Profiler(timer_only=False) chrome-trace "
+        "export (perfetto-compatible), the XLA-native trace format")
+
+
+def load_profiler_result(filename):
+    import json
+
+    with open(filename) as f:
+        return json.load(f)

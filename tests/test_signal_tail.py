@@ -159,3 +159,94 @@ class TestStaticTail:
         label = P.to_tensor(np.array([[1], [0]], np.int64))
         acc = P.static.accuracy(pred, label)
         np.testing.assert_allclose(float(_v(acc)), 1.0)
+
+
+class TestIncubateOpTail:
+    def test_segment_ops(self):
+        data = P.to_tensor(np.array([[1.0, 2], [3, 4], [5, 6]], np.float32))
+        seg = P.to_tensor(np.array([0, 0, 1]))
+        from paddle_tpu import incubate as I
+
+        np.testing.assert_allclose(_v(I.segment_sum(data, seg)), [[4, 6], [5, 6]])
+        np.testing.assert_allclose(_v(I.segment_mean(data, seg)), [[2, 3], [5, 6]])
+        np.testing.assert_allclose(_v(I.segment_max(data, seg)), [[3, 4], [5, 6]])
+        np.testing.assert_allclose(_v(I.segment_min(data, seg)), [[1, 2], [5, 6]])
+
+    def test_graph_send_recv(self):
+        from paddle_tpu import incubate as I
+
+        x = P.to_tensor(np.array([[1.0], [2.0], [3.0]], np.float32))
+        src = P.to_tensor(np.array([0, 1, 2, 0]))
+        dst = P.to_tensor(np.array([1, 2, 0, 0]))
+        out = _v(I.graph_send_recv(x, src, dst, "sum"))
+        np.testing.assert_allclose(out, [[4.0], [1.0], [2.0]])
+
+    def test_softmax_mask_fuse(self):
+        from paddle_tpu import incubate as I
+
+        x = P.to_tensor(RNG.randn(2, 4, 4).astype(np.float32))
+        out = _v(I.softmax_mask_fuse_upper_triangle(x))
+        np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-5)
+        assert (np.triu(out[0], 1) < 1e-6).all()  # future masked
+
+    def test_lookahead_and_model_average(self):
+        from paddle_tpu import incubate as I
+
+        net = P.nn.Linear(4, 2)
+        opt = I.LookAhead(P.optimizer.SGD(learning_rate=0.1,
+                                          parameters=net.parameters()), k=2)
+        ma = I.ModelAverage(parameters=net.parameters())
+        x = P.to_tensor(RNG.randn(8, 4).astype(np.float32))
+        for _ in range(4):
+            loss = P.mean(net(x) ** 2)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            ma.step()
+        w_live = _v(net.weight).copy()
+        with ma.apply():
+            assert not np.allclose(_v(net.weight), w_live)
+        np.testing.assert_allclose(_v(net.weight), w_live)
+
+    def test_lkj_cholesky(self):
+        import paddle_tpu.distribution as D
+
+        d = D.LKJCholesky(dim=3, concentration=2.0)
+        L = _v(d.sample())
+        assert L.shape == (3, 3)
+        corr = L @ L.T
+        np.testing.assert_allclose(np.diag(corr), 1.0, rtol=1e-5)
+        assert np.abs(corr[0, 1]) <= 1.0
+        lp = d.log_prob(P.to_tensor(L))
+        assert np.isfinite(float(_v(lp)))
+
+    def test_khop_multi_hop(self):
+        from paddle_tpu import incubate as I
+
+        # ring graph 0-1-2-3 in CSC
+        row = P.to_tensor(np.array([1, 3, 0, 2, 1, 3, 0, 2], np.int64))
+        colptr = P.to_tensor(np.array([0, 2, 4, 6, 8], np.int64))
+        nodes = P.to_tensor(np.array([0], np.int64))
+        reindex, dst, uniq, cnt = I.graph_khop_sampler(row, colptr, nodes, [2, 2])
+        assert _v(reindex).shape[0] == int(_v(cnt).sum())
+
+    def test_identity_loss_codes(self):
+        from paddle_tpu import incubate as I
+
+        x = P.to_tensor(np.array([1.0, 3.0], np.float32))
+        np.testing.assert_allclose(float(_v(I.identity_loss(x, 0))), 4.0)
+        np.testing.assert_allclose(float(_v(I.identity_loss(x, 1))), 2.0)
+        assert _v(I.identity_loss(x, 2)).tolist() == [1.0, 3.0]
+        import pytest as _pt
+
+        with _pt.raises(ValueError):
+            I.identity_loss(x, "bogus")
+
+    def test_graph_send_recv_validates(self):
+        from paddle_tpu import incubate as I
+        import pytest as _pt
+
+        x = P.to_tensor(np.ones((2, 1), np.float32))
+        idx = P.to_tensor(np.array([0, 1]))
+        with _pt.raises(ValueError):
+            I.graph_send_recv(x, idx, idx, pool_type="SUM")

@@ -10,11 +10,26 @@ from typing import Optional
 
 import jax
 
+from .memory import (  # noqa: F401
+    empty_cache,
+    get_memory_info,
+    max_memory_allocated,
+    max_memory_reserved,
+    memory_allocated,
+    memory_reserved,
+    memory_stats,
+    reset_max_memory_allocated,
+    reset_max_memory_reserved,
+)
+
 __all__ = [
     "Place", "TPUPlace", "CPUPlace", "CUDAPlace", "CUDAPinnedPlace",
     "get_device", "set_device",
     "get_all_devices", "device_count", "is_compiled_with_cuda", "is_compiled_with_xpu",
     "is_compiled_with_rocm", "is_compiled_with_custom_device", "synchronize",
+    "memory_stats", "memory_allocated", "max_memory_allocated",
+    "memory_reserved", "max_memory_reserved", "reset_max_memory_allocated",
+    "reset_max_memory_reserved", "get_memory_info", "empty_cache",
 ]
 
 

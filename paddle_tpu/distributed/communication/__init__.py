@@ -93,11 +93,26 @@ def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[Group] = None, sync_op=T
     if _axis_in_scope(axis):
         out = _lax_reduce(val, op, axis)
     elif jax.process_count() > 1:
-        from jax.experimental import multihost_utils
+        if group is not None and group.nranks < jax.process_count():
+            # subgroup collective: only the group's processes participate
+            out = _group_eager_reduce(val, op, group)
+        else:
+            from jax.experimental import multihost_utils
 
-        out = multihost_utils.process_allgather(val)
-        out = out.sum(0) if op == ReduceOp.SUM else out.max(0) if op == ReduceOp.MAX else out.min(0)
-        out = jnp.asarray(out)
+            out = multihost_utils.process_allgather(val)
+            if op == ReduceOp.SUM:
+                out = out.sum(0)
+            elif op == ReduceOp.MAX:
+                out = out.max(0)
+            elif op == ReduceOp.MIN:
+                out = out.min(0)
+            elif op == ReduceOp.AVG:
+                out = out.mean(0)
+            elif op == ReduceOp.PROD:
+                out = out.prod(0)
+            else:
+                raise ValueError(f"unsupported reduce op {op}")
+            out = jnp.asarray(out)
     else:
         out = val  # single-process SPMD: global array already holds the reduced view
     if isinstance(tensor, Tensor):
@@ -176,7 +191,30 @@ def broadcast_object_list(object_list, src=0, group=None):
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
-    return all_reduce(tensor, op, group, sync_op)  # dst holds it; others too (SPMD)
+    """Reduce to ``dst``: only the destination rank observes the reduced
+    value; other ranks keep their input (ProcessGroup::Reduce contract)."""
+    axis = group.axis_name if group is not None else None
+    if group is not None and group.get_group_rank(dst) < 0:
+        raise ValueError(f"reduce dst={dst} is not a member of {group}")
+    if _axis_in_scope(axis):
+        val = _raw(tensor)
+        reduced = _lax_reduce(val, op, axis)
+        dst_local = group.get_group_rank(dst) if group is not None else dst
+        out = jnp.where(lax.axis_index(axis) == dst_local, reduced, val)
+        if isinstance(tensor, Tensor):
+            tensor._value = out
+            return _Task(out)
+        return out
+    if jax.process_count() > 1:
+        orig = _raw(tensor)
+        task = all_reduce(tensor, op, group, sync_op)
+        my = group.rank if group is not None else jax.process_index()
+        dst_local = group.get_group_rank(dst) if group is not None else dst
+        if my != dst_local and isinstance(tensor, Tensor):
+            tensor._value = orig  # non-destination ranks keep their input
+        return task
+    # single-process SPMD: the global array already holds the reduced view
+    return all_reduce(tensor, op, group, sync_op)
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
@@ -228,26 +266,127 @@ def all_to_all_single(out_tensor, in_tensor, out_split_sizes=None, in_split_size
     return Tensor(out)
 
 
+# --------------------------------------------------------------- eager p2p
+def _proc_mesh(group):
+    """One device per participating process (the ProcessGroup analog: ranks
+    are processes, transport is the jax distributed runtime over ICI/DCN)."""
+    from jax.sharding import Mesh
+
+    ranks = group.ranks if group is not None else list(range(jax.process_count()))
+    devs = []
+    for r in ranks:
+        ds = [d for d in jax.devices() if d.process_index == r]
+        if not ds:
+            raise RuntimeError(f"no device for process {r} in group {ranks}")
+        devs.append(ds[0])
+    return Mesh(np.asarray(devs), ("p",)), ranks
+
+
+def _shard_map_p(fn, mesh):
+    from jax.sharding import PartitionSpec
+
+    try:
+        from jax import shard_map
+
+        return shard_map(fn, mesh=mesh, in_specs=PartitionSpec("p"),
+                         out_specs=PartitionSpec("p"), check_vma=False)
+    except ImportError:  # pragma: no cover - older jax spells it check_rep
+        from jax.experimental.shard_map import shard_map
+
+        return shard_map(fn, mesh=mesh, in_specs=PartitionSpec("p"),
+                         out_specs=PartitionSpec("p"), check_rep=False)
+
+
+def _group_global_array(val, mesh):
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    my = jax.process_index()
+    local_dev = next((d for d in mesh.devices.flat if d.process_index == my), None)
+    if local_dev is None:
+        raise RuntimeError(
+            f"process {my} is not a member of this communication group; only "
+            "group members may call its eager collectives")
+    arr = jnp.asarray(val)
+    return arr, jax.make_array_from_single_device_arrays(
+        (mesh.devices.size, *arr.shape), NamedSharding(mesh, PartitionSpec("p")),
+        [jax.device_put(arr[None], local_dev)])
+
+
+def _group_eager_reduce(val, op, group):
+    """Eager reduction over exactly the group's processes (no global
+    collective): shard_map psum/pmax/... over a one-device-per-rank mesh."""
+    mesh, _ = _proc_mesh(group)
+    arr, garr = _group_global_array(val, mesh)
+    out = jax.jit(_shard_map_p(lambda x: _lax_reduce(x, op, "p"), mesh))(garr)
+    my = jax.process_index()
+    shard = next(s for s in out.addressable_shards if s.device.process_index == my)
+    return jnp.asarray(shard.data)[0].astype(arr.dtype)
+
+
+def _pair_mesh(src, dst, group):
+    """A 2-device mesh over exactly {src, dst} so the transfer is a
+    collective only between the two participating processes (any other rank
+    in the group is uninvolved — no deadlock when it doesn't call)."""
+    from jax.sharding import Mesh
+
+    ranks = group.ranks if group is not None else list(range(jax.process_count()))
+    devs = []
+    for r in (ranks[src], ranks[dst]):
+        ds = [d for d in jax.devices() if d.process_index == r]
+        if not ds:
+            raise RuntimeError(f"no device for process {r}")
+        devs.append(ds[0])
+    return Mesh(np.asarray(devs), ("p",))
+
+
+def _cross_process_permute(val, perm, group, mesh):
+    """Run one ppermute step over the given process mesh on a global array
+    built from each process's local value. Every rank in the mesh must call
+    this with the SAME perm (send/recv pairs do by construction)."""
+    _, garr = _group_global_array(val, mesh)
+    out = jax.jit(_shard_map_p(lambda x: lax.ppermute(x, "p", perm), mesh))(garr)
+    my = jax.process_index()
+    shard = next(s for s in out.addressable_shards if s.device.process_index == my)
+    return jnp.asarray(shard.data)[0]
+
+
+# single-process fallback: FIFO per group id (degenerate convenience so
+# fleet-style scripts run in one process)
+_p2p_buf = {}
+
+
 def send(tensor, dst=0, group=None, sync_op=True):
     axis = group.axis_name if group is not None else None
     if _axis_in_scope(axis):
-        raise RuntimeError("inside shard_map use p2p.ppermute_send_recv (paired send/recv)")
+        raise RuntimeError("inside shard_map use lax.ppermute (paired send/recv)")
     if jax.process_count() == 1:
-        _p2p_buf.append(_raw(tensor))
+        _p2p_buf.setdefault(group.id if group is not None else 0, []).append(_raw(tensor))
         return _Task()
-    raise NotImplementedError("cross-process eager send requires the pipeline p2p helpers")
-
-
-_p2p_buf = []
+    my = group.rank if group is not None else jax.process_index()
+    dst_local = group.get_group_rank(dst) if group is not None else dst
+    # pair mesh: position 0 = sender, 1 = receiver
+    _cross_process_permute(_raw(tensor), [(0, 1)], group,
+                           mesh=_pair_mesh(my, dst_local, group))
+    return _Task()
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
-    if jax.process_count() == 1 and _p2p_buf:
-        val = _p2p_buf.pop(0)
-        if isinstance(tensor, Tensor):
-            tensor._value = val
-        return _Task(val)
-    raise NotImplementedError("cross-process eager recv requires the pipeline p2p helpers")
+    if jax.process_count() == 1:
+        buf = _p2p_buf.get(group.id if group is not None else 0)
+        if buf:
+            val = buf.pop(0)
+            if isinstance(tensor, Tensor):
+                tensor._value = val
+            return _Task(val)
+        raise RuntimeError("recv with no matching single-process send")
+    my = group.rank if group is not None else jax.process_index()
+    src_local = group.get_group_rank(src) if group is not None else src
+    val = _cross_process_permute(_raw(tensor), [(0, 1)], group,
+                                 mesh=_pair_mesh(src_local, my, group))
+    val = val.astype(_raw(tensor).dtype)
+    if isinstance(tensor, Tensor):
+        tensor._value = val
+    return _Task(val)
 
 
 isend = send

@@ -37,9 +37,15 @@ class Group:
         topo = hcg.topology()
         name_map = dict(dp="data", pp="pipe", sharding="sharding", sep="sep", mp="model")
         groups = topo.get_comm_list(name_map[axis])
-        # single-controller SPMD: this process sees group 0's shape; ranks list
-        # is informational (parity with the reference's bookkeeping)
+        # pick the comm group CONTAINING this process (eager subgroup
+        # collectives depend on real membership); single-process SPMD sees
+        # group 0
         ranks = groups[0] if groups else [0]
+        pid = jax.process_index()
+        for g in groups:
+            if pid in g:
+                ranks = g
+                break
         return cls(ranks, axis, hcg.mesh)
 
     @property

@@ -1,4 +1,5 @@
 """fleet.meta_parallel (parity: python/paddle/distributed/fleet/meta_parallel)."""
+from .compiled_pipeline import CompiledPipelineTrainStep, pipeline_bubble_fraction  # noqa: F401
 from .pipeline_parallel import PipelineParallel  # noqa: F401
 from .pp_layers import LayerDesc, PipelineLayer, SegmentLayers, SharedLayerDesc  # noqa: F401
 from ..mp_layers import (  # noqa: F401

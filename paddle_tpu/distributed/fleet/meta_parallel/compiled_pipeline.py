@@ -1,0 +1,262 @@
+"""Compiled pipeline parallelism — the whole microbatch schedule in ONE XLA
+program.
+
+Reference analog: the static-graph pipeline scheduler passes
+(/root/reference/python/paddle/distributed/passes/pipeline_scheduler_pass/)
+which compile 1F1B/ZB orderings into a single program per rank, vs. the eager
+per-op engine (meta_parallel/pipeline_parallel.py).
+
+TPU-native formulation (the GSPMD/shard_map pipeline): every pp rank runs the
+SAME program — stage identity is ``lax.axis_index('pp')``; per-stage weights
+are STACKED on a leading axis sharded over 'pp' (the stacked arrays are the
+canonical storage, so each device holds exactly its stage's weights and
+optimizer state); activations advance around the ring with ``lax.ppermute``
+inside a ``lax.scan`` over T = num_micro + P - 1 ticks. XLA's latency-hiding
+scheduler overlaps the ppermute with the next tick's compute — the
+1F1B/zero-bubble distinction collapses into data dependencies the compiler
+schedules (SURVEY §7.2 item 5). Per-tick ``jax.checkpoint`` keeps saved state
+to stage-boundary activations (1F1B-grade memory, not GPipe-grade).
+
+Composes with TrainStep: the optimizer's param groups are re-pointed at the
+stacked weights, so the framework's own update rules, GradScaler, and donated
+buffers apply unchanged — optimizer accumulators come out [P, ...] and
+pp-sharded automatically.
+
+Requirements (checked): homogeneous stages (identical param trees), one chunk
+per stage (no VPP interleave), activation shape == stage input shape. The
+eager engine remains the general fallback.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ....autograd import tape
+from ....nn.layer.layers import Layer
+from ....tensor.tensor import Tensor
+
+__all__ = ["CompiledPipelineTrainStep", "pipeline_bubble_fraction"]
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    try:
+        from jax import shard_map
+
+        return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                         check_vma=False)
+    except (ImportError, TypeError):  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+
+        return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                         check_rep=False)
+
+
+def pipeline_bubble_fraction(num_micro: int, num_stages: int) -> float:
+    """Idle fraction of the synchronous pipeline: (P-1)/(M+P-1)."""
+    return (num_stages - 1) / (num_micro + num_stages - 1)
+
+
+def _stage_param_lists(pipe) -> List[List]:
+    """Per-stage parameter lists, with homogeneity checks."""
+    if pipe._num_chunks != 1:
+        raise ValueError("compiled pipeline does not support VPP chunks; "
+                         "use the eager engine for interleaved schedules")
+    if pipe._shared_layers:
+        raise ValueError("compiled pipeline does not support SharedLayerDesc")
+    stages = []
+    for s in range(pipe._num_stages):
+        ps = []
+        for layer in pipe._stage_layers[s]:
+            if isinstance(layer, Layer):
+                ps.extend(layer.parameters())
+        stages.append(ps)
+
+    def _sig(s):
+        # every stage runs stage 0's FORWARD program, so layer types (and
+        # their configuration) must match, not just param shapes
+        out = []
+        for layer, f in zip(pipe._stage_layers[s], pipe._stage_fwd_funcs[s]):
+            cfg = repr(layer) if isinstance(layer, Layer) else getattr(
+                layer, "__name__", str(layer))
+            out.append((type(layer).__name__, cfg, f if f == "plain_fn" else None))
+        return out + [(tuple(p.shape), str(p.dtype)) for p in stages[s]]
+
+    ref = _sig(0)
+    for s in range(1, pipe._num_stages):
+        got = _sig(s)
+        if got != ref:
+            raise ValueError(
+                f"compiled pipeline needs homogeneous stages; stage {s} "
+                f"{got} != stage 0 {ref}")
+    return stages
+
+
+class _StackedStages(Layer):
+    """Holds the canonical [P, ...] pp-sharded weights as parameters."""
+
+    def __init__(self, stage_params, mesh):
+        super().__init__()
+        self._mesh = mesh
+        n_per_stage = len(stage_params[0])
+        self.stacked: List[Tensor] = []
+        for j in range(n_per_stage):
+            vals = np.stack([np.asarray(ps[j]._value) for ps in stage_params])
+            sh = NamedSharding(mesh, PartitionSpec("pp", *([None] * stage_params[0][j].ndim)))
+            t = Tensor(jax.device_put(jnp.asarray(vals), sh), stop_gradient=False)
+            self.stacked.append(t)
+            setattr(self, f"w{j}", t)  # registers as parameter
+
+    def parameters(self, include_sublayers=True):
+        return list(self.stacked)
+
+
+class CompiledPipelineTrainStep:
+    """loss + grads + optimizer update for the FULL microbatch pipeline
+    schedule, compiled into one donated-buffer XLA program."""
+
+    def __init__(self, pipe, optimizer, num_micro: int, scaler=None, remat: bool = True):
+        from ....jit.api import TrainStep
+        from ...topology import get_hybrid_communicate_group
+        from .pipeline_parallel import PipelineParallel
+
+        model = pipe._layers if isinstance(pipe, PipelineParallel) else pipe
+        hcg = get_hybrid_communicate_group()
+        if hcg is None or hcg.axis_size("pp") <= 1:
+            raise ValueError("compiled pipeline needs an active mesh with pp > 1")
+        self.mesh = mesh = hcg.mesh
+        self.num_micro = num_micro
+        self.num_stages = P = model._num_stages
+        self._pipe = model
+        self._stage_params = _stage_param_lists(model)
+        n_per_stage = len(self._stage_params[0])
+        self._stacked = _StackedStages(self._stage_params, mesh)
+        if model._loss_fn is None:
+            raise ValueError("PipelineLayer built without loss_fn")
+        loss_fn_t = model._loss_fn
+
+        # re-point the optimizer's param groups at the stacked weights (the
+        # update rules are elementwise, so [P, ...] arrays work unchanged)
+        if optimizer._accumulators or optimizer._master_weights:
+            raise ValueError("pass a fresh optimizer (no accumulated state)")
+        stacked_list = self._stacked.parameters()
+        optimizer._param_groups = [
+            {**{k: v for k, v in g.items() if k != "params"}, "params": stacked_list}
+            for g in optimizer._param_groups[:1]
+        ]
+
+        stage0_layers = model._stage_layers[0]
+        stage0_funcs = model._stage_fwd_funcs[0]
+        stage0_params = self._stage_params[0]
+        dp_axes = tuple(a for a in ("dp", "sharding")
+                        if a in mesh.axis_names and mesh.shape[a] > 1)
+        b_entry = dp_axes if len(dp_axes) > 1 else (dp_axes[0] if dp_axes else None)
+        other_axes = tuple(a for a in mesh.axis_names if a != "pp")
+
+        class _Swap:
+            def __init__(self, tensors, values):
+                self.tensors, self.values = tensors, values
+
+            def __enter__(self):
+                self.saved = [t._value for t in self.tensors]
+                for t, v in zip(self.tensors, self.values):
+                    t._value = v
+
+            def __exit__(self, *exc):
+                for t, v in zip(self.tensors, self.saved):
+                    t._value = v
+                return False
+
+        def run_stage0(param_leaves, x):
+            with _Swap(stage0_params, list(param_leaves)):
+                t = Tensor(x, stop_gradient=True)
+                for layer, ffunc in zip(stage0_layers, stage0_funcs):
+                    if ffunc == "plain_fn":
+                        t = layer(t)
+                    elif ffunc is not None:
+                        t = ffunc(layer, t)
+                    else:
+                        t = layer(t)
+                return t._value
+
+        def loss_of_micro(out, y):
+            with tape.no_grad():
+                return loss_fn_t(Tensor(out, stop_gradient=True),
+                                 Tensor(y, stop_gradient=True))._value
+
+        def local(stacked, xs, ys):
+            p_local = [a[0] for a in stacked]  # this stage's weights
+            stage = lax.axis_index("pp")
+            M = xs.shape[0]
+            T = M + P - 1
+            fwd = jax.checkpoint(run_stage0) if remat else run_stage0
+
+            def tick(h, t):
+                x_t = lax.dynamic_index_in_dim(xs, jnp.clip(t, 0, M - 1), 0,
+                                               keepdims=False)
+                inp = jnp.where(stage == 0, x_t, h)
+                out = fwd(p_local, inp)
+                h_next = lax.ppermute(
+                    out, "pp", [(i, (i + 1) % P) for i in range(P)])
+                return h_next, out
+
+            h0 = jnp.zeros_like(xs[0])
+            _, outs = lax.scan(tick, h0, jnp.arange(T))
+            # microbatch m exits the last stage at tick m + P - 1
+            exit_outs = jnp.take(outs, jnp.arange(M) + P - 1, axis=0)
+            per = jax.vmap(loss_of_micro)(exit_outs, ys)
+            loss = jnp.mean(per.astype(jnp.float32))
+            loss = jnp.where(stage == P - 1, loss, 0.0)
+            loss = lax.psum(loss, "pp")
+            if other_axes:
+                loss = lax.pmean(loss, other_axes)
+            return loss
+
+        stk_specs = tuple(
+            PartitionSpec("pp", *([None] * stage0_params[j].ndim))
+            for j in range(n_per_stage)
+        )
+
+        def pipelined_loss(model_, x, y):
+            from ....ops.dispatch import apply
+
+            def f(xv, yv, *stacked_vals):
+                mb = xv.shape[0] // num_micro
+                xs = xv.reshape(num_micro, mb, *xv.shape[1:])
+                ys = yv.reshape(num_micro, mb, *yv.shape[1:])
+                data_spec = PartitionSpec(None, b_entry)
+                fn = _shard_map(local, mesh,
+                                in_specs=(tuple(stk_specs), data_spec, data_spec),
+                                out_specs=PartitionSpec())
+                return fn(tuple(stacked_vals), xs, ys)
+
+            return apply(f, x, y, *model_.parameters(), op_name="compiled_pipeline")
+
+        self._step = TrainStep(self._stacked, pipelined_loss, optimizer,
+                               scaler=scaler)
+
+    @property
+    def bubble_fraction(self) -> float:
+        return pipeline_bubble_fraction(self.num_micro, self.num_stages)
+
+    def sync_to_model(self):
+        """Write the stacked weights back into the per-stage Tensors (for
+        state_dict / eager eval parity)."""
+        for j, t in enumerate(self._stacked.stacked):
+            host = np.asarray(t._value)
+            for s, ps in enumerate(self._stage_params):
+                sub = self._pipe._submeshes[s]
+                val = jnp.asarray(host[s])
+                if sub is not None:
+                    val = jax.device_put(
+                        val, NamedSharding(sub, PartitionSpec(*([None] * val.ndim))))
+                ps[j]._value = val
+        return self._pipe
+
+    def __call__(self, x, y):
+        return self._step(x, y)

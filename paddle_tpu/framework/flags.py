@@ -100,3 +100,8 @@ define_flag("check_nan_inf", False, "scan op outputs for nan/inf in eager mode")
 define_flag("eager_op_jit", False, "run each eager op through a cached jax.jit")
 define_flag("benchmark", False, "block on every op for precise timing")
 define_flag("use_bf16_default", False, "make bfloat16 the default float dtype")
+define_flag("dump_hlo", "", "directory to dump StableHLO + XLA-optimized HLO "
+            "of every program compiled by TrainStep/to_static")
+define_flag("flash_autotune", False, "measure flash-attention block sizes on "
+            "first encounter of a new (seq, head_dim) instead of using the "
+            "built-in table")

@@ -25,12 +25,21 @@ class Generator:
 
     def __init__(self, seed_: int = 0):
         self._seed = int(seed_)
-        self._key = jax.random.key(self._seed)
+        # key creation is LAZY: touching jax.random at import time would
+        # initialize the XLA backend before a multi-host program can call
+        # jax.distributed.initialize() (see distributed/env.py)
+        self._key_cache = None
         self._counter = 0
+
+    @property
+    def _key(self):
+        if self._key_cache is None:
+            self._key_cache = jax.random.key(self._seed)
+        return self._key_cache
 
     def manual_seed(self, seed_: int):
         self._seed = int(seed_)
-        self._key = jax.random.key(self._seed)
+        self._key_cache = None
         self._counter = 0
         return self
 
@@ -57,7 +66,7 @@ class Generator:
 
     def set_state(self, state):
         self._seed, self._counter = state
-        self._key = jax.random.key(self._seed)
+        self._key_cache = None
         return self
 
     @property

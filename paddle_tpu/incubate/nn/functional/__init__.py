@@ -108,16 +108,18 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None, posit
 
 def swiglu(x, y=None, name=None):
     """parity: incubate/nn/functional/swiglu.py — silu(x) * y (y defaults to
-    second half of x)."""
+    second half of x). Single-HBM-pass Pallas kernel on TPU."""
+    from ....ops.pallas.fused_ops import swiglu_fused
+
     x = to_tensor_like(x)
     if y is None:
         def f(v):
             a, b = jnp.split(v, 2, axis=-1)
-            return jax.nn.silu(a) * b
+            return swiglu_fused(a, b)
 
         return apply(f, x, op_name="swiglu")
     y = to_tensor_like(y)
-    return apply(lambda a, b: jax.nn.silu(a) * b, x, y, op_name="swiglu")
+    return apply(lambda a, b: swiglu_fused(a, b), x, y, op_name="swiglu")
 
 
 def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):

@@ -242,13 +242,20 @@ class StaticFunction:
         key = self._guards(arg_tensors, spec, training)
         entry = self._cache.get(key)
         n_state = len(state_tensors)
-        if entry is None:
+        new_entry = entry is None
+        if new_entry:
             entry = self._build(spec, n_state, len(arg_tensors), training)
             self._cache[key] = entry
         all_tensors = state_tensors + arg_tensors
         flat_vals = tuple(t._value for t in all_tensors)
         rng_key = default_generator().next_key()
 
+        if new_entry:
+            from .hlo_dump import dump_dir, maybe_dump
+
+            if dump_dir():
+                maybe_dump(f"to_static_{getattr(self._fn, '__name__', 'fn')}",
+                           entry["fwd"], (rng_key, flat_vals))
         raw_outs = entry["fwd"](rng_key, flat_vals)
         meta = entry["meta"]
         out_spec = meta["out_spec"]
@@ -495,7 +502,8 @@ class TrainStep:
     # ------------------------------------------------------------- call
     def __call__(self, *batch):
         batch_tensors, spec = flatten_tensors(batch)
-        if self._compiled is None:
+        first_call = self._compiled is None
+        if first_call:
             self._spec = spec
             self._compiled = self._build(spec)
         batch_vals = tuple(t._value for t in batch_tensors)
@@ -503,6 +511,13 @@ class TrainStep:
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         buf_vals = [b._value for b in self._buffers]
         accs, masters = self._get_opt_state()
+        if first_call:
+            from .hlo_dump import dump_dir, maybe_dump
+
+            if dump_dir():
+                maybe_dump("train_step", self._compiled,
+                           ([p._value for p in self._params], accs, masters, buf_vals,
+                            self._scaler_state(), rng_key, batch_vals, lr))
         loss, new_params, new_accs, new_masters, buf_out, new_scaler = self._compiled(
             [p._value for p in self._params], accs, masters, buf_vals,
             self._scaler_state(), rng_key, batch_vals, lr,

@@ -1,0 +1,69 @@
+"""HLO/IR inspection layer.
+
+Reference capability: CINN's ability to *see* what was compiled/fused
+(/root/reference/paddle/cinn/hlir/framework/pir_compiler.h:23 and the PIR
+program print/dump machinery). TPU-native: every compiled program has two
+interesting artifacts — the lowered StableHLO (what we handed XLA) and the
+optimized HLO (what XLA made of it: fusions, layouts, rematerialization).
+
+Enable with ``paddle.set_flags({'FLAGS_dump_hlo': '/some/dir'})`` or
+``FLAGS_dump_hlo=/some/dir`` in the environment; TrainStep and to_static
+write ``<name>.stablehlo.txt`` + ``<name>.optimized.txt`` there on first
+compile. ``lower_text()`` gives the same artifacts programmatically.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Optional
+
+__all__ = ["dump_dir", "maybe_dump", "lower_text"]
+
+_counter = [0]
+
+
+def dump_dir() -> Optional[str]:
+    from ..framework.flags import flag_value
+
+    d = flag_value("dump_hlo")
+    return d or None
+
+
+def lower_text(jitted, *args, optimized: bool = True, **kwargs):
+    """Lower a jax.jit'd callable with the given args.
+
+    Returns (stablehlo_text, optimized_hlo_text_or_None). The optimized text
+    is post-XLA-pipeline: fusion decisions, layout assignment, and collective
+    lowering are all visible in it.
+    """
+    lowered = jitted.lower(*args, **kwargs)
+    shlo = lowered.as_text()
+    opt = None
+    if optimized:
+        try:
+            opt = lowered.compile().as_text()
+        except Exception as e:  # pragma: no cover - backend-specific
+            opt = f"<optimized HLO unavailable: {e}>"
+    return shlo, opt
+
+
+def maybe_dump(name: str, jitted, args, kwargs=None) -> None:
+    """If FLAGS_dump_hlo names a directory, write both artifacts there."""
+    d = dump_dir()
+    if not d:
+        return
+    try:
+        os.makedirs(d, exist_ok=True)
+        safe = re.sub(r"[^A-Za-z0-9_.-]", "_", name)
+        _counter[0] += 1
+        stem = os.path.join(d, f"{_counter[0]:03d}_{safe}")
+        shlo, opt = lower_text(jitted, *args, **(kwargs or {}))
+        with open(stem + ".stablehlo.txt", "w") as f:
+            f.write(shlo)
+        if opt is not None:
+            with open(stem + ".optimized.txt", "w") as f:
+                f.write(opt)
+    except Exception as e:  # never break the training step for a dump
+        import warnings
+
+        warnings.warn(f"FLAGS_dump_hlo: dump of {name} failed: {e}")

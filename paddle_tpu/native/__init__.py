@@ -126,6 +126,8 @@ class ShmQueue:
         if rc == -1:
             raise ValueError(
                 f"payload of {len(payload)} bytes exceeds slot size {self.slot_size}")
+        if rc == -2:
+            raise RuntimeError("shm_queue push failed (semaphore/mutex error)")
         return rc == 0
 
     def pop(self, timeout_ms: int = -1):
@@ -137,10 +139,12 @@ class ShmQueue:
             self._rx = ctypes.create_string_buffer(int(self.slot_size))
         seq = ctypes.c_uint64()
         n = self._lib.shmq_pop(self._h, self._rx, self.slot_size, ctypes.byref(seq), timeout_ms)
-        if n == 0:
-            return None
+        if n == -3:
+            return None  # timeout (distinct code: n == 0 is a valid empty payload)
+        if n == -1:
+            raise RuntimeError("shm_queue pop: receive buffer smaller than payload")
         if n < 0:
-            raise RuntimeError("shm_queue pop failed")
+            raise RuntimeError("shm_queue pop failed (semaphore/mutex error)")
         return int(seq.value), memoryview(self._rx)[:n]
 
     def close(self):

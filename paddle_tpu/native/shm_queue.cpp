@@ -127,7 +127,7 @@ static int lock_robust(pthread_mutex_t* mu) {
 }
 
 // Push one payload (blocks while full; timeout_ms<0 -> wait forever).
-// Returns 0 ok, 1 timeout, -1 error (payload larger than slot).
+// Returns 0 ok, 1 timeout, -1 payload larger than slot, -2 sem/lock failure.
 int shmq_push(void* handle, const void* data, uint64_t len, uint64_t seq,
               int timeout_ms) {
   Handle* hd = static_cast<Handle*>(handle);
@@ -144,10 +144,10 @@ int shmq_push(void* handle, const void* data, uint64_t len, uint64_t seq,
     ts.tv_nsec %= 1000000000L;
     while (sem_timedwait(&h->free_slots, &ts) != 0) {
       if (errno == ETIMEDOUT) return 1;
-      if (errno != EINTR) return -1;
+      if (errno != EINTR) return -2;
     }
   }
-  if (lock_robust(&h->mu) != 0) return -1;
+  if (lock_robust(&h->mu) != 0) return -2;
   uint32_t i = h->tail;
   h->tail = (h->tail + 1) % h->n_slots;
   Slot* s = reinterpret_cast<Slot*>(slot_at(h, i));
@@ -159,9 +159,9 @@ int shmq_push(void* handle, const void* data, uint64_t len, uint64_t seq,
   return 0;
 }
 
-// Pop one payload into out (cap bytes). Returns payload length, 0 on
-// timeout, -1 on error/too-small buffer (len via *seq_out semantics kept
-// simple: seq written to *seq_out).
+// Pop one payload into out (cap bytes). Returns payload length (>= 0 —
+// empty payloads are valid), -3 on timeout, -1 on too-small buffer, -2 on
+// sem/lock failure; seq written to *seq_out.
 int64_t shmq_pop(void* handle, void* out, uint64_t cap, uint64_t* seq_out,
                  int timeout_ms) {
   Handle* hd = static_cast<Handle*>(handle);
@@ -176,11 +176,11 @@ int64_t shmq_pop(void* handle, void* out, uint64_t cap, uint64_t* seq_out,
     ts.tv_sec += ts.tv_nsec / 1000000000L;
     ts.tv_nsec %= 1000000000L;
     while (sem_timedwait(&h->filled_slots, &ts) != 0) {
-      if (errno == ETIMEDOUT) return 0;
-      if (errno != EINTR) return -1;
+      if (errno == ETIMEDOUT) return -3;
+      if (errno != EINTR) return -2;
     }
   }
-  if (lock_robust(&h->mu) != 0) return -1;
+  if (lock_robust(&h->mu) != 0) return -2;
   Slot* s = reinterpret_cast<Slot*>(slot_at(h, h->head));
   uint64_t len = s->len;
   if (len > cap) {

@@ -308,7 +308,14 @@ def class_center_sample(label, num_classes, num_samples, group=None):
         sampled = pos
     else:
         neg_pool = np.setdiff1d(np.arange(num_classes), pos)
-        extra = np.random.RandomState(0).choice(
+        # fresh negatives per call, seeded from the framework RNG stream so
+        # paddle.seed() keeps runs reproducible (the reference PartialFC op
+        # resamples each step; a frozen pool degrades margin-softmax training)
+        from ...framework import random as _fr
+
+        gen = _fr.default_generator()
+        seed_ = int(jax.random.randint(gen.next_key(), (), 0, 2**31 - 1))
+        extra = np.random.RandomState(seed_).choice(
             neg_pool, size=min(num_samples - len(pos), len(neg_pool)), replace=False)
         sampled = np.concatenate([pos, np.sort(extra)])
     remap = {c: i for i, c in enumerate(sampled)}
@@ -355,6 +362,13 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,  # noqa: A002
         # emit log-probs: lp[b, t, u, lab[b, u]] for u < U
         emit_lp = jnp.take_along_axis(
             lp[:, :, :-1, :], lab[:, None, :, None], axis=-1)[..., 0]  # [B,T,U]
+        if fastemit_lambda:
+            # FastEmit regularization (warprnnt binding semantics): the loss
+            # value is unchanged but the gradient flowing through emit
+            # transitions is scaled by (1 + lambda), encouraging earlier
+            # emission. Value-preserving autodiff form of that reweighting:
+            emit_lp = (1.0 + fastemit_lambda) * emit_lp \
+                - fastemit_lambda * lax.stop_gradient(emit_lp)
         neg_inf = -1e30
 
         def step(alpha, t):

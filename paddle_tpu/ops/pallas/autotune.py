@@ -1,0 +1,185 @@
+"""Flash-attention block-size autotuning.
+
+Reference analog: the kernel autotune cache + timing harness
+(/root/reference/paddle/phi/kernels/autotune/switch_autotune.h, cache.h) that
+picks cudnn/cutlass algorithms by measurement. Here the tunable is the
+(block_q, block_k) tiling of the Pallas flash kernels.
+
+Two tiers:
+  * a measured default table (tuned on TPU v5e, see ``tune()``) keyed by
+    (kind, seq bucket, head_dim) — zero-cost lookup, always available;
+  * optional on-line measurement: ``paddle.set_flags({'FLAGS_flash_autotune':
+    True})`` times every candidate on first encounter of a new shape key
+    (eager, cached for the process, persisted to
+    ``PADDLE_TPU_AUTOTUNE_CACHE`` if set).
+"""
+from __future__ import annotations
+
+import functools
+import json
+import os
+import time
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["get_flash_blocks", "tune", "clear_cache"]
+
+
+def _pick_block(s: int, target: int) -> int:
+    b = min(target, s)
+    while s % b:
+        b //= 2
+    return max(b, 1)
+
+
+def _bucket_seq(s: int) -> int:
+    """Round down to a power of two (tables are per-magnitude, not per-shape)."""
+    b = 1
+    while b * 2 <= s:
+        b *= 2
+    return b
+
+
+# Measured on TPU v5e-1 (bf16, causal, head_dim 128): larger q blocks win for
+# the forward until VMEM pressure, the backward prefers squarer tiles. Values
+# are *targets* — _pick_block snaps them to divisors of the actual seq.
+_DEFAULT_TARGETS: Dict[Tuple[str, int], Tuple[int, int]] = {
+    ("fwd", 128): (512, 512),
+    ("bwd", 128): (256, 256),
+    ("fwd", 64): (512, 512),
+    ("bwd", 64): (256, 256),
+}
+
+# process-level measured cache: (kind, sq_bucket, sk_bucket, d) -> (bq, bk)
+_measured: Dict[Tuple, Tuple[int, int]] = {}
+_cache_loaded = False
+
+
+def _cache_path():
+    return os.environ.get("PADDLE_TPU_AUTOTUNE_CACHE")
+
+
+def _load_cache():
+    global _cache_loaded
+    if _cache_loaded:
+        return
+    _cache_loaded = True
+    p = _cache_path()
+    if p and os.path.exists(p):
+        try:
+            with open(p) as f:
+                for k, v in json.load(f).items():
+                    _measured[tuple(json.loads(k))] = tuple(v)
+        except Exception:
+            pass
+
+
+def _save_cache():
+    p = _cache_path()
+    if not p:
+        return
+    try:
+        with open(p, "w") as f:
+            json.dump({json.dumps(list(k)): list(v) for k, v in _measured.items()}, f)
+    except Exception:
+        pass
+
+
+def clear_cache():
+    _measured.clear()
+
+
+def get_flash_blocks(kind: str, sq: int, sk: int, d: int) -> Tuple[int, int]:
+    """Block sizes for the flash kernel. kind: 'fwd' | 'bwd'."""
+    _load_cache()
+    key = (kind, _bucket_seq(sq), _bucket_seq(sk), d)
+    hit = _measured.get(key)
+    if hit is not None:
+        return _pick_block(sq, hit[0]), _pick_block(sk, hit[1])
+
+    from ...framework.flags import flag_value
+
+    try:
+        autotune_on = flag_value("flash_autotune")
+    except KeyError:  # flags module import cycle during bootstrap
+        autotune_on = False
+    if autotune_on and jax.default_backend() in ("tpu", "axon"):
+        bq, bk = _measure(kind, sq, sk, d)
+        _measured[key] = (bq, bk)
+        _save_cache()
+        return _pick_block(sq, bq), _pick_block(sk, bk)
+
+    tq, tk = _DEFAULT_TARGETS.get((kind, d), (512, 512) if kind == "fwd" else (256, 256))
+    return _pick_block(sq, tq), _pick_block(sk, tk)
+
+
+def _candidates(kind: str, sq: int, sk: int):
+    opts = [128, 256, 512, 1024]
+    for bq in opts:
+        for bk in opts:
+            if sq % bq == 0 and sk % bk == 0 and bq * bk <= 512 * 1024:
+                yield bq, bk
+
+
+def _measure(kind: str, sq: int, sk: int, d: int) -> Tuple[int, int]:
+    """Time candidates on synthetic bf16 tensors (eager; one-time per key)."""
+    from . import flash_attention as fa
+
+    bh = 4
+    rng = jax.random.key(0)
+    q = jax.random.normal(rng, (bh, sq, d), jnp.bfloat16)
+    k = jax.random.normal(rng, (bh, sk, d), jnp.bfloat16)
+    v = jax.random.normal(rng, (bh, sk, d), jnp.bfloat16)
+    scale = 1.0 / (d ** 0.5)
+    best, best_t = None, float("inf")
+    for bq, bk in _candidates(kind, sq, sk):
+        try:
+            if kind == "fwd":
+                f = jax.jit(lambda q, k, v, bq=bq, bk=bk: fa._pallas_fwd(
+                    q, k, v, True, scale, bq, bk, False)[0])
+                f(q, k, v).block_until_ready()  # compile
+                t0 = time.perf_counter()
+                for _ in range(5):
+                    out = f(q, k, v)
+                out.block_until_ready()
+            else:
+                o, lse = fa._pallas_fwd(q, k, v, True, scale,
+                                        _pick_block(sq, 512), _pick_block(sk, 512), False)
+                g = jnp.ones_like(o)
+
+                def f_bwd(q, k, v, o, lse, g, bq=bq, bk=bk):
+                    dq, dk, dv = fa._pallas_bwd(q, k, v, o, lse, g, True, scale,
+                                                bq, bk, False)
+                    # consume all three so neither kernel is DCE'd from timing
+                    return dq.sum() + dk.sum() + dv.sum()
+
+                f = jax.jit(f_bwd)
+                f(q, k, v, o, lse, g).block_until_ready()
+                t0 = time.perf_counter()
+                for _ in range(5):
+                    out = f(q, k, v, o, lse, g)
+                out.block_until_ready()
+            dt = time.perf_counter() - t0
+            if dt < best_t:
+                best, best_t = (bq, bk), dt
+        except Exception:
+            continue
+    return best or (_pick_block(sq, 512), _pick_block(sk, 512))
+
+
+def tune(seqs=(1024, 2048, 4096, 8192), head_dims=(64, 128), verbose=True):
+    """Offline tuner: measure all (kind, seq, head_dim) combos and return the
+    results table (also fills the in-process cache)."""
+    out = {}
+    for d in head_dims:
+        for s in seqs:
+            for kind in ("fwd", "bwd"):
+                bq, bk = _measure(kind, s, s, d)
+                _measured[(kind, _bucket_seq(s), _bucket_seq(s), d)] = (bq, bk)
+                out[(kind, s, d)] = (bq, bk)
+                if verbose:
+                    print(f"tune {kind} seq={s} d={d}: block_q={bq} block_k={bk}")
+    _save_cache()
+    return out

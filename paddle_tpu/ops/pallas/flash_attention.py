@@ -35,6 +35,8 @@ try:  # pallas import is TPU/CPU-interpret capable
 except Exception:  # pragma: no cover
     _HAS_PALLAS = False
 
+from .autotune import get_flash_blocks
+
 NEG_INF = -1e30
 
 
@@ -65,7 +67,7 @@ def _ref_impl(q, k, v, causal: bool, scale: float):
     return _ref_fwd_impl(q, k, v, causal, scale)[0]
 
 
-def _ref_bwd_impl(q, k, v, o, lse, g, causal: bool, scale: float):
+def _ref_bwd_impl(q, k, v, o, lse, g, causal: bool, scale: float, delta=None):
     """jnp backward from saved LSE (used on CPU / odd shapes)."""
     s = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * scale
     row_valid = None
@@ -79,7 +81,8 @@ def _ref_bwd_impl(q, k, v, o, lse, g, causal: bool, scale: float):
         # fully-masked rows: output/grads are zero by convention
         p = jnp.where(row_valid[None, :, None], p, 0.0)
     gf = g.astype(jnp.float32)
-    delta = jnp.sum(gf * o.astype(jnp.float32), axis=-1)  # [BH, Sq]
+    if delta is None:
+        delta = jnp.sum(gf * o.astype(jnp.float32), axis=-1)  # [BH, Sq]
     dv = jnp.einsum("bqk,bqd->bkd", p, gf)
     dp = jnp.einsum("bqd,bkd->bqk", gf, v.astype(jnp.float32))
     ds = p * (dp - delta[..., None]) * scale
@@ -142,8 +145,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bo
     lse_ref[0] = (m + jnp.log(l_safe))[:, None]  # [block_q, 1] lane-broadcastable
 
 
-def _pallas_fwd(q, k, v, causal: bool, scale: float, block_q: int, block_k: int, interpret: bool):
-    """q,k,v: [BH, S, D] → (o, lse[f32])."""
+def _pallas_fwd(q, k, v, causal: bool, scale: float, block_q: int, block_k: int,
+                interpret: bool, kv_rep: int = 1):
+    """q: [BH, S, D], k/v: [BHk, S, D] with BH == BHk*kv_rep → (o, lse[f32]).
+
+    GQA is handled in the BlockSpec index map (q batch b reads k/v batch
+    b // kv_rep) — K/V are never materialized at full head count."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     grid = (bh, sq // block_q)
@@ -156,8 +163,8 @@ def _pallas_fwd(q, k, v, causal: bool, scale: float, block_q: int, block_k: int,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i, r=kv_rep: (b // r, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i, r=kv_rep: (b // r, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
@@ -270,11 +277,13 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
 
 
 def _pallas_bwd(q, k, v, o, lse, g, causal: bool, scale: float,
-                block_q: int, block_k: int, interpret: bool):
+                block_q: int, block_k: int, interpret: bool, kv_rep: int = 1,
+                delta=None):
     bh, sq, d = q.shape
-    sk = k.shape[1]
+    bhk, sk, _ = k.shape
     off = sk - sq
-    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [BH, Sq]
+    if delta is None:
+        delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [BH, Sq]
     lse3 = lse[..., None]      # trailing singleton lane dim for TPU tiling
     delta3 = delta[..., None]
 
@@ -284,8 +293,8 @@ def _pallas_bwd(q, k, v, o, lse, g, causal: bool, scale: float,
         grid=(bh, sq // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),   # q
-            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),        # k
-            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),        # v
+            pl.BlockSpec((1, sk, d), lambda b, i, r=kv_rep: (b // r, 0, 0)),   # k
+            pl.BlockSpec((1, sk, d), lambda b, i, r=kv_rep: (b // r, 0, 0)),   # v
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),   # do
             pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),   # lse
             pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),   # delta
@@ -295,13 +304,16 @@ def _pallas_bwd(q, k, v, o, lse, g, causal: bool, scale: float,
         interpret=interpret,
     )(q, k, v, g, lse3, delta3)
 
+    # dK/dV at query-head granularity (fp32 when reducing over a GQA group),
+    # then segment-summed back to kv heads — inputs stay unrepeated.
+    acc_dt = jnp.float32 if kv_rep > 1 else k.dtype
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, block_q=block_q, causal=causal, scale=scale,
                           seq_q=sq, causal_offset=off),
         grid=(bh, sk // block_k),
         in_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),   # k
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),   # v
+            pl.BlockSpec((1, block_k, d), lambda b, j, r=kv_rep: (b // r, j, 0)),  # k
+            pl.BlockSpec((1, block_k, d), lambda b, j, r=kv_rep: (b // r, j, 0)),  # v
             pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0)),        # q
             pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0)),        # do
             pl.BlockSpec((1, sq, 1), lambda b, j: (b, 0, 0)),        # lse
@@ -312,22 +324,18 @@ def _pallas_bwd(q, k, v, o, lse, g, causal: bool, scale: float,
             pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), acc_dt),
+            jax.ShapeDtypeStruct((bh, sk, d), acc_dt),
         ],
         interpret=interpret,
     )(k, v, q, g, lse3, delta3)
+    if kv_rep > 1:
+        dk = dk.reshape(bhk, kv_rep, sk, d).sum(axis=1).astype(k.dtype)
+        dv = dv.reshape(bhk, kv_rep, sk, d).sum(axis=1).astype(v.dtype)
     return dq, dk, dv
 
 
 # --------------------------------------------------------------- vjp wiring
-def _pick_block(s: int, target: int) -> int:
-    b = min(target, s)
-    while s % b:
-        b //= 2
-    return max(b, 1)
-
-
 def _use_kernel(sq: int, sk: int, interpret: bool) -> bool:
     return (
         _HAS_PALLAS
@@ -337,32 +345,60 @@ def _use_kernel(sq: int, sk: int, interpret: bool) -> bool:
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_core(q, k, v, causal, scale, interpret):
-    out, _ = _flash_core_fwd(q, k, v, causal, scale, interpret)
+def _rep_kv(x, rep):
+    """[BHk, S, D] → [BHk*rep, S, D] with j → j // rep (jnp fallback only)."""
+    return jnp.repeat(x, rep, axis=0)
+
+
+def block_fwd(qb, kb, vb, causal, scale, kv_rep=1, interpret=False):
+    """One attention block: qb [BH, Sq, D], kb/vb [BHk, Sk, D] → (o, lse f32).
+
+    The single dispatch point (kernel vs jnp reference, GQA handling) shared
+    by the flash custom_vjp and ring attention's per-ring-step body."""
+    sq, sk = qb.shape[1], kb.shape[1]
+    if _use_kernel(sq, sk, interpret):
+        bq, bk = get_flash_blocks("fwd", sq, sk, qb.shape[-1])
+        return _pallas_fwd(qb, kb, vb, causal, scale, bq, bk, interpret,
+                           kv_rep=kv_rep)
+    kr = _rep_kv(kb, kv_rep) if kv_rep > 1 else kb
+    vr = _rep_kv(vb, kv_rep) if kv_rep > 1 else vb
+    return _ref_fwd_impl(qb, kr, vr, causal, scale)
+
+
+def block_bwd(qb, kb, vb, o, lse, g, causal, scale, kv_rep=1, interpret=False,
+              delta=None):
+    """Backward of one attention block → (dq [BH], dk [BHk], dv [BHk]).
+    ``delta`` (rowsum(g∘o)) may be precomputed by callers that reuse it
+    across blocks (ring attention)."""
+    sq, sk = qb.shape[1], kb.shape[1]
+    if _use_kernel(sq, sk, interpret):
+        bq, bk = get_flash_blocks("bwd", sq, sk, qb.shape[-1])
+        return _pallas_bwd(qb, kb, vb, o, lse, g, causal, scale, bq, bk,
+                           interpret, kv_rep=kv_rep, delta=delta)
+    if kv_rep > 1:
+        bhk, _, d = kb.shape
+        dq, dkr, dvr = _ref_bwd_impl(qb, _rep_kv(kb, kv_rep), _rep_kv(vb, kv_rep),
+                                     o, lse, g, causal, scale, delta=delta)
+        dk = dkr.reshape(bhk, kv_rep, sk, d).sum(axis=1).astype(kb.dtype)
+        dv = dvr.reshape(bhk, kv_rep, sk, d).sum(axis=1).astype(vb.dtype)
+        return dq, dk, dv
+    return _ref_bwd_impl(qb, kb, vb, o, lse, g, causal, scale, delta=delta)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_core(q, k, v, causal, scale, interpret, kv_rep=1):
+    out, _ = _flash_core_fwd(q, k, v, causal, scale, interpret, kv_rep)
     return out
 
 
-def _flash_core_fwd(q, k, v, causal, scale, interpret):
-    bh, sq, d = q.shape
-    sk = k.shape[1]
-    if _use_kernel(sq, sk, interpret):
-        block_q = _pick_block(sq, 512)
-        block_k = _pick_block(sk, 512)
-        out, lse = _pallas_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
-    else:
-        out, lse = _ref_fwd_impl(q, k, v, causal, scale)
+def _flash_core_fwd(q, k, v, causal, scale, interpret, kv_rep=1):
+    out, lse = block_fwd(q, k, v, causal, scale, kv_rep, interpret)
     return out, (q, k, v, out, lse)
 
 
-def _flash_core_bwd(causal, scale, interpret, res, g):
+def _flash_core_bwd(causal, scale, interpret, kv_rep, res, g):
     q, k, v, o, lse = res
-    sq, sk = q.shape[1], k.shape[1]
-    if _use_kernel(sq, sk, interpret):
-        block_q = _pick_block(sq, 256)
-        block_k = _pick_block(sk, 256)
-        return _pallas_bwd(q, k, v, o, lse, g, causal, scale, block_q, block_k, interpret)
-    return _ref_bwd_impl(q, k, v, o, lse, g, causal, scale)
+    return block_bwd(q, k, v, o, lse, g, causal, scale, kv_rep, interpret)
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
@@ -370,18 +406,18 @@ _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 def flash_attention_fwd(q, k, v, *, causal: bool = False, scale: float | None = None,
                         interpret: bool = False):
-    """Public entry: q,k,v [B, S, H, D] (paddle layout) → [B, S, H, D]."""
+    """Public entry: q,k,v [B, S, H, D] (paddle layout) → [B, S, H, D].
+
+    GQA (fewer KV heads than query heads) is handled inside the kernel via
+    index maps — K/V are never repeated to full head count."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
     hk = k.shape[2]
     if scale is None:
         scale = 1.0 / math.sqrt(d)
-    if hk != h:  # grouped-query attention: repeat kv heads
-        rep = h // hk
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    rep = h // hk if hk != h else 1
     qb = jnp.moveaxis(q, 2, 1).reshape(b * h, sq, d)
-    kb = jnp.moveaxis(k, 2, 1).reshape(b * h, sk, d)
-    vb = jnp.moveaxis(v, 2, 1).reshape(b * h, sk, d)
-    ob = _flash_core(qb, kb, vb, causal, scale, interpret)
+    kb = jnp.moveaxis(k, 2, 1).reshape(b * hk, sk, d)
+    vb = jnp.moveaxis(v, 2, 1).reshape(b * hk, sk, d)
+    ob = _flash_core(qb, kb, vb, causal, scale, interpret, rep)
     return jnp.moveaxis(ob.reshape(b, h, sq, d), 1, 2)

@@ -1,13 +1,22 @@
-"""Ring attention — sequence-parallel exact attention over the 'sep' mesh axis.
+"""Ring attention — sequence-parallel exact flash attention over the 'sep' axis.
 
-The reference has only Megatron-SP activity sharding + a SEP axis that
+The reference has only Megatron-SP activation sharding + a SEP axis that
 requires seq-shardable attention (SURVEY.md §5 long-context: "ring attention
 absent — the TPU build supplies the capability natively"). This implements
-blockwise ring attention (Liu et al.) TPU-style: each device holds a local
-Q/K/V sequence block; K/V blocks rotate around the ring via lax.ppermute
-(ICI neighbor exchange) while an online-softmax accumulator builds the exact
-global attention — memory O(S/n), communication fully overlappable by XLA's
-latency-hiding scheduler.
+blockwise ring attention (Liu et al.) TPU-style:
+
+* each device holds a local Q/K/V sequence block; K/V rotate around the ring
+  via ``lax.ppermute`` (ICI neighbor exchange);
+* the **per-block body is the Pallas flash kernel** (ops/pallas/flash_attention)
+  — no [Sl, Sl] logits matrix is ever materialized; block results merge via
+  streaming logsumexp, so device memory is O(Sl·D);
+* under causal masking, ring steps whose K/V block is entirely in the masked
+  future are **skipped** (rotate only — no QK^T is computed);
+* GQA K/V heads are indexed inside the kernel (never repeated);
+* the backward is a hand-written second ring pass (custom_vjp): dK/dV partials
+  ride the ring alongside K/V and arrive home after n steps, dQ accumulates
+  locally — residual memory is O(Sl·D), not O(n·Sl²) as autodiff-through-scan
+  would give.
 
 Layout: paddle's [B, S, H, D]; sequence dim sharded on ``axis_name``.
 """
@@ -22,58 +31,154 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from .pallas.flash_attention import block_bwd, block_fwd
+
 NEG_INF = -1e30
 
 
-def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, scale: float):
-    """Per-device body (inside shard_map). q/k/v local: [B, Sl, H, D]."""
+# ------------------------------------------------------------ per-block body
+def _block_fwd(qb, kb, vb, causal, scale, kv_rep, interpret):
+    """qb [BH, Sl, D], kb/vb [BHk, Sl, D] → (o f32 [BH,Sl,D], lse f32 [BH,Sl])."""
+    o, lse = block_fwd(qb, kb, vb, causal, scale, kv_rep, interpret)
+    return o.astype(jnp.float32), lse
+
+
+def _block_bwd(qb, kb, vb, o, lse, g, causal, scale, kv_rep, interpret, delta):
+    """→ (dq [BH], dk [BHk], dv [BHk]) all f32 (ring accumulators)."""
+    dq, dk, dv = block_bwd(qb, kb, vb, o, lse, g, causal, scale, kv_rep, interpret,
+                           delta=delta)
+    return (dq.astype(jnp.float32), dk.astype(jnp.float32), dv.astype(jnp.float32))
+
+
+def _case_of(j, idx, causal):
+    """0 = skip (fully masked), 1 = diagonal (causal in-block), 2 = full."""
+    if not causal:
+        return jnp.int32(2)
+    return jnp.where(j > idx, jnp.int32(0), jnp.where(j == idx, jnp.int32(1), jnp.int32(2)))
+
+
+# ------------------------------------------------- local fwd/bwd ring loops
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_local(q, k, v, axis_name, causal, scale, kv_rep, interpret):
+    out, _ = _ring_local_fwd(q, k, v, axis_name, causal, scale, kv_rep, interpret)
+    return out
+
+
+def _ring_local_fwd(q, k, v, axis_name, causal, scale, kv_rep, interpret):
+    """q [B,Sl,H,D], k/v [B,Sl,Hk,D] local shards (inside shard_map)."""
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     B, Sl, H, D = q.shape
-    if k.shape[2] != H:  # grouped-query attention: repeat kv heads
-        rep = H // k.shape[2]
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-    qh = jnp.moveaxis(q, 2, 1).astype(jnp.float32) * scale  # [B,H,Sl,D]
+    Hk = k.shape[2]
+    qb = jnp.moveaxis(q, 2, 1).reshape(B * H, Sl, D)
+    kb0 = jnp.moveaxis(k, 2, 1).reshape(B * Hk, Sl, D)
+    vb0 = jnp.moveaxis(v, 2, 1).reshape(B * Hk, Sl, D)
+    perm = [(i, (i + 1) % n) for i in range(n)]
 
+    def merge(acc, lse, o_j, lse_j):
+        lse_new = jnp.logaddexp(lse, lse_j)
+        w_old = jnp.exp(lse - lse_new)[..., None]
+        w_new = jnp.exp(lse_j - lse_new)[..., None]
+        return acc * w_old + o_j * w_new, lse_new
+
+    def step(t, carry):
+        kb, vb, acc, lse = carry
+        j = (idx - t) % n  # global block id currently held
+
+        def do_skip(acc, lse):
+            return acc, lse
+
+        def do_diag(acc, lse):
+            o_j, lse_j = _block_fwd(qb, kb, vb, True, scale, kv_rep, interpret)
+            return merge(acc, lse, o_j, lse_j)
+
+        def do_full(acc, lse):
+            o_j, lse_j = _block_fwd(qb, kb, vb, False, scale, kv_rep, interpret)
+            return merge(acc, lse, o_j, lse_j)
+
+        acc, lse = lax.switch(_case_of(j, idx, causal), [do_skip, do_diag, do_full],
+                              acc, lse)
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return kb, vb, acc, lse
+
+    acc0 = jnp.zeros((B * H, Sl, D), jnp.float32)
+    lse0 = jnp.full((B * H, Sl), NEG_INF, jnp.float32)
+    _, _, acc, lse = lax.fori_loop(0, n, step, (kb0, vb0, acc0, lse0))
+    out = jnp.moveaxis(acc.astype(q.dtype).reshape(B, H, Sl, D), 1, 2)
+    return out, (q, k, v, acc, lse)
+
+
+def _ring_local_bwd(axis_name, causal, scale, kv_rep, interpret, res, g):
+    q, k, v, acc, lse = res
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    B, Sl, H, D = q.shape
+    Hk = k.shape[2]
+    qb = jnp.moveaxis(q, 2, 1).reshape(B * H, Sl, D)
+    kb0 = jnp.moveaxis(k, 2, 1).reshape(B * Hk, Sl, D)
+    vb0 = jnp.moveaxis(v, 2, 1).reshape(B * Hk, Sl, D)
+    gb = jnp.moveaxis(g, 2, 1).reshape(B * H, Sl, D).astype(jnp.float32)
+    o = acc  # f32 normalized output saved by the forward
+    # delta = rowsum(g∘o) is ring-invariant: compute once, reuse every step
+    delta = jnp.sum(gb * o, axis=-1)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def step(t, carry):
-        k_blk, v_blk, acc, m_prev, l_prev = carry
-        j = (idx - t) % n  # global block id currently held
-        kh = jnp.moveaxis(k_blk, 2, 1).astype(jnp.float32)
-        vh = jnp.moveaxis(v_blk, 2, 1).astype(jnp.float32)
-        s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh)
-        if causal:
-            rows = idx * Sl + lax.broadcasted_iota(jnp.int32, (Sl, Sl), 0)
-            cols = j * Sl + lax.broadcasted_iota(jnp.int32, (Sl, Sl), 1)
-            s = jnp.where(rows[None, None] >= cols[None, None], s, NEG_INF)
-        m_cur = jnp.max(s, axis=-1)
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new[..., None])
-        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
-        acc = acc * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vh)
-        # rotate K/V to the next device (receive the previous block)
-        k_next = lax.ppermute(k_blk, axis_name, perm)
-        v_next = lax.ppermute(v_blk, axis_name, perm)
-        return k_next, v_next, acc, m_new, l_new
+        kb, vb, dkb, dvb, dq = carry
+        j = (idx - t) % n
 
-    acc0 = jnp.zeros((B, H, Sl, D), jnp.float32)
-    m0 = jnp.full((B, H, Sl), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((B, H, Sl), jnp.float32)
-    _, _, acc, m, l = lax.fori_loop(0, n, step, (k, v, acc0, m0, l0))
-    out = acc / jnp.maximum(l, 1e-30)[..., None]
-    return jnp.moveaxis(out, 1, 2).astype(q.dtype)  # [B,Sl,H,D]
+        def do_skip(dq, dkb, dvb):
+            return dq, dkb, dvb
+
+        def do_diag(dq, dkb, dvb):
+            dq_j, dk_j, dv_j = _block_bwd(qb, kb, vb, o, lse, gb, True, scale,
+                                          kv_rep, interpret, delta)
+            return dq + dq_j, dkb + dk_j, dvb + dv_j
+
+        def do_full(dq, dkb, dvb):
+            dq_j, dk_j, dv_j = _block_bwd(qb, kb, vb, o, lse, gb, False, scale,
+                                          kv_rep, interpret, delta)
+            return dq + dq_j, dkb + dk_j, dvb + dv_j
+
+        dq, dkb, dvb = lax.switch(_case_of(j, idx, causal),
+                                  [do_skip, do_diag, do_full], dq, dkb, dvb)
+        # dK/dV partials travel WITH their K/V block; after n rotations the
+        # block (and its fully-accumulated gradient) is back home
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        dkb = lax.ppermute(dkb, axis_name, perm)
+        dvb = lax.ppermute(dvb, axis_name, perm)
+        return kb, vb, dkb, dvb, dq
+
+    z_kv = jnp.zeros((B * Hk, Sl, D), jnp.float32)
+    dq0 = jnp.zeros((B * H, Sl, D), jnp.float32)
+    _, _, dkb, dvb, dqb = lax.fori_loop(0, n, step, (kb0, vb0, z_kv, z_kv, dq0))
+    dq = jnp.moveaxis(dqb.astype(q.dtype).reshape(B, H, Sl, D), 1, 2)
+    dk = jnp.moveaxis(dkb.astype(k.dtype).reshape(B, Hk, Sl, D), 1, 2)
+    dv = jnp.moveaxis(dvb.astype(v.dtype).reshape(B, Hk, Sl, D), 1, 2)
+    return dq, dk, dv
+
+
+_ring_local.defvjp(_ring_local_fwd, _ring_local_bwd)
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, scale: float,
+                          interpret: bool = False):
+    """Per-device body (inside shard_map). q [B,Sl,H,D], k/v [B,Sl,Hk,D]."""
+    H, Hk = q.shape[2], k.shape[2]
+    kv_rep = H // Hk if Hk != H else 1
+    return _ring_local(q, k, v, axis_name, causal, scale, kv_rep, interpret)
 
 
 def ring_attention(q, k, v, *, mesh, axis_name: str = "sep", causal: bool = False,
                    scale: Optional[float] = None, batch_axis: Optional[str] = "dp",
-                   head_axis: Optional[str] = "mp"):
+                   head_axis: Optional[str] = "mp", interpret: bool = False):
     """Global entry on sep-sharded [B, S, H, D] jax arrays.
 
     Composes with dp (batch) and mp (head) sharding: those axes simply shrink
-    the local block; collectives ride only the sep ring.
+    the local block; collectives ride only the sep ring. K/V may carry fewer
+    (GQA) heads than Q.
     """
     from jax.experimental.shard_map import shard_map
 
@@ -85,7 +190,8 @@ def ring_attention(q, k, v, *, mesh, axis_name: str = "sep", causal: bool = Fals
     spec = P(b_ax, axis_name, h_ax, None)
 
     fn = shard_map(
-        functools.partial(_ring_attention_local, axis_name=axis_name, causal=causal, scale=scale),
+        functools.partial(_ring_attention_local, axis_name=axis_name, causal=causal,
+                          scale=scale, interpret=interpret),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

@@ -55,12 +55,15 @@ def nms(boxes, iou_threshold: float = 0.3, scores=None, category_idxs=None,
     else:
         scores_v = _t(scores)._value.astype(jnp.float32)
     if category_idxs is not None:
-        # category-aware: offset boxes per category by more than the max
-        # coordinate so cross-class IoU is exactly 0 at any image size
+        # category-aware: shift coordinates non-negative, then offset each
+        # category by more than the full coordinate span so cross-class IoU
+        # is exactly 0 (the standard batched-NMS trick; abs-based spans
+        # overlap for negative coordinates)
         cat = _t(category_idxs)._value.astype(jnp.float32)
-        span = float(jnp.max(jnp.abs(boxes._value))) + 1.0
+        lo = float(jnp.min(boxes._value))
+        span = float(jnp.max(boxes._value)) - lo + 1.0
         off = (cat * span)[:, None]
-        shifted = boxes._value + off
+        shifted = (boxes._value - lo) + off
     else:
         shifted = boxes._value
 
@@ -262,15 +265,19 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
     pooled = roi_align(x, boxes, boxes_num, output_size, spatial_scale, aligned=False)
 
     def _ps_gather(r, ph, pw):
+        # reference layout (phi/kernels/cpu/psroi_pool_kernel.cc:151):
+        # input_channel = (c * pooled_height + i) * pooled_width + j, i.e. the
+        # channel axis decomposes as (co, ph, pw) — bin (i, j) reads channel
+        # group [:, :, i, j] of that decomposition.
         outs = []
         for i in range(ph):
             row = []
             for j in range(pw):
-                row.append(r[:, i, j, :, i, j])  # [N, co]
+                row.append(r[:, :, i, j, i, j])  # [N, co]
             outs.append(jnp.stack(row, axis=-1))  # [N, co, pw]
         return jnp.stack(outs, axis=-2)  # [N, co, ph, pw]
 
-    return apply(lambda p: _ps_gather(p.reshape(p.shape[0], ph, pw, co, ph, pw), ph, pw),
+    return apply(lambda p: _ps_gather(p.reshape(p.shape[0], co, ph, pw, ph, pw), ph, pw),
                  pooled, op_name="psroi_pool")
 
 

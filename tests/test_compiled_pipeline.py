@@ -1,0 +1,109 @@
+"""Compiled pipeline: full microbatch schedule in one XLA program
+(VERDICT r2 item 2; reference analog: pipeline_scheduler_pass/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed.fleet.meta_parallel import (
+    CompiledPipelineTrainStep,
+    LayerDesc,
+    PipelineLayer,
+    pipeline_bubble_fraction,
+)
+from paddle_tpu.distributed.topology import set_hybrid_communicate_group
+
+
+def _init(dp, pp):
+    set_hybrid_communicate_group(None)
+    s = dist.fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": dp, "mp_degree": 1, "pp_degree": pp,
+                        "sharding_degree": 1, "sep_degree": 1}
+    dist.fleet.init(is_collective=True, strategy=s)
+
+
+def _mlp_descs(n, width=16):
+    return [LayerDesc(nn.Linear, width, width) for _ in range(n)]
+
+
+class TestCompiledPipeline:
+    def test_trains_and_matches_sequential(self):
+        _init(dp=2, pp=4)
+        P.seed(7)
+        pipe = PipelineLayer(layers=_mlp_descs(8), num_stages=4,
+                             loss_fn=lambda o, y: F.mse_loss(o, y))
+        # snapshot weights for the sequential reference
+        w0 = [np.asarray(p._value) for ps in
+              [[p for l in pipe._stage_layers[s] for p in l.parameters()]
+               for s in range(4)] for p in ps]
+
+        opt = P.optimizer.SGD(0.05, parameters=pipe.parameters())
+        step = CompiledPipelineTrainStep(pipe, opt, num_micro=4)
+        x = P.randn([8, 16])
+        y = P.randn([8, 16])
+        l0 = float(step(x, y).numpy())
+
+        # sequential single-device reference with identical weights
+        set_hybrid_communicate_group(None)
+        P.seed(7)
+        layers = [nn.Linear(16, 16) for _ in range(8)]
+        flat = [p for l in layers for p in l.parameters()]
+        for p, v in zip(flat, w0):
+            p._value = P.to_tensor(v)._value
+        net = nn.Sequential(*layers)
+        ref = float(F.mse_loss(net(x), y).numpy())
+        np.testing.assert_allclose(l0, ref, rtol=1e-4)
+
+        # trains
+        _init(dp=2, pp=4)
+        for _ in range(10):
+            l1 = float(step(x, y).numpy())
+        assert l1 < l0
+
+    def test_optimizer_state_is_stacked_and_sync_back(self):
+        _init(dp=1, pp=2)
+        P.seed(0)
+        pipe = PipelineLayer(layers=_mlp_descs(4), num_stages=2,
+                             loss_fn=lambda o, y: F.mse_loss(o, y))
+        opt = P.optimizer.AdamW(learning_rate=0.01, parameters=pipe.parameters())
+        step = CompiledPipelineTrainStep(pipe, opt, num_micro=2)
+        x, y = P.randn([4, 16]), P.randn([4, 16])
+        step(x, y)
+        # accumulators exist per stacked [P, ...] weight
+        accs = opt._accumulators.get("moment1") or next(iter(opt._accumulators.values()))
+        shapes = {tuple(v.shape) for v in accs.values()}
+        assert all(s[0] == 2 for s in shapes), shapes
+        # sync back: per-stage tensors updated
+        before = np.asarray(pipe._stage_layers[0][0].parameters()[0]._value).copy()
+        step.sync_to_model()
+        after = np.asarray(pipe._stage_layers[0][0].parameters()[0]._value)
+        assert not np.allclose(before, after)
+
+    def test_bubble_fraction(self):
+        assert pipeline_bubble_fraction(4, 4) == pytest.approx(3 / 7)
+        assert pipeline_bubble_fraction(32, 4) < 0.09
+
+    def test_rejects_heterogeneous_stages(self):
+        _init(dp=1, pp=2)
+        descs = [LayerDesc(nn.Linear, 16, 16), LayerDesc(nn.Linear, 16, 32)]
+        pipe = PipelineLayer(layers=descs, num_stages=2,
+                             loss_fn=lambda o, y: F.mse_loss(o, y))
+        opt = P.optimizer.SGD(0.1, parameters=pipe.parameters())
+        with pytest.raises(ValueError, match="homogeneous"):
+            CompiledPipelineTrainStep(pipe, opt, num_micro=2)
+
+    def test_scaler_integration(self):
+        _init(dp=1, pp=2)
+        P.seed(1)
+        pipe = PipelineLayer(layers=_mlp_descs(4), num_stages=2,
+                             loss_fn=lambda o, y: F.mse_loss(o, y))
+        opt = P.optimizer.SGD(0.05, parameters=pipe.parameters())
+        scaler = P.amp.GradScaler(init_loss_scaling=2.0 ** 10)
+        step = CompiledPipelineTrainStep(pipe, opt, num_micro=2, scaler=scaler)
+        x, y = P.randn([4, 16]), P.randn([4, 16])
+        l0 = float(step(x, y).numpy())
+        for _ in range(8):
+            l1 = float(step(x, y).numpy())
+        assert np.isfinite(l1) and l1 < l0

@@ -1,0 +1,68 @@
+"""HLO dump + device memory stats (reference: paddle/fluid/memory/stats.h,
+paddle/cinn/hlir/framework/pir_compiler.h — the "see what got compiled"
+capability)."""
+import glob
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu import nn
+
+
+def test_memory_stats_api_shape():
+    import paddle_tpu.device as device
+
+    # CPU PJRT may report empty stats; the API contract is ints, no raise.
+    assert isinstance(device.memory_stats(), dict)
+    assert isinstance(device.memory_allocated(), int)
+    assert isinstance(device.max_memory_allocated(), int)
+    assert isinstance(device.memory_reserved(), int)
+    assert isinstance(device.max_memory_reserved(), int)
+    info = device.get_memory_info()
+    assert set(info) == {"total", "used", "free"}
+    device.reset_max_memory_allocated()
+    device.reset_max_memory_reserved()
+    # after reset, peaks track observations monotonically
+    a = device.max_memory_allocated()
+    _ = P.randn([64, 64])
+    assert device.max_memory_allocated() >= a
+    device.empty_cache()
+
+
+def test_hlo_dump_trainstep_and_to_static(tmp_path):
+    d = str(tmp_path / "hlo")
+    P.set_flags({"FLAGS_dump_hlo": d})
+    try:
+        model = nn.Linear(8, 4)
+        opt = P.optimizer.SGD(0.1, parameters=model.parameters())
+        step = P.jit.TrainStep(
+            model, lambda m, x, y: P.nn.functional.mse_loss(m(x), y), opt)
+        step(P.randn([4, 8]), P.randn([4, 4]))
+
+        fn = P.jit.to_static(lambda x: x * 2 + 1)
+        fn(P.randn([3]))
+    finally:
+        P.set_flags({"FLAGS_dump_hlo": ""})
+
+    shlo = sorted(glob.glob(os.path.join(d, "*.stablehlo.txt")))
+    opt_files = sorted(glob.glob(os.path.join(d, "*.optimized.txt")))
+    assert len(shlo) >= 2, shlo
+    assert len(opt_files) >= 2, opt_files
+    text = open(shlo[0]).read()
+    assert "module" in text  # StableHLO module text
+    opt_text = open(opt_files[0]).read()
+    assert "HloModule" in opt_text or "fusion" in opt_text or "unavailable" in opt_text
+
+
+def test_lower_text_programmatic():
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.jit.hlo_dump import lower_text
+
+    f = jax.jit(lambda x: jnp.sin(x) * 2)
+    shlo, opt = lower_text(f, np.ones((4,), np.float32))
+    assert "sine" in shlo or "sin" in shlo
+    assert opt is not None

@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""BASELINE ladder rungs beyond the flagship (BASELINE.md configs):
+ResNet-50 ImageNet-shape training imgs/sec/chip and BERT-base-class finetune
+step time. Prints one JSON line per rung. The flagship Llama rung stays in
+bench.py (the driver's single-line contract).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import numpy as np
+
+
+def _timeit(step, args, steps):
+    loss = step(*args)
+    loss.numpy()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(*args)
+    float(loss.numpy())
+    return (time.perf_counter() - t0) / steps, float(loss.numpy())
+
+
+def bench_resnet50():
+    import paddle_tpu as P
+    from paddle_tpu.vision.models import resnet50
+
+    backend = jax.default_backend()
+    on_accel = backend in ("tpu", "axon")
+    P.seed(0)
+    batch = 128 if on_accel else 4
+    size = 224 if on_accel else 32
+    steps = 10 if on_accel else 2
+    model = resnet50(num_classes=1000)
+    if on_accel:
+        model.bfloat16()
+    opt = P.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                               parameters=model.parameters(),
+                               multi_precision=on_accel)
+    step = P.jit.TrainStep(
+        model, lambda m, x, y: P.nn.functional.cross_entropy(m(x), y), opt)
+    x = P.to_tensor(np.random.RandomState(0).rand(batch, 3, size, size).astype(np.float32))
+    if on_accel:
+        x = x.astype("bfloat16")
+    y = P.to_tensor(np.random.RandomState(1).randint(0, 1000, (batch,)).astype(np.int64))
+    dt, loss = _timeit(step, (x, y), steps)
+    print(json.dumps({
+        "metric": "resnet50_train_imgs_per_sec_per_chip",
+        "value": round(batch / dt, 1),
+        "unit": "imgs/s",
+        "extra": {"backend": backend, "batch": batch, "img": size,
+                  "step_ms": round(dt * 1e3, 2), "loss": loss},
+    }))
+
+
+def bench_bert_base():
+    import paddle_tpu as P
+    from paddle_tpu import nn
+
+    backend = jax.default_backend()
+    on_accel = backend in ("tpu", "axon")
+    P.seed(0)
+    if on_accel:
+        h, layers, heads, seq, batch, vocab, steps = 768, 12, 12, 128, 32, 30522, 10
+    else:
+        h, layers, heads, seq, batch, vocab, steps = 64, 2, 4, 32, 4, 512, 2
+
+    class BertClassifier(nn.Layer):
+        """BERT-base-shape encoder + pooler + 2-way head (finetune config)."""
+
+        def __init__(self):
+            super().__init__()
+            self.embed = nn.Embedding(vocab, h)
+            self.pos = nn.Embedding(seq, h)
+            enc_layer = nn.TransformerEncoderLayer(h, heads, 4 * h, dropout=0.1,
+                                                   activation="gelu")
+            self.encoder = nn.TransformerEncoder(enc_layer, layers)
+            self.cls = nn.Linear(h, 2)
+
+        def forward(self, ids):
+            import paddle_tpu as P
+
+            x = self.embed(ids) + self.pos(P.arange(seq).astype("int32"))
+            return self.cls(self.encoder(x)[:, 0])
+
+    model = BertClassifier()
+    if on_accel:
+        model.bfloat16()
+    opt = P.optimizer.AdamW(learning_rate=2e-5, parameters=model.parameters(),
+                            multi_precision=on_accel)
+    step = P.jit.TrainStep(
+        model, lambda m, ids, y: P.nn.functional.cross_entropy(m(ids), y), opt)
+    ids = P.to_tensor(np.random.RandomState(0).randint(0, vocab, (batch, seq)).astype(np.int32))
+    y = P.to_tensor(np.random.RandomState(1).randint(0, 2, (batch,)).astype(np.int64))
+    dt, loss = _timeit(step, (ids, y), steps)
+    print(json.dumps({
+        "metric": "bert_base_finetune_step_ms",
+        "value": round(dt * 1e3, 2),
+        "unit": "ms/step",
+        "extra": {"backend": backend, "batch": batch, "seq": seq,
+                  "examples_per_sec": round(batch / dt, 1), "loss": loss},
+    }))
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which in ("all", "resnet"):
+        bench_resnet50()
+    if which in ("all", "bert"):
+        bench_bert_base()

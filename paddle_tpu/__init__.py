@@ -78,7 +78,7 @@ import importlib as _importlib
 for _sub in ("nn", "optimizer", "metric", "amp", "io", "jit", "vision", "distributed",
              "models", "profiler", "hapi", "regularizer", "distribution", "fft",
              "sparse", "static", "quantization", "inference", "audio", "text",
-             "callbacks", "incubate", "signal"):
+             "callbacks", "incubate", "signal", "strings"):
     try:
         globals()[_sub] = _importlib.import_module(f".{_sub}", __name__)
     except ModuleNotFoundError as _e:

@@ -8,6 +8,8 @@ topology→jax.sharding.Mesh.
 from . import checkpoint  # noqa: F401
 from . import env  # noqa: F401
 from . import fleet  # noqa: F401
+from . import ps  # noqa: F401
+from . import rpc  # noqa: F401
 from .auto_parallel import api as _auto_api  # noqa: F401
 from .auto_parallel.api import (  # noqa: F401
     dtensor_from_fn,

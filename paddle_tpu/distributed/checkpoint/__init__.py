@@ -253,11 +253,17 @@ def load_state_dict(state_dict: Dict[str, Tensor], path: str, process_group=None
 
             new_val = jax.make_array_from_callback(tuple(tm["global_shape"]), sharding, cb)
         else:
+            from jax.sharding import SingleDeviceSharding
+
             full = np.zeros(tm["global_shape"], dtype=np_src_dtype)
             _fill_region(full, tuple(slice(0, d) for d in tm["global_shape"]), tm, reader)
-            if sharding is not None and not isinstance(val, np.ndarray):
+            if sharding is not None and not isinstance(val, np.ndarray) and \
+                    not isinstance(sharding, SingleDeviceSharding):
                 new_val = jax.device_put(full.astype(target_dtype), sharding)
             else:
+                # keep the array UNCOMMITTED (plain asarray): committing a
+                # replicated param to one device would conflict with mesh-
+                # sharded peers in the same jitted step
                 new_val = jnp.asarray(full, target_dtype)
         t._value = new_val
     reader.close()

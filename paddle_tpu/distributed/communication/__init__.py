@@ -285,16 +285,9 @@ def _proc_mesh(group):
 def _shard_map_p(fn, mesh):
     from jax.sharding import PartitionSpec
 
-    try:
-        from jax import shard_map
+    from ..shard_map_compat import shard_map_compat
 
-        return shard_map(fn, mesh=mesh, in_specs=PartitionSpec("p"),
-                         out_specs=PartitionSpec("p"), check_vma=False)
-    except ImportError:  # pragma: no cover - older jax spells it check_rep
-        from jax.experimental.shard_map import shard_map
-
-        return shard_map(fn, mesh=mesh, in_specs=PartitionSpec("p"),
-                         out_specs=PartitionSpec("p"), check_rep=False)
+    return shard_map_compat(fn, mesh, PartitionSpec("p"), PartitionSpec("p"))
 
 
 def _group_global_array(val, mesh):

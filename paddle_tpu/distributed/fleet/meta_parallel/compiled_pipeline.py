@@ -44,17 +44,7 @@ from ....tensor.tensor import Tensor
 __all__ = ["CompiledPipelineTrainStep", "pipeline_bubble_fraction"]
 
 
-def _shard_map(fn, mesh, in_specs, out_specs):
-    try:
-        from jax import shard_map
-
-        return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                         check_vma=False)
-    except (ImportError, TypeError):  # pragma: no cover
-        from jax.experimental.shard_map import shard_map
-
-        return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                         check_rep=False)
+from ...shard_map_compat import shard_map_compat as _shard_map
 
 
 def pipeline_bubble_fraction(num_micro: int, num_stages: int) -> float:
@@ -84,7 +74,9 @@ def _stage_param_lists(pipe) -> List[List]:
         for layer, f in zip(pipe._stage_layers[s], pipe._stage_fwd_funcs[s]):
             cfg = repr(layer) if isinstance(layer, Layer) else getattr(
                 layer, "__name__", str(layer))
-            out.append((type(layer).__name__, cfg, f if f == "plain_fn" else None))
+            fid = f if isinstance(f, str) or f is None else getattr(
+                f, "__qualname__", repr(f))
+            out.append((type(layer).__name__, cfg, fid))
         return out + [(tuple(p.shape), str(p.dtype)) for p in stages[s]]
 
     ref = _sig(0)
@@ -144,10 +136,14 @@ class CompiledPipelineTrainStep:
         # update rules are elementwise, so [P, ...] arrays work unchanged)
         if optimizer._accumulators or optimizer._master_weights:
             raise ValueError("pass a fresh optimizer (no accumulated state)")
+        if len(optimizer._param_groups) != 1:
+            raise ValueError(
+                "compiled pipeline supports a single param group (per-group "
+                "hyperparameters cannot be mapped onto the stacked weights)")
         stacked_list = self._stacked.parameters()
         optimizer._param_groups = [
             {**{k: v for k, v in g.items() if k != "params"}, "params": stacked_list}
-            for g in optimizer._param_groups[:1]
+            for g in optimizer._param_groups
         ]
 
         stage0_layers = model._stage_layers[0]

@@ -107,6 +107,14 @@ class KVClient:
         except OSError:
             return {}
 
+    def delete(self, key: str) -> bool:
+        req = urllib.request.Request(f"{self.base}{key}", method="DELETE")
+        try:
+            with urllib.request.urlopen(req, timeout=5) as r:
+                return r.status == 200
+        except OSError:
+            return False
+
     def _get_prefix_raw(self, prefix: str) -> Dict[str, str]:
         with urllib.request.urlopen(f"{self.base}/prefix{prefix}", timeout=5) as r:
             return json.loads(r.read().decode())

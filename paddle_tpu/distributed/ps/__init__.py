@@ -1,0 +1,125 @@
+"""Parameter server — CPU-host sharded embedding tables with sparse
+push/pull (minimal capability analog of
+/root/reference/python/paddle/distributed/ps/the_one_ps.py +
+paddle/fluid/distributed/ps/ sharded tables).
+
+TPU-native stance: the PS pattern exists for sparse-recsys workloads whose
+embedding tables exceed accelerator memory. Here the tables live in HOST
+numpy memory, sharded row-wise across server workers (row r lives on server
+r % num_servers — the reference's hash sharding); trainers ``pull`` the rows
+a batch touches and ``push`` sparse gradients back (async SGD, the
+reference's default mode). Transport is paddle_tpu.distributed.rpc; the
+dense model path stays on the XLA side entirely.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from . import _worker
+from .. import rpc as _rpc
+
+__all__ = ["SparseTable", "ShardedEmbedding", "start_server", "Table"]
+
+
+class Table:
+    """One server's shard of a row-sharded table (host memory)."""
+
+    def __init__(self, name: str, dim: int, initializer="zeros", seed: int = 0):
+        self.name = name
+        self.dim = dim
+        self.rows: Dict[int, np.ndarray] = {}
+        self._init = initializer
+        self._seed = seed
+        self._lock = threading.Lock()
+
+    def _row(self, rid: int) -> np.ndarray:
+        row = self.rows.get(rid)
+        if row is None:
+            if self._init == "zeros":
+                row = np.zeros(self.dim, np.float32)
+            else:  # deterministic per-row init (reference: uniform fill)
+                rng = np.random.RandomState((self._seed * 1000003 + rid) % (2**31))
+                row = (rng.rand(self.dim).astype(np.float32) - 0.5) * 0.02
+            self.rows[rid] = row
+        return row
+
+    def pull(self, ids: Sequence[int]) -> np.ndarray:
+        with self._lock:
+            return np.stack([self._row(int(i)) for i in ids])
+
+    def push(self, ids: Sequence[int], grads: np.ndarray, lr: float):
+        """Sparse SGD update (async-mode semantics: apply on arrival)."""
+        with self._lock:
+            for i, g in zip(ids, np.asarray(grads, np.float32)):
+                self._row(int(i))[:] -= lr * g
+
+    def size(self) -> int:
+        return len(self.rows)
+
+
+def start_server(name: str, dim: int, table_name: str = "emb",
+                 initializer: str = "uniform", seed: int = 0) -> str:
+    """Register a table on THIS rpc worker (call after init_rpc)."""
+    _worker.TABLES[table_name] = Table(table_name, dim, initializer, seed)
+    return table_name
+
+
+class ShardedEmbedding:
+    """Trainer-side handle: pull/push rows sharded over the server workers.
+
+    Row r is owned by servers[r % S] (the reference's hash-sharded table
+    accessor)."""
+
+    def __init__(self, table_name: str, dim: int, servers: List[str]):
+        self.table_name = table_name
+        self.dim = dim
+        self.servers = list(servers)
+
+    def _shard(self, ids: np.ndarray):
+        ids = np.asarray(ids).reshape(-1).astype(np.int64)
+        owner = ids % len(self.servers)
+        return ids, owner
+
+    def pull(self, ids) -> np.ndarray:
+        """Gather rows for ``ids`` (any shape) -> [*ids.shape, dim]."""
+        arr = np.asarray(ids)
+        flat, owner = self._shard(arr)
+        out = np.zeros((flat.size, self.dim), np.float32)
+        futs = []
+        for s, server in enumerate(self.servers):
+            mask = owner == s
+            if not mask.any():
+                continue
+            futs.append((mask, _rpc.rpc_async(
+                server, _worker.table_pull,
+                args=(self.table_name, flat[mask].tolist()))))
+        for mask, f in futs:
+            out[mask] = f.result()
+        return out.reshape(*arr.shape, self.dim)
+
+    def push(self, ids, grads, lr: float = 0.01):
+        """Scatter sparse gradients back (rows repeated in ids accumulate)."""
+        arr = np.asarray(ids)
+        flat, owner = self._shard(arr)
+        g = np.asarray(grads, np.float32).reshape(flat.size, self.dim)
+        futs = []
+        for s, server in enumerate(self.servers):
+            mask = owner == s
+            if not mask.any():
+                continue
+            futs.append(_rpc.rpc_async(
+                server, _worker.table_push,
+                args=(self.table_name, flat[mask].tolist(), g[mask], lr)))
+        for f in futs:
+            f.result()
+
+    def server_sizes(self) -> List[int]:
+        return [_rpc.rpc_sync(s, _worker.table_size, args=(self.table_name,))
+                for s in self.servers]
+
+
+# reference-compatible alias
+SparseTable = ShardedEmbedding

@@ -1,0 +1,20 @@
+"""Server-side table registry + the module-level functions RPC invokes
+(pickled by reference, so they must be importable top-level functions)."""
+from __future__ import annotations
+
+from typing import Dict
+
+TABLES: Dict[str, object] = {}
+
+
+def table_pull(table_name, ids):
+    return TABLES[table_name].pull(ids)
+
+
+def table_push(table_name, ids, grads, lr):
+    TABLES[table_name].push(ids, grads, lr)
+    return True
+
+
+def table_size(table_name):
+    return TABLES[table_name].size()

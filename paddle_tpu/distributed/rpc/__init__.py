@@ -1,0 +1,183 @@
+"""User RPC (parity: /root/reference/python/paddle/distributed/rpc/rpc.py:73
+init_rpc / rpc_sync:143 / rpc_async:183 / shutdown / get_worker_info over the
+brpc stack).
+
+TPU-native layering: the control plane rides plain HTTP + the launch KV
+master for discovery (paddle_tpu.distributed.launch.master), not a native
+comm library — RPC here is host-side orchestration (parameter-server pulls,
+eval coordination), never the tensor hot path, which belongs to XLA
+collectives. Payloads are pickled like the reference's serialized Python
+functions (trusted-cluster assumption, identical to the reference contract).
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import http.server
+import pickle
+import socket
+import threading
+import time
+import urllib.request
+from typing import Any, Dict, List, NamedTuple, Optional
+
+__all__ = ["init_rpc", "shutdown", "rpc_sync", "rpc_async", "get_worker_info",
+           "get_all_worker_infos", "WorkerInfo"]
+
+
+class WorkerInfo(NamedTuple):
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+
+_state: Dict[str, Any] = {
+    "server": None, "name": None, "workers": {}, "pool": None, "kv": None,
+}
+
+
+class _RpcHandler(http.server.BaseHTTPRequestHandler):
+    def log_message(self, *a):  # quiet
+        pass
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        payload = self.rfile.read(n)
+        try:
+            fn, args, kwargs = pickle.loads(payload)
+            result = ("ok", fn(*args, **kwargs))
+        except Exception as e:  # error travels back to the caller
+            result = ("err", e)
+        body = pickle.dumps(result)
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class _Server(http.server.ThreadingHTTPServer):
+    daemon_threads = True
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def init_rpc(name: str, rank: Optional[int] = None, world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None):
+    """Start this worker's RPC server and register it for discovery.
+
+    Discovery: a KV master endpoint ("ip:port" of a launch KVServer) when
+    given / when PADDLE_MASTER is set; otherwise an in-process registry
+    (single-process tests)."""
+    import os
+
+    if _state["server"] is not None:
+        raise RuntimeError("init_rpc already called")
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None else rank
+    world_size = (int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+                  if world_size is None else world_size)
+    master_endpoint = master_endpoint or os.environ.get("PADDLE_MASTER")
+
+    port = _free_port()
+    # bind all interfaces; advertise a peer-reachable address (multi-node
+    # workers resolve each other through the KV master)
+    srv = _Server(("0.0.0.0", port), _RpcHandler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    ip = os.environ.get("PADDLE_LOCAL_IP")
+    if not ip:
+        if master_endpoint:
+            try:
+                ip = socket.gethostbyname(socket.gethostname())
+            except OSError:
+                ip = "127.0.0.1"
+        else:
+            ip = "127.0.0.1"
+    info = WorkerInfo(name, rank, ip, port)
+    _state.update(server=srv, name=name,
+                  pool=concurrent.futures.ThreadPoolExecutor(max_workers=8))
+
+    if master_endpoint:
+        from ..launch.master import KVClient
+
+        kv = KVClient(master_endpoint)
+        _state["kv"] = kv
+        kv.put(f"/rpc/workers/{name}", f"{rank}:{info.ip}:{port}")
+        # wait for the full membership
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            entries = kv.get_prefix("/rpc/workers/")
+            if len(entries) >= world_size:
+                for key, val in entries.items():
+                    wname = key.rsplit("/", 1)[-1]
+                    r, ip, p = val.split(":")
+                    _state["workers"][wname] = WorkerInfo(wname, int(r), ip, int(p))
+                break
+            time.sleep(0.05)
+        else:
+            raise TimeoutError("init_rpc: rendezvous timed out")
+    else:
+        _GLOBAL_REGISTRY[name] = info
+        _state["workers"] = _GLOBAL_REGISTRY
+    return info
+
+
+_GLOBAL_REGISTRY: Dict[str, WorkerInfo] = {}
+
+
+def get_worker_info(name: Optional[str] = None) -> WorkerInfo:
+    if name is None:
+        name = _state["name"]
+    return _state["workers"][name]
+
+
+def get_all_worker_infos() -> List[WorkerInfo]:
+    return sorted(_state["workers"].values(), key=lambda w: w.rank)
+
+
+def _post(info: WorkerInfo, payload: bytes, timeout: float):
+    req = urllib.request.Request(f"http://{info.ip}:{info.port}/", data=payload,
+                                 method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        status, value = pickle.loads(r.read())
+    if status == "err":
+        raise value
+    return value
+
+
+def rpc_sync(to: str, fn, args=(), kwargs=None, timeout: float = 300.0):
+    """Run ``fn(*args, **kwargs)`` on worker ``to``; block for the result."""
+    info = get_worker_info(to)
+    payload = pickle.dumps((fn, tuple(args), dict(kwargs or {})))
+    return _post(info, payload, timeout)
+
+
+def rpc_async(to: str, fn, args=(), kwargs=None, timeout: float = 300.0):
+    """Like rpc_sync but returns a Future (``.wait()``/``.result()``)."""
+    info = get_worker_info(to)
+    payload = pickle.dumps((fn, tuple(args), dict(kwargs or {})))
+    fut = _state["pool"].submit(_post, info, payload, timeout)
+    fut.wait = fut.result  # paddle Future parity
+    return fut
+
+
+def shutdown():
+    srv = _state.get("server")
+    if srv is not None:
+        srv.shutdown()
+    pool = _state.get("pool")
+    if pool is not None:
+        pool.shutdown(wait=False)
+    name = _state.get("name")
+    kv = _state.get("kv")
+    if kv is not None and name:
+        try:
+            kv.delete(f"/rpc/workers/{name}")
+        except Exception:
+            pass
+    _GLOBAL_REGISTRY.pop(name, None)
+    _state.update(server=None, name=None, workers={}, pool=None, kv=None)

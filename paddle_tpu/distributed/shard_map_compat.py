@@ -1,0 +1,20 @@
+"""Version-compat shard_map: jax ≥0.8 spells the replication check
+``check_vma`` on ``jax.shard_map``; older releases have
+``jax.experimental.shard_map`` with ``check_rep``. One shim, used by the
+eager collectives and the compiled pipeline."""
+from __future__ import annotations
+
+__all__ = ["shard_map_compat"]
+
+
+def shard_map_compat(fn, mesh, in_specs, out_specs):
+    try:
+        from jax import shard_map
+
+        return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                         check_vma=False)
+    except (ImportError, TypeError):  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+
+        return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                         check_rep=False)

@@ -32,6 +32,20 @@ __all__ = ["to_static", "not_to_static", "StaticFunction", "ignore_module", "Tra
 # jit.enable_to_static(False) falls every StaticFunction back to eager
 _to_static_enabled = True
 
+# exceptions that mean "this Python is untraceable", not "user bug": the
+# graph-break conditions of the reference's SOT (opcode_executor.py:1594)
+_TRACE_BREAK_ERRORS = tuple(
+    getattr(jax.errors, n)
+    for n in (
+        "TracerArrayConversionError",
+        "TracerBoolConversionError",
+        "TracerIntegerConversionError",
+        "ConcretizationTypeError",
+        "UnexpectedTracerError",
+    )
+    if hasattr(jax.errors, n)
+)
+
 
 class InputSpec:
     """paddle.static.InputSpec parity (shape with None for dynamic dims)."""
@@ -112,7 +126,7 @@ class _SwapValues:
 
 class StaticFunction:
     def __init__(self, function: Callable, input_spec=None, build_strategy=None, backend=None,
-                 full_graph=True, donate_state=False, bucket_dynamic_batch=False):
+                 full_graph=False, donate_state=False, bucket_dynamic_batch=False):
         from ..nn.layer.layers import Layer
 
         self._layer: Optional[Layer] = None
@@ -127,6 +141,11 @@ class StaticFunction:
         self._input_spec = input_spec
         self._bucket_dynamic_batch = bucket_dynamic_batch
         self._cache: Dict[Any, Any] = {}
+        # guard keys whose trace failed: calls fall back to eager (the SOT
+        # graph-break analog, reference opcode_executor.py:1594 resume-eager)
+        self._fallback_keys: set = set()
+        self._full_graph = full_graph
+        self._warned_fallback = False
         functools.update_wrapper(self, function if callable(function) else self._fn)
 
     # paddle surface
@@ -240,6 +259,8 @@ class StaticFunction:
 
         state_tensors = self._state_tensors()
         key = self._guards(arg_tensors, spec, training)
+        if key in self._fallback_keys:
+            return self._fn(*args, **kwargs)  # cached graph-break: stay eager
         entry = self._cache.get(key)
         n_state = len(state_tensors)
         new_entry = entry is None
@@ -256,7 +277,28 @@ class StaticFunction:
             if dump_dir():
                 maybe_dump(f"to_static_{getattr(self._fn, '__name__', 'fn')}",
                            entry["fwd"], (rng_key, flat_vals))
-        raw_outs = entry["fwd"](rng_key, flat_vals)
+        try:
+            raw_outs = entry["fwd"](rng_key, flat_vals)
+        except _TRACE_BREAK_ERRORS as e:
+            # graph break: the function does data-dependent Python (e.g.
+            # .numpy()/bool() on a traced value). Fall back to eager for this
+            # specialization and remember it — the SOT capability contract
+            # (trace Python, resume eagerly at breaks) without the bytecode
+            # interpreter. full_graph=True keeps the reference's strict mode.
+            if self._full_graph:
+                raise
+            self._fallback_keys.add(key)
+            self._cache.pop(key, None)
+            if not self._warned_fallback:
+                self._warned_fallback = True
+                import warnings
+
+                name = getattr(self._fn, "__name__", "fn")
+                warnings.warn(
+                    f"to_static({name}): graph break "
+                    f"({type(e).__name__}); falling back to eager for this "
+                    "input signature. Pass full_graph=True to error instead.")
+            return self._fn(*args, **kwargs)
         meta = entry["meta"]
         out_spec = meta["out_spec"]
         updated_buffers = meta["updated_buffers"]
@@ -310,11 +352,16 @@ class StaticFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None, backend=None, **kwargs):
-    """Decorator/wrapper parity with paddle.jit.to_static."""
+    """Decorator/wrapper parity with paddle.jit.to_static.
+
+    ``full_graph=False`` (default, matching the reference's SOT mode) falls
+    back to eager per input-signature on untraceable Python (graph break);
+    ``full_graph=True`` raises instead (the reference's strict AST mode)."""
 
     def decorate(fn):
         return StaticFunction(fn, input_spec=input_spec, build_strategy=build_strategy,
                               backend=backend,
+                              full_graph=kwargs.get("full_graph", False),
                               bucket_dynamic_batch=kwargs.get("bucket_dynamic_batch", False))
 
     if function is not None:

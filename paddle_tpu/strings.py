@@ -1,0 +1,102 @@
+"""String tensor tier (parity: /root/reference/paddle/phi/kernels/strings/ —
+strings_empty / strings_lower_upper / strings_copy kernels over
+phi::StringTensor, paddle/phi/ops/yaml/strings_ops.yaml).
+
+TPU-native stance: strings never touch the accelerator (no XLA string type);
+a StringTensor is a host-side numpy object array with the same op surface.
+The utf8/ascii split mirrors the reference kernels' use_utf8_encoding flag
+(case_utils.h: ascii fast path vs unicode conversion).
+"""
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import numpy as np
+
+__all__ = ["StringTensor", "empty", "empty_like", "copy", "lower", "upper",
+           "to_string_tensor"]
+
+
+class StringTensor:
+    """Host string tensor: shape + numpy object array of ``str``."""
+
+    def __init__(self, data: Union[np.ndarray, Sequence, str]):
+        if isinstance(data, StringTensor):
+            data = data._data
+        arr = np.asarray(data, dtype=object)
+        # normalize elements to str
+        self._data = np.vectorize(lambda x: "" if x is None else str(x),
+                                  otypes=[object])(arr) if arr.size else arr
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    def numpy(self) -> np.ndarray:
+        return self._data
+
+    def tolist(self):
+        return self._data.tolist()
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        return out if isinstance(out, str) else StringTensor(out)
+
+    def __eq__(self, other):
+        other = to_string_tensor(other)
+        return np.array_equal(self._data, other._data)
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, data={self._data!r})"
+
+
+def to_string_tensor(x) -> StringTensor:
+    return x if isinstance(x, StringTensor) else StringTensor(x)
+
+
+def empty(shape: Sequence[int]) -> StringTensor:
+    """parity: strings_empty_kernel — a tensor of empty strings."""
+    arr = np.empty(tuple(shape), dtype=object)
+    arr.fill("")
+    return StringTensor(arr)
+
+
+def empty_like(x) -> StringTensor:
+    return empty(to_string_tensor(x).shape)
+
+
+def copy(x) -> StringTensor:
+    """parity: strings_copy_kernel."""
+    return StringTensor(to_string_tensor(x)._data.copy())
+
+
+def _case_map(x, fn, use_utf8_encoding: bool):
+    x = to_string_tensor(x)
+    if use_utf8_encoding:
+        out = np.vectorize(fn, otypes=[object])(x._data) if x.size else x._data.copy()
+    else:
+        # ascii fast path: only [A-Za-z] change case (case_utils.h semantics)
+        def ascii_fn(s: str) -> str:
+            return "".join(fn(c) if ("a" <= c <= "z" or "A" <= c <= "Z") else c
+                           for c in s)
+
+        out = np.vectorize(ascii_fn, otypes=[object])(x._data) if x.size else x._data.copy()
+    return StringTensor(out)
+
+
+def lower(x, use_utf8_encoding: bool = False) -> StringTensor:
+    """parity: strings_lower_upper_kernel StringLower."""
+    return _case_map(x, str.lower, use_utf8_encoding)
+
+
+def upper(x, use_utf8_encoding: bool = False) -> StringTensor:
+    """parity: strings_lower_upper_kernel StringUpper."""
+    return _case_map(x, str.upper, use_utf8_encoding)

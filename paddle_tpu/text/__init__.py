@@ -20,7 +20,17 @@ from ..nn.layer.layers import Layer
 from ..ops.dispatch import apply
 from ..tensor.tensor import Tensor
 
-__all__ = ["viterbi_decode", "ViterbiDecoder", "UCIHousing", "Imdb"]
+from .datasets_nlp import (  # noqa: E402,F401
+    WMT14,
+    WMT16,
+    Conll05st,
+    Imdb,
+    Imikolov,
+    Movielens,
+)
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "UCIHousing", "Imdb",
+           "Imikolov", "WMT14", "WMT16", "Conll05st", "Movielens"]
 
 
 def viterbi_decode(potentials, transition_params, lengths,
@@ -124,30 +134,3 @@ class UCIHousing(Dataset):
 
     def __getitem__(self, i):
         return self.x[i], self.y[i]
-
-
-class Imdb(Dataset):
-    """parity: text/datasets/imdb.py — reads a local aclImdb directory."""
-
-    def __init__(self, data_dir=None, mode="train", cutoff=150):
-        if data_dir is None or not os.path.isdir(data_dir):
-            raise RuntimeError(
-                "Imdb: pass data_dir pointing at a local aclImdb tree "
-                "(no network access in this environment)")
-        self.samples = []
-        for label, sub in ((0, "neg"), (1, "pos")):
-            d = os.path.join(data_dir, mode, sub)
-            if os.path.isdir(d):
-                for fn in sorted(os.listdir(d)):
-                    self.samples.append((os.path.join(d, fn), label))
-        self._vocab = None
-        self.cutoff = cutoff
-
-    def __len__(self):
-        return len(self.samples)
-
-    def __getitem__(self, i):
-        path, label = self.samples[i]
-        with open(path, encoding="utf-8") as f:
-            text = f.read().lower().split()
-        return text, np.int64(label)

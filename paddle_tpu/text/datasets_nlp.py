@@ -1,0 +1,403 @@
+"""NLP dataset classes (parity: /root/reference/python/paddle/text/datasets/
+imdb.py, imikolov.py, wmt14.py, wmt16.py, conll05.py, movielens.py).
+
+Sandbox stance: no network — every class takes ``data_file`` pointing at the
+same archive format the reference downloads (aclImdb tar, PTB
+simple-examples tar, WMT dicts+parallel-corpus tar, CoNLL-2005 release tar,
+MovieLens 1M zip) and parses it identically, so locally-provided copies of
+the official archives work unchanged.
+"""
+from __future__ import annotations
+
+import collections
+import gzip
+import re
+import string
+import tarfile
+import zipfile
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["Imdb", "Imikolov", "WMT14", "WMT16", "Conll05st", "Movielens"]
+
+UNK_IDX = 0
+_START = "<s>"
+_END = "<e>"
+
+
+def _require(data_file: Optional[str], name: str) -> str:
+    if not data_file:
+        raise RuntimeError(
+            f"{name}: pass data_file pointing at a local copy of the official "
+            "archive (downloading is disabled in this environment)")
+    return data_file
+
+
+class Imdb(Dataset):
+    """IMDB sentiment (aclImdb tar). Labels: pos=0, neg=1 and samples are
+    word-id arrays — BOTH per the reference's imdb.py `_load_anno` (note:
+    this corrects the pre-round-3 class, which emitted raw tokens with
+    inverted labels).
+
+    Accepts either the official tar (``data_file``) or an extracted directory
+    (``data_dir`` convenience; same reference label/id semantics).
+    """
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 cutoff: int = 150, download: bool = False, data_dir=None):
+        assert mode.lower() in ("train", "test"), mode
+        self.mode = mode.lower()
+        if data_dir is not None:
+            self._init_from_dir(data_dir, cutoff)
+            return
+        self.data_file = _require(data_file, "Imdb")
+        self.word_idx = self._build_word_dict(cutoff)
+        self._load_anno()
+
+    # ---- directory fallback (non-reference convenience)
+    def _init_from_dir(self, data_dir, cutoff):
+        import os
+
+        docs = {}
+        for sub in ("pos", "neg"):
+            out = []
+            d = os.path.join(data_dir, self.mode, sub)
+            if os.path.isdir(d):
+                for fn in sorted(os.listdir(d)):
+                    with open(os.path.join(d, fn), "rb") as f:
+                        out.append(self._clean(f.read()))
+            docs[sub] = out
+        freq = collections.defaultdict(int)
+        for ds in docs.values():
+            for doc in ds:
+                for w in doc:
+                    freq[w] += 1
+        self.word_idx = self._freq_to_idx(freq, cutoff)
+        unk = self.word_idx["<unk>"]
+        self.docs, self.labels = [], []
+        for label, sub in ((0, "pos"), (1, "neg")):
+            for doc in docs[sub]:
+                self.docs.append([self.word_idx.get(w, unk) for w in doc])
+                self.labels.append(label)
+
+    @staticmethod
+    def _clean(raw: bytes) -> List[bytes]:
+        return (raw.rstrip(b"\n\r")
+                .translate(None, string.punctuation.encode("latin-1"))
+                .lower().split())
+
+    @staticmethod
+    def _freq_to_idx(freq, cutoff) -> Dict[bytes, int]:
+        kept = [x for x in freq.items() if x[1] > cutoff]
+        kept = sorted(kept, key=lambda x: (-x[1], x[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(kept)}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+
+    def _tokenize(self, pattern):
+        data = []
+        with tarfile.open(self.data_file) as tarf:
+            tf = tarf.next()
+            while tf is not None:
+                if pattern.match(tf.name):
+                    data.append(self._clean(tarf.extractfile(tf).read()))
+                tf = tarf.next()
+        return data
+
+    def _build_word_dict(self, cutoff):
+        pattern = re.compile(r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$")
+        freq = collections.defaultdict(int)
+        for doc in self._tokenize(pattern):
+            for w in doc:
+                freq[w] += 1
+        return self._freq_to_idx(freq, cutoff)
+
+    def _load_anno(self):
+        unk = self.word_idx["<unk>"]
+        self.docs, self.labels = [], []
+        for label, sub in ((0, "pos"), (1, "neg")):
+            pattern = re.compile(rf"aclImdb/{self.mode}/{sub}/.*\.txt$")
+            for doc in self._tokenize(pattern):
+                self.docs.append([self.word_idx.get(w, unk) for w in doc])
+                self.labels.append(label)
+
+    def __getitem__(self, idx):
+        return np.array(self.docs[idx]), np.array([self.labels[idx]])
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB language-model dataset (simple-examples tar): NGRAM or SEQ mode."""
+
+    def __init__(self, data_file: Optional[str] = None, data_type: str = "NGRAM",
+                 window_size: int = -1, mode: str = "train",
+                 min_word_freq: int = 50, download: bool = False):
+        assert data_type.upper() in ("NGRAM", "SEQ"), data_type
+        assert mode.lower() in ("train", "test"), mode
+        self.data_type = data_type.upper()
+        self.mode = mode.lower()
+        self.window_size = window_size
+        self.min_word_freq = min_word_freq
+        self.data_file = _require(data_file, "Imikolov")
+        self.word_idx = self._build_word_dict()
+        self._load_anno()
+
+    @staticmethod
+    def _word_count(f, freq):
+        for line in f:
+            for w in line.strip().split():
+                freq[w] += 1
+            freq[b"<s>"] += 1
+            freq[b"<e>"] += 1
+        return freq
+
+    def _build_word_dict(self):
+        with tarfile.open(self.data_file) as tf:
+            freq = collections.defaultdict(int)
+            self._word_count(tf.extractfile("./simple-examples/data/ptb.train.txt"), freq)
+            self._word_count(tf.extractfile("./simple-examples/data/ptb.valid.txt"), freq)
+        freq.pop(b"<unk>", None)
+        kept = [x for x in freq.items() if x[1] > self.min_word_freq]
+        kept = sorted(kept, key=lambda x: (-x[1], x[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(kept)}
+        word_idx[b"<unk>"] = len(word_idx)
+        return word_idx
+
+    def _load_anno(self):
+        self.data = []
+        # reference maps mode 'test' -> ptb.valid.txt? No: ptb.{mode}.txt with
+        # mode in {train, valid}; paddle passes 'test' through — keep parity
+        name = {"train": "train", "test": "valid"}[self.mode]
+        unk = self.word_idx[b"<unk>"]
+        with tarfile.open(self.data_file) as tf:
+            f = tf.extractfile(f"./simple-examples/data/ptb.{name}.txt")
+            for line in f:
+                if self.data_type == "NGRAM":
+                    assert self.window_size > -1, "Invalid gram length"
+                    toks = [b"<s>"] + line.strip().split() + [b"<e>"]
+                    if len(toks) >= self.window_size:
+                        ids = [self.word_idx.get(w, unk) for w in toks]
+                        for i in range(self.window_size, len(ids) + 1):
+                            self.data.append(tuple(ids[i - self.window_size:i]))
+                else:
+                    ids = [self.word_idx.get(w, unk) for w in line.strip().split()]
+                    src = [self.word_idx[b"<s>"]] + ids
+                    trg = ids + [self.word_idx[b"<e>"]]
+                    if self.window_size > 0 and len(src) > self.window_size:
+                        continue
+                    self.data.append((src, trg))
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+class WMT14(Dataset):
+    """WMT14 en→fr (paddle-preprocessed tar: src.dict/trg.dict + parallel
+    corpus under {mode}/{mode})."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 dict_size: int = -1, download: bool = False):
+        assert mode.lower() in ("train", "test", "gen"), mode
+        self.mode = mode.lower()
+        self.data_file = _require(data_file, "WMT14")
+        assert dict_size > 0, "dict_size should be set as positive number"
+        self.dict_size = dict_size
+        self._load_data()
+
+    def _load_data(self):
+        def to_dict(fd, size):
+            out = {}
+            for i, line in enumerate(fd):
+                if i >= size:
+                    break
+                out[line.strip().decode()] = i
+            return out
+
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(self.data_file) as f:
+            members = f.getmembers()
+            src_dicts = [m for m in members if m.name.endswith("src.dict")]
+            trg_dicts = [m for m in members if m.name.endswith("trg.dict")]
+            assert len(src_dicts) == 1 and len(trg_dicts) == 1
+            self.src_dict = to_dict(f.extractfile(src_dicts[0]), self.dict_size)
+            self.trg_dict = to_dict(f.extractfile(trg_dicts[0]), self.dict_size)
+            suffix = f"{self.mode}/{self.mode}"
+            for m in members:
+                if not m.name.endswith(suffix):
+                    continue
+                for line in f.extractfile(m):
+                    parts = line.decode().strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    src_words = parts[0].split()
+                    src = [self.src_dict.get(w, UNK_IDX)
+                           for w in [_START] + src_words + [_END]]
+                    trg_words = parts[1].split()
+                    trg = [self.trg_dict.get(w, UNK_IDX) for w in trg_words]
+                    if len(src) > 80 or len(trg) > 80:
+                        continue
+                    self.src_ids.append(src)
+                    self.trg_ids.append([self.trg_dict[_START]] + trg)
+                    self.trg_ids_next.append(trg + [self.trg_dict[_END]])
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]), np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)
+
+
+class WMT16(WMT14):
+    """WMT16 en↔de over the same preprocessed-archive surface.
+
+    ``lang`` selects the SOURCE language (reference semantics): lang='en'
+    reads the corpus as stored; lang='de' swaps source and target sides
+    (ids and dicts). ``src_dict_size``/``trg_dict_size`` truncate each dict
+    independently."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 src_dict_size: int = -1, trg_dict_size: int = -1,
+                 lang: str = "en", download: bool = False):
+        self.lang = lang
+        self._src_size = src_dict_size if src_dict_size > 0 else 1 << 30
+        self._trg_size = trg_dict_size if trg_dict_size > 0 else 1 << 30
+        super().__init__(data_file=_require(data_file, "WMT16"), mode=mode,
+                         dict_size=1 << 30)
+        # re-truncate each side independently, then optionally swap direction
+        self.src_dict = {w: i for w, i in self.src_dict.items() if i < self._src_size}
+        self.trg_dict = {w: i for w, i in self.trg_dict.items() if i < self._trg_size}
+        clip = lambda seq, n: [i if i < n else UNK_IDX for i in seq]  # noqa: E731
+        self.src_ids = [clip(s, self._src_size) for s in self.src_ids]
+        self.trg_ids = [clip(s, self._trg_size) for s in self.trg_ids]
+        self.trg_ids_next = [clip(s, self._trg_size) for s in self.trg_ids_next]
+        if lang != "en":
+            # swap translation direction: target words become sources
+            trg_words = [t[1:] for t in self.trg_ids]      # strip <s>
+            src_words = [s[1:-1] for s in self.src_ids]    # strip <s>/<e>
+            self.src_dict, self.trg_dict = self.trg_dict, self.src_dict
+            s_start = self.src_dict.get(_START, UNK_IDX)
+            s_end = self.src_dict.get(_END, UNK_IDX)
+            t_start = self.trg_dict.get(_START, UNK_IDX)
+            t_end = self.trg_dict.get(_END, UNK_IDX)
+            self.src_ids = [[s_start] + w + [s_end] for w in trg_words]
+            self.trg_ids = [[t_start] + w for w in src_words]
+            self.trg_ids_next = [w + [t_end] for w in src_words]
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL test.wsj split (words + props gz inside the release
+    tar), emitting (sentence words, predicate, BIO labels) triples."""
+
+    def __init__(self, data_file: Optional[str] = None, download: bool = False,
+                 **kw):
+        self.data_file = _require(data_file, "Conll05st")
+        self._load_anno()
+
+    def _load_anno(self):
+        self.sentences, self.predicates, self.labels = [], [], []
+        with tarfile.open(self.data_file) as tf:
+            wf = tf.extractfile("conll05st-release/test.wsj/words/test.wsj.words.gz")
+            pf = tf.extractfile("conll05st-release/test.wsj/props/test.wsj.props.gz")
+            with gzip.GzipFile(fileobj=wf) as words_f, gzip.GzipFile(fileobj=pf) as props_f:
+                sent, seg = [], []
+                for word, prop in zip(words_f, props_f):
+                    word = word.strip().decode()
+                    cols = prop.strip().decode().split()
+                    if not cols:  # sentence boundary
+                        self._emit(sent, seg)
+                        sent, seg = [], []
+                    else:
+                        sent.append(word)
+                        seg.append(cols)
+        # trailing sentence without a final blank line
+        if sent:
+            self._emit(sent, seg)
+
+    def _emit(self, sent, seg):
+        if not seg:
+            return
+        n_cols = len(seg[0])
+        cols = [[row[i] for row in seg] for i in range(n_cols)]
+        verbs = [v for v in cols[0] if v != "-"]
+        for i, col in enumerate(cols[1:]):
+            cur, inside, out = "O", False, []
+            for tag in col:
+                if tag == "*" and not inside:
+                    out.append("O")
+                elif tag == "*" and inside:
+                    out.append("I-" + cur)
+                elif tag == "*)":
+                    out.append("I-" + cur)
+                    inside = False
+                elif "(" in tag and ")" in tag:
+                    cur = tag[1:tag.find("*")]
+                    out.append("B-" + cur)
+                    inside = False
+                elif "(" in tag:
+                    cur = tag[1:tag.find("*")]
+                    out.append("B-" + cur)
+                    inside = True
+                else:
+                    raise RuntimeError(f"Unexpected label: {tag}")
+            self.sentences.append(list(sent))
+            self.predicates.append(verbs[i] if i < len(verbs) else verbs[-1])
+            self.labels.append(out)
+
+    def __getitem__(self, idx):
+        return self.sentences[idx], self.predicates[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.sentences)
+
+
+class Movielens(Dataset):
+    """MovieLens 1M ratings (official ml-1m zip: users.dat/movies.dat/
+    ratings.dat with '::' separators)."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 test_ratio: float = 0.1, rand_seed: int = 0, download: bool = False):
+        assert mode.lower() in ("train", "test"), mode
+        self.mode = mode.lower()
+        self.data_file = _require(data_file, "Movielens")
+        rng = np.random.RandomState(rand_seed)
+        with zipfile.ZipFile(self.data_file) as z:
+            root = next(n for n in z.namelist() if n.endswith("ratings.dat"))
+            base = root.rsplit("/", 1)[0]
+            self.movie_info = {}
+            with z.open(f"{base}/movies.dat") as f:
+                for line in f.read().decode("latin-1").splitlines():
+                    mid, title, genres = line.split("::")
+                    self.movie_info[int(mid)] = {
+                        "title": title, "genres": genres.split("|")}
+            self.user_info = {}
+            with z.open(f"{base}/users.dat") as f:
+                for line in f.read().decode("latin-1").splitlines():
+                    uid, gender, age, job, _zip = line.split("::")
+                    self.user_info[int(uid)] = {
+                        "gender": gender, "age": int(age), "job": int(job)}
+            self.data = []
+            with z.open(root) as f:
+                for line in f.read().decode("latin-1").splitlines():
+                    uid, mid, rating, _ts = line.split("::")
+                    is_test = rng.rand() < test_ratio
+                    if (self.mode == "test") == is_test:
+                        self.data.append((int(uid), int(mid), float(rating)))
+
+    def __getitem__(self, idx):
+        uid, mid, rating = self.data[idx]
+        u = self.user_info[uid]
+        m = self.movie_info[mid]
+        return (np.array([uid]), np.array([u["age"]]), np.array([u["job"]]),
+                np.array([mid]), m["title"], m["genres"], np.array([rating]))
+
+    def __len__(self):
+        return len(self.data)

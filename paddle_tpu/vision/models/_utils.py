@@ -1,0 +1,61 @@
+"""Pretrained-weight loading mechanics (reference analog: the
+get_weights_path_from_url + load_dict flow every factory in
+python/paddle/vision/models/*.py runs when ``pretrained=True``).
+
+Sandbox stance: no network — weights come from LOCAL files:
+  * ``pretrained=<path>``: load that file directly;
+  * ``pretrained=True``: look for ``<arch>.npz`` / ``<arch>.pdparams`` under
+    ``$PADDLE_TPU_PRETRAINED_HOME`` (default ``~/.cache/paddle_tpu/weights``).
+Formats: ``.npz`` archives of named arrays, or ``paddle.save``d state_dicts.
+"""
+from __future__ import annotations
+
+import os
+from typing import Union
+
+import numpy as np
+
+__all__ = ["load_pretrained"]
+
+
+def _weights_home() -> str:
+    return os.environ.get(
+        "PADDLE_TPU_PRETRAINED_HOME",
+        os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu", "weights"))
+
+
+def load_pretrained(model, arch: str, pretrained: Union[bool, str]):
+    """Fill ``model`` with pretrained weights; returns the model."""
+    if not pretrained:
+        return model
+    if isinstance(pretrained, str):
+        path = pretrained
+    else:
+        home = _weights_home()
+        for ext in (".npz", ".pdparams"):
+            cand = os.path.join(home, arch + ext)
+            if os.path.exists(cand):
+                path = cand
+                break
+        else:
+            raise RuntimeError(
+                f"pretrained weights for {arch!r} not found under {home} "
+                "(downloading is disabled in this environment; place "
+                f"{arch}.npz or {arch}.pdparams there, or pass "
+                "pretrained='/path/to/weights')")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"pretrained weight file not found: {path}")
+
+    from ...tensor.tensor import Tensor
+
+    if path.endswith(".npz"):
+        arrays = dict(np.load(path))
+        state = {k: Tensor(v) for k, v in arrays.items()}
+    else:
+        from ...framework.framework_io import load as p_load
+
+        state = p_load(path)
+        state = {k: (v if isinstance(v, Tensor) else Tensor(np.asarray(v)))
+                 for k, v in state.items()}
+    model.set_state_dict(state)
+    return model

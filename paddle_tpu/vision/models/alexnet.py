@@ -3,6 +3,7 @@ the architecture is the spec; independent compact rewrite)."""
 from __future__ import annotations
 
 from ... import nn
+from ._utils import load_pretrained
 
 __all__ = ["AlexNet", "alexnet"]
 
@@ -38,6 +39,5 @@ class AlexNet(nn.Layer):
 
 
 def alexnet(pretrained=False, **kwargs):
-    if pretrained:
-        raise NotImplementedError("no pretrained weights in this environment")
-    return AlexNet(**kwargs)
+    model = AlexNet(**kwargs)
+    return load_pretrained(model, "alexnet", pretrained)

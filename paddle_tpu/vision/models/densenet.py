@@ -3,6 +3,7 @@ from __future__ import annotations
 
 from ... import nn
 from ...tensor.manipulation import concat, flatten
+from ._utils import load_pretrained
 
 __all__ = ["DenseNet", "densenet121", "densenet161", "densenet169", "densenet201",
            "densenet264"]
@@ -78,9 +79,8 @@ class DenseNet(nn.Layer):
 
 def _factory(n):
     def f(pretrained=False, **kwargs):
-        if pretrained:
-            raise NotImplementedError("no pretrained weights in this environment")
-        return DenseNet(layers=n, **kwargs)
+        model = DenseNet(layers=n, **kwargs)
+        return load_pretrained(model, f"densenet{n}", pretrained)
 
     return f
 

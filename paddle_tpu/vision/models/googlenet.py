@@ -3,6 +3,7 @@ from __future__ import annotations
 
 from ... import nn
 from ...tensor.manipulation import concat, flatten
+from ._utils import load_pretrained
 
 __all__ = ["GoogLeNet", "googlenet"]
 
@@ -66,6 +67,5 @@ class GoogLeNet(nn.Layer):
 
 
 def googlenet(pretrained=False, **kwargs):
-    if pretrained:
-        raise NotImplementedError("no pretrained weights in this environment")
-    return GoogLeNet(**kwargs)
+    model = GoogLeNet(**kwargs)
+    return load_pretrained(model, "googlenet", pretrained)

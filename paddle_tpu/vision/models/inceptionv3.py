@@ -4,6 +4,7 @@ from __future__ import annotations
 
 from ... import nn
 from ...tensor.manipulation import concat, flatten
+from ._utils import load_pretrained
 
 __all__ = ["InceptionV3", "inception_v3"]
 
@@ -119,6 +120,5 @@ class InceptionV3(nn.Layer):
 
 
 def inception_v3(pretrained=False, **kwargs):
-    if pretrained:
-        raise NotImplementedError("no pretrained weights in this environment")
-    return InceptionV3(**kwargs)
+    model = InceptionV3(**kwargs)
+    return load_pretrained(model, "inception_v3", pretrained)

@@ -5,6 +5,7 @@ from __future__ import annotations
 
 from ... import nn
 from ...tensor.manipulation import flatten
+from ._utils import load_pretrained
 
 __all__ = ["MobileNetV1", "mobilenet_v1"]
 
@@ -50,6 +51,5 @@ class MobileNetV1(nn.Layer):
 
 
 def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
-    if pretrained:
-        raise NotImplementedError("no pretrained weights in this environment")
-    return MobileNetV1(scale=scale, **kwargs)
+    model = MobileNetV1(scale=scale, **kwargs)
+    return load_pretrained(model, "mobilenet_v1", pretrained)

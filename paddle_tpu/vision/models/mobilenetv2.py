@@ -2,6 +2,7 @@
 from __future__ import annotations
 
 from ... import nn
+from ._utils import load_pretrained
 
 __all__ = ["MobileNetV2", "mobilenet_v2"]
 
@@ -87,4 +88,5 @@ class MobileNetV2(nn.Layer):
 
 
 def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
-    return MobileNetV2(scale=scale, **kwargs)
+    model = MobileNetV2(scale=scale, **kwargs)
+    return load_pretrained(model, "mobilenet_v2", pretrained)

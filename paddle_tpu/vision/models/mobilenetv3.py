@@ -3,6 +3,7 @@ from __future__ import annotations
 
 from ... import nn
 from ...tensor.manipulation import flatten
+from ._utils import load_pretrained
 
 __all__ = ["MobileNetV3Small", "MobileNetV3Large", "mobilenet_v3_small", "mobilenet_v3_large"]
 
@@ -108,12 +109,10 @@ class MobileNetV3Large(_MobileNetV3):
 
 
 def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
-    if pretrained:
-        raise NotImplementedError("no pretrained weights in this environment")
-    return MobileNetV3Small(scale=scale, **kwargs)
+    model = MobileNetV3Small(scale=scale, **kwargs)
+    return load_pretrained(model, "mobilenet_v3_small", pretrained)
 
 
 def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
-    if pretrained:
-        raise NotImplementedError("no pretrained weights in this environment")
-    return MobileNetV3Large(scale=scale, **kwargs)
+    model = MobileNetV3Large(scale=scale, **kwargs)
+    return load_pretrained(model, "mobilenet_v3_large", pretrained)

@@ -6,6 +6,7 @@ TPU notes: conv+BN+relu chains fuse in XLA; NCHW layout is kept for API parity
 from __future__ import annotations
 
 from ... import nn
+from ._utils import load_pretrained
 
 __all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152"]
 
@@ -126,20 +127,25 @@ def _resnet(block, depth, **kwargs):
 
 
 def resnet18(pretrained=False, **kwargs):
-    return _resnet(BasicBlock, 18, **kwargs)
+    model = _resnet(BasicBlock, 18, **kwargs)
+    return load_pretrained(model, "resnet18", pretrained)
 
 
 def resnet34(pretrained=False, **kwargs):
-    return _resnet(BasicBlock, 34, **kwargs)
+    model = _resnet(BasicBlock, 34, **kwargs)
+    return load_pretrained(model, "resnet34", pretrained)
 
 
 def resnet50(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 50, **kwargs)
+    model = _resnet(BottleneckBlock, 50, **kwargs)
+    return load_pretrained(model, "resnet50", pretrained)
 
 
 def resnet101(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 101, **kwargs)
+    model = _resnet(BottleneckBlock, 101, **kwargs)
+    return load_pretrained(model, "resnet101", pretrained)
 
 
 def resnet152(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 152, **kwargs)
+    model = _resnet(BottleneckBlock, 152, **kwargs)
+    return load_pretrained(model, "resnet152", pretrained)

@@ -3,6 +3,7 @@ from __future__ import annotations
 
 from ... import nn
 from ...tensor.manipulation import concat, flatten, split
+from ._utils import load_pretrained
 
 __all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_5",
            "shufflenet_v2_x1_0", "shufflenet_v2_x1_5", "shufflenet_v2_x2_0"]
@@ -88,9 +89,8 @@ class ShuffleNetV2(nn.Layer):
 
 def _factory(scale):
     def f(pretrained=False, **kwargs):
-        if pretrained:
-            raise NotImplementedError("no pretrained weights in this environment")
-        return ShuffleNetV2(scale=scale, **kwargs)
+        model = ShuffleNetV2(scale=scale, **kwargs)
+        return load_pretrained(model, (f"shufflenet_v2_x{scale}".replace(".", "_") if scale != 1.0 else "shufflenet_v2_x1_0"), pretrained)
 
     return f
 

@@ -3,6 +3,7 @@ from __future__ import annotations
 
 from ... import nn
 from ...tensor.manipulation import concat, flatten
+from ._utils import load_pretrained
 
 __all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
 
@@ -58,12 +59,10 @@ class SqueezeNet(nn.Layer):
 
 
 def squeezenet1_0(pretrained=False, **kwargs):
-    if pretrained:
-        raise NotImplementedError("no pretrained weights in this environment")
-    return SqueezeNet("1.0", **kwargs)
+    model = SqueezeNet("1.0", **kwargs)
+    return load_pretrained(model, "squeezenet1_0", pretrained)
 
 
 def squeezenet1_1(pretrained=False, **kwargs):
-    if pretrained:
-        raise NotImplementedError("no pretrained weights in this environment")
-    return SqueezeNet("1.1", **kwargs)
+    model = SqueezeNet("1.1", **kwargs)
+    return load_pretrained(model, "squeezenet1_1", pretrained)

@@ -2,6 +2,7 @@
 from __future__ import annotations
 
 from ... import nn
+from ._utils import load_pretrained
 
 __all__ = ["VGG", "vgg11", "vgg13", "vgg16", "vgg19"]
 
@@ -56,16 +57,20 @@ class VGG(nn.Layer):
 
 
 def vgg11(pretrained=False, batch_norm=False, **kwargs):
-    return VGG(_make_features(_CFGS["A"], batch_norm), **kwargs)
+    model = VGG(_make_features(_CFGS["A"], batch_norm), **kwargs)
+    return load_pretrained(model, "vgg11", pretrained)
 
 
 def vgg13(pretrained=False, batch_norm=False, **kwargs):
-    return VGG(_make_features(_CFGS["B"], batch_norm), **kwargs)
+    model = VGG(_make_features(_CFGS["B"], batch_norm), **kwargs)
+    return load_pretrained(model, "vgg13", pretrained)
 
 
 def vgg16(pretrained=False, batch_norm=False, **kwargs):
-    return VGG(_make_features(_CFGS["D"], batch_norm), **kwargs)
+    model = VGG(_make_features(_CFGS["D"], batch_norm), **kwargs)
+    return load_pretrained(model, "vgg16", pretrained)
 
 
 def vgg19(pretrained=False, batch_norm=False, **kwargs):
-    return VGG(_make_features(_CFGS["E"], batch_norm), **kwargs)
+    model = VGG(_make_features(_CFGS["E"], batch_norm), **kwargs)
+    return load_pretrained(model, "vgg19", pretrained)

@@ -220,3 +220,68 @@ class TestShardedTraining:
         sd2 = {"w": dist.shard_tensor(P.zeros([8, 8]), mesh, [dist.Replicate(), dist.Shard(1)])}
         dist.checkpoint.load_state_dict(sd2, str(tmp_path / "ckpt"))
         np.testing.assert_allclose(np.asarray(sd2["w"]._value), data)
+
+
+class TestCrossTopologyCheckpoint:
+    """Save under {dp=8}, load under {dp=2, mp=2, sharding=2} and train
+    (VERDICT r2 item 7a; reference: distributed/checkpoint/load_state_dict.py
+    resharding-on-load across parallel configs)."""
+
+    def test_dp8_to_hybrid_reshard_and_train(self, tmp_path):
+        from paddle_tpu.distributed.topology import set_hybrid_communicate_group
+        from paddle_tpu.models import (
+            LlamaForCausalLM,
+            LlamaPretrainingCriterion,
+            llama_tiny,
+        )
+
+        # ---- phase 1: pure data parallel (dp=8), train 2 steps, save
+        set_hybrid_communicate_group(None)
+        s = dist.fleet.DistributedStrategy()
+        s.hybrid_configs = {"dp_degree": 8, "mp_degree": 1, "pp_degree": 1,
+                            "sharding_degree": 1, "sep_degree": 1}
+        dist.fleet.init(is_collective=True, strategy=s)
+        P.seed(42)
+        cfg = llama_tiny()
+        inner = LlamaForCausalLM(cfg)
+        model = dist.fleet.distributed_model(inner)
+        crit = LlamaPretrainingCriterion()
+        opt = P.optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
+        step = P.jit.TrainStep(model, lambda m, i: crit(m(i), i), opt)
+        ids = P.to_tensor(np.random.RandomState(0).randint(
+            0, cfg.vocab_size, (8, 32)).astype(np.int32))
+        step(ids)
+        l_dp8 = float(step(ids).numpy())
+        sd = model.state_dict()
+        dist.checkpoint.save_state_dict(sd, str(tmp_path / "ckpt"))
+        ref_w = {k: np.asarray(v._value) for k, v in sd.items()}
+
+        # ---- phase 2: hybrid {dp=2, mp=2, sharding=2} — params TP-sharded
+        set_hybrid_communicate_group(None)
+        s2 = dist.fleet.DistributedStrategy()
+        s2.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 1,
+                             "sharding_degree": 2, "sep_degree": 1}
+        dist.fleet.init(is_collective=True, strategy=s2)
+        P.seed(7)  # different init on purpose — the load must overwrite it
+        inner2 = LlamaForCausalLM(cfg)
+        model2 = dist.fleet.distributed_model(inner2)
+        sd2 = model2.state_dict()
+        dist.checkpoint.load_state_dict(sd2, str(tmp_path / "ckpt"))
+
+        # loaded values match the dp=8 run, now under mp sharding
+        for k, v in sd2.items():
+            np.testing.assert_allclose(
+                np.asarray(v._value), ref_w[k], rtol=1e-5,
+                err_msg=f"reshard mismatch for {k}")
+        qw = inner2.llama.layers[0].self_attn.q_proj.weight
+        assert "mp" in str(qw._value.sharding.spec), qw._value.sharding.spec
+
+        # and training continues under the new topology
+        opt2 = P.optimizer.AdamW(learning_rate=1e-3, parameters=model2.parameters())
+        step2 = P.jit.TrainStep(model2, lambda m, i: crit(m(i), i), opt2)
+        l0 = float(step2(ids).numpy())
+        l1 = float(step2(ids).numpy())
+        assert np.isfinite(l0) and np.isfinite(l1) and l1 < l0
+        # the resumed loss continues from the dp=8 trajectory, not from the
+        # fresh seed-7 init
+        assert abs(l0 - l_dp8) < 1.0

@@ -1,0 +1,122 @@
+"""RPC + parameter-server sharded embedding (VERDICT r2 item 9; reference:
+python/paddle/distributed/rpc/rpc.py:73, distributed/ps/the_one_ps.py)."""
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fresh_rpc():
+    from paddle_tpu.distributed import rpc
+
+    rpc.shutdown()
+    return rpc
+
+
+def test_rpc_sync_async_in_process():
+    rpc = _fresh_rpc()
+    rpc.init_rpc("solo", rank=0, world_size=1)
+    try:
+        import operator
+
+        assert rpc.rpc_sync("solo", operator.add, args=(2, 3)) == 5
+        fut = rpc.rpc_async("solo", pow, args=(2, 10))
+        assert fut.wait() == 1024
+        info = rpc.get_worker_info()
+        assert info.name == "solo" and info.rank == 0
+        # errors propagate
+        with pytest.raises(ZeroDivisionError):
+            rpc.rpc_sync("solo", operator.truediv, args=(1, 0))
+    finally:
+        rpc.shutdown()
+
+
+SERVER = textwrap.dedent("""
+    import os, sys, time
+    sys.path.insert(0, os.environ["PADDLE_TPU_REPO"])
+    from paddle_tpu.distributed import rpc, ps
+    from paddle_tpu.distributed.launch.master import KVClient
+
+    name = sys.argv[1]
+    rank = int(sys.argv[2])
+    master = sys.argv[3]
+    rpc.init_rpc(name, rank=rank, world_size=3, master_endpoint=master)
+    ps.start_server(name, dim=4, initializer="uniform", seed=rank)
+    kv = KVClient(master)
+    kv.put(f"/ps/ready/{name}", "1")
+    while kv.get("/ps/done") is None:
+        time.sleep(0.1)
+    rpc.shutdown()
+""")
+
+TRAINER = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, os.environ["PADDLE_TPU_REPO"])
+    import numpy as np
+    from paddle_tpu.distributed import rpc, ps
+    from paddle_tpu.distributed.launch.master import KVClient
+
+    master = sys.argv[1]
+    rpc.init_rpc("trainer", rank=2, world_size=3, master_endpoint=master)
+    kv = KVClient(master)
+    kv.wait_n("/ps/ready/", 2, timeout=60)
+
+    emb = ps.ShardedEmbedding("emb", dim=4, servers=["server0", "server1"])
+    ids = np.array([[0, 1], [5, 0]])
+    rows = emb.pull(ids)
+    assert rows.shape == (2, 2, 4)
+    # same id pulls the same row
+    np.testing.assert_allclose(rows[0, 0], rows[1, 1])
+
+    # push a sparse gradient: row 0 appears twice -> both updates apply
+    g = np.ones((2, 2, 4), np.float32)
+    emb.push(ids, g, lr=0.5)
+    rows2 = emb.pull(ids)
+    np.testing.assert_allclose(rows2[0, 0], rows[0, 0] - 2 * 0.5, atol=1e-6)
+    np.testing.assert_allclose(rows2[0, 1], rows[0, 1] - 0.5, atol=1e-6)
+    # rows are hash-sharded across both servers (0 -> s0, 1/5 -> s1)
+    sizes = emb.server_sizes()
+    assert sizes[0] >= 1 and sizes[1] >= 2, sizes
+
+    kv.put("/ps/done", "1")
+    rpc.shutdown()
+    print("PS_OK")
+""")
+
+
+def test_sharded_embedding_push_pull_cross_process(tmp_path):
+    from paddle_tpu.distributed.launch.master import KVServer
+
+    srv = KVServer(0).start()
+    master = f"127.0.0.1:{srv.port}"
+    env = dict(os.environ)
+    env["PADDLE_TPU_REPO"] = REPO
+    sfile = tmp_path / "server.py"
+    sfile.write_text(SERVER)
+    tfile = tmp_path / "trainer.py"
+    tfile.write_text(TRAINER)
+    procs = [
+        subprocess.Popen([sys.executable, str(sfile), f"server{i}", str(i), master],
+                         env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                         text=True)
+        for i in range(2)
+    ]
+    try:
+        r = subprocess.run([sys.executable, str(tfile), master], env=env,
+                           capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-1500:])
+        assert "PS_OK" in r.stdout
+        for p in procs:
+            out, err = p.communicate(timeout=30)
+            assert p.returncode == 0, err[-1500:]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        srv.stop()

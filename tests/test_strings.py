@@ -1,0 +1,33 @@
+"""String tensor tier (reference: paddle/phi/kernels/strings/,
+strings_ops.yaml)."""
+import numpy as np
+
+import paddle_tpu as P
+from paddle_tpu import strings
+
+
+def test_empty_and_copy():
+    t = strings.empty([2, 3])
+    assert t.shape == [2, 3]
+    assert t[0, 0] == ""
+    t2 = strings.copy(strings.StringTensor([["a", "b"], ["c", "d"]]))
+    assert t2.tolist() == [["a", "b"], ["c", "d"]]
+    like = strings.empty_like(t2)
+    assert like.shape == [2, 2] and like[1, 1] == ""
+
+
+def test_lower_upper_ascii_and_utf8():
+    t = strings.StringTensor(["Hello World", "ABC-def", "Ünïcode Ü"])
+    lo = strings.lower(t)
+    assert lo.tolist() == ["hello world", "abc-def", "Ünïcode Ü".replace("U", "u").replace("ÜnÏ", "Üni") if False else "Ünïcode Ü"]
+    # ascii mode leaves non-ascii untouched
+    assert strings.lower(t).tolist()[2] == "Ünïcode Ü"
+    # utf8 mode lowers unicode too
+    assert strings.lower(t, use_utf8_encoding=True).tolist()[2] == "ünïcode ü"
+    up = strings.upper(t, use_utf8_encoding=True)
+    assert up.tolist()[0] == "HELLO WORLD"
+    assert up.tolist()[2] == "ÜNÏCODE Ü"
+
+
+def test_namespace_export():
+    assert P.strings.lower(P.strings.StringTensor(["A"])).tolist() == ["a"]

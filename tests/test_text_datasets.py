@@ -1,0 +1,151 @@
+"""NLP datasets parse the official archive formats from local files
+(reference: python/paddle/text/datasets/)."""
+import gzip
+import io
+import os
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.text import WMT14, WMT16, Conll05st, Imdb, Imikolov, Movielens
+
+
+def _add(tf, name, data: bytes):
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tf.addfile(info, io.BytesIO(data))
+
+
+@pytest.fixture
+def imdb_tar(tmp_path):
+    p = tmp_path / "aclImdb_v1.tar.gz"
+    with tarfile.open(p, "w:gz") as tf:
+        docs = {
+            "aclImdb/train/pos/0_9.txt": b"a great great movie !",
+            "aclImdb/train/pos/1_8.txt": b"great fun, great cast.",
+            "aclImdb/train/neg/0_2.txt": b"a terrible movie; great sets though",
+            "aclImdb/test/pos/0_9.txt": b"great",
+            "aclImdb/test/neg/0_1.txt": b"bad bad bad",
+        }
+        for name, data in docs.items():
+            _add(tf, name, data)
+    return str(p)
+
+
+def test_imdb_tar(imdb_tar):
+    ds = Imdb(data_file=imdb_tar, mode="train", cutoff=1)
+    assert len(ds) == 3
+    doc, label = ds[0]
+    assert doc.dtype.kind == "i" and label.shape == (1,)
+    # 'great' appears 6x > cutoff -> a real (non-unk) vocab entry
+    assert b"great" in ds.word_idx
+    labels = sorted(int(ds[i][1][0]) for i in range(len(ds)))
+    assert labels == [0, 0, 1]  # pos=0, neg=1
+
+
+@pytest.fixture
+def ptb_tar(tmp_path):
+    p = tmp_path / "simple-examples.tgz"
+    train = b"the cat sat on the mat\nthe dog sat\n" * 30
+    valid = b"the cat ran\n" * 10
+    with tarfile.open(p, "w:gz") as tf:
+        _add(tf, "./simple-examples/data/ptb.train.txt", train)
+        _add(tf, "./simple-examples/data/ptb.valid.txt", valid)
+    return str(p)
+
+
+def test_imikolov_ngram_and_seq(ptb_tar):
+    ds = Imikolov(data_file=ptb_tar, data_type="NGRAM", window_size=2,
+                  mode="train", min_word_freq=5)
+    assert len(ds) > 0
+    gram = ds[0]
+    assert len(gram) == 2
+    ds2 = Imikolov(data_file=ptb_tar, data_type="SEQ", mode="test", min_word_freq=5)
+    src, trg = ds2[0]
+    assert src[0] == ds2.word_idx[b"<s>"]
+    assert trg[-1] == ds2.word_idx[b"<e>"]
+    assert list(src[1:]) == list(trg[:-1])
+
+
+@pytest.fixture
+def wmt_tar(tmp_path):
+    p = tmp_path / "wmt14.tgz"
+    src_dict = b"<unk>\n<s>\n<e>\nhello\nworld\n"
+    trg_dict = b"<unk>\n<s>\n<e>\nbonjour\nmonde\n"
+    corpus = b"hello world\tbonjour monde\nhello\tbonjour\n"
+    with tarfile.open(p, "w:gz") as tf:
+        _add(tf, "wmt14/src.dict", src_dict)
+        _add(tf, "wmt14/trg.dict", trg_dict)
+        _add(tf, "wmt14/train/train", corpus)
+        _add(tf, "wmt14/test/test", corpus[: corpus.index(b"\n") + 1])
+    return str(p)
+
+
+def test_wmt14(wmt_tar):
+    ds = WMT14(data_file=wmt_tar, mode="train", dict_size=5)
+    assert len(ds) == 2
+    src, trg, trg_next = ds[0]
+    assert src[0] == ds.src_dict["<s>"] and src[-1] == ds.src_dict["<e>"]
+    assert trg[0] == ds.trg_dict["<s>"]
+    assert trg_next[-1] == ds.trg_dict["<e>"]
+    assert list(trg[1:]) == list(trg_next[:-1])
+    ds_t = WMT14(data_file=wmt_tar, mode="test", dict_size=5)
+    assert len(ds_t) == 1
+
+
+def test_wmt16(wmt_tar):
+    ds = WMT16(data_file=wmt_tar, mode="train", src_dict_size=5, trg_dict_size=5)
+    assert len(ds) == 2
+
+
+@pytest.fixture
+def conll_tar(tmp_path):
+    words = b"The\ncat\nsat\n\nDogs\nbark\n\n"
+    props = b"-\t(A0*\nsit\t*)\n-\t(V*)\n\nbark\t(V*)\n-\t*\n\n"
+    # columns: words file one token/line, props whitespace-separated columns;
+    # sentence boundary = blank line in both
+    words = b"The\ncat\nsat\n\n"
+    props = b"-  (A0*\nsit  *)\n-  (V*)\n\n"
+    wbuf, pbuf = io.BytesIO(), io.BytesIO()
+    with gzip.GzipFile(fileobj=wbuf, mode="w") as g:
+        g.write(words)
+    with gzip.GzipFile(fileobj=pbuf, mode="w") as g:
+        g.write(props)
+    p = tmp_path / "conll05st.tar.gz"
+    with tarfile.open(p, "w:gz") as tf:
+        _add(tf, "conll05st-release/test.wsj/words/test.wsj.words.gz", wbuf.getvalue())
+        _add(tf, "conll05st-release/test.wsj/props/test.wsj.props.gz", pbuf.getvalue())
+    return str(p)
+
+
+def test_conll05(conll_tar):
+    ds = Conll05st(data_file=conll_tar)
+    assert len(ds) == 1
+    sent, pred, labels = ds[0]
+    assert sent == ["The", "cat", "sat"]
+    assert pred == "sit"
+    assert labels == ["B-A0", "I-A0", "B-V"]
+
+
+def test_movielens(tmp_path):
+    p = tmp_path / "ml-1m.zip"
+    with zipfile.ZipFile(p, "w") as z:
+        z.writestr("ml-1m/movies.dat", "1::Toy Story (1995)::Animation|Comedy\n")
+        z.writestr("ml-1m/users.dat", "1::F::1::10::48067\n2::M::25::4::02139\n")
+        z.writestr("ml-1m/ratings.dat",
+                   "1::1::5::978300760\n2::1::3::978300761\n")
+    tr = Movielens(data_file=str(p), mode="train", test_ratio=0.0)
+    assert len(tr) == 2
+    uid, age, job, mid, title, genres, rating = tr[0]
+    assert title.startswith("Toy Story")
+    assert genres == ["Animation", "Comedy"]
+    assert rating[0] in (5.0, 3.0)
+
+
+def test_missing_file_raises():
+    with pytest.raises(RuntimeError, match="data_file"):
+        Imdb(data_file=None)
+    with pytest.raises(RuntimeError, match="data_file"):
+        Imikolov(data_file=None)

@@ -224,6 +224,14 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
         idx = lax.axis_index(axis)
         out = lax.dynamic_index_in_dim(stacked, idx, 0, keepdims=False)
     else:
+        if tensor_list and len(tensor_list) > 1:
+            import warnings
+
+            warnings.warn(
+                "eager scatter outside a shard_map/jit scope runs under "
+                "single-controller SPMD where per-rank views do not exist; "
+                "returning tensor_list[0]. Use it inside shard_map (or a "
+                "multi-process launch) for real per-rank scattering.")
         out = _raw(tensor_list[0]) if tensor_list else _raw(tensor)
     if isinstance(tensor, Tensor):
         tensor._value = out

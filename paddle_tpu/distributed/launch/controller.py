@@ -142,8 +142,20 @@ class Controller:
                 self._logs.append(log)
             cmd = [sys.executable, "-u", self.args.training_script, *self.args.script_args]
             self._procs.append(subprocess.Popen(cmd, env=env, stdout=log, stderr=log))
+        if self.log_dir:
+            # pod utilization watcher (reference: controllers/watcher.py)
+            from .watcher import Watcher
+
+            self._watcher = Watcher(self.log_dir, [p.pid for p in self._procs],
+                                    interval=float(os.environ.get(
+                                        "PADDLE_WATCHER_INTERVAL", 10)))
+            self._watcher.start()
 
     def _kill_all(self):
+        w = getattr(self, "_watcher", None)
+        if w is not None:
+            w.stop()
+            self._watcher = None
         for p in self._procs:
             if p.poll() is None:
                 p.terminate()

@@ -1,0 +1,94 @@
+"""Pod utilization watcher (parity:
+/root/reference/python/paddle/distributed/launch/controllers/watcher.py —
+the controller-side loop that samples device utilization into a per-pod log).
+
+TPU-native: the controller must not grab the accelerator (the workers own
+it), so the watcher samples host-side /proc counters for the pod's worker
+processes (CPU%, RSS) plus system memory, appending JSON lines to
+``<log_dir>/watcher.log``. Device HBM numbers belong to the workers via
+paddle_tpu.device.memory_stats().
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["Watcher"]
+
+
+def _read_proc(pid: int) -> Optional[Dict]:
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            parts = f.read().rsplit(")", 1)[1].split()
+        utime, stime = int(parts[11]), int(parts[12])
+        with open(f"/proc/{pid}/statm") as f:
+            rss_pages = int(f.read().split()[1])
+        return {"cpu_ticks": utime + stime,
+                "rss_mb": rss_pages * os.sysconf("SC_PAGE_SIZE") // (1 << 20)}
+    except (OSError, IndexError, ValueError):
+        return None
+
+
+def _host_mem() -> Dict:
+    out = {}
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                k, v = line.split(":", 1)
+                if k in ("MemTotal", "MemAvailable"):
+                    out[k] = int(v.strip().split()[0]) // 1024  # MB
+    except OSError:
+        pass
+    return out
+
+
+class Watcher:
+    """Background sampler writing one JSON line per interval."""
+
+    def __init__(self, log_dir: str, pids: List[int], interval: float = 10.0):
+        self.log_path = os.path.join(log_dir, "watcher.log")
+        self.pids = list(pids)
+        self.interval = interval
+        self._stop = threading.Event()
+        self._prev: Dict[int, int] = {}
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "Watcher":
+        os.makedirs(os.path.dirname(self.log_path) or ".", exist_ok=True)
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def _sample(self):
+        tick_hz = os.sysconf("SC_CLK_TCK")
+        workers = []
+        for pid in self.pids:
+            st = _read_proc(pid)
+            if st is None:
+                workers.append({"pid": pid, "alive": False})
+                continue
+            prev = self._prev.get(pid)
+            cpu_pct = None
+            if prev is not None:
+                cpu_pct = round((st["cpu_ticks"] - prev) / tick_hz
+                                / self.interval * 100, 1)
+            self._prev[pid] = st["cpu_ticks"]
+            workers.append({"pid": pid, "alive": True, "rss_mb": st["rss_mb"],
+                            "cpu_pct": cpu_pct})
+        rec = {"ts": round(time.time(), 1), "workers": workers,
+               "host_mem_mb": _host_mem()}
+        with open(self.log_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+    def _run(self):
+        while not self._stop.wait(self.interval):
+            self._sample()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None

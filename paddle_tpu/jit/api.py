@@ -407,6 +407,8 @@ class TrainStep:
         optimizer._ensure_state()
         self._pid2idx = {id(p): i for i, p in enumerate(self._params)}
         self._compiled = None
+        self._multi_cache: Dict[Any, Any] = {}
+        self._step_raw = None
         self._donate = donate
 
     # -------------------------------------------------- state pytree helpers
@@ -544,6 +546,7 @@ class TrainStep:
             return loss_val, new_params, new_accs, new_masters, buf_out, new_scaler_state
 
         donate = (0, 1, 2, 3) if self._donate else ()
+        self._step_raw = step
         return jax.jit(step, donate_argnums=donate)
 
     # ------------------------------------------------------------- call
@@ -584,3 +587,72 @@ class TrainStep:
     def sync_to_model(self):
         """Params are written back after every step; kept for API compat."""
         return self.model
+
+    # ------------------------------------------------------- multi-step scan
+    def run_steps(self, *batch_stacks):
+        """Run K optimizer steps in ONE compiled dispatch.
+
+        Each tensor leaf in ``batch_stacks`` carries a leading dim K (one
+        slice per step); the whole schedule executes as a ``lax.scan`` over
+        that dim, so per-dispatch host/marshalling overhead is paid once per
+        K steps instead of per step (decisive for models with many small
+        parameter tensors, and for remote/tunneled accelerators). Returns the
+        per-step losses as a [K] tensor. The learning rate is evaluated once
+        and held constant across the window (scheduler advances by K after).
+        """
+        batch_tensors, spec = flatten_tensors(batch_stacks)
+        if not batch_tensors:
+            raise ValueError("run_steps needs at least one tensor input")
+        K = int(batch_tensors[0]._value.shape[0])
+        if self._compiled is None:
+            # build the single-step program for this batch ELEMENT spec
+            self._spec = spec
+            self._compiled = self._build(spec)
+        multi = self._multi_cache.get(spec_sig := _spec_signature(spec))
+        if multi is None:
+            step_raw = self._step_raw
+
+            def multi_fn(param_vals, accs, masters, buf_vals, scaler_state,
+                         base_key, batch_stack_vals, lr):
+                # K comes from the stack itself (jit retraces per shape), so
+                # the structure-keyed cache serves any window length
+                n_steps = batch_stack_vals[0].shape[0]
+
+                def body(carry, xs):
+                    pv, ac, ms, bv, ss = carry
+                    i, batch_vals = xs
+                    key = jax.random.fold_in(base_key, i)
+                    loss, pv, ac, ms, bv, ss = step_raw(
+                        pv, ac, ms, bv, ss, key, batch_vals, lr)
+                    return (pv, ac, ms, bv, ss), loss
+
+                carry0 = (list(param_vals), accs, masters, list(buf_vals),
+                          scaler_state)
+                (pv, ac, ms, bv, ss), losses = jax.lax.scan(
+                    body, carry0, (jnp.arange(n_steps), tuple(batch_stack_vals)))
+                return losses, pv, ac, ms, bv, ss
+
+            donate = (0, 1, 2, 3) if self._donate else ()
+            multi = jax.jit(multi_fn, donate_argnums=donate)
+            self._multi_cache[spec_sig] = multi
+
+        batch_vals = tuple(t._value for t in batch_tensors)
+        base_key = default_generator().next_key()
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        accs, masters = self._get_opt_state()
+        losses, new_params, new_accs, new_masters, buf_out, new_scaler = multi(
+            [p._value for p in self._params], accs, masters,
+            [b._value for b in self._buffers], self._scaler_state(),
+            base_key, batch_vals, lr,
+        )
+        for p, v in zip(self._params, new_params):
+            p._value = v
+        self._put_opt_state(new_accs, new_masters)
+        for b, v in zip(self._buffers, buf_out):
+            b._value = v
+        if self.scaler is not None and new_scaler:
+            self.scaler._scale = new_scaler["scale"]
+            self.scaler._good_steps = new_scaler["good"]
+            self.scaler._bad_steps = new_scaler["bad"]
+        self.optimizer._step_count += K
+        return Tensor(losses)

@@ -304,11 +304,13 @@ def weight_dequantize(qw, scale, algo="weight_only_int8"):
 
 
 def weight_only_linear(x, qweight, bias=None, weight_scale=None, weight_dtype="int8"):
-    """x @ dequant(qweight) + bias — int8 storage, bf16/fp32 MXU compute."""
+    """x @ dequant(qweight) + bias — int8 HBM storage, per-tile VMEM dequant
+    into bf16/fp32 MXU compute (Pallas kernel on TPU; jnp fallback)."""
 
     def f(xv, q, s):
-        w = q.astype(xv.dtype) * s.astype(xv.dtype)
-        return xv @ w
+        from ..ops.pallas.int8_matmul import int8_matmul
+
+        return int8_matmul(xv, q, s)
 
     out = apply(f, x, qweight, weight_scale, op_name="weight_only_linear")
     if bias is not None:

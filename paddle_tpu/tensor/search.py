@@ -12,6 +12,7 @@ from .tensor import Tensor
 __all__ = [
     "argmax", "argmin", "argsort", "sort", "topk", "nonzero", "searchsorted", "bucketize",
     "masked_select", "index_select", "kthvalue", "mode", "index_sample", "where",
+    "top_p_sampling",
 ]
 
 
@@ -144,3 +145,75 @@ from .manipulation import index_sample, index_select, masked_select  # noqa: E40
 from .logic import where  # noqa: E402,F401
 
 import jax  # noqa: E402
+
+
+def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1, k=0,
+                   mode="truncated", return_top=False, name=None):
+    """parity: paddle.tensor.top_p_sampling (reference search.py:1360, GPU
+    top_p_sampling kernel — nucleus sampling over probability rows).
+
+    x: [B, V] probabilities; ps: [B] per-row top-p. Returns (value, index)
+    of ONE sampled token per row ([B, 1]); with ``return_top`` also the
+    top-k (scores, ids). TPU-native: sort + cumsum + Gumbel-free inverse-CDF
+    sampling, all static-shaped under jit.
+    """
+    from ..framework.random import default_generator
+
+    x = to_tensor_like(x)
+    ps = to_tensor_like(ps)
+    thr = to_tensor_like(threshold) if threshold is not None else None
+    tseed = to_tensor_like(topp_seed) if topp_seed is not None else None
+    if tseed is not None:
+        key = None  # per-row keys derived from topp_seed inside the op
+    elif seed is not None and seed >= 0:
+        key = jax.random.PRNGKey(int(seed))
+    else:
+        key = default_generator().next_key()
+    kk = int(k) if k else 1
+
+    def f(xv, pv, *rest):
+        rest = list(rest)
+        tv = rest.pop(0) if thr is not None else None
+        sv = rest.pop(0) if tseed is not None else None
+        B, V = xv.shape
+        probs = xv.astype(jnp.float32)
+        if tv is not None:
+            probs = jnp.where(probs >= tv.reshape(-1, 1).astype(jnp.float32),
+                              probs, 0.0)
+        order = jnp.argsort(-probs, axis=-1)
+        sp = jnp.take_along_axis(probs, order, axis=-1)  # sorted desc
+        csum = jnp.cumsum(sp, axis=-1)
+        p_col = pv.reshape(-1, 1).astype(jnp.float32)
+        # nucleus: keep tokens whose PRECEDING cumulative mass < p (always
+        # keeps the argmax token)
+        keep = (csum - sp) < p_col
+        if mode == "truncated":
+            # clip the boundary token so the kept mass is exactly top-p
+            sp_kept = jnp.clip(p_col - (csum - sp), 0.0, sp)
+        else:  # non-truncated: keep the boundary token's full mass
+            sp_kept = jnp.where(keep, sp, 0.0)
+        total = jnp.maximum(sp_kept.sum(-1, keepdims=True), 1e-30)
+        if sv is not None:
+            u_row = jax.vmap(
+                lambda s: jax.random.uniform(jax.random.PRNGKey(s)))(
+                    sv.reshape(-1).astype(jnp.uint32))
+            u = u_row.reshape(B, 1) * total
+        else:
+            u = jax.random.uniform(key, (B, 1)) * total
+        # inverse CDF over the kept mass
+        ccum = jnp.cumsum(sp_kept, axis=-1)
+        pos = jnp.sum((ccum < u).astype(jnp.int32), axis=-1, keepdims=True)
+        pos = jnp.clip(pos, 0, V - 1)
+        idx = jnp.take_along_axis(order, pos, axis=-1).astype(jnp.int64)
+        val = jnp.take_along_axis(xv, idx, axis=-1)
+        top_val = sp[:, :kk].astype(xv.dtype)
+        top_idx = order[:, :kk].astype(jnp.int64)
+        return val, idx, top_val, top_idx
+
+    args = (x, ps) + ((thr,) if thr is not None else ()) \
+        + ((tseed,) if tseed is not None else ())
+    val, idx, top_val, top_idx = apply(lambda *a: tuple(f(*a)), *args,
+                                       op_name="top_p_sampling", n_outs=4)
+    if return_top:
+        return val, idx, top_val, top_idx
+    return val, idx

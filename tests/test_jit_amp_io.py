@@ -456,3 +456,49 @@ class TestInputSpecBucketing:
         # only the 3 real rows contribute: grad = sum over real rows of x
         expect = np.asarray(x._value).sum(0)[:, None] * np.ones((1, 2))
         np.testing.assert_allclose(g, expect, rtol=1e-4, atol=1e-5)
+
+
+class TestRunSteps:
+    def test_multi_step_matches_sequential(self):
+        import numpy as np
+
+        P.seed(0)
+        m1 = nn.Linear(8, 4)
+        m2 = nn.Linear(8, 4)
+        for a, b in zip(m2.parameters(), m1.parameters()):
+            a._value = P.to_tensor(np.asarray(b._value))._value  # real copy:
+            # sharing would let s1's donated buffers delete m2's params
+        o1 = P.optimizer.AdamW(learning_rate=0.01, parameters=m1.parameters())
+        o2 = P.optimizer.AdamW(learning_rate=0.01, parameters=m2.parameters())
+        loss_fn = lambda m, x, y: F.mse_loss(m(x), y)  # noqa: E731
+        s1 = P.jit.TrainStep(m1, loss_fn, o1)
+        s2 = P.jit.TrainStep(m2, loss_fn, o2)
+        rng = np.random.RandomState(0)
+        xs = rng.randn(4, 16, 8).astype(np.float32)
+        ys = rng.randn(4, 16, 4).astype(np.float32)
+        seq_losses = [float(s1(P.to_tensor(xs[i]), P.to_tensor(ys[i])).numpy())
+                      for i in range(4)]
+        multi_losses = s2.run_steps(P.to_tensor(xs), P.to_tensor(ys)).numpy()
+        np.testing.assert_allclose(multi_losses, seq_losses, rtol=1e-4, atol=1e-5)
+        for a, b in zip(m2.parameters(), m1.parameters()):
+            np.testing.assert_allclose(np.asarray(a._value), np.asarray(b._value),
+                                       rtol=1e-4, atol=1e-5)
+        assert o2._step_count == 4
+
+    def test_multi_step_with_scaler(self):
+        import numpy as np
+
+        P.seed(1)
+        m = nn.Linear(8, 4)
+        opt = P.optimizer.SGD(0.05, parameters=m.parameters())
+        scaler = P.amp.GradScaler(init_loss_scaling=1024.0)
+        step = P.jit.TrainStep(m, lambda mm, x, y: F.mse_loss(mm(x), y), opt,
+                               scaler=scaler)
+        x1 = P.randn([8, 8])
+        y1 = P.randn([8, 4])
+        xs = P.to_tensor(np.broadcast_to(np.asarray(x1._value), (6, 8, 8)).copy())
+        ys = P.to_tensor(np.broadcast_to(np.asarray(y1._value), (6, 8, 4)).copy())
+        losses = step.run_steps(xs, ys).numpy()
+        assert losses.shape == (6,)
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]

@@ -169,3 +169,40 @@ class TestMultiNodeRestart:
         # its second rendezvous would have timed out and failed the launch
         ck = json.load(open(tmp_path / "ckpt_1.json"))
         assert ck["step"] == 3 and ck["restart"] == "1"
+
+
+class TestWatcher:
+    def test_watcher_samples_workers(self, tmp_path):
+        import os
+        import time
+
+        from paddle_tpu.distributed.launch.watcher import Watcher
+
+        w = Watcher(str(tmp_path), [os.getpid()], interval=0.2).start()
+        time.sleep(0.7)
+        w.stop()
+        lines = [json.loads(l) for l in
+                 open(tmp_path / "watcher.log").read().splitlines()]
+        assert len(lines) >= 2
+        rec = lines[-1]
+        me = rec["workers"][0]
+        assert me["alive"] and me["rss_mb"] > 0
+        assert me["cpu_pct"] is not None  # second sample has a delta
+        assert "MemTotal" in rec["host_mem_mb"]
+
+    def test_launcher_writes_watcher_log(self, tmp_path):
+        script = tmp_path / "worker.py"
+        script.write_text("import time\ntime.sleep(1)\n")
+        env = dict(os.environ)
+        env["PADDLE_WATCHER_INTERVAL"] = "0.2"
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "2", "--log_dir", str(tmp_path / "logs"),
+             str(script)],
+            cwd=REPO, capture_output=True, text=True, timeout=120, env=env,
+        )
+        assert r.returncode == 0, r.stderr[-1000:]
+        log = tmp_path / "logs" / "watcher.log"
+        assert log.exists()
+        recs = [json.loads(l) for l in log.read_text().splitlines()]
+        assert recs and len(recs[0]["workers"]) == 2

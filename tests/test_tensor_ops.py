@@ -304,3 +304,60 @@ class TestTensorMisc:
         c = x.clone()
         (c * 2).backward()
         check(x.grad, [2.0])
+
+
+class TestTopPSampling:
+    def test_nucleus_truncation_and_top(self):
+        import numpy as np
+
+        from paddle_tpu.tensor.search import top_p_sampling
+
+        P.seed(0)
+        probs = P.to_tensor(np.array([[0.5, 0.3, 0.15, 0.05],
+                                      [0.9, 0.05, 0.03, 0.02]], np.float32))
+        ps = P.to_tensor(np.array([0.6, 0.5], np.float32))
+        v, i = top_p_sampling(probs, ps)
+        assert v.shape == [2, 1] and i.shape == [2, 1]
+        # row 1: p=0.5 keeps only token 0
+        assert int(i.numpy()[1, 0]) == 0
+        # row 0: p=0.6 keeps tokens {0, 1}
+        assert int(i.numpy()[0, 0]) in (0, 1)
+        v2, i2, tv, ti = top_p_sampling(probs, ps, k=2, return_top=True)
+        np.testing.assert_allclose(tv.numpy(), [[0.5, 0.3], [0.9, 0.05]])
+        np.testing.assert_array_equal(ti.numpy(), [[0, 1], [0, 1]])
+
+    def test_threshold_filters_low_scores(self):
+        import numpy as np
+
+        from paddle_tpu.tensor.search import top_p_sampling
+
+        P.seed(1)
+        probs = P.to_tensor(np.array([[0.4, 0.35, 0.25]], np.float32))
+        ps = P.to_tensor(np.array([0.99], np.float32))
+        thr = P.to_tensor(np.array([0.3], np.float32))
+        seen = set()
+        for _ in range(12):
+            _, i = top_p_sampling(probs, ps, threshold=thr)
+            seen.add(int(i.numpy()[0, 0]))
+        assert 2 not in seen  # 0.25 < threshold is never sampled
+
+    def test_seed_reproducible_and_modes(self):
+        import numpy as np
+
+        from paddle_tpu.tensor.search import top_p_sampling
+
+        probs = P.to_tensor(np.array([[0.4, 0.3, 0.2, 0.1]], np.float32))
+        ps = P.to_tensor(np.array([0.65], np.float32))
+        _, i1 = top_p_sampling(probs, ps, seed=2023)
+        _, i2 = top_p_sampling(probs, ps, seed=2023)
+        assert int(i1.numpy()[0, 0]) == int(i2.numpy()[0, 0])
+        # per-row topp_seed reproducibility
+        tseed = P.to_tensor(np.array([7], np.int64))
+        _, j1 = top_p_sampling(probs, ps, topp_seed=tseed)
+        _, j2 = top_p_sampling(probs, ps, topp_seed=tseed)
+        assert int(j1.numpy()[0, 0]) == int(j2.numpy()[0, 0])
+        # non-truncated mode still samples only from the nucleus
+        P.seed(3)
+        for _ in range(12):
+            _, idx = top_p_sampling(probs, ps, mode="non-truncated")
+            assert int(idx.numpy()[0, 0]) in (0, 1)  # {0.4, 0.3} nucleus

@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Perf regression gate (reference analog: tools/ci_op_benchmark.sh +
+check_op_benchmark_result.py — CI fails when a benchmark regresses vs the
+recorded baseline).
+
+Compares the newest BENCH_r*.json against the previous round's; fails when
+the headline `vs_baseline` ratio drops more than --tolerance (default 10%).
+Run with no arguments from the repo root.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+
+def load_rounds(root: str):
+    out = []
+    for path in glob.glob(os.path.join(root, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"perf-gate: skipping unreadable {path}: {e}")
+            continue
+        # driver schema: the bench line lives under "parsed"
+        if isinstance(data, dict) and "parsed" in data:
+            data = data["parsed"]
+        if isinstance(data, dict) and "vs_baseline" in data:
+            out.append((int(m.group(1)), path, data))
+    return sorted(out)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tolerance", type=float, default=0.10,
+                    help="allowed fractional drop in vs_baseline")
+    ap.add_argument("--root", default=os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    args = ap.parse_args()
+
+    rounds = load_rounds(args.root)
+    if len(rounds) < 2:
+        print(f"perf-gate: {len(rounds)} recorded round(s); nothing to compare — pass")
+        return 0
+    (pn, ppath, prev), (cn, cpath, cur) = rounds[-2], rounds[-1]
+    pv, cv = prev["vs_baseline"], cur["vs_baseline"]
+    drop = (pv - cv) / pv if pv > 0 else 0.0
+    print(f"perf-gate: r{pn} {pv:.4f} -> r{cn} {cv:.4f} "
+          f"({'-' if drop > 0 else '+'}{abs(drop) * 100:.1f}%)")
+    if drop > args.tolerance:
+        print(f"perf-gate: FAIL — vs_baseline regressed more than "
+              f"{args.tolerance * 100:.0f}% ({ppath} -> {cpath})")
+        return 1
+    print("perf-gate: pass")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

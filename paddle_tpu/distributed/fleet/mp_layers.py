@@ -43,8 +43,19 @@ def _put(param: Tensor, spec: PartitionSpec):
     return param
 
 
-def _constrain(t: Tensor, spec: PartitionSpec) -> Tensor:
+def _constrain(t: Tensor, spec: PartitionSpec, like: Tensor = None) -> Tensor:
+    """Constrain an activation's sharding. ``like`` (typically the layer's
+    weight) supplies the mesh when the layer lives on a pipeline-stage
+    SUBMESH (pp_layers._place_stages re-placed its params there) — the full
+    hcg mesh would conflict with stage-local activations."""
     mesh = _mp_mesh()
+    if like is not None:
+        v = like._value
+        sh = getattr(v, "sharding", None)
+        if (sh is not None and hasattr(sh, "mesh")
+                and not isinstance(v, jax.core.Tracer)
+                and "mp" in getattr(sh.mesh, "axis_names", ())):
+            mesh = sh.mesh
     if mesh is None:
         return t
     sharding = NamedSharding(mesh, spec)
@@ -73,8 +84,8 @@ class ColumnParallelLinear(nn.Layer):
     def forward(self, x):
         out = F.linear(x, self.weight, self.bias)
         if self.gather_output:
-            return _constrain(out, PartitionSpec(*([None] * out.ndim)))
-        return _constrain(out, PartitionSpec(*([None] * (out.ndim - 1)), "mp"))
+            return _constrain(out, PartitionSpec(*([None] * out.ndim)), like=self.weight)
+        return _constrain(out, PartitionSpec(*([None] * (out.ndim - 1)), "mp"), like=self.weight)
 
 
 class RowParallelLinear(nn.Layer):
@@ -96,9 +107,9 @@ class RowParallelLinear(nn.Layer):
 
     def forward(self, x):
         if not self.input_is_parallel:
-            x = _constrain(x, PartitionSpec(*([None] * (x.ndim - 1)), "mp"))
+            x = _constrain(x, PartitionSpec(*([None] * (x.ndim - 1)), "mp"), like=self.weight)
         out = F.linear(x, self.weight)
-        out = _constrain(out, PartitionSpec(*([None] * out.ndim)))
+        out = _constrain(out, PartitionSpec(*([None] * out.ndim)), like=self.weight)
         if self.bias is not None:
             out = out + self.bias
         return out
@@ -119,7 +130,7 @@ class VocabParallelEmbedding(nn.Layer):
 
     def forward(self, x):
         out = F.embedding(x, self.weight)
-        return _constrain(out, PartitionSpec(*([None] * out.ndim)))
+        return _constrain(out, PartitionSpec(*([None] * out.ndim)), like=self.weight)
 
 
 class ParallelCrossEntropy(nn.Layer):

@@ -54,6 +54,7 @@ class Watcher:
         self.interval = interval
         self._stop = threading.Event()
         self._prev: Dict[int, int] = {}
+        self._last_sample = 0.0
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> "Watcher":
@@ -64,6 +65,9 @@ class Watcher:
 
     def _sample(self):
         tick_hz = os.sysconf("SC_CLK_TCK")
+        now = time.monotonic()
+        elapsed = now - self._last_sample if self._last_sample else self.interval
+        self._last_sample = now
         workers = []
         for pid in self.pids:
             st = _read_proc(pid)
@@ -72,9 +76,9 @@ class Watcher:
                 continue
             prev = self._prev.get(pid)
             cpu_pct = None
-            if prev is not None:
+            if prev is not None and elapsed > 0:
                 cpu_pct = round((st["cpu_ticks"] - prev) / tick_hz
-                                / self.interval * 100, 1)
+                                / elapsed * 100, 1)
             self._prev[pid] = st["cpu_ticks"]
             workers.append({"pid": pid, "alive": True, "rss_mb": st["rss_mb"],
                             "cpu_pct": cpu_pct})

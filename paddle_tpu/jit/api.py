@@ -555,7 +555,12 @@ class TrainStep:
         first_call = self._compiled is None
         if first_call:
             self._spec = spec
+            self._spec_sig = _spec_signature(spec)
             self._compiled = self._build(spec)
+        elif _spec_signature(spec) != self._spec_sig:
+            raise ValueError(
+                "TrainStep is specialized to the batch structure of its first "
+                "call; build a new TrainStep for a different structure")
         batch_vals = tuple(t._value for t in batch_tensors)
         rng_key = default_generator().next_key()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
@@ -604,11 +609,18 @@ class TrainStep:
         if not batch_tensors:
             raise ValueError("run_steps needs at least one tensor input")
         K = int(batch_tensors[0]._value.shape[0])
+        spec_sig = _spec_signature(spec)
         if self._compiled is None:
-            # build the single-step program for this batch ELEMENT spec
+            # build the single-step program for this batch structure (the
+            # stacked spec has the same TREE as the per-step spec)
             self._spec = spec
+            self._spec_sig = spec_sig
             self._compiled = self._build(spec)
-        multi = self._multi_cache.get(spec_sig := _spec_signature(spec))
+        elif spec_sig != self._spec_sig:
+            raise ValueError(
+                "TrainStep is specialized to the batch structure of its first "
+                "call; build a new TrainStep for a different structure")
+        multi = self._multi_cache.get(spec_sig)
         if multi is None:
             step_raw = self._step_raw
 

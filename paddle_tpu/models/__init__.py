@@ -6,5 +6,6 @@ from .llama import (  # noqa: F401
     LlamaModel,
     LlamaPretrainingCriterion,
     llama_7b,
+    llama_pipeline_descs,
     llama_tiny,
 )

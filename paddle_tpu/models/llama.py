@@ -30,7 +30,8 @@ from ..ops.dispatch import apply
 from ..tensor import manipulation as M
 from ..tensor.tensor import Tensor
 
-__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM", "LlamaPretrainingCriterion", "llama_tiny", "llama_7b"]
+__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM", "LlamaPretrainingCriterion",
+           "llama_tiny", "llama_7b", "llama_pipeline_descs"]
 
 
 @dataclass
@@ -318,6 +319,63 @@ class LlamaForCausalLM(nn.Layer):
     @property
     def num_params(self) -> int:
         return sum(p.size for p in self.parameters())
+
+
+# ------------------------------------------------- pipeline-parallel mapping
+class _PipeEmbed(nn.Layer):
+    """Stage-0 module: token embedding (+ bf16 cast) — single-tensor
+    in/out as the pipeline engine requires."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = VocabParallelEmbedding(config.vocab_size, config.hidden_size)
+
+    def forward(self, input_ids):
+        hidden = self.embed_tokens(input_ids)
+        if self.config.dtype == "bfloat16":
+            hidden = hidden.astype("bfloat16")
+        return hidden
+
+
+class _PipeDecoder(nn.Layer):
+    """One decoder layer owning its own rope cache (stages are independent
+    modules; the cache is deterministic from the config)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.block = LlamaDecoderLayer(config)
+        cos, sin = _rope_cache(config)
+        self.register_buffer("rope_cos", Tensor(cos), persistable=False)
+        self.register_buffer("rope_sin", Tensor(sin), persistable=False)
+
+    def forward(self, hidden):
+        return self.block(hidden, self._buffers["rope_cos"], self._buffers["rope_sin"])
+
+
+class _PipeHead(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.norm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        self.lm_head = ColumnParallelLinear(config.hidden_size, config.vocab_size,
+                                            has_bias=False, gather_output=True)
+
+    def forward(self, hidden):
+        return self.lm_head(self.norm(hidden))
+
+
+def llama_pipeline_descs(config: LlamaConfig):
+    """LayerDescs for fleet's PipelineLayer: [embed] + L×[decoder] + [head].
+
+    Compose with pp via ``PipelineLayer(layers=llama_pipeline_descs(cfg),
+    num_stages=pp, loss_fn=...)`` under a hybrid dp×pp×mp mesh — the TP
+    layers inside each stage shard on the stage's mp submesh (the 4-D hybrid
+    of BASELINE's GPT-3 rung)."""
+    from ..distributed.fleet.meta_parallel import LayerDesc
+
+    return ([LayerDesc(_PipeEmbed, config)]
+            + [LayerDesc(_PipeDecoder, config) for _ in range(config.num_hidden_layers)]
+            + [LayerDesc(_PipeHead, config)])
 
 
 class LlamaPretrainingCriterion(nn.Layer):

@@ -51,6 +51,11 @@ _DEFAULT_TARGETS: Dict[Tuple[str, int], Tuple[int, int]] = {
     ("bwd", 128): (256, 256),
     ("fwd", 64): (256, 256),
     ("bwd", 64): (256, 256),
+    # large head_dim: smaller tiles keep K/V + fp32 staging inside VMEM
+    ("fwd", 256): (256, 256),
+    ("bwd", 256): (128, 256),
+    ("fwd", 512): (128, 128),
+    ("bwd", 512): (128, 128),
 }
 
 # process-level measured cache: (kind, sq_bucket, sk_bucket, d) -> (bq, bk)

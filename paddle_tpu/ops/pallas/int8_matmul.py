@@ -110,8 +110,18 @@ _int8_mm.defvjp(_int8_mm_fwd, _int8_mm_bwd)
 
 def int8_matmul(x, qw, scale, interpret: bool = False):
     """x [..., K] @ qw [K, N] int8 * scale [N] -> [..., N]. Differentiable
-    w.r.t. x (dequantized transpose matmul in the backward)."""
+    w.r.t. x (dequantized transpose matmul in the backward).
+
+    Small/odd row counts (autoregressive decode: m = batch) are zero-padded
+    to the 8-row sublane so the int8-streaming kernel still serves them —
+    the dense-dequant fallback would re-materialize the full bf16 weight."""
     orig_shape = x.shape
     x2 = x.reshape(-1, orig_shape[-1])
-    out = _int8_mm(x2, qw, scale, interpret)
+    m = x2.shape[0]
+    pad = (-m) % 8
+    if pad and _use_kernel(m + pad, x2.shape[1], qw.shape[1], interpret):
+        out = _int8_mm(jnp.concatenate(
+            [x2, jnp.zeros((pad, x2.shape[1]), x2.dtype)]), qw, scale, interpret)[:m]
+    else:
+        out = _int8_mm(x2, qw, scale, interpret)
     return out.reshape(*orig_shape[:-1], qw.shape[1])

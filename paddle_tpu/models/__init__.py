@@ -9,3 +9,4 @@ from .llama import (  # noqa: F401
     llama_pipeline_descs,
     llama_tiny,
 )
+from .generation import generate  # noqa: F401,E402

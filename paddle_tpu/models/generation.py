@@ -1,0 +1,80 @@
+"""Autoregressive generation utilities (capability parity: PaddleNLP's
+``model.generate`` surface that BASELINE's serving story implies; reference
+framework pieces: paddle.tensor.top_p_sampling + the KV-cache decode path
+fused ops serve, incubate/nn/functional/masked_multihead_attention.py).
+
+TPU-native notes: prefill runs as one compiled forward; the decode loop is
+eager over single-token steps with KV caches threaded through the model's
+``caches`` interface (each step's shapes grow, so the per-step forward is
+recompiled per length unless the model buckets — acceptable for the
+capability tier; serving-grade decode belongs to a fixed-size cache ring).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor
+
+__all__ = ["generate"]
+
+
+def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False,
+             top_p: float = 1.0, temperature: float = 1.0,
+             eos_token_id: Optional[int] = None):
+    """Greedy / nucleus decoding with KV caches.
+
+    model: a causal LM whose forward supports ``model(ids, caches=...)``
+    returning (logits, new_caches) — e.g. LlamaForCausalLM.
+    Returns the generated ids [B, <=max_new_tokens] (prompt not included).
+    """
+    from ..autograd import tape
+    from ..tensor.search import top_p_sampling
+
+    ids = input_ids if isinstance(input_ids, Tensor) else Tensor(jnp.asarray(input_ids))
+    B, S = ids.shape
+    cfg = getattr(model, "config", None)
+    if cfg is None:
+        raise ValueError("generate() needs a model with a .config describing "
+                         "num_hidden_layers/num_key_value_heads/head_dim "
+                         "(e.g. LlamaForCausalLM)")
+    n_layers = cfg.num_hidden_layers
+    n_kv = cfg.num_key_value_heads
+    head_dim = cfg.head_dim
+    dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+
+    with tape.no_grad():
+        # prefill with empty caches so the forward returns them populated
+        empty = [(Tensor(jnp.zeros((B, 0, n_kv, head_dim), dtype)),
+                  Tensor(jnp.zeros((B, 0, n_kv, head_dim), dtype)))
+                 for _ in range(n_layers)]
+        logits, caches = model(ids, caches=empty)
+        out_tokens = []
+        finished = np.zeros((B,), bool)
+        for step_i in range(max_new_tokens):
+            last = logits._value[:, -1, :].astype(jnp.float32)
+            if temperature != 1.0:
+                last = last / max(temperature, 1e-6)
+            if do_sample:
+                probs = jax.nn.softmax(last, axis=-1)
+                _, idx = top_p_sampling(Tensor(probs),
+                                        Tensor(jnp.full((B,), float(top_p))))
+                nxt = np.asarray(idx._value).reshape(B)
+            else:
+                nxt = np.asarray(jnp.argmax(last, axis=-1)).reshape(B)
+            if eos_token_id is not None:
+                nxt = np.where(finished, eos_token_id, nxt)
+                finished |= nxt == eos_token_id
+            out_tokens.append(nxt)
+            done = eos_token_id is not None and finished.all()
+            if done or step_i == max_new_tokens - 1:
+                break  # budget spent: don't pay a decode forward we'd discard
+            cur = Tensor(jnp.asarray(nxt.astype(np.int32)[:, None]))
+            logits, caches = model(cur, caches=caches)
+    if not out_tokens:
+        return Tensor(jnp.zeros((B, 0), jnp.int64))
+    return Tensor(jnp.asarray(np.stack(out_tokens, axis=1).astype(np.int64)))

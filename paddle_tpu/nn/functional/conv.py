@@ -115,23 +115,27 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, 
                 eff = dil[i] * (k - 1)
                 padding_cfg.append((eff - lo, eff - hi + out_pad[i]))
         dn = lax.conv_dimension_numbers(v.shape, (w.shape[0], w.shape[1], *w.shape[2:]), (io_spec, k_spec, io_spec))
+        # gradient-style transposed conv: fractional stride via lhs_dilation
+        # + SPATIALLY FLIPPED kernel (conv_general_dilated has no
+        # transpose_kernel arg; the "IOHW" spec already contracts over the
+        # weight's leading `in` dim)
+        spatial_axes = tuple(range(2, 2 + n_spatial))
+        wf = jnp.flip(w, axis=spatial_axes)
         if groups > 1:
-            # split groups manually (lax transpose conv w/ groups)
             vs = jnp.split(v, groups, axis=1 if channels_first else -1)
-            ws = jnp.split(w, groups, axis=0)
+            ws = jnp.split(wf, groups, axis=0)
             outs = [
                 lax.conv_general_dilated(
                     vv, ww, window_strides=(1,) * n_spatial, padding=padding_cfg,
                     lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn,
-                    transpose_kernel=True,
                 )
                 for vv, ww in zip(vs, ws)
             ]
             out = jnp.concatenate(outs, axis=1 if channels_first else -1)
         else:
             out = lax.conv_general_dilated(
-                v, w, window_strides=(1,) * n_spatial, padding=padding_cfg, lhs_dilation=strides,
-                rhs_dilation=dil, dimension_numbers=dn, transpose_kernel=True,
+                v, wf, window_strides=(1,) * n_spatial, padding=padding_cfg,
+                lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn,
             )
         if rest:
             b = rest[0]

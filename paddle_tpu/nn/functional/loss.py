@@ -369,6 +369,10 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction
         m = jnp.maximum(a_last, a_prev)
         ll = m + jnp.log(jnp.exp(a_last - m) + jnp.exp(a_prev - m))
         loss = -ll
+        if reduction == "mean":
+            # reference contract (nn/functional/loss.py ctc_loss docstring):
+            # 'mean' divides each sample's loss by its label length first
+            return jnp.mean(loss / jnp.maximum(lab_len.astype(loss.dtype), 1.0))
         return _reduce(loss, reduction)
 
     return apply(f, log_probs, labels, input_lengths, label_lengths, op_name="ctc_loss")

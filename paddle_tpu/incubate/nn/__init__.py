@@ -1,1 +1,17 @@
 from . import functional  # noqa: F401
+from .layer import (  # noqa: F401
+    FusedBiasDropoutResidualLayerNorm,
+    FusedDropoutAdd,
+    FusedEcMoe,
+    FusedFeedForward,
+    FusedLinear,
+    FusedMultiHeadAttention,
+    FusedMultiTransformer,
+    FusedTransformerEncoderLayer,
+)
+
+__all__ = [
+    "FusedMultiHeadAttention", "FusedFeedForward", "FusedTransformerEncoderLayer",
+    "FusedMultiTransformer", "FusedLinear", "FusedBiasDropoutResidualLayerNorm",
+    "FusedEcMoe", "FusedDropoutAdd", "functional",
+]

@@ -10,3 +10,12 @@ from .llama import (  # noqa: F401
     llama_tiny,
 )
 from .generation import generate  # noqa: F401,E402
+from .gpt import (  # noqa: F401,E402
+    GPTConfig,
+    GPTForCausalLM,
+    GPTModel,
+    GPTPretrainingCriterion,
+    gpt3_1_3b,
+    gpt_pipeline_descs,
+    gpt_tiny,
+)

@@ -76,5 +76,5 @@ def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False
             cur = Tensor(jnp.asarray(nxt.astype(np.int32)[:, None]))
             logits, caches = model(cur, caches=caches)
     if not out_tokens:
-        return Tensor(jnp.zeros((B, 0), jnp.int64))
-    return Tensor(jnp.asarray(np.stack(out_tokens, axis=1).astype(np.int64)))
+        return Tensor(jnp.zeros((B, 0), jnp.int32))
+    return Tensor(jnp.asarray(np.stack(out_tokens, axis=1).astype(np.int32)))

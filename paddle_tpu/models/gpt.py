@@ -1,0 +1,235 @@
+"""GPT family — the second decoder LM (BASELINE ladder rung 5 is GPT-3 1.3B
+4-D hybrid; PaddleNLP's GPT implementation is the reference capability,
+built from the same framework pieces: fleet TP layers, flash attention,
+fused dropout-add-ln analogs).
+
+Architecture (GPT-2/3 style, vs Llama): learned positional embeddings, pre-LN
+LayerNorm (not RMSNorm), gelu MLP (not swiglu), standard MHA with bias terms.
+TPU-first construction mirrors models/llama.py: TP layers lower to GSPMD
+shardings, flash attention kernel on the hot path, KV-cache decode interface
+compatible with models.generate.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..distributed.fleet.mp_layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from ..nn import functional as F
+from ..ops.dispatch import apply
+from ..tensor import manipulation as M
+from ..tensor.tensor import Tensor
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "GPTPretrainingCriterion",
+           "gpt_tiny", "gpt3_1_3b", "gpt_pipeline_descs"]
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 2048
+    intermediate_size: Optional[int] = None
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
+    max_position_embeddings: int = 2048
+    layer_norm_epsilon: float = 1e-5
+    use_flash_attention: bool = True
+    recompute: bool = False
+    dtype: str = "float32"
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            self.intermediate_size = 4 * self.hidden_size
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    # generate() compatibility (no GQA in GPT)
+    @property
+    def num_key_value_heads(self) -> int:
+        return self.num_attention_heads
+
+
+def gpt_tiny(**kw) -> "GPTConfig":
+    return GPTConfig(vocab_size=512, hidden_size=128, num_hidden_layers=2,
+                     num_attention_heads=4, max_position_embeddings=256, **kw)
+
+
+def gpt3_1_3b(**kw) -> "GPTConfig":
+    """GPT-3 XL shape (the BASELINE 4-D hybrid rung)."""
+    return GPTConfig(vocab_size=50304, hidden_size=2048, num_hidden_layers=24,
+                     num_attention_heads=16, max_position_embeddings=2048, **kw)
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        h = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.head_dim = config.head_dim
+        self.qkv = ColumnParallelLinear(h, 3 * h, gather_output=False)
+        self.out_proj = RowParallelLinear(h, h, input_is_parallel=True)
+
+    def forward(self, hidden, attn_mask=None, cache=None):
+        b, s = hidden.shape[0], hidden.shape[1]
+        qkv = self.qkv(hidden)
+
+        def split_qkv(v):
+            # [B, S, 3H] -> three [B, S, nh, hd]; interleave so each head's
+            # q/k/v stay adjacent under mp sharding of the 3H dim
+            v = v.reshape(b, s, 3, self.num_heads, self.head_dim)
+            return v[:, :, 0], v[:, :, 1], v[:, :, 2]
+
+        q, k, v = apply(lambda t: tuple(split_qkv(t)), qkv, op_name="split_qkv",
+                        n_outs=3)
+        new_cache = None
+        if cache is not None:
+            k = M.concat([cache[0], k], axis=1)
+            v = M.concat([cache[1], v], axis=1)
+            new_cache = (k, v)
+        if attn_mask is None:
+            out, _ = F.flash_attention(q, k, v, causal=True)
+        else:
+            out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask)
+        out = self.out_proj(M.reshape(out, [b, s, self.num_heads * self.head_dim]))
+        if cache is not None:
+            return out, new_cache
+        return out
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        h = config.hidden_size
+        self.ln_1 = nn.LayerNorm(h, epsilon=config.layer_norm_epsilon)
+        self.attn = GPTAttention(config)
+        self.ln_2 = nn.LayerNorm(h, epsilon=config.layer_norm_epsilon)
+        self.fc_in = ColumnParallelLinear(h, config.intermediate_size,
+                                          gather_output=False)
+        self.fc_out = RowParallelLinear(config.intermediate_size, h,
+                                        input_is_parallel=True)
+
+    def forward(self, hidden, attn_mask=None, cache=None):
+        attn_out = self.attn(self.ln_1(hidden), attn_mask, cache)
+        if cache is not None:
+            attn_out, new_cache = attn_out
+        hidden = hidden + attn_out
+        hidden = hidden + self.fc_out(F.gelu(self.fc_in(self.ln_2(hidden))))
+        if cache is not None:
+            return hidden, new_cache
+        return hidden
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.wte = VocabParallelEmbedding(config.vocab_size, config.hidden_size)
+        self.wpe = nn.Embedding(config.max_position_embeddings, config.hidden_size)
+        self.h = nn.LayerList([GPTBlock(config) for _ in range(config.num_hidden_layers)])
+        self.ln_f = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+
+    def forward(self, input_ids, attn_mask=None, caches=None):
+        b, s = input_ids.shape
+        offset = 0 if caches is None else int(caches[0][0].shape[1])
+        pos = Tensor(jnp.arange(offset, offset + s, dtype=jnp.int32))
+        hidden = self.wte(input_ids) + self.wpe(pos)
+        if self.config.dtype == "bfloat16":
+            hidden = hidden.astype("bfloat16")
+        use_recompute = self.config.recompute and caches is None and self.training
+        new_caches = []
+        for i, block in enumerate(self.h):
+            if caches is not None:
+                hidden, c = block(hidden, attn_mask, caches[i])
+                new_caches.append(c)
+            elif use_recompute:
+                from ..distributed.fleet.utils.recompute import recompute
+
+                hidden = recompute(block, hidden) if attn_mask is None \
+                    else recompute(block, hidden, attn_mask)
+            else:
+                hidden = block(hidden, attn_mask)
+        hidden = self.ln_f(hidden)
+        if caches is not None:
+            return hidden, new_caches
+        return hidden
+
+
+class GPTForCausalLM(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+        self.lm_head = ColumnParallelLinear(config.hidden_size, config.vocab_size,
+                                            has_bias=False, gather_output=True)
+
+    def forward(self, input_ids, attn_mask=None, caches=None):
+        out = self.gpt(input_ids, attn_mask, caches)
+        hidden = out[0] if caches is not None else out
+        logits = self.lm_head(hidden)
+        if caches is not None:
+            return logits, out[1]
+        return logits
+
+    @property
+    def num_params(self) -> int:
+        return sum(p.size for p in self.parameters())
+
+
+class GPTPretrainingCriterion(nn.Layer):
+    """Shifted next-token CE."""
+
+    def forward(self, logits, labels):
+        shift_logits = logits[:, :-1, :]
+        shift_labels = labels[:, 1:]
+        return F.cross_entropy(
+            M.reshape(shift_logits, [-1, shift_logits.shape[-1]]),
+            M.reshape(shift_labels, [-1]),
+        )
+
+
+# ------------------------------------------------- pipeline-parallel mapping
+class _GPTPipeEmbed(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.wte = VocabParallelEmbedding(config.vocab_size, config.hidden_size)
+        self.wpe = nn.Embedding(config.max_position_embeddings, config.hidden_size)
+
+    def forward(self, input_ids):
+        s = input_ids.shape[1]
+        pos = Tensor(jnp.arange(s, dtype=jnp.int32))
+        hidden = self.wte(input_ids) + self.wpe(pos)
+        if self.config.dtype == "bfloat16":
+            hidden = hidden.astype("bfloat16")
+        return hidden
+
+
+class _GPTPipeHead(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln_f = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+        self.lm_head = ColumnParallelLinear(config.hidden_size, config.vocab_size,
+                                            has_bias=False, gather_output=True)
+
+    def forward(self, hidden):
+        return self.lm_head(self.ln_f(hidden))
+
+
+def gpt_pipeline_descs(config: GPTConfig):
+    """LayerDescs for fleet's PipelineLayer (see llama_pipeline_descs)."""
+    from ..distributed.fleet.meta_parallel import LayerDesc
+
+    return ([LayerDesc(_GPTPipeEmbed, config)]
+            + [LayerDesc(GPTBlock, config) for _ in range(config.num_hidden_layers)]
+            + [LayerDesc(_GPTPipeHead, config)])

@@ -142,12 +142,75 @@ def zeropad2d(x, padding, data_format="NCHW", name=None):
     return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
 
 
+def _interp_axis_nearest(v, axis, out_len):
+    in_len = v.shape[axis]
+    d = jnp.arange(out_len, dtype=jnp.float32)
+    idx = jnp.floor(d * in_len / out_len)  # paddle/torch floor convention
+    return jnp.take(v, jnp.clip(idx.astype(jnp.int32), 0, in_len - 1), axis=axis)
+
+
+def _src_coords(out_len, in_len, align_corners, align_mode, clamp_lo):
+    d = jnp.arange(out_len, dtype=jnp.float32)
+    if align_corners:
+        return d * (in_len - 1) / max(out_len - 1, 1)
+    if align_mode == 1:  # paddle's legacy src_idx = dst * scale
+        return d * in_len / out_len
+    src = (d + 0.5) * in_len / out_len - 0.5
+    return jnp.maximum(src, 0.0) if clamp_lo else src
+
+
+def _interp_axis_linear(v, axis, out_len, align_corners, align_mode):
+    in_len = v.shape[axis]
+    src = _src_coords(out_len, in_len, align_corners, align_mode, clamp_lo=True)
+    i0 = jnp.floor(src).astype(jnp.int32)
+    w = (src - i0).astype(jnp.float32)
+    i0c = jnp.clip(i0, 0, in_len - 1)
+    i1c = jnp.clip(i0 + 1, 0, in_len - 1)
+    shape = [1] * v.ndim
+    shape[axis] = out_len
+    wb = w.reshape(shape).astype(v.dtype)
+    return jnp.take(v, i0c, axis=axis) * (1 - wb) + jnp.take(v, i1c, axis=axis) * wb
+
+
+def _interp_axis_cubic(v, axis, out_len, align_corners):
+    in_len = v.shape[axis]
+    src = _src_coords(out_len, in_len, align_corners, 0, clamp_lo=False)
+    i0 = jnp.floor(src).astype(jnp.int32)
+    t = (src - i0).astype(jnp.float32)
+    A = -0.75  # torch/paddle cubic convolution coefficient
+
+    def wfun(xx):
+        ax = jnp.abs(xx)
+        return jnp.where(
+            ax <= 1, ((A + 2) * ax - (A + 3)) * ax * ax + 1,
+            jnp.where(ax < 2, (((ax - 5) * ax + 8) * ax - 4) * A, 0.0))
+
+    shape = [1] * v.ndim
+    shape[axis] = out_len
+    out = 0
+    for k in (-1, 0, 1, 2):
+        idx = jnp.clip(i0 + k, 0, in_len - 1)
+        wk = wfun(t - k).reshape(shape).astype(v.dtype)
+        out = out + jnp.take(v, idx, axis=axis) * wk
+    return out
+
+
 def interpolate(
     x, size=None, scale_factor=None, mode="nearest", align_corners=False, align_mode=0,
     data_format="NCHW", name=None,
 ):
+    """Paddle-faithful resampling (reference: nearest/bilinear/bicubic/...
+    _interp kernels): separable gather-based sampling — NO antialias filter
+    on downsampling (jax.image.resize applies one, silently diverging from
+    the reference), floor nearest convention, align_corners/align_mode
+    honored."""
     x = to_tensor_like(x)
     nd = x.ndim
+    if align_corners and mode in ("nearest", "area"):
+        # reference contract (nn/functional/common.py:490)
+        raise ValueError(
+            "align_corners option can only be set with the interpolating "
+            "modes: linear | bilinear | bicubic | trilinear")
     channels_first = data_format.startswith("NC")
     spatial = x.shape[2:] if channels_first else x.shape[1:-1]
     if size is not None:
@@ -156,14 +219,36 @@ def interpolate(
     else:
         sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * len(spatial)
         out_spatial = [int(d * s) for d, s in zip(spatial, sf)]
-    method = {"nearest": "nearest", "bilinear": "linear", "linear": "linear", "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+    axes = list(range(2, nd)) if channels_first else list(range(1, nd - 1))
+
+    if mode == "area":
+        # paddle 'area' == adaptive average pooling
+        from . import pooling as _pool
+
+        fn = {3: _pool.adaptive_avg_pool1d, 4: _pool.adaptive_avg_pool2d,
+              5: _pool.adaptive_avg_pool3d}[nd]
+        if not channels_first:
+            perm_in = [0, nd - 1] + list(range(1, nd - 1))
+            perm_out = [0] + list(range(2, nd)) + [1]
+            return apply(
+                lambda v: jnp.transpose(
+                    fn(Tensor(jnp.transpose(v, perm_in)), out_spatial)._value,
+                    perm_out),
+                x, op_name="interpolate_area")
+        return fn(x, out_spatial)
 
     def f(v):
-        if channels_first:
-            tgt_shape = v.shape[:2] + tuple(out_spatial)
-        else:
-            tgt_shape = (v.shape[0],) + tuple(out_spatial) + (v.shape[-1],)
-        return jax.image.resize(v, tgt_shape, method=method).astype(v.dtype)
+        out = v
+        for ax, ol in zip(axes, out_spatial):
+            if mode == "nearest":
+                out = _interp_axis_nearest(out, ax, ol)
+            elif mode in ("linear", "bilinear", "trilinear"):
+                out = _interp_axis_linear(out, ax, ol, align_corners, align_mode)
+            elif mode == "bicubic":
+                out = _interp_axis_cubic(out, ax, ol, align_corners)
+            else:
+                raise ValueError(f"unsupported interpolate mode {mode!r}")
+        return out.astype(v.dtype)
 
     return apply(f, x, op_name="interpolate")
 

@@ -1,0 +1,79 @@
+"""Golden-value tests: interpolation modes + STFT/iSTFT vs torch CPU —
+classic silent-divergence territory (align_corners conventions, window
+normalization)."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import paddle_tpu as P  # noqa: E402
+import paddle_tpu.nn.functional as F  # noqa: E402
+
+RNG = np.random.RandomState(0)
+
+
+@pytest.mark.parametrize("mode,align", [
+    ("nearest", False),
+    ("bilinear", False), ("bilinear", True),
+    ("bicubic", False), ("bicubic", True),
+])
+def test_interpolate_2d_modes(mode, align):
+    x = RNG.randn(2, 3, 8, 8).astype(np.float32)
+    kwargs = {} if mode == "nearest" else {"align_corners": align}
+    ours = F.interpolate(P.to_tensor(x), size=[13, 5], mode=mode, **kwargs).numpy()
+    ref = torch.nn.functional.interpolate(
+        torch.tensor(x), size=[13, 5], mode=mode,
+        **({} if mode == "nearest" else {"align_corners": align})).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_interpolate_linear_and_trilinear():
+    x1 = RNG.randn(2, 3, 9).astype(np.float32)
+    ours = F.interpolate(P.to_tensor(x1), size=[5], mode="linear",
+                         align_corners=True, data_format="NCW").numpy()
+    ref = torch.nn.functional.interpolate(torch.tensor(x1), size=[5],
+                                          mode="linear", align_corners=True).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+    x3 = RNG.randn(1, 2, 4, 5, 6).astype(np.float32)
+    ours3 = F.interpolate(P.to_tensor(x3), size=[3, 7, 4], mode="trilinear",
+                          align_corners=False, data_format="NCDHW").numpy()
+    ref3 = torch.nn.functional.interpolate(torch.tensor(x3), size=[3, 7, 4],
+                                           mode="trilinear",
+                                           align_corners=False).numpy()
+    np.testing.assert_allclose(ours3, ref3, rtol=1e-4, atol=1e-4)
+
+
+def test_stft_matches_torch():
+    import paddle_tpu.signal as signal
+
+    x = RNG.randn(2, 400).astype(np.float32)
+    n_fft, hop, win_len = 64, 16, 64
+    win = np.hanning(win_len + 1)[:-1].astype(np.float32)
+    ours = signal.stft(P.to_tensor(x), n_fft=n_fft, hop_length=hop,
+                       win_length=win_len, window=P.to_tensor(win),
+                       center=True, onesided=True).numpy()
+    ref = torch.stft(torch.tensor(x), n_fft=n_fft, hop_length=hop,
+                     win_length=win_len, window=torch.tensor(win),
+                     center=True, onesided=True, return_complex=True).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-3, atol=1e-4)
+
+
+def test_istft_roundtrip_matches_torch():
+    import paddle_tpu.signal as signal
+
+    x = RNG.randn(1, 512).astype(np.float32)
+    n_fft, hop = 128, 32
+    win = np.hanning(n_fft + 1)[:-1].astype(np.float32)
+    spec_t = torch.stft(torch.tensor(x), n_fft=n_fft, hop_length=hop,
+                        window=torch.tensor(win), center=True,
+                        return_complex=True)
+    rec_t = torch.istft(spec_t, n_fft=n_fft, hop_length=hop,
+                        window=torch.tensor(win), center=True,
+                        length=512).numpy()
+    spec_p = signal.stft(P.to_tensor(x), n_fft=n_fft, hop_length=hop,
+                         window=P.to_tensor(win), center=True, onesided=True)
+    rec_p = signal.istft(spec_p, n_fft=n_fft, hop_length=hop,
+                         window=P.to_tensor(win), center=True,
+                         length=512).numpy()
+    np.testing.assert_allclose(rec_p, rec_t, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(rec_p, x, rtol=1e-3, atol=1e-4)  # true roundtrip

@@ -1,0 +1,90 @@
+"""incubate.nn fused layer classes (reference: incubate/nn/layer/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu.incubate.nn import (
+    FusedBiasDropoutResidualLayerNorm,
+    FusedDropoutAdd,
+    FusedEcMoe,
+    FusedFeedForward,
+    FusedLinear,
+    FusedMultiHeadAttention,
+    FusedMultiTransformer,
+    FusedTransformerEncoderLayer,
+)
+
+
+def test_fused_linear_and_dropout_add():
+    P.seed(0)
+    lin = FusedLinear(8, 4)
+    x = P.randn([3, 8])
+    out = lin(x)
+    assert out.shape == [3, 4]
+    da = FusedDropoutAdd(p=0.0)
+    y = P.randn([3, 4])
+    np.testing.assert_allclose(da(out, y).numpy(), out.numpy() + y.numpy(), rtol=1e-6)
+
+
+def test_fused_bias_dropout_residual_ln():
+    P.seed(0)
+    m = FusedBiasDropoutResidualLayerNorm(8, dropout_rate=0.0)
+    m.eval()
+    x = P.randn([2, 5, 8])
+    r = P.randn([2, 5, 8])
+    out = m(x, r)
+    assert out.shape == [2, 5, 8]
+    # layer norm output: per-element mean ~0, var ~1 (fresh scale=1, bias=0)
+    v = out.numpy().reshape(-1, 8)
+    np.testing.assert_allclose(v.mean(-1), 0, atol=1e-5)
+
+
+@pytest.mark.parametrize("normalize_before", [False, True])
+def test_fused_mha_and_ffn_and_encoder(normalize_before):
+    P.seed(0)
+    x = P.randn([2, 6, 16])
+    mha = FusedMultiHeadAttention(16, 4, dropout_rate=0.0, attn_dropout_rate=0.0,
+                                  normalize_before=normalize_before)
+    mha.eval()
+    out = mha(x)
+    assert out.shape == [2, 6, 16]
+    ffn = FusedFeedForward(16, 32, dropout_rate=0.0,
+                           normalize_before=normalize_before)
+    ffn.eval()
+    out2 = ffn(out)
+    assert out2.shape == [2, 6, 16]
+    enc = FusedTransformerEncoderLayer(16, 4, 32, dropout_rate=0.0,
+                                       normalize_before=normalize_before)
+    enc.eval()
+    out3 = enc(x)
+    assert out3.shape == [2, 6, 16]
+    # trains end to end
+    enc.train()
+    opt = P.optimizer.AdamW(learning_rate=1e-3, parameters=enc.parameters())
+    step = P.jit.TrainStep(enc, lambda m, xx, yy: P.nn.functional.mse_loss(m(xx), yy), opt)
+    y = P.randn([2, 6, 16])
+    l0 = float(step(x, y).numpy())
+    for _ in range(3):
+        l1 = float(step(x, y).numpy())
+    assert l1 < l0
+
+
+def test_fused_multi_transformer():
+    P.seed(0)
+    m = FusedMultiTransformer(16, 4, 32, num_layers=2)
+    m.eval()
+    out = m(P.randn([2, 5, 16]))
+    assert out.shape == [2, 5, 16]
+
+
+def test_fused_ec_moe():
+    P.seed(0)
+    moe = FusedEcMoe(16, 32, num_experts=4, act_type="gelu")
+    x = P.randn([2, 8, 16])
+    gate = P.randn([2, 8, 4])
+    out = moe(x, gate)
+    assert out.shape == [2, 8, 16]
+    x.stop_gradient = False
+    out = moe(x, gate)
+    out.sum().backward()
+    assert moe.bmm_weight0.grad is not None and x.grad is not None

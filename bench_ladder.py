@@ -119,9 +119,54 @@ def bench_bert_base():
     }))
 
 
+def bench_llama_decode():
+    """Serving decode rung: static-KV-cache autoregressive generation on the
+    ~1B flagship (ideal is HBM-bound: all params stream per token)."""
+    import paddle_tpu as P
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, greedy_decode
+
+    backend = jax.default_backend()
+    on_accel = backend in ("tpu", "axon")
+    P.seed(0)
+    if on_accel:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2560, intermediate_size=8192,
+                          num_hidden_layers=9, num_attention_heads=10,
+                          max_position_embeddings=2048, dtype="bfloat16")
+        batch, prompt, new = 8, 128, 64
+    else:
+        cfg = LlamaConfig(vocab_size=512, hidden_size=128, intermediate_size=352,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          max_position_embeddings=256)
+        batch, prompt, new = 2, 8, 8
+    model = LlamaForCausalLM(cfg)
+    if on_accel:
+        model.bfloat16()
+    model.eval()
+    ids = P.to_tensor(np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (batch, prompt)).astype(np.int32))
+    # whole decode loop compiled into ONE program (single dispatch)
+    out = greedy_decode(model, ids, max_new_tokens=new, max_length=prompt + new)
+    out.numpy()  # compile + warm
+    t0 = time.perf_counter()
+    out = greedy_decode(model, ids, max_new_tokens=new, max_length=prompt + new)
+    out.numpy()
+    dt = time.perf_counter() - t0
+    tps = batch * out.shape[1] / dt
+    print(json.dumps({
+        "metric": "llama_1b_decode_tokens_per_sec_per_chip",
+        "value": round(tps, 1),
+        "unit": "tokens/s",
+        "extra": {"backend": backend, "batch": batch, "prompt": prompt,
+                  "new_tokens": int(out.shape[1]),
+                  "ms_per_token_per_seq": round(dt / out.shape[1] * 1e3, 2)},
+    }))
+
+
 if __name__ == "__main__":
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
     if which in ("all", "resnet"):
         bench_resnet50()
     if which in ("all", "bert"):
         bench_bert_base()
+    if which in ("all", "decode"):
+        bench_llama_decode()

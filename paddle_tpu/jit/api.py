@@ -126,10 +126,14 @@ class _SwapValues:
 
 class StaticFunction:
     def __init__(self, function: Callable, input_spec=None, build_strategy=None, backend=None,
-                 full_graph=False, donate_state=False, bucket_dynamic_batch=False):
+                 full_graph=False, donate_state=False, bucket_dynamic_batch=False,
+                 state_layer=None):
         from ..nn.layer.layers import Layer
 
-        self._layer: Optional[Layer] = None
+        # state_layer: trace this Layer's params/buffers as state even though
+        # ``function`` is a plain callable (closures over a model, e.g. the
+        # compiled decode loop in models/generation.py)
+        self._layer: Optional[Layer] = state_layer
         if isinstance(function, Layer):
             self._layer = function
             self._fn = function.forward
@@ -362,7 +366,8 @@ def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
         return StaticFunction(fn, input_spec=input_spec, build_strategy=build_strategy,
                               backend=backend,
                               full_graph=kwargs.get("full_graph", False),
-                              bucket_dynamic_batch=kwargs.get("bucket_dynamic_batch", False))
+                              bucket_dynamic_batch=kwargs.get("bucket_dynamic_batch", False),
+                              state_layer=kwargs.get("state_layer"))
 
     if function is not None:
         return decorate(function)

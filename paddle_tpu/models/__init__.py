@@ -9,7 +9,7 @@ from .llama import (  # noqa: F401
     llama_pipeline_descs,
     llama_tiny,
 )
-from .generation import generate  # noqa: F401,E402
+from .generation import generate, greedy_decode  # noqa: F401,E402
 from .gpt import (  # noqa: F401,E402
     GPTConfig,
     GPTForCausalLM,

@@ -20,17 +20,24 @@ import jax.numpy as jnp
 
 from ..tensor.tensor import Tensor
 
-__all__ = ["generate"]
+__all__ = ["generate", "greedy_decode"]
 
 
 def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False,
              top_p: float = 1.0, temperature: float = 1.0,
-             eos_token_id: Optional[int] = None):
+             eos_token_id: Optional[int] = None, use_static_cache: bool = False,
+             max_length: Optional[int] = None):
     """Greedy / nucleus decoding with KV caches.
 
     model: a causal LM whose forward supports ``model(ids, caches=...)``
     returning (logits, new_caches) — e.g. LlamaForCausalLM.
     Returns the generated ids [B, <=max_new_tokens] (prompt not included).
+
+    ``use_static_cache=True`` (Llama-family): fixed-size [B, max_length] KV
+    buffers + a traced write position, run through ``jit.to_static`` — every
+    decode step has identical shapes, so the whole loop executes from ONE
+    compiled program (two compiles total: prefill + decode) instead of one
+    compile per sequence length. The serving-grade decode path.
     """
     from ..autograd import tape
     from ..tensor.search import top_p_sampling
@@ -48,11 +55,40 @@ def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False
     dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
 
     with tape.no_grad():
-        # prefill with empty caches so the forward returns them populated
-        empty = [(Tensor(jnp.zeros((B, 0, n_kv, head_dim), dtype)),
-                  Tensor(jnp.zeros((B, 0, n_kv, head_dim), dtype)))
-                 for _ in range(n_layers)]
-        logits, caches = model(ids, caches=empty)
+        if use_static_cache:
+            from ..jit import to_static
+
+            if not getattr(model, "supports_static_kv_cache", False):
+                raise ValueError(
+                    f"{type(model).__name__} does not support static KV "
+                    "caches (3-tuple ring buffers); use use_static_cache="
+                    "False or a Llama-family model")
+            L = int(max_length or (S + max_new_tokens))
+            if L < S + max_new_tokens:
+                raise ValueError(
+                    f"max_length={L} is smaller than prompt ({S}) + "
+                    f"max_new_tokens ({max_new_tokens}); the KV ring would "
+                    "silently overwrite its last row")
+            caches = [(Tensor(jnp.zeros((B, L, n_kv, head_dim), dtype)),
+                       Tensor(jnp.zeros((B, L, n_kv, head_dim), dtype)),
+                       Tensor(jnp.zeros((), jnp.int32)))
+                      for _ in range(n_layers)]
+            # cache the traced forward ON the model so repeated generate()
+            # calls reuse the compiled prefill/decode programs
+            if not hasattr(model, "_decode_cache"):
+                model._decode_cache = {}
+            fwd = model._decode_cache.get("_static_fwd")
+            if fwd is None:
+                fwd = to_static(model)
+                model._decode_cache["_static_fwd"] = fwd
+        else:
+            # growing caches: prefill with empty buffers so the forward
+            # returns them populated (one recompile per decode length)
+            caches = [(Tensor(jnp.zeros((B, 0, n_kv, head_dim), dtype)),
+                       Tensor(jnp.zeros((B, 0, n_kv, head_dim), dtype)))
+                      for _ in range(n_layers)]
+            fwd = model
+        logits, caches = fwd(ids, caches=caches)
         out_tokens = []
         finished = np.zeros((B,), bool)
         for step_i in range(max_new_tokens):
@@ -74,7 +110,87 @@ def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False
             if done or step_i == max_new_tokens - 1:
                 break  # budget spent: don't pay a decode forward we'd discard
             cur = Tensor(jnp.asarray(nxt.astype(np.int32)[:, None]))
-            logits, caches = model(cur, caches=caches)
+            logits, caches = fwd(cur, caches=caches)
     if not out_tokens:
         return Tensor(jnp.zeros((B, 0), jnp.int32))
     return Tensor(jnp.asarray(np.stack(out_tokens, axis=1).astype(np.int32)))
+
+
+def greedy_decode(model, input_ids, max_new_tokens: int, max_length: Optional[int] = None):
+    """Whole-loop compiled greedy decoding: prefill + a lax.scan of static-
+    cache decode steps run as ONE program — a single host dispatch produces
+    all tokens (no per-token round trips; the device-side sampling loop of a
+    serving runtime). Llama-family models."""
+    from ..autograd import tape
+    from ..jit import to_static
+    from ..ops.dispatch import apply
+
+    ids = input_ids if isinstance(input_ids, Tensor) else Tensor(jnp.asarray(input_ids))
+    B, S = ids.shape
+    cfg = model.config
+    if not getattr(model, "supports_static_kv_cache", False):
+        raise ValueError(
+            f"{type(model).__name__} does not support static KV caches; "
+            "greedy_decode needs a Llama-family model")
+    if max_new_tokens <= 0:
+        return Tensor(jnp.zeros((B, 0), jnp.int32))
+    L = int(max_length or (S + max_new_tokens))
+    if L < S + max_new_tokens:
+        raise ValueError(
+            f"max_length={L} < prompt ({S}) + max_new_tokens "
+            f"({max_new_tokens}): the KV ring would overflow")
+    n_layers = cfg.num_hidden_layers
+    dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+
+    class _Decoder:
+        """to_static-traceable callable bound to the model (state traced)."""
+
+        def __init__(self, m, n_new):
+            self.m = m
+            self.n_new = n_new
+
+        def __call__(self, ids_t, caches):
+            logits, caches = self.m(ids_t, caches=caches)
+            n_new = self.n_new
+            m = self.m
+
+            def prog(last_logits, *cache_vals):
+                def body(carry, _):
+                    cur, cvals = carry
+                    caches_t = [tuple(Tensor(v) for v in triple)
+                                for triple in cvals]
+                    lg, nc = m(Tensor(cur), caches=caches_t)
+                    nxt = jnp.argmax(
+                        lg._value[:, -1, :].astype(jnp.float32), -1
+                    ).astype(jnp.int32)[:, None]
+                    flat = tuple(tuple(x._value for x in pair) for pair in nc)
+                    return (nxt, flat), nxt[:, 0]
+
+                first = jnp.argmax(last_logits[:, -1, :].astype(jnp.float32),
+                                   -1).astype(jnp.int32)[:, None]
+                cvals0 = tuple(tuple(cache_vals[i * 3 + j] for j in range(3))
+                               for i in range(len(cache_vals) // 3))
+                if n_new == 1:
+                    return first
+                (_, _), toks = jax.lax.scan(body, (first, cvals0), None,
+                                            length=n_new - 1)
+                return jnp.concatenate([first, jnp.moveaxis(toks, 0, 1)], axis=1)
+
+            flat_tensors = [t for triple in caches for t in triple]
+            return apply(prog, logits, *flat_tensors, op_name="greedy_decode")
+
+    key = ("_greedy_decoder", max_new_tokens, L, B, S)
+    st = getattr(model, "_decode_cache", {}).get(key)
+    if st is None:
+        dec = _Decoder(model, max_new_tokens)
+        st = to_static(lambda ids_t, caches: dec(ids_t, caches),
+                       state_layer=model)  # trace params/buffers as state
+        if not hasattr(model, "_decode_cache"):
+            model._decode_cache = {}
+        model._decode_cache[key] = st
+    caches = [(Tensor(jnp.zeros((B, L, cfg.num_key_value_heads, cfg.head_dim), dtype)),
+               Tensor(jnp.zeros((B, L, cfg.num_key_value_heads, cfg.head_dim), dtype)),
+               Tensor(jnp.zeros((), jnp.int32)))
+              for _ in range(n_layers)]
+    with tape.no_grad():
+        return st(ids, caches)

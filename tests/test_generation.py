@@ -42,3 +42,71 @@ def test_zero_budget_returns_empty():
     ids = P.to_tensor(np.random.RandomState(2).randint(0, 512, (2, 4)).astype(np.int32))
     out = generate(m, ids, max_new_tokens=0)
     assert out.shape == [2, 0]
+
+
+def test_static_cache_matches_dynamic():
+    """Fixed-size KV ring decode == growing-cache decode, with exactly TWO
+    compiled programs (prefill + decode) regardless of sequence length."""
+    m = _model()
+    ids = P.to_tensor(np.random.RandomState(3).randint(0, 512, (2, 6)).astype(np.int32))
+    ref = generate(m, ids, max_new_tokens=6)
+    out = generate(m, ids, max_new_tokens=6, use_static_cache=True)
+    np.testing.assert_array_equal(out.numpy(), ref.numpy())
+
+
+def test_static_cache_compile_count():
+    from paddle_tpu.jit.api import StaticFunction
+
+    m = _model()
+    st = StaticFunction(m)
+    B, S, L = 1, 4, 12
+    cfg = m.config
+    import jax.numpy as jnp
+
+    from paddle_tpu.tensor.tensor import Tensor
+
+    caches = [(Tensor(jnp.zeros((B, L, cfg.num_key_value_heads, cfg.head_dim))),
+               Tensor(jnp.zeros((B, L, cfg.num_key_value_heads, cfg.head_dim))),
+               Tensor(jnp.zeros((), jnp.int32)))
+              for _ in range(cfg.num_hidden_layers)]
+    ids = P.to_tensor(np.random.RandomState(0).randint(0, 512, (B, S)).astype(np.int32))
+    logits, caches = st(ids, caches=caches)
+    n_prefill = len(st._cache)
+    for _ in range(5):
+        tok = P.to_tensor(np.array([[7]], np.int32))
+        logits, caches = st(tok, caches=caches)
+    assert n_prefill == 1
+    assert len(st._cache) == 2  # prefill + ONE decode program for all steps
+
+
+def test_greedy_decode_compiled_loop_matches():
+    from paddle_tpu.models import greedy_decode
+
+    m = _model()
+    ids = P.to_tensor(np.random.RandomState(5).randint(0, 512, (2, 6)).astype(np.int32))
+    ref = generate(m, ids, max_new_tokens=6)
+    out = greedy_decode(m, ids, max_new_tokens=6)
+    np.testing.assert_array_equal(out.numpy(), ref.numpy())
+    # second call reuses the compiled program (guard-cache hit)
+    out2 = greedy_decode(m, ids, max_new_tokens=6)
+    np.testing.assert_array_equal(out2.numpy(), ref.numpy())
+    st = m._decode_cache[next(iter(m._decode_cache))]
+    assert len(st._cache) == 1
+
+
+def test_static_cache_guards():
+    import pytest as _pt
+
+    from paddle_tpu.models import GPTForCausalLM, greedy_decode, gpt_tiny
+
+    m = _model()
+    ids = P.to_tensor(np.random.RandomState(6).randint(0, 512, (1, 4)).astype(np.int32))
+    with _pt.raises(ValueError, match="KV ring"):
+        generate(m, ids, max_new_tokens=8, use_static_cache=True, max_length=6)
+    with _pt.raises(ValueError, match="KV ring|overflow"):
+        greedy_decode(m, ids, max_new_tokens=8, max_length=6)
+    assert greedy_decode(m, ids, max_new_tokens=0).shape == [1, 0]
+    gm = GPTForCausalLM(gpt_tiny())
+    gm.eval()
+    with _pt.raises(ValueError, match="static KV"):
+        generate(gm, ids, max_new_tokens=4, use_static_cache=True)

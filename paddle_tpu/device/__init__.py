@@ -10,6 +10,7 @@ from typing import Optional
 
 import jax
 
+from . import cuda  # noqa: F401
 from .memory import (  # noqa: F401
     empty_cache,
     get_memory_info,

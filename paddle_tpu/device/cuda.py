@@ -1,0 +1,136 @@
+"""paddle.device.cuda parity surface (reference:
+python/paddle/device/cuda/__init__.py + streams.py).
+
+TPU-native: XLA owns streams — dispatch order IS the stream, PJRT manages
+events. Stream/Event are therefore sequencing facades (wait/synchronize map
+to dispatch-order guarantees + block-on-readback), and the memory APIs
+delegate to the PJRT counters in device/memory.py. Code written against the
+CUDA surface runs unchanged; nothing here launches CUDA."""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+import jax
+
+from . import memory as _mem
+from .memory import (  # noqa: F401
+    empty_cache,
+    max_memory_allocated,
+    max_memory_reserved,
+    memory_allocated,
+    memory_reserved,
+)
+
+__all__ = [
+    "Stream", "Event", "current_stream", "synchronize", "device_count",
+    "empty_cache", "max_memory_allocated", "max_memory_reserved",
+    "memory_allocated", "memory_reserved", "stream_guard",
+    "get_device_properties", "get_device_name", "get_device_capability",
+]
+
+
+def synchronize(device=None):
+    from . import synchronize as _sync
+
+    return _sync(device)
+
+
+def device_count() -> int:
+    return len(jax.devices())
+
+
+class Event:
+    """Event parity: records a point in dispatch order; query/synchronize map
+    to XLA's program-order execution guarantee."""
+
+    def __init__(self, enable_timing: bool = False, blocking: bool = False,
+                 interprocess: bool = False):
+        self._recorded_at: Optional[float] = None
+
+    def record(self, stream: "Stream" = None):
+        synchronize()  # dispatch-order fence
+        self._recorded_at = time.perf_counter()
+
+    def query(self) -> bool:
+        return True  # work dispatched before record() has completed (fenced)
+
+    def synchronize(self):
+        return None
+
+    def elapsed_time(self, end_event: "Event") -> float:
+        if self._recorded_at is None or end_event._recorded_at is None:
+            raise RuntimeError("both events must be recorded first")
+        return (end_event._recorded_at - self._recorded_at) * 1e3
+
+
+class Stream:
+    """Stream parity: XLA serializes per-device dispatch, so every Stream is
+    a view of the one device stream (the reference's multi-stream overlap is
+    what XLA's latency-hiding scheduler does automatically)."""
+
+    def __init__(self, device=None, priority: int = 2):
+        self.device = device
+        self.priority = priority
+
+    def record_event(self, event: Event = None) -> Event:
+        event = event or Event()
+        event.record(self)
+        return event
+
+    def wait_event(self, event: Event):
+        return None  # program order already guarantees it
+
+    def wait_stream(self, stream: "Stream"):
+        return None
+
+    def synchronize(self):
+        synchronize(self.device)
+
+    def query(self) -> bool:
+        return True
+
+
+_current = Stream()
+
+
+def current_stream(device=None) -> Stream:
+    return _current
+
+
+@contextlib.contextmanager
+def stream_guard(stream: Stream):
+    """parity: device.cuda.stream_guard — a no-op scope (one device stream)."""
+    global _current
+    prev = _current
+    _current = stream
+    try:
+        yield stream
+    finally:
+        _current = prev
+
+
+class _DeviceProperties:
+    def __init__(self, d):
+        self.name = f"{d.platform}:{d.device_kind}" if hasattr(d, "device_kind") else str(d)
+        st = _mem.memory_stats(d)
+        self.total_memory = int(st.get("bytes_limit", 0))
+        self.major, self.minor = 0, 0
+        self.multi_processor_count = 1
+
+    def __repr__(self):
+        return (f"_DeviceProperties(name='{self.name}', "
+                f"total_memory={self.total_memory // (1 << 20)}MB)")
+
+
+def get_device_properties(device=None) -> _DeviceProperties:
+    return _DeviceProperties(_mem._device(device))
+
+
+def get_device_name(device=None) -> str:
+    return get_device_properties(device).name
+
+
+def get_device_capability(device=None):
+    return (0, 0)  # CUDA compute capability has no TPU analog

@@ -23,6 +23,34 @@ from ..tensor.tensor import Tensor
 __all__ = ["generate", "greedy_decode"]
 
 
+def _make_static_caches(model, B: int, S: int, max_new_tokens: int,
+                        max_length: Optional[int]):
+    """Validate + build the fixed-size KV ring triples (shared by generate's
+    static branch and greedy_decode)."""
+    cfg = model.config
+    if not getattr(model, "supports_static_kv_cache", False):
+        raise ValueError(
+            f"{type(model).__name__} does not support static KV caches "
+            "(3-tuple ring buffers); use a Llama-family model")
+    L = int(max_length or (S + max_new_tokens))
+    if L < S + max_new_tokens:
+        raise ValueError(
+            f"max_length={L} is smaller than prompt ({S}) + max_new_tokens "
+            f"({max_new_tokens}); the KV ring would silently overwrite its "
+            "last row")
+    if L > cfg.max_position_embeddings:
+        raise ValueError(
+            f"max_length={L} exceeds max_position_embeddings "
+            f"({cfg.max_position_embeddings}); rope rows past the table end "
+            "would be clamped and rotations silently wrong")
+    dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+    caches = [(Tensor(jnp.zeros((B, L, cfg.num_key_value_heads, cfg.head_dim), dtype)),
+               Tensor(jnp.zeros((B, L, cfg.num_key_value_heads, cfg.head_dim), dtype)),
+               Tensor(jnp.zeros((), jnp.int32)))
+              for _ in range(cfg.num_hidden_layers)]
+    return L, caches
+
+
 def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False,
              top_p: float = 1.0, temperature: float = 1.0,
              eos_token_id: Optional[int] = None, use_static_cache: bool = False,
@@ -58,21 +86,7 @@ def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False
         if use_static_cache:
             from ..jit import to_static
 
-            if not getattr(model, "supports_static_kv_cache", False):
-                raise ValueError(
-                    f"{type(model).__name__} does not support static KV "
-                    "caches (3-tuple ring buffers); use use_static_cache="
-                    "False or a Llama-family model")
-            L = int(max_length or (S + max_new_tokens))
-            if L < S + max_new_tokens:
-                raise ValueError(
-                    f"max_length={L} is smaller than prompt ({S}) + "
-                    f"max_new_tokens ({max_new_tokens}); the KV ring would "
-                    "silently overwrite its last row")
-            caches = [(Tensor(jnp.zeros((B, L, n_kv, head_dim), dtype)),
-                       Tensor(jnp.zeros((B, L, n_kv, head_dim), dtype)),
-                       Tensor(jnp.zeros((), jnp.int32)))
-                      for _ in range(n_layers)]
+            _, caches = _make_static_caches(model, B, S, max_new_tokens, max_length)
             # cache the traced forward ON the model so repeated generate()
             # calls reuse the compiled prefill/decode programs
             if not hasattr(model, "_decode_cache"):
@@ -127,20 +141,9 @@ def greedy_decode(model, input_ids, max_new_tokens: int, max_length: Optional[in
 
     ids = input_ids if isinstance(input_ids, Tensor) else Tensor(jnp.asarray(input_ids))
     B, S = ids.shape
-    cfg = model.config
-    if not getattr(model, "supports_static_kv_cache", False):
-        raise ValueError(
-            f"{type(model).__name__} does not support static KV caches; "
-            "greedy_decode needs a Llama-family model")
     if max_new_tokens <= 0:
         return Tensor(jnp.zeros((B, 0), jnp.int32))
-    L = int(max_length or (S + max_new_tokens))
-    if L < S + max_new_tokens:
-        raise ValueError(
-            f"max_length={L} < prompt ({S}) + max_new_tokens "
-            f"({max_new_tokens}): the KV ring would overflow")
-    n_layers = cfg.num_hidden_layers
-    dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+    L, prebuilt_caches = _make_static_caches(model, B, S, max_new_tokens, max_length)
 
     class _Decoder:
         """to_static-traceable callable bound to the model (state traced)."""
@@ -180,17 +183,21 @@ def greedy_decode(model, input_ids, max_new_tokens: int, max_length: Optional[in
             return apply(prog, logits, *flat_tensors, op_name="greedy_decode")
 
     key = ("_greedy_decoder", max_new_tokens, L, B, S)
-    st = getattr(model, "_decode_cache", {}).get(key)
+    if not hasattr(model, "_decode_cache"):
+        model._decode_cache = {}
+    st = model._decode_cache.get(key)
     if st is None:
         dec = _Decoder(model, max_new_tokens)
         st = to_static(lambda ids_t, caches: dec(ids_t, caches),
                        state_layer=model)  # trace params/buffers as state
-        if not hasattr(model, "_decode_cache"):
-            model._decode_cache = {}
+        # bound the per-model program cache: each entry holds a compiled
+        # whole-loop XLA program. Serving with naturally varying prompt
+        # lengths should pad/bucket S (see jit bucket_dynamic_batch) rather
+        # than rely on one program per exact length.
+        decoder_keys = [k for k in model._decode_cache
+                        if isinstance(k, tuple) and k and k[0] == "_greedy_decoder"]
+        if len(decoder_keys) >= 8:
+            model._decode_cache.pop(decoder_keys[0], None)
         model._decode_cache[key] = st
-    caches = [(Tensor(jnp.zeros((B, L, cfg.num_key_value_heads, cfg.head_dim), dtype)),
-               Tensor(jnp.zeros((B, L, cfg.num_key_value_heads, cfg.head_dim), dtype)),
-               Tensor(jnp.zeros((), jnp.int32)))
-              for _ in range(n_layers)]
     with tape.no_grad():
-        return st(ids, caches)
+        return st(ids, prebuilt_caches)

@@ -180,7 +180,7 @@ def ring_attention(q, k, v, *, mesh, axis_name: str = "sep", causal: bool = Fals
     the local block; collectives ride only the sep ring. K/V may carry fewer
     (GQA) heads than Q.
     """
-    from jax.experimental.shard_map import shard_map
+    from ..distributed.shard_map_compat import shard_map_compat
 
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
@@ -189,12 +189,11 @@ def ring_attention(q, k, v, *, mesh, axis_name: str = "sep", causal: bool = Fals
     h_ax = head_axis if head_axis in names and mesh.shape[head_axis] > 1 else None
     spec = P(b_ax, axis_name, h_ax, None)
 
-    fn = shard_map(
+    fn = shard_map_compat(
         functools.partial(_ring_attention_local, axis_name=axis_name, causal=causal,
                           scale=scale, interpret=interpret),
-        mesh=mesh,
+        mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_rep=False,
     )
     return fn(q, k, v)

@@ -103,10 +103,28 @@ def test_static_cache_guards():
     ids = P.to_tensor(np.random.RandomState(6).randint(0, 512, (1, 4)).astype(np.int32))
     with _pt.raises(ValueError, match="KV ring"):
         generate(m, ids, max_new_tokens=8, use_static_cache=True, max_length=6)
-    with _pt.raises(ValueError, match="KV ring|overflow"):
+    with _pt.raises(ValueError, match="KV ring"):
         greedy_decode(m, ids, max_new_tokens=8, max_length=6)
     assert greedy_decode(m, ids, max_new_tokens=0).shape == [1, 0]
     gm = GPTForCausalLM(gpt_tiny())
     gm.eval()
     with _pt.raises(ValueError, match="static KV"):
         generate(gm, ids, max_new_tokens=4, use_static_cache=True)
+
+
+def test_static_cache_rejects_beyond_rope_table():
+    import pytest as _pt
+
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, greedy_decode
+
+    P.seed(0)
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=1, num_attention_heads=2,
+                      max_position_embeddings=8)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    ids = P.to_tensor(np.random.RandomState(0).randint(0, 64, (1, 6)).astype(np.int32))
+    with _pt.raises(ValueError, match="max_position_embeddings"):
+        greedy_decode(m, ids, max_new_tokens=6)
+    with _pt.raises(ValueError, match="max_position_embeddings"):
+        generate(m, ids, max_new_tokens=6, use_static_cache=True)

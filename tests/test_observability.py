@@ -66,3 +66,26 @@ def test_lower_text_programmatic():
     shlo, opt = lower_text(f, np.ones((4,), np.float32))
     assert "sine" in shlo or "sin" in shlo
     assert opt is not None
+
+
+def test_device_cuda_parity_surface():
+    """paddle.device.cuda facade (streams/events/properties over XLA)."""
+    import time
+
+    import paddle_tpu.device.cuda as cuda
+
+    assert cuda.device_count() >= 1
+    s = cuda.current_stream()
+    ev1 = s.record_event()
+    time.sleep(0.01)
+    ev2 = cuda.Event()
+    ev2.record()
+    assert ev1.query() and ev2.query()
+    assert ev1.elapsed_time(ev2) >= 5.0  # ms
+    with cuda.stream_guard(cuda.Stream()) as st:
+        assert cuda.current_stream() is st
+        st.synchronize()
+    props = cuda.get_device_properties()
+    assert cuda.get_device_name()
+    assert isinstance(cuda.memory_allocated(), int)
+    assert cuda.get_device_capability() == (0, 0)

@@ -18,6 +18,8 @@ from ....tensor.tensor import Tensor
 
 __all__ = [
     "fused_rms_norm", "fused_layer_norm", "fused_rotary_position_embedding", "swiglu",
+    "fused_dot_product_attention", "blha_get_max_len", "masked_multihead_attention",
+    "fused_gate_attention", "block_multihead_attention",
     "fused_linear", "fused_bias_act", "fused_dropout_add", "fused_multi_head_attention",
     "fused_matmul_bias", "fused_linear_activation",
     "fused_bias_dropout_residual_layer_norm", "fused_feedforward", "fused_moe",
@@ -300,3 +302,192 @@ def variable_length_memory_efficient_attention(query, key, value, seq_lens=None,
     out = F.scaled_dot_product_attention(q_s, k_s, v_s, attn_mask=mask,
                                          is_causal=causal)
     return _tr(out, [0, 2, 1, 3])
+
+
+def fused_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                is_causal=False, scaling_factor=None, training=True,
+                                name=None):
+    """parity: fused_dot_product_attention (cudnn fused SDPA) — [B,S,H,D]
+    layout; lowers to the flash kernel / fused XLA attention."""
+    if is_causal and attn_mask is not None:
+        raise AssertionError(
+            "attn_mask must be None when is_causal=True (reference contract)")
+    if scaling_factor is not None:
+        q = to_tensor_like(query)
+        d = q.shape[-1]
+        query = q * (scaling_factor * (d ** 0.5))  # fold custom scale into q
+    return F.scaled_dot_product_attention(query, key, value, attn_mask=attn_mask,
+                                          dropout_p=dropout_p, is_causal=is_causal,
+                                          training=training)
+
+
+def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size):
+    """parity: blha_get_max_len — (max encoder len, max decoder len) this
+    step (used ahead of block_multihead_attention)."""
+    import numpy as _np
+
+    enc = to_tensor_like(seq_lens_encoder)
+    dec = to_tensor_like(seq_lens_decoder)
+    # live rows only (seq_lens arrays may be padded past the real batch)
+    b = int(_np.asarray(to_tensor_like(batch_size)._value).reshape(-1)[0])
+    mx = lambda t: apply(  # noqa: E731
+        lambda v: jnp.max(v.astype(jnp.int32)[:b]).reshape(1), t,
+        op_name="blha_max")
+    return mx(enc), mx(dec)
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
+                               cum_offsets=None, sequence_lengths=None,
+                               rotary_tensor=None, beam_cache_offset=None,
+                               qkv_out_scale=None, out_shift=None, out_smooth=None,
+                               seq_len=1, rotary_emb_dims=0,
+                               use_neox_rotary_style=False,
+                               compute_dtype="default", out_scale=-1,
+                               quant_round_type=1, quant_max_bound=127.0,
+                               quant_min_bound=-127.0):
+    """parity: masked_multihead_attention — single-token decode attention
+    over a [2, B, H, max_seq, D] cache (the reference's fused MMHA decode
+    kernel). Supported subset: bias add, src_mask, sequence_lengths write
+    positions; quant/rotary-in-kernel paths raise (use apply_rotary_pos_emb
+    upstream)."""
+    if any(a is not None for a in (qkv_out_scale, out_shift, out_smooth)) or out_scale != -1:
+        raise NotImplementedError("masked_multihead_attention: quant paths not supported")
+    if rotary_tensor is not None or rotary_emb_dims:
+        raise NotImplementedError(
+            "masked_multihead_attention: in-kernel rotary not supported; apply "
+            "rope to x before calling")
+    if beam_cache_offset is not None or cum_offsets is not None:
+        raise NotImplementedError(
+            "masked_multihead_attention: beam_cache_offset/cum_offsets (beam "
+            "search cache reordering) are not supported")
+    if sequence_lengths is None and src_mask is None:
+        raise ValueError(
+            "masked_multihead_attention needs sequence_lengths (write "
+            "positions) or src_mask (whose length infers the timestep)")
+    x = to_tensor_like(x)
+    cache = to_tensor_like(cache_kv)
+    b_t = to_tensor_like(bias) if bias is not None else None
+    m_t = to_tensor_like(src_mask) if src_mask is not None else None
+    sl_t = to_tensor_like(sequence_lengths) if sequence_lengths is not None else None
+
+    def f(xv, cv, *rest):
+        rest = list(rest)
+        bv = rest.pop(0) if b_t is not None else None
+        mv = rest.pop(0) if m_t is not None else None
+        sv = rest.pop(0) if sl_t is not None else None
+        B = xv.shape[0]
+        _, _, H, S, D = cv.shape
+        qkv = xv.reshape(B, 3, H, D)
+        if bv is not None:
+            qkv = qkv + bv[None]
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # [B, H, D]
+        if sv is not None:
+            pos = sv.reshape(B).astype(jnp.int32)
+        else:
+            # reference behavior: the mask covers [0, timestep] — its length
+            # IS timestep+1, so the write position is mask_len - 1
+            pos = jnp.full((B,), mv.shape[-1] - 1, jnp.int32)
+        bidx = jnp.arange(B)
+        # cache layout [2, B, H, S, D]: plane 0 = K, plane 1 = V
+        ck = cv[0].at[bidx, :, pos].set(k)   # write k at pos: [B,H,S,D]
+        cvv = cv[1].at[bidx, :, pos].set(v)
+        logits = jnp.einsum("bhd,bhsd->bhs", q.astype(jnp.float32),
+                            ck.astype(jnp.float32)) / (D ** 0.5)
+        valid = jnp.arange(S)[None, :] <= pos[:, None]  # [B, S]
+        logits = jnp.where(valid[:, None, :], logits, -1e30)
+        if mv is not None:
+            # documented src_mask shape [B,1,1,t+1] may be shorter than the
+            # cache capacity S: pad with zeros (those slots are already
+            # masked by the validity window)
+            mslice = mv.reshape(B, 1, -1)[:, :, :S].astype(jnp.float32)
+            short = S - mslice.shape[-1]
+            if short > 0:
+                mslice = jnp.pad(mslice, ((0, 0), (0, 0), (0, short)))
+            logits = logits + mslice
+        p = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bhs,bhsd->bhd", p, cvv.astype(jnp.float32))
+        new_cache = jnp.stack([ck, cvv], axis=0).astype(cv.dtype)
+        return out.reshape(B, H * D).astype(xv.dtype), new_cache
+
+    args = [x, cache] + [t for t in (b_t, m_t, sl_t) if t is not None]
+    out, new_cache = apply(lambda *a: tuple(f(*a)), *args,
+                           op_name="masked_multihead_attention", n_outs=2)
+    return out, new_cache
+
+
+def fused_gate_attention(query, key=None, query_weight=None, key_weight=None,
+                         value_weight=None, qkv_weight=None,
+                         gate_linear_weight=None, gate_linear_bias=None,
+                         out_linear_weight=None, out_linear_bias=None,
+                         nonbatched_bias=None, attn_mask=None, has_gating=True,
+                         merge_qkv=True, use_flash_attn=False):
+    """parity: fused_gate_attention (the AlphaFold gate-attention fusion).
+    query [B, M, S, Dq]; merged qkv_weight [3, H, D, Dq] or separate
+    q/k/v weights [Dq, H, D]; sigmoid gating + output projection."""
+    q_in = to_tensor_like(query)
+    k_in = to_tensor_like(key) if key is not None else q_in
+
+    def proj(x, w):
+        # x [B,M,S,Dq] @ w [Dq,H,D] -> [B,M,S,H,D]
+        return apply(lambda xv, wv: jnp.einsum("bmsq,qhd->bmshd", xv, wv),
+                     x, to_tensor_like(w), op_name="gate_proj")
+
+    if merge_qkv:
+        if qkv_weight is None:
+            raise ValueError("merge_qkv=True needs qkv_weight")
+        qkvw = to_tensor_like(qkv_weight)
+        q = apply(lambda xv, wv: jnp.einsum("bmsq,hdq->bmshd", xv, wv[0]),
+                  q_in, qkvw, op_name="gate_q")
+        k = apply(lambda xv, wv: jnp.einsum("bmsq,hdq->bmshd", xv, wv[1]),
+                  q_in, qkvw, op_name="gate_k")
+        v = apply(lambda xv, wv: jnp.einsum("bmsq,hdq->bmshd", xv, wv[2]),
+                  q_in, qkvw, op_name="gate_v")
+    else:
+        q = proj(q_in, query_weight)
+        k = proj(k_in, key_weight)
+        v = proj(k_in, value_weight)
+
+    mask_t = to_tensor_like(attn_mask) if attn_mask is not None else None
+    nb_t = to_tensor_like(nonbatched_bias) if nonbatched_bias is not None else None
+
+    def attn(qv, kv, vv, *rest):
+        rest = list(rest)
+        mv = rest.pop(0) if mask_t is not None else None
+        nb = rest.pop(0) if nb_t is not None else None
+        D = qv.shape[-1]
+        logits = jnp.einsum("bmqhd,bmkhd->bmhqk", qv, kv).astype(jnp.float32) / (D ** 0.5)
+        if nb is not None:  # [B, 1?, H, S, S] broadcast bias
+            logits = logits + nb.astype(jnp.float32)
+        if mv is not None:
+            logits = logits + mv.astype(jnp.float32)
+        p = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("bmhqk,bmkhd->bmqhd", p, vv).astype(qv.dtype)
+
+    a_args = [q, k, v] + [t for t in (mask_t, nb_t) if t is not None]
+    out = apply(attn, *a_args, op_name="gate_attention")
+
+    if has_gating:
+        if gate_linear_weight is None:
+            raise ValueError("has_gating=True needs gate_linear_weight")
+        gw = to_tensor_like(gate_linear_weight)
+        gb = to_tensor_like(gate_linear_bias)
+        gate = apply(lambda xv, wv, bv: jax.nn.sigmoid(
+            jnp.einsum("bmsq,qhd->bmshd", xv, wv) + bv),
+            q_in, gw, gb, op_name="gate_gate")
+        out = apply(lambda o, g: o * g.astype(o.dtype), out, gate, op_name="gate_mul")
+
+    ow = to_tensor_like(out_linear_weight)
+    ob = to_tensor_like(out_linear_bias)
+    return apply(lambda o, wv, bv: jnp.einsum("bmshd,hdq->bmsq", o, wv) + bv,
+                 out, ow, ob, op_name="gate_out")
+
+
+def block_multihead_attention(*args, **kwargs):
+    """reference: block_multihead_attention (paged-KV serving attention with
+    block tables + quant variants). The TPU serving path uses the static KV
+    ring decode (models.generate / greedy_decode) instead; paged block tables
+    are not implemented."""
+    raise NotImplementedError(
+        "block_multihead_attention (paged KV blocks) is not implemented; use "
+        "models.generate(use_static_cache=True) / models.greedy_decode for "
+        "TPU serving decode")

@@ -88,3 +88,114 @@ def test_fused_ec_moe():
     out = moe(x, gate)
     out.sum().backward()
     assert moe.bmm_weight0.grad is not None and x.grad is not None
+
+
+class TestIncubateFunctionalTail:
+    def test_fused_dot_product_attention(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.incubate.nn import functional as IF
+        from paddle_tpu.nn.functional import scaled_dot_product_attention
+
+        P.seed(0)
+        q, k, v = P.randn([2, 8, 4, 16]), P.randn([2, 8, 4, 16]), P.randn([2, 8, 4, 16])
+        out = IF.fused_dot_product_attention(q, k, v, is_causal=True)
+        ref = scaled_dot_product_attention(q, k, v, is_causal=True)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5)
+
+    def test_blha_get_max_len(self):
+        from paddle_tpu.incubate.nn import functional as IF
+
+        enc = P.to_tensor(np.array([3, 9, 5], np.int32))
+        dec = P.to_tensor(np.array([1, 2, 7], np.int32))
+        me, md = IF.blha_get_max_len(enc, dec, P.to_tensor(np.array([3])))
+        assert int(me.numpy()) == 9 and int(md.numpy()) == 7
+
+    def test_masked_multihead_attention_decode_steps(self):
+        """Two decode steps through the [2,B,H,S,D] cache match a dense
+        attention over the accumulated keys."""
+        from paddle_tpu.incubate.nn import functional as IF
+
+        rng = np.random.RandomState(0)
+        B, H, S, D = 2, 2, 6, 8
+        cache = P.to_tensor(np.zeros((2, B, H, S, D), np.float32))
+        ks, vs, qs = [], [], []
+        for step in range(2):
+            x = rng.randn(B, 3 * H * D).astype(np.float32)
+            qkv = x.reshape(B, 3, H, D)
+            qs.append(qkv[:, 0]); ks.append(qkv[:, 1]); vs.append(qkv[:, 2])
+            seq_lens = P.to_tensor(np.full((B, 1), step, np.int32))
+            out, cache = IF.masked_multihead_attention(
+                P.to_tensor(x), cache_kv=cache, sequence_lengths=seq_lens)
+        # dense reference at the second step
+        K = np.stack(ks, axis=2)  # [B,H,t,D]
+        V = np.stack(vs, axis=2)
+        q = qs[-1]
+        logits = np.einsum("bhd,bhtd->bht", q, K) / np.sqrt(D)
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = np.einsum("bht,bhtd->bhd", p, V).reshape(B, H * D)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_fused_gate_attention(self):
+        from paddle_tpu.incubate.nn import functional as IF
+
+        rng = np.random.RandomState(1)
+        B, M, S, Dq, Hh, D = 1, 2, 4, 8, 2, 4
+        query = P.to_tensor(rng.randn(B, M, S, Dq).astype(np.float32))
+        qkvw = P.to_tensor(rng.randn(3, Hh, D, Dq).astype(np.float32))
+        gw = P.to_tensor(rng.randn(Dq, Hh, D).astype(np.float32))
+        gb = P.to_tensor(np.zeros((Hh, D), np.float32))
+        ow = P.to_tensor(rng.randn(Hh, D, Dq).astype(np.float32))
+        ob = P.to_tensor(np.zeros((Dq,), np.float32))
+        out = IF.fused_gate_attention(query, qkv_weight=qkvw,
+                                      gate_linear_weight=gw, gate_linear_bias=gb,
+                                      out_linear_weight=ow, out_linear_bias=ob)
+        assert out.shape == [B, M, S, Dq]
+        assert np.isfinite(out.numpy()).all()
+        # no gating path
+        out2 = IF.fused_gate_attention(query, qkv_weight=qkvw, has_gating=False,
+                                       out_linear_weight=ow, out_linear_bias=ob)
+        assert out2.shape == [B, M, S, Dq]
+
+    def test_block_mha_raises_with_guidance(self):
+        import pytest as _pt
+
+        from paddle_tpu.incubate.nn import functional as IF
+
+        with _pt.raises(NotImplementedError, match="greedy_decode"):
+            IF.block_multihead_attention()
+
+    def test_mmha_timestep_from_mask_and_guards(self):
+        import pytest as _pt
+
+        from paddle_tpu.incubate.nn import functional as IF
+
+        rng = np.random.RandomState(2)
+        B, H, S, D = 1, 2, 8, 4
+        cache = P.to_tensor(np.zeros((2, B, H, S, D), np.float32))
+        x0 = P.to_tensor(rng.randn(B, 3 * H * D).astype(np.float32))
+        # step 0 via mask of length 1, step 1 via mask of length 2
+        m0 = P.to_tensor(np.zeros((B, 1, 1, 1), np.float32))
+        out0, cache = IF.masked_multihead_attention(x0, cache_kv=cache, src_mask=m0)
+        x1 = P.to_tensor(rng.randn(B, 3 * H * D).astype(np.float32))
+        m1 = P.to_tensor(np.zeros((B, 1, 1, 2), np.float32))
+        out1, cache = IF.masked_multihead_attention(x1, cache_kv=cache, src_mask=m1)
+        # both cache rows written (non-zero)
+        c = np.asarray(cache._value)
+        assert np.abs(c[0, :, :, 0]).sum() > 0 and np.abs(c[0, :, :, 1]).sum() > 0
+        assert np.abs(c[0, :, :, 2]).sum() == 0
+        with _pt.raises(ValueError, match="sequence_lengths"):
+            IF.masked_multihead_attention(x0, cache_kv=cache)
+        with _pt.raises(NotImplementedError, match="beam"):
+            IF.masked_multihead_attention(x0, cache_kv=cache, src_mask=m1,
+                                          beam_cache_offset=m1)
+
+    def test_fdpa_causal_mask_assertion(self):
+        import pytest as _pt
+
+        from paddle_tpu.incubate.nn import functional as IF
+
+        q = P.randn([1, 4, 2, 8])
+        with _pt.raises(AssertionError, match="attn_mask"):
+            IF.fused_dot_product_attention(q, q, q, attn_mask=q, is_causal=True)

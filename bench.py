@@ -112,6 +112,15 @@ def main():
             "step_ms": round(dt * 1e3, 2),
             "mfu": round(mfu, 4),
             "loss": float(np.asarray(loss.numpy()).reshape(-1)[-1]),
+            # workload identity so cross-round comparisons (tools/perf_gate.py)
+            # can detect mismatched configs instead of comparing apples/oranges
+            "workload": {
+                "heads": cfg.num_attention_heads,
+                "hidden": cfg.hidden_size,
+                "layers": cfg.num_hidden_layers,
+                "batch": batch,
+                "loss_mode": loss_mode if on_accel else "unfused",
+            },
         },
     }))
 

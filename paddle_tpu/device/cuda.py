@@ -43,21 +43,39 @@ def device_count() -> int:
 
 class Event:
     """Event parity: records a point in dispatch order; query/synchronize map
-    to XLA's program-order execution guarantee."""
+    to XLA's program-order execution guarantee.
+
+    Semantics differ from CUDA events: with ``enable_timing=True``,
+    ``record()`` is a BLOCKING device fence (full ``synchronize()``) so
+    ``elapsed_time`` measures host wall-clock between fences — code using
+    events for async overlap will serialize at each timed record. With
+    ``enable_timing=False`` (default) ``record()`` is a no-op marker:
+    XLA's program-order guarantee already provides the cross-stream
+    ordering CUDA events exist for, so no fence is needed and nothing
+    serializes."""
 
     def __init__(self, enable_timing: bool = False, blocking: bool = False,
                  interprocess: bool = False):
+        self._enable_timing = enable_timing
         self._recorded_at: Optional[float] = None
+        self._fenced = True  # nothing recorded yet → trivially complete
 
     def record(self, stream: "Stream" = None):
-        synchronize()  # dispatch-order fence
+        if self._enable_timing:
+            synchronize()  # blocking fence so the timestamp is meaningful
+            self._fenced = True
+        else:
+            self._fenced = False  # async marker; fence deferred to query/sync
         self._recorded_at = time.perf_counter()
 
     def query(self) -> bool:
-        return True  # work dispatched before record() has completed (fenced)
+        self.synchronize()  # conservative: fence, then truthfully report done
+        return True
 
     def synchronize(self):
-        return None
+        if not self._fenced:
+            synchronize()  # wait for work dispatched before record()
+            self._fenced = True
 
     def elapsed_time(self, end_event: "Event") -> float:
         if self._recorded_at is None or end_event._recorded_at is None:

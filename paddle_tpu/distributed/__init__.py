@@ -47,9 +47,46 @@ from .communication import (  # noqa: F401
     stream,
     wait,
 )
+from .communication import (  # noqa: F401
+    alltoall,
+    alltoall_single,
+    destroy_process_group,
+    get_backend,
+    is_available,
+    scatter_object_list,
+)
 from .env import ParallelEnv, get_rank, get_world_size, init_parallel_env, is_initialized  # noqa: F401
 from .parallel import DataParallel  # noqa: F401
 from .placements import Partial, Placement, ProcessMesh, Replicate, Shard  # noqa: F401
-from .topology import get_hybrid_communicate_group  # noqa: F401
+from .topology import ParallelMode, get_hybrid_communicate_group  # noqa: F401
+
+# -- semi-auto static conversion + strategy (auto_parallel/dist_model.py)
+from .auto_parallel.api import (  # noqa: F401
+    ShardingStage1,
+    ShardingStage2,
+    ShardingStage3,
+)
+from .auto_parallel.dist_model import (  # noqa: F401
+    DistAttr,
+    DistModel,
+    ReduceType,
+    ShardDataloader,
+    Strategy,
+    shard_dataloader,
+    shard_scaler,
+    to_static,
+)
+
+# -- sharded checkpoint re-exports (paddle.distributed.save_state_dict)
+from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
+
+# -- host-side tiers: io / gloo / spawn / launch / PS entries / datasets
+from . import io  # noqa: F401
+from .entry_attr import CountFilterEntry, ProbabilityEntry, ShowClickEntry  # noqa: F401
+from .fleet.dataset import InMemoryDataset, QueueDataset  # noqa: F401
+from .fleet.mp_layers import split  # noqa: F401
+from .launch.main import launch  # noqa: F401
+from .parallel_with_gloo import gloo_barrier, gloo_init_parallel_env, gloo_release  # noqa: F401
+from .spawn import spawn  # noqa: F401
 
 # namespace parity: paddle.distributed.fleet.* available as attribute already

@@ -34,9 +34,10 @@ from .group import Group, ReduceOp, get_group, new_group  # noqa: F401
 
 __all__ = [
     "all_reduce", "all_gather", "all_gather_object", "all_to_all", "all_to_all_single",
-    "reduce_scatter", "broadcast", "broadcast_object_list", "reduce", "scatter",
-    "gather", "send", "recv", "isend", "irecv", "barrier", "wait", "stream",
-    "Group", "ReduceOp", "new_group", "get_group", "P2POp", "batch_isend_irecv",
+    "alltoall", "alltoall_single", "reduce_scatter", "broadcast", "broadcast_object_list",
+    "reduce", "scatter", "gather", "scatter_object_list", "send", "recv", "isend",
+    "irecv", "barrier", "wait", "stream", "Group", "ReduceOp", "new_group", "get_group",
+    "P2POp", "batch_isend_irecv", "destroy_process_group", "get_backend", "is_available",
 ]
 
 
@@ -210,8 +211,10 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
         task = all_reduce(tensor, op, group, sync_op)
         my = group.rank if group is not None else jax.process_index()
         dst_local = group.get_group_rank(dst) if group is not None else dst
-        if my != dst_local and isinstance(tensor, Tensor):
-            tensor._value = orig  # non-destination ranks keep their input
+        if my != dst_local:
+            if isinstance(tensor, Tensor):
+                tensor._value = orig  # non-destination ranks keep their input
+            return _Task(orig)  # task consumers must not observe the reduction
         return task
     # single-process SPMD: the global array already holds the reduced view
     return all_reduce(tensor, op, group, sync_op)
@@ -420,6 +423,74 @@ def barrier(group=None):
 
 def wait(tensor, group=None, use_calc_stream=True):
     jax.block_until_ready(_raw(tensor))
+
+
+# ----------------------------------------------------- surface-parity tail
+# (parity: python/paddle/distributed/__init__.py exports — alltoall/
+# alltoall_single are the documented spellings of all_to_all/…_single,
+# communication/all_to_all.py:26)
+alltoall = all_to_all
+alltoall_single = all_to_all_single
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0, group=None):
+    """parity: communication/scatter.py scatter_object_list — pickled-object
+    scatter. Single-controller SPMD: every process holds the full list, so
+    each rank receives its slot; multi-host eager broadcasts src's list.
+    ``src`` is a GLOBAL rank (reduce()/broadcast() convention); each rank
+    receives ``in_object_list[its group-local rank]``."""
+    if group is not None:
+        my_local = group.rank  # already group-local
+        src_local = group.get_group_rank(src)
+        if src_local < 0:
+            raise ValueError(f"scatter_object_list src={src} not in {group}")
+    else:
+        my_local = jax.process_index() if jax.process_count() > 1 else 0
+        src_local = src
+    objs = in_object_list
+    if jax.process_count() > 1:
+        import pickle
+
+        from jax.experimental import multihost_utils
+
+        is_src = my_local == src_local
+        payload = np.frombuffer(pickle.dumps(in_object_list or []), np.uint8)
+        # fixed-size contract: broadcast length first, then the padded buffer
+        n = multihost_utils.broadcast_one_to_all(
+            np.asarray([payload.size], np.int64), is_source=is_src)
+        buf = np.zeros(int(n[0]), np.uint8)
+        buf[: min(payload.size, int(n[0]))] = payload[: int(n[0])]
+        out = multihost_utils.broadcast_one_to_all(buf, is_source=is_src)
+        objs = pickle.loads(np.asarray(out).tobytes())
+    out_object_list.clear()
+    out_object_list.append(objs[my_local] if objs and my_local < len(objs) else None)
+
+
+def destroy_process_group(group=None):
+    """parity: collective.py destroy_process_group — release group
+    bookkeeping (XLA holds no persistent communicator state to tear down)."""
+    from . import group as _group_mod
+
+    if group is None:
+        _group_mod._groups.clear()
+    else:
+        _group_mod._groups.pop(group.id, None)
+
+
+def get_backend(group=None) -> str:
+    """parity: collective.py get_backend. The one transport is XLA
+    collectives (ICI/DCN), reported as 'XCCL' for scripts that branch on the
+    custom-device backend name."""
+    return "XCCL"
+
+
+def is_available() -> bool:
+    """parity: distributed.is_available — collectives are always compiled
+    in; availability == a jax backend exists."""
+    try:
+        return len(jax.devices()) > 0
+    except Exception:
+        return False
 
 
 class _StreamNS:

@@ -1,0 +1,73 @@
+"""Sparse-table entry policies for the parameter server (parity:
+/root/reference/python/paddle/distributed/entry_attr.py:62 ProbabilityEntry,
+:107 CountFilterEntry, :155 ShowClickEntry).
+
+These configure when a sparse embedding row is admitted/retained in the PS
+table (paddle_tpu.distributed.ps). They are pure config carriers; the table
+consults ``admit(count)``/``_to_attr()``.
+"""
+from __future__ import annotations
+
+__all__ = ["EntryAttr", "ProbabilityEntry", "CountFilterEntry", "ShowClickEntry"]
+
+
+class EntryAttr:
+    def __init__(self):
+        self._name = None
+
+    def _to_attr(self) -> str:
+        raise NotImplementedError
+
+
+class ProbabilityEntry(EntryAttr):
+    """Admit a new row with the given probability (feature-hash sampling)."""
+
+    def __init__(self, probability: float):
+        super().__init__()
+        if not isinstance(probability, float) or not (0.0 < probability < 1.0):
+            raise ValueError("probability must be a float in (0,1)")
+        self._name = "probability_entry"
+        self._probability = probability
+
+    def admit(self, count: int, rng=None) -> bool:
+        import random
+
+        return (rng or random).random() < self._probability
+
+    def _to_attr(self) -> str:
+        return f"{self._name}:{self._probability}"
+
+
+class CountFilterEntry(EntryAttr):
+    """Admit a row only after it has been seen ``count_filter`` times."""
+
+    def __init__(self, count_filter: int):
+        super().__init__()
+        if not isinstance(count_filter, int) or count_filter < 0:
+            raise ValueError("count_filter must be an integer >= 0")
+        self._name = "count_filter_entry"
+        self._count_filter = count_filter
+
+    def admit(self, count: int, rng=None) -> bool:
+        return count >= self._count_filter
+
+    def _to_attr(self) -> str:
+        return f"{self._name}:{self._count_filter}"
+
+
+class ShowClickEntry(EntryAttr):
+    """Weight rows by named show/click statistics (CTR tables)."""
+
+    def __init__(self, show_name: str, click_name: str):
+        super().__init__()
+        if not isinstance(show_name, str) or not isinstance(click_name, str):
+            raise ValueError("show_name and click_name must be strings")
+        self._name = "show_click_entry"
+        self._show_name = show_name
+        self._click_name = click_name
+
+    def admit(self, count: int, rng=None) -> bool:
+        return True
+
+    def _to_attr(self) -> str:
+        return f"{self._name}:{self._show_name}:{self._click_name}"

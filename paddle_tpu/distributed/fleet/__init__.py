@@ -15,6 +15,8 @@ from ..topology import (
 from .distributed_strategy import DistributedStrategy
 from . import mp_layers  # noqa: F401
 from . import meta_parallel  # noqa: F401
+from . import dataset  # noqa: F401
+from .dataset import InMemoryDataset, QueueDataset  # noqa: F401
 from .mp_layers import (  # noqa: F401
     ColumnParallelLinear,
     ParallelCrossEntropy,

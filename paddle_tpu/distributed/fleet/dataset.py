@@ -1,0 +1,196 @@
+"""Industrial slot-data datasets (parity:
+/root/reference/python/paddle/distributed/fleet/dataset/dataset.py:410
+InMemoryDataset, :1389 QueueDataset, DatasetBase).
+
+TPU-native scope: the reference's C++ ``MultiSlotDataFeed``/``Dataset`` tier
+feeds the parameter-server trainers from slot-formatted text files. Here the
+same contract (filelist + use_var slots + batched dict feed, in-memory vs
+streaming-queue modes, local/global shuffle) is a host-side Python pipeline —
+sparse ids go to the PS tier (paddle_tpu.distributed.ps), dense batches go to
+jnp; there is no GPU feed path to replicate.
+
+Line format (MultiSlotDataFeed parity): per line, for each slot in order,
+``<n> <v1> ... <vn>`` — the slot's value count followed by its values.
+"""
+from __future__ import annotations
+
+import os
+import random
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["DatasetBase", "InMemoryDataset", "QueueDataset"]
+
+
+class DatasetBase:
+    def __init__(self):
+        self._filelist: List[str] = []
+        self._use_var: List[str] = []
+        self._var_dtypes: Dict[str, str] = {}
+        self._batch_size = 1
+        self._thread_num = 1
+        self._pipe_command: Optional[str] = None
+        self._initialized = False
+
+    def init(self, batch_size=1, thread_num=1, use_var=None, pipe_command=None,
+             input_type=0, fs_name="", fs_ugi="", download_cmd="cat", **kwargs):
+        """parity: DatasetBase.init — record the feed schema. ``use_var``
+        entries may be names or objects with ``.name``/``.dtype``."""
+        self._batch_size = int(batch_size)
+        self._thread_num = int(thread_num)
+        self._pipe_command = pipe_command
+        self._use_var = []
+        for v in use_var or []:
+            name = getattr(v, "name", v)
+            self._use_var.append(name)
+            dt = getattr(v, "dtype", None)
+            self._var_dtypes[name] = str(dt) if dt is not None else "int64"
+        self._initialized = True
+        return self
+
+    def set_filelist(self, filelist: Sequence[str]):
+        self._filelist = list(filelist)
+
+    def get_filelist(self) -> List[str]:
+        return list(self._filelist)
+
+    def set_batch_size(self, batch_size: int):
+        self._batch_size = int(batch_size)
+
+    def set_thread(self, thread_num: int):
+        self._thread_num = int(thread_num)
+
+    def set_use_var(self, var_list):
+        self.init(batch_size=self._batch_size, thread_num=self._thread_num,
+                  use_var=var_list, pipe_command=self._pipe_command)
+
+    # ------------------------------------------------------------- parsing
+    def _parse_line(self, line: str):
+        toks = line.split()
+        sample, i = [], 0
+        for slot in self._use_var:
+            if i >= len(toks):
+                return None
+            n = int(toks[i])
+            vals = toks[i + 1: i + 1 + n]
+            i += 1 + n
+            dt = self._var_dtypes.get(slot, "int64")
+            arr = np.asarray(vals, np.float32 if "float" in dt else np.int64)
+            sample.append(arr)
+        return sample
+
+    def _read_file(self, path: str):
+        import subprocess
+
+        if self._pipe_command:
+            with open(path, "rb") as f:
+                out = subprocess.run(self._pipe_command, shell=True, stdin=f,
+                                     capture_output=True, check=True).stdout.decode()
+            lines = out.splitlines()
+        else:
+            with open(path) as f:
+                lines = f.read().splitlines()
+        for line in lines:
+            if line.strip():
+                s = self._parse_line(line)
+                if s is not None:
+                    yield s
+
+    def _batched(self, samples):
+        """Group samples into dict-of-array batches keyed by slot name.
+        Variable-length slots are ragged → object arrays are avoided by
+        padding to the batch max (TPU static shapes)."""
+        batch = []
+        for s in samples:
+            batch.append(s)
+            if len(batch) == self._batch_size:
+                yield self._collate(batch)
+                batch = []
+        if batch:
+            yield self._collate(batch)
+
+    def _collate(self, batch):
+        out = {}
+        for si, slot in enumerate(self._use_var):
+            arrs = [b[si] for b in batch]
+            width = max(a.shape[0] for a in arrs)
+            dt = arrs[0].dtype
+            mat = np.zeros((len(arrs), width), dt)
+            for r, a in enumerate(arrs):
+                mat[r, : a.shape[0]] = a
+            out[slot] = mat
+        return out
+
+
+class InMemoryDataset(DatasetBase):
+    """Load-then-shuffle-then-train dataset (parity: dataset.py:410)."""
+
+    def __init__(self):
+        super().__init__()
+        self._memory: List = []
+        self._preload: Optional[List] = None
+
+    # -- reference lifecycle ------------------------------------------------
+    def load_into_memory(self):
+        self._memory = []
+        for path in self._filelist:
+            self._memory.extend(self._read_file(path))
+
+    def preload_into_memory(self, thread_num: Optional[int] = None):
+        # synchronous preload: the async win is IO overlap, which the host
+        # pipeline gets from the DataLoader's prefetch ring when it matters
+        self._preload = []
+        for path in self._filelist:
+            self._preload.extend(self._read_file(path))
+
+    def wait_preload_done(self):
+        if self._preload is not None:
+            self._memory = self._preload
+            self._preload = None
+
+    def local_shuffle(self):
+        random.shuffle(self._memory)
+
+    def global_shuffle(self, fleet=None, thread_num: Optional[int] = None):
+        """Cross-rank shuffle: each rank keeps the samples hashed to it.
+        Single process degenerates to local_shuffle (reference contract:
+        after global_shuffle each sample lives on exactly one rank)."""
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        world = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+        if world > 1:
+            self._memory = [s for i, s in enumerate(self._memory)
+                            if (hash(i) % world) == rank]
+        random.shuffle(self._memory)
+
+    def release_memory(self):
+        self._memory = []
+
+    def get_memory_data_size(self, fleet=None) -> int:
+        return len(self._memory)
+
+    def get_shuffle_data_size(self, fleet=None) -> int:
+        return len(self._memory)
+
+    def slots_shuffle(self, slots: Sequence[str]):
+        idxs = [self._use_var.index(s) for s in slots if s in self._use_var]
+        for si in idxs:
+            col = [m[si] for m in self._memory]
+            random.shuffle(col)
+            for m, v in zip(self._memory, col):
+                m[si] = v
+
+    def __iter__(self):
+        return self._batched(iter(self._memory))
+
+
+class QueueDataset(DatasetBase):
+    """Streaming dataset: files are consumed as a queue, never fully resident
+    (parity: dataset.py:1389)."""
+
+    def __iter__(self):
+        def stream():
+            for path in self._filelist:
+                yield from self._read_file(path)
+
+        return self._batched(stream())

@@ -26,7 +26,8 @@ from ...ops.dispatch import apply
 from ...tensor.tensor import Tensor
 from ..topology import get_hybrid_communicate_group
 
-__all__ = ["VocabParallelEmbedding", "ColumnParallelLinear", "RowParallelLinear", "ParallelCrossEntropy"]
+__all__ = ["VocabParallelEmbedding", "ColumnParallelLinear", "RowParallelLinear",
+           "ParallelCrossEntropy", "split"]
 
 
 def _mp_mesh():
@@ -143,3 +144,38 @@ class ParallelCrossEntropy(nn.Layer):
 
     def forward(self, input, label):  # noqa: A002
         return F.cross_entropy(input, label, reduction="none", ignore_index=self.ignore_index)
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Functional model-parallel op (parity:
+    /root/reference/python/paddle/distributed/fleet/layers/mpu/mp_ops.py:698).
+
+    Builds the matching parallel layer and applies it: ``operation=
+    'embedding'`` -> VocabParallelEmbedding; ``operation='linear'`` with
+    ``axis=0`` -> RowParallelLinear (weight rows split), ``axis=1`` ->
+    ColumnParallelLinear (weight cols split). ``num_partitions`` is advisory
+    on TPU — the actual partition count is the mesh's 'mp' axis size (GSPMD
+    owns the layout). Intended for the captured static-Program world where
+    the call site runs once; in dygraph, construct the layer class directly
+    so parameters persist."""
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1], weight_attr=weight_attr,
+                                       name=name)
+        return layer(x)
+    if operation != "linear":
+        raise ValueError(f"split supports 'linear'|'embedding', got {operation!r}")
+    if axis == 0:
+        # row parallel: the op splits the replicated input along its last dim
+        # itself (GSPMD does this from the weight's 'mp' sharding), so the
+        # caller's x is never pre-split — input_is_parallel=False
+        layer = RowParallelLinear(size[0], size[1], weight_attr=weight_attr,
+                                  has_bias=bias_attr is not False,
+                                  input_is_parallel=False, name=name)
+    elif axis == 1:
+        layer = ColumnParallelLinear(size[0], size[1], weight_attr=weight_attr,
+                                     has_bias=None if bias_attr is not False else False,
+                                     gather_output=gather_out, name=name)
+    else:
+        raise ValueError("axis must be 0 (row parallel) or 1 (column parallel)")
+    return layer(x)

@@ -1,0 +1,61 @@
+"""Distributed persistable IO (parity:
+/root/reference/python/paddle/distributed/io.py:392 save_persistables,
+:132 load_persistables, :357 is_persistable, :464
+load_inference_model_distributed).
+
+TPU-native: a "persistable var" is a Program parameter (captured ``static``
+world) — there is no remote-PS split-fetch here because dense state lives in
+jax.Arrays; PS tables save/load through paddle_tpu.distributed.ps directly.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["save_persistables", "load_persistables", "is_persistable",
+           "load_inference_model_distributed"]
+
+
+def is_persistable(var) -> bool:
+    """parity: io.py:357 — parameters and explicitly-persistable vars."""
+    return bool(getattr(var, "is_parameter", False) or
+                getattr(var, "persistable", False))
+
+
+def _resolve_program(main_program):
+    if main_program is not None:
+        return main_program
+    from ..static import default_main_program
+
+    return default_main_program()
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """Save every persistable var of the program under ``dirname``
+    (parity: io.py:392)."""
+    from ..static import save as static_save
+
+    program = _resolve_program(main_program)
+    os.makedirs(dirname, exist_ok=True)
+    prefix = os.path.join(dirname, filename or "persistables")
+    static_save(program, prefix)
+    return prefix
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    """parity: io.py:132."""
+    from ..static import load as static_load
+
+    program = _resolve_program(main_program)
+    prefix = os.path.join(dirname, filename or "persistables")
+    static_load(program, prefix, executor=executor)
+    return program
+
+
+def load_inference_model_distributed(dirname, executor, model_filename=None,
+                                     params_filename=None):
+    """parity: io.py:464 — load a jit.save'd inference artifact. Distributed
+    PS-table reassembly does not apply: dense params are in the artifact."""
+    from ..static import load_inference_model
+
+    return load_inference_model(os.path.join(dirname, model_filename or "model"),
+                                executor=executor)

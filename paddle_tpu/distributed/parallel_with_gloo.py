@@ -1,0 +1,79 @@
+"""CPU-side rendezvous/barrier (parity:
+/root/reference/python/paddle/distributed/parallel_with_gloo.py:42
+gloo_init_parallel_env, :141 gloo_barrier, gloo_release).
+
+TPU-native: gloo's role (host-side barriers for data-prep/PS processes that
+own no accelerator) is played by the launch KV master — a tiny HTTP KV store
+(paddle_tpu.distributed.launch.master), the same rendezvous the launcher and
+RPC tiers use. No tensor transport: these are control-plane only, exactly how
+the reference uses its gloo-only mode.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+__all__ = ["gloo_init_parallel_env", "gloo_barrier", "gloo_release"]
+
+_gloo_state = {"kv": None, "rank": 0, "world": 1, "seq": 0, "server": None}
+
+
+def gloo_init_parallel_env(rank_id: int, rank_num: int, server_endpoint: str):
+    """Join the host-side group: rank 0 starts the KV master at
+    ``server_endpoint`` ("ip:port"); everyone registers and waits for full
+    membership."""
+    from .launch.master import KVClient, KVServer
+
+    if _gloo_state["kv"] is not None:
+        return
+    ip, port = server_endpoint.rsplit(":", 1)
+    if rank_id == 0:
+        try:
+            _gloo_state["server"] = KVServer(int(port)).start()
+        except OSError:
+            _gloo_state["server"] = None  # already running (launcher-owned)
+    kv = KVClient(server_endpoint)
+    _gloo_state.update(kv=kv, rank=rank_id, world=rank_num)
+    deadline = time.time() + 300
+    registered = False
+    while time.time() < deadline:
+        # retry registration until the (possibly later-starting) KV master is
+        # up — KVClient.put returns False on connection errors
+        if not registered:
+            registered = kv.put(f"/gloo/members/{rank_id}", "1")
+        if registered and len(kv.get_prefix("/gloo/members/")) >= rank_num:
+            return
+        time.sleep(0.05)
+    raise TimeoutError("gloo_init_parallel_env: rendezvous timed out")
+
+
+def gloo_barrier():
+    """All ranks arrive before any leaves (two-phase KV barrier)."""
+    kv, rank, world = _gloo_state["kv"], _gloo_state["rank"], _gloo_state["world"]
+    if kv is None:
+        raise RuntimeError("call gloo_init_parallel_env first")
+    seq = _gloo_state["seq"] = _gloo_state["seq"] + 1
+    kv.put(f"/gloo/barrier/{seq}/{rank}", "1")
+    deadline = time.time() + 300
+    while time.time() < deadline:
+        if len(kv.get_prefix(f"/gloo/barrier/{seq}/")) >= world:
+            return
+        time.sleep(0.02)
+    raise TimeoutError("gloo_barrier timed out")
+
+
+def gloo_release():
+    """Leave the group; rank 0 stops the KV master it started."""
+    kv, rank = _gloo_state["kv"], _gloo_state["rank"]
+    if kv is not None:
+        try:
+            kv.delete(f"/gloo/members/{rank}")
+        except Exception:
+            pass
+    srv = _gloo_state.get("server")
+    if srv is not None:
+        try:
+            srv.stop()
+        except Exception:
+            pass
+    _gloo_state.update(kv=None, rank=0, world=1, seq=0, server=None)

@@ -43,6 +43,12 @@ class _RpcHandler(http.server.BaseHTTPRequestHandler):
     def do_POST(self):
         n = int(self.headers.get("Content-Length", 0))
         payload = self.rfile.read(n)
+        token = _state.get("token")
+        if token and self.headers.get("X-Paddle-Rpc-Token") != token:
+            self.send_response(403)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
         try:
             fn, args, kwargs = pickle.loads(payload)
             result = ("ok", fn(*args, **kwargs))
@@ -84,9 +90,13 @@ def init_rpc(name: str, rank: Optional[int] = None, world_size: Optional[int] = 
     master_endpoint = master_endpoint or os.environ.get("PADDLE_MASTER")
 
     port = _free_port()
-    # bind all interfaces; advertise a peer-reachable address (multi-node
-    # workers resolve each other through the KV master)
-    srv = _Server(("0.0.0.0", port), _RpcHandler)
+    # Single-process / no-master mode never needs to be reachable from other
+    # hosts: bind loopback only.  Multi-node (a KV master exists) binds all
+    # interfaces and advertises a peer-reachable address; an optional shared
+    # secret (PADDLE_RPC_TOKEN) gates unpickling on every request.
+    bind_host = "0.0.0.0" if master_endpoint else "127.0.0.1"
+    _state["token"] = os.environ.get("PADDLE_RPC_TOKEN")
+    srv = _Server((bind_host, port), _RpcHandler)
     threading.Thread(target=srv.serve_forever, daemon=True).start()
     ip = os.environ.get("PADDLE_LOCAL_IP")
     if not ip:
@@ -140,8 +150,11 @@ def get_all_worker_infos() -> List[WorkerInfo]:
 
 
 def _post(info: WorkerInfo, payload: bytes, timeout: float):
+    headers = {}
+    if _state.get("token"):
+        headers["X-Paddle-Rpc-Token"] = _state["token"]
     req = urllib.request.Request(f"http://{info.ip}:{info.port}/", data=payload,
-                                 method="POST")
+                                 headers=headers, method="POST")
     with urllib.request.urlopen(req, timeout=timeout) as r:
         status, value = pickle.loads(r.read())
     if status == "err":
@@ -180,4 +193,5 @@ def shutdown():
         except Exception:
             pass
     _GLOBAL_REGISTRY.pop(name, None)
-    _state.update(server=None, name=None, workers={}, pool=None, kv=None)
+    _state.update(server=None, name=None, workers={}, pool=None, kv=None,
+                  token=None)

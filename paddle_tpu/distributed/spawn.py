@@ -1,0 +1,89 @@
+"""Multi-process launcher-as-a-function (parity:
+/root/reference/python/paddle/distributed/spawn.py:448 spawn).
+
+TPU-native: each spawned process is one JAX *process* in a multi-process
+group — ranks come from the ``PADDLE_TRAINER_*`` env contract (the same one
+``paddle_tpu.distributed.launch`` writes), and a KV master started in the
+parent provides rendezvous. On a single TPU chip real nprocs>1 accelerator
+training is not possible (chips are single-owner); spawn is the CPU-backend /
+host-side path, matching how the reference uses spawn for gloo or single-node
+debug runs.
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+from typing import Optional, Sequence
+
+from .launch.controller import _free_port
+
+__all__ = ["spawn"]
+
+
+def _worker(func, args, rank: int, nprocs: int, master: str, backend: Optional[str],
+            env_overrides: dict):
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    os.environ["PADDLE_MASTER"] = master
+    os.environ["PADDLE_LOCAL_IP"] = "127.0.0.1"
+    os.environ.setdefault("FLAGS_selected_gpus", str(rank))
+    if backend in ("gloo", "cpu", None):
+        # host-side group: don't let child processes fight over the one chip
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.update({k: str(v) for k, v in env_overrides.items()})
+    func(*args)
+
+
+class MultiprocessContext:
+    """Return value of ``spawn(join=False)`` (parity: spawn.py context)."""
+
+    def __init__(self, processes, server=None):
+        self.processes = processes
+        self._server = server  # auto-started KV master, stopped at join
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        try:
+            for p in self.processes:
+                p.join(timeout)
+            failed = [p for p in self.processes if p.exitcode not in (0, None)]
+            if failed:
+                codes = {p.pid: p.exitcode for p in failed}
+                raise RuntimeError(f"spawned process(es) failed: {codes}")
+            return all(p.exitcode == 0 for p in self.processes)
+        finally:
+            if self._server is not None and all(
+                    p.exitcode is not None for p in self.processes):
+                self._server.stop()
+                self._server = None
+
+
+def spawn(func, args=(), nprocs: int = -1, join: bool = True,
+          daemon: bool = False, **options) -> MultiprocessContext:
+    """Run ``func(*args)`` in ``nprocs`` processes with the distributed env
+    contract set (parity: spawn.py:448). ``options``: ``backend``
+    ('gloo'|'xla'), ``master`` ("ip:port"), plus extra env overrides."""
+    from .launch.master import KVServer
+
+    if nprocs <= 0:
+        nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", 0)) or os.cpu_count() or 1
+    backend = options.pop("backend", None)
+    master = options.pop("master", None)
+    server = None
+    if master is None:
+        port = _free_port()
+        server = KVServer(port).start()
+        master = f"127.0.0.1:{port}"
+
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_worker,
+                        args=(func, tuple(args), rank, nprocs, master, backend,
+                              dict(options)),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    context = MultiprocessContext(procs, server=server)
+    if join:
+        context.join()
+    return context

@@ -85,8 +85,10 @@ class GPTAttention(nn.Layer):
         qkv = self.qkv(hidden)
 
         def split_qkv(v):
-            # [B, S, 3H] -> three [B, S, nh, hd]; interleave so each head's
-            # q/k/v stay adjacent under mp sharding of the 3H dim
+            # [B, S, 3H] -> three [B, S, nh, hd]. 3-major layout (all q, then
+            # k, then v along 3H): under mp sharding of the 3H dim the
+            # reshape crosses shard boundaries, so GSPMD reshards here; XLA
+            # folds that into the surrounding fusion on the bench shapes.
             v = v.reshape(b, s, 3, self.num_heads, self.head_dim)
             return v[:, :, 0], v[:, :, 1], v[:, :, 2]
 

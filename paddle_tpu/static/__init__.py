@@ -45,6 +45,18 @@ from ..jit.api import InputSpec  # noqa: E402  (shared spec type)
 Variable = Tensor  # static-graph "Variable" is the same symbolic Tensor
 
 
+def __getattr__(name):
+    # lazy: static.nn pulls in nn.functional + vision; avoid import cycles at
+    # paddle_tpu package init time
+    if name == "nn":
+        import importlib
+
+        mod = importlib.import_module(__name__ + ".nn")
+        globals()["nn"] = mod
+        return mod
+    raise AttributeError(name)
+
+
 class Program:
     """A captured op list + feed/fetch bookkeeping (parity:
     python/paddle/base/framework.py Program; block structure collapsed —

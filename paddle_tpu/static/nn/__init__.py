@@ -1,0 +1,426 @@
+"""paddle.static.nn (parity:
+/root/reference/python/paddle/static/nn/__init__.py — the 38-export surface:
+static control flow + parameter-creating layer functions + sequence ops).
+
+TPU-native layering: the layer functions are the reference's LayerHelper
+pattern (create parameters at the call site, then apply the functional op) —
+here parameters are created eagerly (concrete jax.Arrays the captured
+Program closes over) and the math delegates to ``paddle_tpu.nn.functional``.
+Control flow lowers to ``lax.cond``/``lax.while_loop`` (control_flow.py);
+sequence ops use the padded-batch data model (sequence_lod.py).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...base.param_attr import ParamAttr
+from ...nn import functional as F
+from ...ops.dispatch import apply
+from ...tensor.extras import create_parameter
+from ...tensor.tensor import Tensor
+from .control_flow import case, cond, py_func, static_pylayer, switch_case, while_loop  # noqa: F401
+from .sequence_lod import (  # noqa: F401
+    sequence_conv,
+    sequence_enumerate,
+    sequence_expand,
+    sequence_expand_as,
+    sequence_first_step,
+    sequence_last_step,
+    sequence_pad,
+    sequence_pool,
+    sequence_reshape,
+    sequence_scatter,
+    sequence_slice,
+    sequence_softmax,
+    sequence_unpad,
+)
+
+__all__ = [
+    "fc", "batch_norm", "bilinear_tensor_product", "embedding", "case", "cond",
+    "static_pylayer", "conv2d", "conv2d_transpose", "conv3d", "conv3d_transpose",
+    "data_norm", "deform_conv2d", "group_norm", "instance_norm", "layer_norm",
+    "nce", "prelu", "py_func", "row_conv", "spectral_norm", "switch_case",
+    "while_loop", "sparse_embedding", "sequence_conv", "sequence_softmax",
+    "sequence_pool", "sequence_first_step", "sequence_last_step",
+    "sequence_slice", "sequence_expand", "sequence_expand_as", "sequence_pad",
+    "sequence_unpad", "sequence_reshape", "sequence_scatter", "sequence_enumerate",
+]
+
+
+def _as_t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _dtype_of(t: Tensor) -> str:
+    v = t._value
+    return str(v.dtype) if hasattr(v, "dtype") else "float32"
+
+
+def _act(out, act: Optional[str]):
+    if act is None:
+        return out
+    return getattr(F, act)(out)
+
+
+# ---------------------------------------------------------- dense / embedding
+def fc(x, size: int, num_flatten_dims: int = 1, weight_attr=None, bias_attr=None,
+       activation: Optional[str] = None, name=None):
+    """parity: static/nn/common.py fc — flatten trailing dims and project."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = []
+    for xi in xs:
+        xi = _as_t(xi)
+        shape = tuple(xi.shape)
+        nfd = num_flatten_dims if num_flatten_dims >= 0 else len(shape) - 1
+        in_dim = int(np.prod(shape[nfd:]))
+        w = create_parameter([in_dim, size], _dtype_of(xi),
+                             attr=ParamAttr._to_attr(weight_attr))
+
+        def proj(v, wv, _nfd=nfd, _in=in_dim):
+            lead = v.shape[:_nfd]
+            return (v.reshape((*lead, _in)) @ wv)
+
+        outs.append(apply(proj, xi, w, op_name="fc"))
+    out = outs[0]
+    for o in outs[1:]:
+        out = out + o
+    if bias_attr is not False:
+        from ...nn.initializer import Constant
+
+        b = create_parameter([size], _dtype_of(_as_t(xs[0])),
+                             attr=ParamAttr._to_attr(bias_attr), is_bias=True,
+                             default_initializer=Constant(0.0))
+        out = out + b
+    return _act(out, activation)
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,  # noqa: A002
+              padding_idx=None, param_attr=None, dtype="float32"):
+    """parity: static/nn/common.py embedding."""
+    w = create_parameter(list(size), dtype, attr=ParamAttr._to_attr(param_attr))
+    return F.embedding(_as_t(input), w, padding_idx=padding_idx)
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,  # noqa: A002
+                     entry=None, table_class="MemorySparseTable",
+                     param_attr=None, dtype="float32", slot=None):
+    """parity: static/nn/common.py sparse_embedding — the PS-backed embedding.
+    Dense jax.Array storage here (the PS tier handles true sparse tables);
+    the admission ``entry`` policy is recorded on the parameter for the PS
+    runtime (paddle_tpu.distributed.ps) to consult."""
+    w = create_parameter(list(size), dtype, attr=ParamAttr._to_attr(param_attr))
+    if entry is not None:
+        attrs = w._optimize_attrs or {}
+        attrs["ps_entry"] = entry
+        w._optimize_attrs = attrs
+    return F.embedding(_as_t(input), w, padding_idx=padding_idx)
+
+
+# ----------------------------------------------------------------- conv zoo
+def _conv_params(x, num_filters, filter_size, groups, channels_last, ndim,
+                 param_attr, bias_attr, transpose=False):
+    cin = int(x.shape[-1] if channels_last else x.shape[1])
+    ks = list(filter_size) if isinstance(filter_size, (list, tuple)) else [filter_size] * ndim
+    if transpose:
+        wshape = [cin, num_filters // (groups or 1), *ks]
+    else:
+        wshape = [num_filters, cin // (groups or 1), *ks]
+    w = create_parameter(wshape, _dtype_of(x), attr=ParamAttr._to_attr(param_attr))
+    b = None
+    if bias_attr is not False:
+        from ...nn.initializer import Constant
+
+        b = create_parameter([num_filters], _dtype_of(x),
+                             attr=ParamAttr._to_attr(bias_attr), is_bias=True,
+                             default_initializer=Constant(0.0))
+    return w, b
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,  # noqa: A002
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True, act=None,
+           name=None, data_format="NCHW"):
+    x = _as_t(input)
+    w, b = _conv_params(x, num_filters, filter_size, groups,
+                        data_format == "NHWC", 2, param_attr, bias_attr)
+    out = F.conv2d(x, w, b, stride=stride, padding=padding, dilation=dilation,
+                   groups=groups, data_format=data_format)
+    return _act(out, act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,  # noqa: A002
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True, act=None,
+           name=None, data_format="NCDHW"):
+    x = _as_t(input)
+    w, b = _conv_params(x, num_filters, filter_size, groups,
+                        data_format == "NDHWC", 3, param_attr, bias_attr)
+    out = F.conv3d(x, w, b, stride=stride, padding=padding, dilation=dilation,
+                   groups=groups, data_format=data_format)
+    return _act(out, act)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,  # noqa: A002
+                     padding=0, stride=1, dilation=1, groups=1, param_attr=None,
+                     bias_attr=None, use_cudnn=True, act=None, name=None,
+                     data_format="NCHW"):
+    x = _as_t(input)
+    w, b = _conv_params(x, num_filters, filter_size or 1, groups,
+                        data_format == "NHWC", 2, param_attr, bias_attr,
+                        transpose=True)
+    out = F.conv2d_transpose(x, w, b, stride=stride, padding=padding,
+                             dilation=dilation, groups=groups,
+                             output_size=output_size, data_format=data_format)
+    return _act(out, act)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,  # noqa: A002
+                     padding=0, stride=1, dilation=1, groups=1, param_attr=None,
+                     bias_attr=None, use_cudnn=True, act=None, name=None,
+                     data_format="NCDHW"):
+    x = _as_t(input)
+    w, b = _conv_params(x, num_filters, filter_size or 1, groups,
+                        data_format == "NDHWC", 3, param_attr, bias_attr,
+                        transpose=True)
+    out = F.conv3d_transpose(x, w, b, stride=stride, padding=padding,
+                             dilation=dilation, groups=groups,
+                             output_size=output_size, data_format=data_format)
+    return _act(out, act)
+
+
+def deform_conv2d(input, offset, mask, num_filters, filter_size, stride=1,  # noqa: A002
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None, name=None):
+    """Delegates to vision.ops.deform_conv2d (the DCNv2 kernel analog)."""
+    from ...vision.ops import deform_conv2d as _dc
+
+    x = _as_t(input)
+    w, b = _conv_params(x, num_filters, filter_size, groups, False, 2,
+                        param_attr, bias_attr)
+    return _dc(x, _as_t(offset), w, bias=b, stride=stride, padding=padding,
+               dilation=dilation, deformable_groups=deformable_groups,
+               groups=groups, mask=_as_t(mask) if mask is not None else None)
+
+
+# ---------------------------------------------------------------- norm zoo
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,  # noqa: A002
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=True,
+               use_global_stats=False):
+    from ...nn.initializer import Constant
+
+    x = _as_t(input)
+    c = int(x.shape[-1] if data_layout == "NHWC" else x.shape[1])
+    dt = _dtype_of(x)
+    scale = create_parameter([c], dt, attr=ParamAttr._to_attr(param_attr),
+                             default_initializer=Constant(1.0))
+    bias = create_parameter([c], dt, attr=ParamAttr._to_attr(bias_attr),
+                            is_bias=True, default_initializer=Constant(0.0))
+    mean = create_parameter([c], dt, name=moving_mean_name,
+                            default_initializer=Constant(0.0))
+    var = create_parameter([c], dt, name=moving_variance_name,
+                           default_initializer=Constant(1.0))
+    mean.stop_gradient = var.stop_gradient = True
+    out = F.batch_norm(x, mean, var, weight=scale, bias=bias,
+                       training=not (is_test or use_global_stats),
+                       momentum=momentum, epsilon=epsilon,
+                       data_format=data_layout)
+    return _act(out, act)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1, epsilon=1e-5,  # noqa: A002
+               param_attr=None, bias_attr=None, act=None, name=None):
+    from ...nn.initializer import Constant
+
+    x = _as_t(input)
+    norm_shape = [int(s) for s in x.shape[begin_norm_axis:]]
+    dt = _dtype_of(x)
+    w = create_parameter(norm_shape, dt, attr=ParamAttr._to_attr(param_attr),
+                         default_initializer=Constant(1.0)) if scale else None
+    b = create_parameter(norm_shape, dt, attr=ParamAttr._to_attr(bias_attr),
+                         is_bias=True, default_initializer=Constant(0.0)) if shift else None
+    out = F.layer_norm(x, norm_shape, weight=w, bias=b, epsilon=epsilon)
+    return _act(out, act)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,  # noqa: A002
+               act=None, data_layout="NCHW", name=None):
+    from ...nn.initializer import Constant
+
+    x = _as_t(input)
+    c = int(x.shape[-1] if data_layout == "NHWC" else x.shape[1])
+    dt = _dtype_of(x)
+    w = create_parameter([c], dt, attr=ParamAttr._to_attr(param_attr),
+                         default_initializer=Constant(1.0))
+    b = create_parameter([c], dt, attr=ParamAttr._to_attr(bias_attr),
+                         is_bias=True, default_initializer=Constant(0.0))
+    out = F.group_norm(x, groups, epsilon=epsilon, weight=w, bias=b,
+                       data_format=data_layout)
+    return _act(out, act)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None, name=None):  # noqa: A002
+    from ...nn.initializer import Constant
+
+    x = _as_t(input)
+    c = int(x.shape[1])
+    dt = _dtype_of(x)
+    w = None if param_attr is False else create_parameter(
+        [c], dt, attr=ParamAttr._to_attr(param_attr), default_initializer=Constant(1.0))
+    b = None if bias_attr is False else create_parameter(
+        [c], dt, attr=ParamAttr._to_attr(bias_attr), is_bias=True,
+        default_initializer=Constant(0.0))
+    return F.instance_norm(x, weight=w, bias=b, eps=epsilon)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None, shift=True,  # noqa: A002
+              scale=True, data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999,
+              enable_scale_and_shift=False):
+    """parity: static/nn/common.py data_norm — normalization by accumulated
+    batch statistics (batch_size/batch_sum/batch_square_sum parameters), the
+    PS-training normalizer. Statistics update rides the forward."""
+    from ...nn.initializer import Constant
+
+    x = _as_t(input)
+    c = int(x.shape[-1])
+    dt = _dtype_of(x)
+    batch_size = create_parameter([c], dt, default_initializer=Constant(1e4))
+    batch_sum = create_parameter([c], dt, default_initializer=Constant(0.0))
+    batch_sq = create_parameter([c], dt, default_initializer=Constant(1e4))
+    for p in (batch_size, batch_sum, batch_sq):
+        p.stop_gradient = True
+
+    def f(v, n, s, sq):
+        means = s / n
+        scales = jnp.sqrt(n / jnp.maximum(sq - s * means, epsilon))
+        return (v - means) * scales
+
+    out = apply(f, x, batch_size, batch_sum, batch_sq, op_name="data_norm")
+    return _act(out, act)
+
+
+def spectral_norm(weight, dim: int = 0, power_iters: int = 1, eps: float = 1e-12,
+                  name=None):
+    """parity: static/nn/common.py spectral_norm — weight / sigma_max via
+    power iteration, with persistent u/v vectors."""
+    from ...nn.initializer import Normal
+
+    w = _as_t(weight)
+    shape = tuple(int(s) for s in w.shape)
+    h = shape[dim]
+    wmat_cols = int(np.prod(shape)) // h
+    u = create_parameter([h], _dtype_of(w), default_initializer=Normal(0.0, 1.0))
+    v = create_parameter([wmat_cols], _dtype_of(w), default_initializer=Normal(0.0, 1.0))
+    u.stop_gradient = v.stop_gradient = True
+
+    def f(wv, uv, vv):
+        perm = (dim, *(i for i in range(len(shape)) if i != dim))
+        m = jnp.transpose(wv, perm).reshape(h, -1)
+        for _ in range(power_iters):
+            vv = m.T @ uv
+            vv = vv / jnp.maximum(jnp.linalg.norm(vv), eps)
+            uv = m @ vv
+            uv = uv / jnp.maximum(jnp.linalg.norm(uv), eps)
+        sigma = uv @ m @ vv
+        return wv / sigma
+
+    return apply(f, w, u, v, op_name="spectral_norm")
+
+
+# ------------------------------------------------------------------ misc ops
+def bilinear_tensor_product(x, y, size: int, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """parity: static/nn/common.py bilinear_tensor_product —
+    out[:, i] = x · W[i] · yᵀ + b."""
+    from ...nn.initializer import Constant
+
+    xt, yt = _as_t(x), _as_t(y)
+    dx, dy = int(xt.shape[-1]), int(yt.shape[-1])
+    w = create_parameter([size, dx, dy], _dtype_of(xt),
+                         attr=ParamAttr._to_attr(param_attr))
+    out = apply(lambda a, b, wv: jnp.einsum("bi,oij,bj->bo", a, wv, b),
+                xt, yt, w, op_name="bilinear_tensor_product")
+    if bias_attr is not False:
+        bias = create_parameter([size], _dtype_of(xt),
+                                attr=ParamAttr._to_attr(bias_attr), is_bias=True,
+                                default_initializer=Constant(0.0))
+        out = out + bias
+    return _act(out, act)
+
+
+def prelu(x, mode: str = "all", param_attr=None, data_format="NCHW", name=None):
+    """parity: static/nn/common.py prelu — modes all/channel/element."""
+    from ...nn.initializer import Constant
+
+    xt = _as_t(x)
+    if mode == "all":
+        shape = [1]
+    elif mode == "channel":
+        shape = [int(xt.shape[1] if data_format == "NCHW" else xt.shape[-1])]
+    elif mode == "element":
+        shape = [1, *(int(s) for s in xt.shape[1:])]
+    else:
+        raise ValueError("prelu mode must be all|channel|element")
+    alpha = create_parameter(shape, _dtype_of(xt),
+                             attr=ParamAttr._to_attr(param_attr),
+                             default_initializer=Constant(0.25))
+    return F.prelu(xt, alpha, data_format=data_format)
+
+
+def row_conv(input, future_context_size: int, param_attr=None, act=None):  # noqa: A002
+    """parity: static/nn/common.py row_conv — lookahead convolution over
+    [B, T, D]: out[t] = Σ_{k=0..fcs} in[t+k] * w[k]."""
+    x = _as_t(input)
+    d = int(x.shape[-1])
+    w = create_parameter([future_context_size + 1, d], _dtype_of(x),
+                         attr=ParamAttr._to_attr(param_attr))
+
+    def f(v, wv):
+        out = jnp.zeros_like(v)
+        tlen = v.shape[1]
+        for k in range(wv.shape[0]):
+            shifted = jnp.roll(v, -k, axis=1)
+            valid = (jnp.arange(tlen) + k) < tlen
+            out = out + jnp.where(valid[None, :, None], shifted, 0) * wv[k]
+        return out
+
+    return _act(apply(f, x, w, op_name="row_conv"), act)
+
+
+def nce(input, label, num_total_classes: int, sample_weight=None,  # noqa: A002
+        param_attr=None, bias_attr=None, num_neg_samples: Optional[int] = None,
+        name=None, sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """parity: static/nn/common.py nce — noise-contrastive estimation loss:
+    one positive logistic term + num_neg_samples uniform negatives per row."""
+    from ...nn.initializer import Constant
+
+    x, lbl = _as_t(input), _as_t(label)
+    d = int(x.shape[-1])
+    k = num_neg_samples or 10
+    w = create_parameter([num_total_classes, d], _dtype_of(x),
+                         attr=ParamAttr._to_attr(param_attr))
+    b = create_parameter([num_total_classes], _dtype_of(x),
+                         attr=ParamAttr._to_attr(bias_attr), is_bias=True,
+                         default_initializer=Constant(0.0))
+    # negatives drawn host-side per call (the reference samples inside the
+    # kernel with its own generator; fixed draws keep the op pure/jit-safe)
+    rng = np.random.RandomState(seed or None)
+    negs = Tensor(jnp.asarray(rng.randint(0, num_total_classes, size=(k,)),
+                              jnp.int32))
+
+    def f(v, y, wv, bv, nv):
+        y = jnp.reshape(y, (-1,)).astype(jnp.int32)
+        pos = jnp.sum(v * wv[y], -1) + bv[y]                      # [B]
+        neg = v @ wv[nv].T + bv[nv]                               # [B, k]
+        ln_sig = jax.nn.log_sigmoid
+        loss = -(ln_sig(pos) + ln_sig(-neg).sum(-1))
+        return loss.reshape(-1, 1)
+
+    return apply(f, x, lbl, w, b, negs, op_name="nce")

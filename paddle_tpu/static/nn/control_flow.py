@@ -1,0 +1,416 @@
+"""Static control flow (parity:
+/root/reference/python/paddle/static/nn/control_flow.py — cond, while_loop,
+case, switch_case; /root/reference/python/paddle/static/nn/static_pylayer.py;
+/root/reference/python/paddle/base/layers/layer_function_generator.py py_func).
+
+TPU-native lowering: the reference builds conditional sub-blocks in the
+ProgramDesc and runs them through interpreter control-flow instructions
+(paddle/fluid/pir/dialect/operator/ir/control_flow_op.h). Here the same API
+lowers to ``lax.cond`` / ``lax.while_loop`` — XLA's native control flow —
+in whichever execution world the call happens:
+
+1. eager with a concrete predicate → plain Python branch (constant fold);
+2. inside a jit/to_static trace (predicate is a tracer) → ``lax.cond`` with
+   branch closures traced in place;
+3. inside a captured ``static.Program`` (predicate is symbolic) → each branch
+   is traced into a sub-program; ONE program op is recorded whose pure fn
+   replays the branches under ``lax.cond``, with every symbolic tensor the
+   branches capture passed as an explicit operand.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...tensor.tensor import Tensor
+
+__all__ = ["cond", "while_loop", "case", "switch_case", "static_pylayer", "py_func"]
+
+
+# ------------------------------------------------------------- tree helpers
+def _flatten(out) -> Tuple[List[Tensor], Any]:
+    """Flatten a nest of Tensors (tuple/list/dict) into leaves + treedef."""
+    leaves, treedef = jax.tree_util.tree_flatten(
+        out, is_leaf=lambda x: isinstance(x, Tensor))
+    ts = [x if isinstance(x, Tensor) else Tensor(jnp.asarray(x)) for x in leaves]
+    return ts, treedef
+
+
+def _unflatten(treedef, tensors: Sequence[Tensor]):
+    return jax.tree_util.tree_unflatten(treedef, list(tensors))
+
+
+def _is_sym(t: Tensor) -> bool:
+    return isinstance(t._value, jax.ShapeDtypeStruct)
+
+
+def _is_tracer(v) -> bool:
+    return isinstance(v, jax.core.Tracer)
+
+
+def _as_tensor(x) -> Tensor:
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+# ------------------------------------------------- sub-program branch tracing
+class _Branch:
+    """One branch traced into its own sub-Program (capture mode)."""
+
+    def __init__(self, fn: Callable, args: Sequence[Tensor] = ()):
+        from .. import Program, program_guard
+
+        self.args = list(args)
+        sub = Program()
+        with program_guard(sub):
+            out = fn(*args)
+        self.ops = list(sub.ops)
+        self.out_ts, self.treedef = _flatten(out)
+        produced = set()
+        for _, _, outs, _ in self.ops:
+            produced.update(id(o) for o in outs)
+        arg_ids = {id(a) for a in self.args}
+        # externals: symbolic tensors read (or returned) that this branch
+        # neither produced nor received as a loop/branch argument
+        self.externals: List[Tensor] = []
+        seen = set()
+
+        def note(t):
+            if (id(t) not in produced and id(t) not in arg_ids
+                    and id(t) not in seen and _is_sym(t)):
+                seen.add(id(t))
+                self.externals.append(t)
+
+        for _, ins, _, _ in self.ops:
+            for t in ins:
+                note(t)
+        for t in self.out_ts:
+            note(t)
+
+    def replay(self, env: dict):
+        """Execute the recorded ops over raw values in ``env`` (id → value);
+        returns the branch's raw outputs."""
+        env = dict(env)
+        for fn, ins, outs, _ in self.ops:
+            vals = [env[id(t)] if id(t) in env else t._value for t in ins]
+            res = fn(*vals)
+            rs = list(res) if isinstance(res, (tuple, list)) else [res]
+            for o, r in zip(outs, rs):
+                env[id(o)] = r
+        return tuple(env[id(t)] if id(t) in env else t._value for t in self.out_ts)
+
+
+def _merge_externals(*branches: _Branch) -> List[Tensor]:
+    ext, seen = [], set()
+    for b in branches:
+        for t in b.externals:
+            if id(t) not in seen:
+                seen.add(id(t))
+                ext.append(t)
+    return ext
+
+
+def _check_same_structure(a: _Branch, b: _Branch, what: str):
+    if a.treedef != b.treedef or len(a.out_ts) != len(b.out_ts):
+        raise ValueError(f"{what}: branch outputs must have identical structure "
+                         f"({a.treedef} vs {b.treedef})")
+    for x, y in zip(a.out_ts, b.out_ts):
+        sx = tuple(jnp.shape(x._value)) if not _is_sym(x) else tuple(x._value.shape)
+        sy = tuple(jnp.shape(y._value)) if not _is_sym(y) else tuple(y._value.shape)
+        if sx != sy:
+            raise ValueError(f"{what}: branch output shapes differ: {sx} vs {sy}")
+
+
+# --------------------------------------------------------------------- cond
+def cond(pred, true_fn: Callable = None, false_fn: Callable = None, name=None,
+         return_names=None):
+    """parity: static/nn/control_flow.py cond — run ``true_fn()`` when pred
+    else ``false_fn()``; both must return structurally identical nests."""
+    from ...ops import dispatch
+
+    pred_t = _as_tensor(pred)
+    pv = pred_t._value
+
+    # capture mode with a symbolic predicate → record one lax.cond op
+    if dispatch._static_capture and _is_sym(pred_t):
+        tb = _Branch(true_fn)
+        fb = _Branch(false_fn)
+        _check_same_structure(tb, fb, "cond")
+        ext = _merge_externals(tb, fb)
+
+        def cond_op(pred_val, *ext_vals):
+            env = {id(t): v for t, v in zip(ext, ext_vals)}
+            return lax.cond(jnp.reshape(pred_val, ()).astype(bool),
+                            lambda: tb.replay(env), lambda: fb.replay(env))
+
+        from .. import _capture
+
+        out = _capture(cond_op, [pred_t, *ext], "cond")
+        outs = list(out) if isinstance(out, (tuple, list)) else [out]
+        return _unflatten(tb.treedef, outs)
+
+    # traced predicate inside jit/to_static → lax.cond in place
+    if _is_tracer(pv):
+        trees = {}
+
+        def branch(fn, key):
+            def run():
+                ts, treedef = _flatten(fn())
+                trees[key] = treedef
+                return tuple(t._value for t in ts)
+
+            return run
+
+        out_vals = lax.cond(jnp.reshape(pv, ()).astype(bool),
+                            branch(true_fn, "t"), branch(false_fn, "f"))
+        if trees["t"] != trees["f"]:
+            raise ValueError("cond: true_fn/false_fn must return the same "
+                             f"structure ({trees['t']} vs {trees['f']})")
+        return _unflatten(trees["t"], [Tensor(v) for v in out_vals])
+
+    # concrete predicate → constant fold
+    return true_fn() if bool(np.asarray(pv)) else false_fn()
+
+
+# --------------------------------------------------------------- while_loop
+def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars, is_test=False,
+               name=None, max_iters: Optional[int] = None):
+    """parity: control_flow.py while_loop — iterate ``body`` while ``cond``;
+    lowers to ``lax.while_loop`` (shapes must be loop-invariant, the XLA
+    contract the reference's dynamic-shape LoD world doesn't have).
+
+    ``max_iters`` (TPU extension): reverse-mode differentiation through an
+    unbounded ``lax.while_loop`` is impossible (residual storage is unbounded
+    — the reference's interpreter records the dynamic trip count instead,
+    which XLA's static world cannot). Passing ``max_iters`` lowers to a
+    fixed-length masked ``lax.scan`` — iterations after the condition goes
+    False are identity — which XLA reverse-differentiates; required when
+    training through the loop."""
+    from ...ops import dispatch
+
+    var_ts, treedef = _flatten(loop_vars)
+
+    # capture mode: loop vars symbolic → record one lax.while_loop op
+    if dispatch._static_capture and any(_is_sym(t) for t in var_ts):
+        cb = _Branch(lambda *a: cond_fn(*_unflatten(treedef, a)), var_ts)
+        bb = _Branch(lambda *a: body_fn(*_unflatten(treedef, a)), var_ts)
+        if bb.treedef != treedef or len(bb.out_ts) != len(var_ts):
+            raise ValueError("while_loop: body must return the same structure "
+                             "as loop_vars")
+        ext = _merge_externals(cb, bb)
+        n = len(var_ts)
+
+        def while_op(*vals):
+            carry0, ext_vals = tuple(vals[:n]), vals[n:]
+            env_ext = {id(t): v for t, v in zip(ext, ext_vals)}
+
+            def c(carry):
+                env = dict(env_ext)
+                env.update({id(t): v for t, v in zip(var_ts, carry)})
+                return jnp.reshape(cb.replay(env)[0], ()).astype(bool)
+
+            def b(carry):
+                env = dict(env_ext)
+                env.update({id(t): v for t, v in zip(var_ts, carry)})
+                return bb.replay(env)
+
+            return _lower_while(c, b, carry0, max_iters)
+
+        from .. import _capture
+
+        out = _capture(while_op, [*var_ts, *ext], "while_loop")
+        outs = list(out) if isinstance(out, (tuple, list)) else [out]
+        return _unflatten(treedef, outs)
+
+    # traced loop vars → lax.while_loop in place
+    if any(_is_tracer(t._value) for t in var_ts):
+        def c(carry):
+            r = cond_fn(*_unflatten(treedef, [Tensor(v) for v in carry]))
+            return jnp.reshape(_as_tensor(r)._value, ()).astype(bool)
+
+        def b(carry):
+            out = body_fn(*_unflatten(treedef, [Tensor(v) for v in carry]))
+            ts, td = _flatten(out)
+            if td != treedef:
+                raise ValueError("while_loop: body must return the same "
+                                 "structure as loop_vars")
+            return tuple(t._value for t in ts)
+
+        out_vals = _lower_while(c, b, tuple(t._value for t in var_ts), max_iters)
+        return _unflatten(treedef, [Tensor(v) for v in out_vals])
+
+    # concrete eager → Python loop
+    vars_now = _unflatten(treedef, var_ts)
+    while bool(np.asarray(_as_tensor(cond_fn(*vars_now))._value)):
+        out = body_fn(*vars_now)
+        ts, td = _flatten(out)
+        if td != treedef:
+            raise ValueError("while_loop: body must return the same structure "
+                             "as loop_vars")
+        vars_now = _unflatten(td, ts)
+    return vars_now
+
+
+def _lower_while(c, b, carry0, max_iters: Optional[int]):
+    """Unbounded lax.while_loop, or (with max_iters) the reverse-
+    differentiable masked-scan form: each of the max_iters steps applies the
+    body only while the condition holds, else passes the carry through."""
+    if max_iters is None:
+        return lax.while_loop(c, b, carry0)
+
+    def step(carry, _):
+        cont = c(carry)
+        new = b(carry)
+        merged = tuple(jnp.where(cont, nv, cv) for nv, cv in zip(new, carry))
+        return merged, None
+
+    out, _ = lax.scan(step, tuple(carry0), None, length=int(max_iters))
+    return out
+
+
+# --------------------------------------------------------------------- case
+def case(pred_fn_pairs, default: Optional[Callable] = None, name=None):
+    """parity: control_flow.py case — first true predicate wins; when
+    ``default`` is None the last pair's fn is the default (reference
+    contract). Lowers to a nested cond chain."""
+    pairs = list(pred_fn_pairs)
+    if not pairs:
+        raise ValueError("case: pred_fn_pairs must be non-empty")
+    for p in pairs:
+        if not (isinstance(p, (tuple, list)) and len(p) == 2 and callable(p[1])):
+            raise TypeError("case: each element must be a (pred, fn) pair")
+    if default is None:
+        default = pairs[-1][1]
+        pairs = pairs[:-1]
+        if not pairs:
+            return default()
+
+    pred, fn = pairs[0]
+    rest = pairs[1:]
+    if rest:
+        return cond(pred, fn, lambda: case(rest, default))
+    return cond(pred, fn, default)
+
+
+def switch_case(branch_index, branch_fns, default: Optional[Callable] = None,
+                name=None):
+    """parity: control_flow.py switch_case — dispatch on an integer index.
+    ``branch_fns``: dict{int: fn} | list[(int, fn)] | list[fn] (keys 0..n-1).
+    Reduces to an equality-predicate case chain (nested ``lax.cond``)."""
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    elif branch_fns and isinstance(branch_fns[0], (tuple, list)):
+        items = sorted((int(k), f) for k, f in branch_fns)
+    else:
+        items = list(enumerate(branch_fns))
+    if not items:
+        raise ValueError("switch_case: branch_fns must be non-empty")
+    if default is None:
+        default = items[-1][1]  # reference: highest key is the fallback
+    idx_t = _as_tensor(branch_index)
+    from ...tensor import logic as _logic
+
+    pairs = [(_logic.equal(idx_t, _as_tensor(np.asarray(k, np.int64))), fn)
+             for k, fn in items]
+    return case(pairs, default)
+
+
+# ------------------------------------------------------------ static_pylayer
+def static_pylayer(forward_fn: Callable, inputs: Sequence, backward_fn=None,
+                   name=None):
+    """parity: static/nn/static_pylayer.py — a forward fn with a user-supplied
+    backward, usable in all three execution worlds via ``jax.custom_vjp``
+    dispatched through the op chokepoint (so Program capture records it)."""
+    from ...autograd import tape
+    from ...ops.dispatch import apply
+
+    ins = [_as_tensor(x) for x in inputs]
+    if backward_fn is None:
+        out = forward_fn(*ins)
+        ts, treedef = _flatten(out)
+        for t in ts:
+            t.stop_gradient = True  # reference: no backward ⇒ no grad path
+        return _unflatten(treedef, ts)
+
+    treedef_box = {}
+
+    @jax.custom_vjp
+    def f(*vals):
+        with tape.no_grad():
+            out = forward_fn(*[Tensor(v, stop_gradient=True) for v in vals])
+        ts, treedef_box["td"] = _flatten(out)
+        return tuple(t._value for t in ts)
+
+    def f_fwd(*vals):
+        return f(*vals), None
+
+    def f_bwd(_, gs):
+        with tape.no_grad():
+            gin = backward_fn(*[Tensor(g, stop_gradient=True) for g in gs])
+        gts, _ = _flatten(gin)
+        return tuple(g._value for g in gts)
+
+    f.defvjp(f_fwd, f_bwd)
+    out = apply(f, *ins, op_name="static_pylayer")
+    outs = out if isinstance(out, list) else [out]
+    td = treedef_box.get("td")
+    return _unflatten(td, outs) if td is not None else (
+        outs[0] if len(outs) == 1 else tuple(outs))
+
+
+# ------------------------------------------------------------------- py_func
+def py_func(func: Callable, x, out, backward_func=None,
+            skip_vars_in_backward_input=None, name=None):
+    """parity: base py_func — run arbitrary host Python inside the graph.
+    Lowers to ``jax.pure_callback`` (the XLA host-callback mechanism), so it
+    stays jit-safe; ``out`` supplies the result shape/dtype contract."""
+    from ...ops.dispatch import apply
+
+    ins = [_as_tensor(t) for t in (x if isinstance(x, (list, tuple)) else [x])]
+    out_list = out if isinstance(out, (list, tuple)) else [out]
+    specs = [jax.ShapeDtypeStruct(tuple(t.shape), t._value.dtype
+                                  if not _is_sym(t) else t._value.dtype)
+             for t in out_list]
+
+    def host(*arrs):
+        res = func(*arrs)
+        rs = res if isinstance(res, (tuple, list)) else [res]
+        return tuple(np.asarray(r, s.dtype).reshape(s.shape)
+                     for r, s in zip(rs, specs))
+
+    def op(*vals):
+        res = jax.pure_callback(host, tuple(specs), *vals)
+        return res if len(specs) > 1 else res[0]
+
+    if backward_func is not None:
+        g_specs = [jax.ShapeDtypeStruct(tuple(jnp.shape(t._value))
+                                        if not _is_sym(t) else tuple(t._value.shape),
+                                        t._value.dtype) for t in ins]
+
+        @jax.custom_vjp
+        def op_vjp(*vals):
+            return op(*vals)
+
+        def fwd(*vals):
+            return op_vjp(*vals), None
+
+        def bwd(_, gs):
+            gseq = gs if isinstance(gs, (tuple, list)) else (gs,)
+
+            def ghost(*arrs):
+                res = backward_func(*arrs)
+                rs = res if isinstance(res, (tuple, list)) else [res]
+                return tuple(np.asarray(r, s.dtype).reshape(s.shape)
+                             for r, s in zip(rs, g_specs))
+
+            return jax.pure_callback(ghost, tuple(g_specs), *gseq)
+
+        op_vjp.defvjp(fwd, bwd)
+        result = apply(op_vjp, *ins, op_name="py_func")
+    else:
+        result = apply(op, *ins, op_name="py_func")
+    return result

@@ -1,0 +1,278 @@
+"""Sequence ops (parity:
+/root/reference/python/paddle/static/nn/sequence_lod.py — sequence_conv,
+sequence_softmax, sequence_pool, sequence_first/last_step, sequence_slice,
+sequence_expand(_as), sequence_pad/unpad, sequence_reshape, sequence_scatter,
+sequence_enumerate).
+
+TPU-native data model: the reference's LoD (ragged level-of-detail) tensors
+are a dynamic-shape construct XLA does not admit. The capability translates
+to the padded-batch form every TPU pipeline uses: a sequence batch is a dense
+``[B, T, ...]`` array plus an optional per-row ``length`` vector; masking
+replaces LoD boundaries. Functions that in the reference consume a 2-level
+LoD take the dense batch (with ``length`` where semantics need it); functions
+whose outputs would be ragged (``sequence_unpad``) return the dense array
+masked to length — the shapes stay static, the values carry the raggedness.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...ops.dispatch import apply
+from ...tensor.tensor import Tensor
+
+__all__ = [
+    "sequence_conv", "sequence_softmax", "sequence_pool", "sequence_first_step",
+    "sequence_last_step", "sequence_slice", "sequence_expand",
+    "sequence_expand_as", "sequence_pad", "sequence_unpad", "sequence_reshape",
+    "sequence_scatter", "sequence_enumerate",
+]
+
+
+def _as_t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _mask(v, length, fill=0.0):
+    """[B,T,...] masked beyond per-row length."""
+    if length is None:
+        return v
+    t = jnp.arange(v.shape[1])
+    m = t[None, :] < jnp.reshape(length, (-1, 1))
+    m = m.reshape(m.shape + (1,) * (v.ndim - 2))
+    return jnp.where(m, v, jnp.asarray(fill, v.dtype))
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None):  # noqa: A002
+    """Sliding-window projection over the time dim: each step's context
+    window [t+pad_start, t+pad_start+filter_size) is flattened and projected
+    to num_filters (reference sequence_conv contract)."""
+    from ...base.param_attr import ParamAttr
+    from ...tensor.extras import create_parameter
+
+    x = _as_t(input)
+    d = int(x.shape[-1])
+    w = create_parameter([filter_size * d, num_filters], str(x.dtype.name),
+                         attr=ParamAttr._to_attr(param_attr))
+    b = None
+    if bias_attr is not False:
+        from ...nn.initializer import Constant
+
+        b = create_parameter([num_filters], str(x.dtype.name),
+                             attr=ParamAttr._to_attr(bias_attr), is_bias=True,
+                             default_initializer=Constant(0.0))
+    start = -((filter_size - 1) // 2) if padding_start is None else padding_start
+
+    def f(v, wv, *rest):
+        ctx = []
+        for k in range(filter_size):
+            off = start + k
+            shifted = jnp.roll(v, -off, axis=1)
+            t = jnp.arange(v.shape[1])
+            valid = (t + off >= 0) & (t + off < v.shape[1])
+            ctx.append(jnp.where(valid[None, :, None], shifted, 0))
+        win = jnp.concatenate(ctx, axis=-1)  # [B,T,fs*d]
+        out = win @ wv
+        if rest:
+            out = out + rest[0]
+        return out
+
+    args = (x, w, b) if b is not None else (x, w)
+    out = apply(f, *args, op_name="sequence_conv")
+    if act is not None:
+        from ...nn import functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+def sequence_softmax(input, use_cudnn=False, name=None, length=None):  # noqa: A002
+    """Softmax over the time dim, masked beyond ``length``."""
+    x = _as_t(input)
+    ln = _as_t(length) if length is not None else None
+
+    def f(v, *rest):
+        l = rest[0] if rest else None
+        logits = v
+        if l is not None:
+            t = jnp.arange(v.shape[1])
+            m = t[None, :] < jnp.reshape(l, (-1, 1))
+            m = m.reshape(m.shape + (1,) * (v.ndim - 2))
+            logits = jnp.where(m, v, -jnp.inf)
+        e = jnp.exp(logits - jnp.max(logits, axis=1, keepdims=True))
+        e = jnp.where(jnp.isfinite(e), e, 0)
+        return e / jnp.maximum(e.sum(1, keepdims=True), 1e-12)
+
+    return apply(f, *((x, ln) if ln is not None else (x,)), op_name="sequence_softmax")
+
+
+def sequence_pool(input, pool_type, is_test=False, pad_value=0.0, length=None,
+                  name=None):  # noqa: A002
+    """[B,T,D] → [B,D] with sum/average/sqrt/max/last/first over valid steps."""
+    x = _as_t(input)
+    ln = _as_t(length) if length is not None else None
+    kind = pool_type.lower()
+
+    def f(v, *rest):
+        l = rest[0] if rest else None
+        tlen = v.shape[1]
+        counts = (jnp.reshape(l, (-1, 1)).astype(v.dtype) if l is not None
+                  else jnp.full((v.shape[0], 1), tlen, v.dtype))
+        vm = v if l is None else _mask(v, l)
+        if kind == "sum":
+            return vm.sum(1)
+        if kind == "average":
+            return vm.sum(1) / jnp.maximum(counts, 1)
+        if kind == "sqrt":
+            return vm.sum(1) / jnp.sqrt(jnp.maximum(counts, 1))
+        if kind == "max":
+            if l is not None:
+                t = jnp.arange(tlen)
+                m = t[None, :] < jnp.reshape(l, (-1, 1))
+                m = m.reshape(m.shape + (1,) * (v.ndim - 2))
+                vm = jnp.where(m, v, -jnp.inf)
+            return vm.max(1)
+        if kind == "last":
+            idx = (jnp.reshape(l, (-1,)).astype(jnp.int32) - 1 if l is not None
+                   else jnp.full((v.shape[0],), tlen - 1, jnp.int32))
+            return jnp.take_along_axis(
+                v, idx.reshape(-1, *([1] * (v.ndim - 1))), axis=1)[:, 0]
+        if kind == "first":
+            return v[:, 0]
+        raise ValueError(f"unknown pool_type {pool_type}")
+
+    return apply(f, *((x, ln) if ln is not None else (x,)), op_name="sequence_pool")
+
+
+def sequence_first_step(input, name=None):  # noqa: A002
+    return sequence_pool(input, "first")
+
+
+def sequence_last_step(input, length=None, name=None):  # noqa: A002
+    return sequence_pool(input, "last", length=length)
+
+
+def sequence_slice(input, offset, length, name=None):  # noqa: A002
+    """Per-row slice [offset, offset+length) along time; output padded to
+    max(length) (static shape), rows masked past their own length."""
+    x, off, ln = _as_t(input), _as_t(offset), _as_t(length)
+
+    def f(v, o, l):
+        o = jnp.reshape(o, (-1,)).astype(jnp.int32)
+        l = jnp.reshape(l, (-1,)).astype(jnp.int32)
+        width = v.shape[1]
+        t = jnp.arange(width)
+        idx = jnp.clip(o[:, None] + t[None, :], 0, width - 1)
+        g = jnp.take_along_axis(v, idx.reshape(idx.shape + (1,) * (v.ndim - 2)), 1)
+        m = t[None, :] < l[:, None]
+        return jnp.where(m.reshape(m.shape + (1,) * (v.ndim - 2)), g, 0)
+
+    return apply(f, x, off, ln, op_name="sequence_slice")
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    """Repeat each row of x per the batch of y (padded analog: broadcast x's
+    rows to y's leading shape). With dense batches both carry [B,...], so the
+    expansion is x broadcast against y's row count."""
+    xt, yt = _as_t(x), _as_t(y)
+
+    def f(a, b):
+        reps = b.shape[0] // max(a.shape[0], 1)
+        return jnp.repeat(a, reps, axis=0) if reps > 1 else a
+
+    return apply(f, xt, yt, op_name="sequence_expand")
+
+
+def sequence_expand_as(x, y, name=None):
+    return sequence_expand(x, y)
+
+
+def sequence_pad(x, pad_value, maxlen: Optional[int] = None, length=None,
+                 name=None):
+    """Pad/truncate the time dim to ``maxlen``; returns (padded, lengths)
+    (reference returns Out + Length)."""
+    xt = _as_t(x)
+    pv = _as_t(pad_value)
+    ln = _as_t(length) if length is not None else None
+    tgt = maxlen
+
+    def f(v, p, *rest):
+        l = rest[0] if rest else None
+        t = v.shape[1]
+        m = tgt or t
+        if m > t:
+            pad_shape = (v.shape[0], m - t) + v.shape[2:]
+            v = jnp.concatenate(
+                [v, jnp.full(pad_shape, jnp.reshape(p, ()).astype(v.dtype))], 1)
+        elif m < t:
+            v = v[:, :m]
+        lengths = (jnp.minimum(jnp.reshape(l, (-1,)), m) if l is not None
+                   else jnp.full((v.shape[0],), min(m, t), jnp.int64))
+        if l is not None:
+            tt = jnp.arange(v.shape[1])
+            msk = tt[None, :] < lengths[:, None]
+            msk = msk.reshape(msk.shape + (1,) * (v.ndim - 2))
+            v = jnp.where(msk, v, jnp.reshape(p, ()).astype(v.dtype))
+        return v, lengths
+
+    args = (xt, pv, ln) if ln is not None else (xt, pv)
+    out = apply(f, *args, op_name="sequence_pad", n_outs=2)
+    return out[0], out[1]
+
+
+def sequence_unpad(x, length, name=None):
+    """Inverse of sequence_pad. Ragged output is impossible under static
+    shapes; returns the dense array zero-masked past each row's length (the
+    values equal the reference's unpadded rows; consumers read ``length``)."""
+    xt, ln = _as_t(x), _as_t(length)
+    return apply(lambda v, l: _mask(v, jnp.reshape(l, (-1,))), xt, ln,
+                 op_name="sequence_unpad")
+
+
+def sequence_reshape(input, new_dim: int, name=None):  # noqa: A002
+    """Re-chunk the flattened time*feature stream into rows of new_dim."""
+    x = _as_t(input)
+
+    def f(v):
+        b = v.shape[0]
+        total = v.shape[1] * v.shape[2] if v.ndim == 3 else v.shape[1]
+        if total % new_dim != 0:
+            raise ValueError(f"sequence_reshape: {total} not divisible by {new_dim}")
+        return v.reshape(b, total // new_dim, new_dim)
+
+    return apply(f, x, op_name="sequence_reshape")
+
+
+def sequence_scatter(input, index, updates, name=None):  # noqa: A002
+    """Scatter ``updates`` into per-row time positions ``index``."""
+    x, idx, upd = _as_t(input), _as_t(index), _as_t(updates)
+
+    def f(v, i, u):
+        i = i.astype(jnp.int32)
+        rows = jnp.arange(v.shape[0])[:, None]
+        rows = jnp.broadcast_to(rows, i.shape)
+        return v.at[rows, i].add(u.astype(v.dtype))
+
+    return apply(f, x, idx, upd, op_name="sequence_scatter")
+
+
+def sequence_enumerate(input, win_size: int, pad_value: int = 0, name=None):  # noqa: A002
+    """All length-win_size subsequences per step: [B,T] → [B,T,win_size]."""
+    x = _as_t(input)
+
+    def f(v):
+        t = jnp.arange(v.shape[1])
+        outs = []
+        for k in range(win_size):
+            idx = jnp.clip(t + k, 0, v.shape[1] - 1)
+            val = v[:, idx]
+            outs.append(jnp.where((t + k < v.shape[1])[None, :], val,
+                                  jnp.asarray(pad_value, v.dtype)))
+        return jnp.stack(outs, axis=-1)
+
+    return apply(f, x, op_name="sequence_enumerate")

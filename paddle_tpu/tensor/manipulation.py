@@ -21,7 +21,8 @@ __all__ = [
     "concat", "stack", "hstack", "vstack", "dstack", "split", "vsplit", "hsplit", "dsplit",
     "tensor_split", "chunk", "tile", "expand", "expand_as", "broadcast_to", "broadcast_tensors",
     "flip", "rot90", "roll", "gather", "gather_nd", "scatter", "scatter_", "scatter_nd",
-    "scatter_nd_add", "index_select", "index_sample", "index_add", "index_put", "index_fill",
+    "scatter_nd_add", "index_select", "index_sample", "index_add", "index_add_",
+    "index_put", "index_put_", "index_fill", "index_fill_",
     "masked_select", "masked_fill", "masked_scatter", "take_along_axis", "put_along_axis",
     "unbind", "unique", "unique_consecutive", "repeat_interleave", "tril", "triu", "tril_",
     "triu_", "diag", "diagflat", "diag_embed", "meshgrid", "moveaxis", "swapaxes", "as_real",
@@ -328,11 +329,17 @@ def index_add(x, index, axis, value, name=None):
 
     def f(v, i, u):
         i = i.reshape(-1).astype(jnp.int32)
-        idx = [slice(None)] * v.ndim
+        idx = [builtins.slice(None)] * v.ndim
         idx[axis] = i
         return v.at[tuple(idx)].add(u)
 
     return apply(f, x, index, value, op_name="index_add")
+
+
+def index_add_(x, index, axis, value, name=None):
+    """Inplace index_add (parity: /root/reference/python/paddle/tensor/
+    manipulation.py:6582)."""
+    return x._inplace_adopt(index_add(x, index, axis, value))
 
 
 def index_put(x, indices, value, accumulate=False, name=None):
@@ -348,16 +355,28 @@ def index_put(x, indices, value, accumulate=False, name=None):
     return apply(f, x, value, op_name="index_put")
 
 
+def index_put_(x, indices, value, accumulate=False, name=None):
+    """Inplace index_put (parity: /root/reference/python/paddle/tensor/
+    manipulation.py:6610)."""
+    return x._inplace_adopt(index_put(x, indices, value, accumulate))
+
+
 def index_fill(x, index, axis, value, name=None):
     x, index = to_tensor_like(x), to_tensor_like(index)
     val = value._value if isinstance(value, Tensor) else value
 
     def f(v, i):
-        idx = [slice(None)] * v.ndim
+        idx = [builtins.slice(None)] * v.ndim
         idx[axis] = i.reshape(-1).astype(jnp.int32)
         return v.at[tuple(idx)].set(val)
 
     return apply(f, x, index, op_name="index_fill")
+
+
+def index_fill_(x, index, axis, value, name=None):
+    """Inplace index_fill (parity: /root/reference/python/paddle/tensor/
+    manipulation.py:7060)."""
+    return x._inplace_adopt(index_fill(x, index, axis, value))
 
 
 def masked_select(x, mask, name=None):

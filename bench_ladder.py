@@ -162,6 +162,86 @@ def bench_llama_decode():
     }))
 
 
+def bench_pipeline_compiled_vs_eager():
+    """Compiled-vs-eager pipeline rung: the same dp2×mp2×pp2 llama microbatch
+    schedule through the eager per-op 1F1B engine vs CompiledPipelineTrainStep
+    (one XLA program). Runs on a virtual 8-device CPU mesh in a subprocess —
+    pipeline parallelism needs >1 device, and the comparison (host-dispatch
+    overhead vs one fused program) is the quantity of interest."""
+    import subprocess
+
+    child = os.environ.get("_PADDLE_TPU_PP_BENCH_CHILD") == "1"
+    if not child:
+        env = dict(os.environ)
+        env["_PADDLE_TPU_PP_BENCH_CHILD"] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        flags.append("--xla_force_host_platform_device_count=8")
+        env["XLA_FLAGS"] = " ".join(flags)
+        for k in list(env):
+            if k.startswith(("TPU_", "LIBTPU", "AXON")):
+                env.pop(k)
+        subprocess.run([sys.executable, os.path.abspath(__file__), "pipeline"],
+                       env=env, check=True)
+        return
+
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as P
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        CompiledPipelineTrainStep,
+        PipelineLayer,
+    )
+    from paddle_tpu.models import (
+        LlamaPretrainingCriterion,
+        llama_pipeline_descs,
+        llama_tiny,
+    )
+
+    P.seed(0)
+    s = dist.fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 2,
+                        "sharding_degree": 1, "sep_degree": 1}
+    s.pipeline_configs = {"accumulate_steps": 4, "schedule_mode": "1F1B"}
+    dist.fleet.init(is_collective=True, strategy=s)
+    cfg = llama_tiny()
+    crit = LlamaPretrainingCriterion()
+    pipe = PipelineLayer(layers=llama_pipeline_descs(cfg), num_stages=2,
+                         loss_fn=lambda lo, la: crit(lo, la))
+    model = dist.fleet.distributed_model(pipe)
+    opt = P.optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    ids = P.to_tensor(np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (8, 32)).astype(np.int32))
+    reps = 5
+    model.train_batch([ids, ids], opt)  # warm
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        loss_e = model.train_batch([ids, ids], opt)
+    float(loss_e.numpy())
+    eager_ms = (time.perf_counter() - t0) / reps * 1e3
+
+    cstep = CompiledPipelineTrainStep(pipe, getattr(opt, "_inner", opt),
+                                      num_micro=4)
+    float(cstep(ids, ids).numpy())  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        loss_c = cstep(ids, ids)
+    float(loss_c.numpy())
+    comp_ms = (time.perf_counter() - t0) / reps * 1e3
+    print(json.dumps({
+        "metric": "pp_llama_step_ms_compiled_vs_eager",
+        "value": round(comp_ms, 2),
+        "unit": "ms/step",
+        "extra": {"backend": "cpu-mesh-8dev", "mesh": "dp2.mp2.pp2",
+                  "eager_step_ms": round(eager_ms, 2),
+                  "speedup_vs_eager": round(eager_ms / comp_ms, 2),
+                  "num_micro": 4},
+    }))
+
+
 if __name__ == "__main__":
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
     if which in ("all", "resnet"):
@@ -170,3 +250,5 @@ if __name__ == "__main__":
         bench_bert_base()
     if which in ("all", "decode"):
         bench_llama_decode()
+    if which in ("all", "pipeline"):
+        bench_pipeline_compiled_vs_eager()

@@ -194,11 +194,20 @@ class DistModel:
         return inputs, labels
 
     def _compute_loss(self, model, *args):
+        import contextlib
+
+        amp_cfg = self._strategy.amp
+        ctx = contextlib.nullcontext()
+        if amp_cfg.enable:
+            from ...amp import auto_cast
+
+            ctx = auto_cast(enable=True, dtype=amp_cfg.dtype, level=amp_cfg.level)
         inputs, labels = self._split_batch(args)
-        out = model(*inputs)
-        if self._loss is None:
-            return out
-        return self._loss(out, *labels) if labels else self._loss(out)
+        with ctx:
+            out = model(*inputs)
+            if self._loss is None:
+                return out
+            return self._loss(out, *labels) if labels else self._loss(out)
 
     def __call__(self, *args):
         args = [a if isinstance(a, Tensor) else Tensor(a) for a in args]

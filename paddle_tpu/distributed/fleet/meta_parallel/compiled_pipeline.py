@@ -1,34 +1,54 @@
 """Compiled pipeline parallelism — the whole microbatch schedule in ONE XLA
-program.
+program, for REAL models (heterogeneous stages, tied embeddings, stateful
+optimizers).
 
 Reference analog: the static-graph pipeline scheduler passes
 (/root/reference/python/paddle/distributed/passes/pipeline_scheduler_pass/)
-which compile 1F1B/ZB orderings into a single program per rank, vs. the eager
-per-op engine (meta_parallel/pipeline_parallel.py).
+which compile 1F1B/ZB orderings into a single program per rank, plus
+SharedLayerDesc's shared-grad allreduce
+(/root/reference/python/paddle/distributed/fleet/meta_parallel/parallel_layers/pp_layers.py:76).
 
-TPU-native formulation (the GSPMD/shard_map pipeline): every pp rank runs the
-SAME program — stage identity is ``lax.axis_index('pp')``; per-stage weights
-are STACKED on a leading axis sharded over 'pp' (the stacked arrays are the
-canonical storage, so each device holds exactly its stage's weights and
-optimizer state); activations advance around the ring with ``lax.ppermute``
-inside a ``lax.scan`` over T = num_micro + P - 1 ticks. XLA's latency-hiding
-scheduler overlaps the ppermute with the next tick's compute — the
-1F1B/zero-bubble distinction collapses into data dependencies the compiler
-schedules (SURVEY §7.2 item 5). Per-tick ``jax.checkpoint`` keeps saved state
-to stage-boundary activations (1F1B-grade memory, not GPipe-grade).
+TPU-native formulation (the GSPMD/shard_map pipeline):
 
-Composes with TrainStep: the optimizer's param groups are re-pointed at the
-stacked weights, so the framework's own update rules, GradScaler, and donated
-buffers apply unchanged — optimizer accumulators come out [P, ...] and
-pp-sharded automatically.
+* **Partial-manual shard_map**: only the 'pp' axis is manual
+  (``jax.shard_map(..., axis_names={'pp'})``); dp/mp/sharding stay AUTO
+  inside, so the model's GSPMD sharding annotations (TP layers'
+  ``with_sharding_constraint``) keep working verbatim inside the pipeline
+  body and XLA inserts the mp collectives — no manual rewrite of the layer
+  library.
+* **Head/body/tail decomposition**: a real LM pipeline is [embedding]
+  + P×[k uniform decoder layers] + [norm+lm_head]. The homogeneous BODY is
+  stacked ``[P, ...]`` and pp-sharded — each device holds exactly its
+  stage's decoder weights. The HEAD (first-stage prefix) and TAIL
+  (last-stage suffix) ride as ordinary pp-replicated (auto) arrays; under
+  SPMD every rank executes head/tail in lockstep and masks by
+  ``lax.axis_index('pp')``, so the redundant compute costs no wall-clock
+  (all ranks would be in that program region anyway) and ``jnp.where``
+  keeps gradients exact.
+* **Tied embeddings (SharedLayerDesc)**: the shared layer's weight enters
+  the program ONCE as an auto array used by both the head lookup (live on
+  stage 0) and the tail logits matmul (live on stage P-1); shard_map's
+  reverse rule psums the cotangent over the manual 'pp' axis — exactly the
+  reference's shared-grad allreduce, derived by AD instead of hand-wired.
+* **Schedule**: activations advance around the pp ring with
+  ``lax.ppermute`` inside a ``lax.scan`` over T = num_micro + P - 1 ticks;
+  XLA's latency-hiding scheduler overlaps the ppermute with the next tick's
+  compute. Per-tick ``jax.checkpoint`` keeps saved state to stage-boundary
+  activations (1F1B-grade memory).
 
-Requirements (checked): homogeneous stages (identical param trees), one chunk
-per stage (no VPP interleave), activation shape == stage input shape. The
-eager engine remains the general fallback.
+Composes with TrainStep: stacked body weights + head/tail params form the
+parameter set; the optimizer's param groups are REWIRED onto them (per-group
+hyperparameters preserved — group membership must be uniform across stages
+for each body slot) and any pre-existing accumulator/master state is
+restacked ``[P, ...]`` so a mid-training switch to the compiled engine keeps
+optimizer momentum.
+
+Remaining scope limit: VPP interleave (num_chunks > 1) stays on the eager
+engine — the compiled ring models one chunk per stage.
 """
 from __future__ import annotations
 
-from typing import List
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -44,73 +64,303 @@ from ....tensor.tensor import Tensor
 __all__ = ["CompiledPipelineTrainStep", "pipeline_bubble_fraction"]
 
 
-from ...shard_map_compat import shard_map_compat as _shard_map
-
-
 def pipeline_bubble_fraction(num_micro: int, num_stages: int) -> float:
     """Idle fraction of the synchronous pipeline: (P-1)/(M+P-1)."""
     return (num_stages - 1) / (num_micro + num_stages - 1)
 
 
-def _stage_param_lists(pipe) -> List[List]:
-    """Per-stage parameter lists, with homogeneity checks."""
-    if pipe._num_chunks != 1:
-        raise ValueError("compiled pipeline does not support VPP chunks; "
-                         "use the eager engine for interleaved schedules")
-    if pipe._shared_layers:
-        raise ValueError("compiled pipeline does not support SharedLayerDesc")
-    stages = []
-    for s in range(pipe._num_stages):
-        ps = []
-        for layer in pipe._stage_layers[s]:
+def _shard_map_pp(fn, mesh, in_specs, out_specs):
+    """Manual over 'pp' only; every other mesh axis stays auto (GSPMD)."""
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                         axis_names={"pp"}, check_vma=False)
+
+
+def _layer_sig(layer, ffunc):
+    cfg = repr(layer) if isinstance(layer, Layer) else getattr(
+        layer, "__name__", str(layer))
+    fid = ffunc if isinstance(ffunc, str) or ffunc is None else getattr(
+        ffunc, "__qualname__", repr(ffunc))
+    return (type(layer).__name__, cfg, fid)
+
+
+class _Swap:
+    """Temporarily install traced values into param Tensors."""
+
+    def __init__(self, tensors, values):
+        self.tensors, self.values = tensors, values
+
+    def __enter__(self):
+        self.saved = [t._value for t in self.tensors]
+        for t, v in zip(self.tensors, self.values):
+            t._value = v
+
+    def __exit__(self, *exc):
+        for t, v in zip(self.tensors, self.saved):
+            t._value = v
+        return False
+
+
+class _Segment:
+    """A contiguous run of (layer, fwd_func) pairs + its parameter list."""
+
+    def __init__(self, pairs: Sequence[Tuple]):
+        self.pairs = list(pairs)
+        self.params: List[Tensor] = []
+        seen = set()
+        for layer, _ in self.pairs:
             if isinstance(layer, Layer):
-                ps.extend(layer.parameters())
-        stages.append(ps)
+                for p in layer.parameters():
+                    if id(p) not in seen:
+                        seen.add(id(p))
+                        self.params.append(p)
 
-    def _sig(s):
-        # every stage runs stage 0's FORWARD program, so layer types (and
-        # their configuration) must match, not just param shapes
-        out = []
-        for layer, f in zip(pipe._stage_layers[s], pipe._stage_fwd_funcs[s]):
-            cfg = repr(layer) if isinstance(layer, Layer) else getattr(
-                layer, "__name__", str(layer))
-            fid = f if isinstance(f, str) or f is None else getattr(
-                f, "__qualname__", repr(f))
-            out.append((type(layer).__name__, cfg, fid))
-        return out + [(tuple(p.shape), str(p.dtype)) for p in stages[s]]
+    def sig(self):
+        return ([_layer_sig(l, f) for l, f in self.pairs]
+                + [(tuple(p.shape), str(p.dtype)) for p in self.params])
 
-    ref = _sig(0)
-    for s in range(1, pipe._num_stages):
-        got = _sig(s)
-        if got != ref:
+    def run(self, param_leaves, x_val):
+        """Pure function: swap in leaves, run the chain on a raw value."""
+        with _Swap(self.params, list(param_leaves)):
+            t = Tensor(x_val, stop_gradient=True)
+            for layer, ffunc in self.pairs:
+                if ffunc == "plain_fn":
+                    t = layer(t)
+                elif ffunc is not None:
+                    t = ffunc(layer, t)
+                else:
+                    t = layer(t)
+            return t._value
+
+
+def _decompose(pipe) -> Tuple[_Segment, List[_Segment], _Segment]:
+    """Split the pipeline's stages into (head, per-stage body, tail).
+
+    The body layer type is the one whose instances appear on more than one
+    stage (the repeated trunk — e.g. the decoder layer); the head is stage
+    0's prefix before its first body layer, the tail is the last stage's
+    suffix after its last body layer. Every stage must carry the same number
+    of body layers with identical signatures."""
+    P = pipe._num_stages
+    pairs = [list(zip(pipe._stage_layers[s], pipe._stage_fwd_funcs[s]))
+             for s in range(P)]
+    shared_ids = {id(l) for l in pipe._shared_layers.values()}
+    type_stages: Dict[str, set] = {}
+    for s in range(P):
+        for layer, _ in pairs[s]:
+            if id(layer) in shared_ids:
+                continue  # one OBJECT on many stages (tied weights) ≠ a body
+            type_stages.setdefault(type(layer).__name__, set()).add(s)
+    body_types = {t for t, ss in type_stages.items() if len(ss) == P}
+    if not body_types and P > 1:
+        # fall back: types on >1 stage (short pipes where the trunk doesn't
+        # reach every stage can't be stacked)
+        body_types = {t for t, ss in type_stages.items() if len(ss) > 1}
+    if not body_types:
+        raise ValueError(
+            "compiled pipeline: no layer type spans multiple stages — cannot "
+            "identify a homogeneous body to stack; use the eager engine")
+
+    def is_body(layer):
+        return id(layer) not in shared_ids and type(layer).__name__ in body_types
+
+    head_pairs, first_body = [], None
+    for i, (layer, f) in enumerate(pairs[0]):
+        if is_body(layer):
+            first_body = i
+            break
+        head_pairs.append((layer, f))
+    if first_body is None:
+        raise ValueError("compiled pipeline: stage 0 has no body layers")
+
+    tail_pairs, last_body = [], None
+    for i in range(len(pairs[-1]) - 1, -1, -1):
+        if is_body(pairs[-1][i][0]):
+            last_body = i
+            break
+    if last_body is None:
+        raise ValueError(f"compiled pipeline: stage {P - 1} has no body layers")
+    tail_pairs = pairs[-1][last_body + 1:]
+
+    body_segs = []
+    for s in range(P):
+        lo = first_body if s == 0 else 0
+        hi = last_body + 1 if s == P - 1 else len(pairs[s])
+        seg_pairs = pairs[s][lo:hi]
+        if any(not is_body(l) for l, _ in seg_pairs):
             raise ValueError(
-                f"compiled pipeline needs homogeneous stages; stage {s} "
-                f"{got} != stage 0 {ref}")
-    return stages
+                f"compiled pipeline: stage {s} interleaves body and non-body "
+                "layers; head/tail must be contiguous prefixes/suffixes")
+        body_segs.append(_Segment(seg_pairs))
+
+    ref = body_segs[0].sig()
+    for s in range(1, P):
+        if body_segs[s].sig() != ref:
+            raise ValueError(
+                f"compiled pipeline needs a homogeneous body; stage {s} "
+                f"{body_segs[s].sig()} != stage 0 {ref}. Choose a seg_method "
+                "that gives every stage the same decoder count")
+    return _Segment(head_pairs), body_segs, _Segment(tail_pairs)
 
 
-class _StackedStages(Layer):
-    """Holds the canonical [P, ...] pp-sharded weights as parameters."""
+def _full_mesh_put(p: Tensor, mesh):
+    """Move a head/tail param from its stage submesh onto the full mesh,
+    keeping axis-name sharding dims that exist there (mp etc.)."""
+    if isinstance(p._value, jax.core.Tracer):
+        return
+    try:
+        old = p._value.sharding.spec
+    except Exception:
+        old = None
+    spec = PartitionSpec(*[
+        e if (e in mesh.axis_names or isinstance(e, tuple)) else None
+        for e in (old or [None] * p.ndim)
+    ]) if old else PartitionSpec(*([None] * p.ndim))
+    p._value = jax.device_put(np.asarray(p._value), NamedSharding(mesh, spec))
 
-    def __init__(self, stage_params, mesh):
+
+class _PipeParams(Layer):
+    """Parameter container the TrainStep compiles against: stacked [P, ...]
+    body weights (canonical storage, pp-sharded) + the head/tail params."""
+
+    def __init__(self, body_segs: List[_Segment], aux_params: List[Tensor], mesh):
         super().__init__()
         self._mesh = mesh
-        n_per_stage = len(stage_params[0])
+        P = len(body_segs)
         self.stacked: List[Tensor] = []
-        for j in range(n_per_stage):
-            vals = np.stack([np.asarray(ps[j]._value) for ps in stage_params])
-            sh = NamedSharding(mesh, PartitionSpec("pp", *([None] * stage_params[0][j].ndim)))
+        self.stacked_specs: List[PartitionSpec] = []
+        for j, p0 in enumerate(body_segs[0].params):
+            vals = np.stack([np.asarray(seg.params[j]._value) for seg in body_segs])
+            try:
+                inner = tuple(
+                    e if (e in mesh.axis_names and e != "pp") or isinstance(e, tuple)
+                    else None
+                    for e in (p0._value.sharding.spec or ()))
+            except Exception:
+                inner = ()
+            inner = tuple(inner) + (None,) * (p0.ndim - len(inner))
+            spec = PartitionSpec("pp", *inner)
+            sh = NamedSharding(mesh, spec)
             t = Tensor(jax.device_put(jnp.asarray(vals), sh), stop_gradient=False)
+            t.name = f"pipe_stacked_{j}"
             self.stacked.append(t)
+            self.stacked_specs.append(spec)
             setattr(self, f"w{j}", t)  # registers as parameter
+        self.aux: List[Tensor] = list(aux_params)
+        for k, p in enumerate(self.aux):
+            _full_mesh_put(p, mesh)
+            setattr(self, f"aux{k}", p)
 
     def parameters(self, include_sublayers=True):
-        return list(self.stacked)
+        return list(self.stacked) + list(self.aux)
+
+
+def _remesh_value(v, mesh):
+    """Move a pre-existing state array from a stage submesh onto the full
+    mesh, keeping sharding dims whose axis names exist there."""
+    try:
+        old = v.sharding.spec
+    except Exception:
+        old = None
+    spec = PartitionSpec(*[
+        e if (e in mesh.axis_names or isinstance(e, tuple)) else None
+        for e in (old or [None] * np.ndim(v))
+    ]) if old else PartitionSpec(*([None] * np.ndim(v)))
+    return jax.device_put(jnp.asarray(np.asarray(v)), NamedSharding(mesh, spec))
+
+
+def _rewire_optimizer(optimizer, body_segs: List[_Segment],
+                      stacked: List[Tensor], aux_ids: set, mesh,
+                      stacked_specs: List[PartitionSpec]):
+    """Re-point param groups at stacked weights (per-group hyperparameters
+    kept) and restack any pre-existing optimizer state [P, ...]."""
+    P = len(body_segs)
+    slot_of: Dict[int, Tuple[int, int]] = {}
+    for s, seg in enumerate(body_segs):
+        for j, p in enumerate(seg.params):
+            slot_of[id(p)] = (s, j)
+
+    # group membership per body slot, from each group's original params
+    group_of_slot: Dict[int, int] = {}
+    for gi, g in enumerate(optimizer._param_groups):
+        for p in g["params"]:
+            slot = slot_of.get(id(p))
+            if slot is None:
+                continue
+            s, j = slot
+            prev = group_of_slot.setdefault(j, gi)
+            if prev != gi:
+                raise ValueError(
+                    f"compiled pipeline: body slot {j} belongs to different "
+                    f"param groups on different stages ({prev} vs {gi}); "
+                    "group membership must be uniform across stages")
+
+    new_groups = []
+    for gi, g in enumerate(optimizer._param_groups):
+        new_params, seen = [], set()
+        for p in g["params"]:
+            slot = slot_of.get(id(p))
+            if slot is not None:
+                j = slot[1]
+                if j not in seen and group_of_slot[j] == gi:
+                    seen.add(j)
+                    new_params.append(stacked[j])
+            else:
+                # aux (head/tail) params and any params outside the pipeline
+                # stay as-is (aux already re-placed by _full_mesh_put)
+                new_params.append(p)
+        new_groups.append({**{k: v for k, v in g.items() if k != "params"},
+                           "params": new_params})
+    optimizer._param_groups = new_groups
+    optimizer._parameter_list = [p for g in new_groups for p in g["params"]]
+
+    # restack pre-existing state so momentum survives the engine switch
+    def restack(d: Dict[int, jnp.ndarray], j: int, target: Tensor):
+        vals, found = [], 0
+        for s in range(P):
+            v = d.pop(id(body_segs[s].params[j]), None)
+            if v is not None:
+                found += 1
+            vals.append(v)
+        if found == 0:
+            return
+        if found != P:
+            raise ValueError(
+                f"compiled pipeline: optimizer state for body slot {j} exists "
+                f"on {found}/{P} stages — cannot restack partial state")
+        if np.ndim(vals[0]) == 0:
+            # scalar accumulators (step counters like beta_pow) advanced in
+            # lockstep across stages — keep one, don't stack (stacking would
+            # break broadcasting against the [P, ...] moments)
+            d[id(target)] = jax.device_put(jnp.asarray(np.asarray(vals[0])),
+                                           NamedSharding(mesh, PartitionSpec()))
+            return
+        # per-stage values live on different stage submeshes — stack on host
+        arr = np.stack([np.asarray(v) for v in vals])
+        spec = (stacked_specs[j] if arr.ndim == len(stacked_specs[j])
+                else PartitionSpec(*([None] * arr.ndim)))
+        d[id(target)] = jax.device_put(jnp.asarray(arr), NamedSharding(mesh, spec))
+
+    for name, d in optimizer._accumulators.items():
+        for j, t in enumerate(stacked):
+            restack(d, j, t)
+        # head/tail params moved to the full mesh — their existing state must
+        # follow or jit sees mixed device sets
+        for pid in list(d):
+            if pid in aux_ids:
+                d[pid] = _remesh_value(d[pid], mesh)
+    for j, t in enumerate(stacked):
+        restack(optimizer._master_weights, j, t)
+    for pid in list(optimizer._master_weights):
+        if pid in aux_ids:
+            optimizer._master_weights[pid] = _remesh_value(
+                optimizer._master_weights[pid], mesh)
 
 
 class CompiledPipelineTrainStep:
     """loss + grads + optimizer update for the FULL microbatch pipeline
-    schedule, compiled into one donated-buffer XLA program."""
+    schedule, compiled into one donated-buffer XLA program. Handles
+    heterogeneous stages (embedding head / lm-head tail), SharedLayerDesc
+    tied weights, and optimizers with existing state / multiple groups."""
 
     def __init__(self, pipe, optimizer, num_micro: int, scaler=None, remat: bool = True):
         from ....jit.api import TrainStep
@@ -121,119 +371,104 @@ class CompiledPipelineTrainStep:
         hcg = get_hybrid_communicate_group()
         if hcg is None or hcg.axis_size("pp") <= 1:
             raise ValueError("compiled pipeline needs an active mesh with pp > 1")
+        if model._num_chunks != 1:
+            raise ValueError("compiled pipeline does not support VPP chunks; "
+                             "use the eager engine for interleaved schedules")
         self.mesh = mesh = hcg.mesh
         self.num_micro = num_micro
         self.num_stages = P = model._num_stages
         self._pipe = model
-        self._stage_params = _stage_param_lists(model)
-        n_per_stage = len(self._stage_params[0])
-        self._stacked = _StackedStages(self._stage_params, mesh)
         if model._loss_fn is None:
             raise ValueError("PipelineLayer built without loss_fn")
         loss_fn_t = model._loss_fn
 
-        # re-point the optimizer's param groups at the stacked weights (the
-        # update rules are elementwise, so [P, ...] arrays work unchanged)
-        if optimizer._accumulators or optimizer._master_weights:
-            raise ValueError("pass a fresh optimizer (no accumulated state)")
-        if len(optimizer._param_groups) != 1:
-            raise ValueError(
-                "compiled pipeline supports a single param group (per-group "
-                "hyperparameters cannot be mapped onto the stacked weights)")
-        stacked_list = self._stacked.parameters()
-        optimizer._param_groups = [
-            {**{k: v for k, v in g.items() if k != "params"}, "params": stacked_list}
-            for g in optimizer._param_groups
-        ]
+        head, body_segs, tail = _decompose(model)
+        self._body_segs = body_segs
+        # head/tail params deduped — a SharedLayerDesc layer appearing in
+        # both (tied embedding) enters the program exactly once
+        aux, seen = [], set()
+        for p in head.params + tail.params:
+            if id(p) not in seen:
+                seen.add(id(p))
+                aux.append(p)
+        self._params_layer = _PipeParams(body_segs, aux, mesh)
+        stacked = self._params_layer.stacked
+        n_stacked = len(stacked)
+        n_aux = len(aux)
+        aux_index = {id(p): k for k, p in enumerate(aux)}
+        head_idx = [aux_index[id(p)] for p in head.params]
+        tail_idx = [aux_index[id(p)] for p in tail.params]
 
-        stage0_layers = model._stage_layers[0]
-        stage0_funcs = model._stage_fwd_funcs[0]
-        stage0_params = self._stage_params[0]
-        dp_axes = tuple(a for a in ("dp", "sharding")
-                        if a in mesh.axis_names and mesh.shape[a] > 1)
-        b_entry = dp_axes if len(dp_axes) > 1 else (dp_axes[0] if dp_axes else None)
-        other_axes = tuple(a for a in mesh.axis_names if a != "pp")
+        _rewire_optimizer(optimizer, body_segs, stacked, set(aux_index), mesh,
+                          self._params_layer.stacked_specs)
 
-        class _Swap:
-            def __init__(self, tensors, values):
-                self.tensors, self.values = tensors, values
+        body0 = body_segs[0]
 
-            def __enter__(self):
-                self.saved = [t._value for t in self.tensors]
-                for t, v in zip(self.tensors, self.values):
-                    t._value = v
+        # ring activation shape = the body input (head output when a head
+        # exists, else the data microbatch itself)
+        self._head = head
+        self._tail = tail
 
-            def __exit__(self, *exc):
-                for t, v in zip(self.tensors, self.saved):
-                    t._value = v
-                return False
+        stk_specs = tuple(PartitionSpec("pp") for _ in range(n_stacked))
 
-        def run_stage0(param_leaves, x):
-            with _Swap(stage0_params, list(param_leaves)):
-                t = Tensor(x, stop_gradient=True)
-                for layer, ffunc in zip(stage0_layers, stage0_funcs):
-                    if ffunc == "plain_fn":
-                        t = layer(t)
-                    elif ffunc is not None:
-                        t = ffunc(layer, t)
-                    else:
-                        t = layer(t)
-                return t._value
-
-        def loss_of_micro(out, y):
-            with tape.no_grad():
-                return loss_fn_t(Tensor(out, stop_gradient=True),
-                                 Tensor(y, stop_gradient=True))._value
-
-        def local(stacked, xs, ys):
-            p_local = [a[0] for a in stacked]  # this stage's weights
+        def local(stacked_vals, aux_vals, xs, ys):
             stage = lax.axis_index("pp")
+            p_local = [a[0] for a in stacked_vals]
+            head_vals = [aux_vals[k] for k in head_idx]
+            tail_vals = [aux_vals[k] for k in tail_idx]
             M = xs.shape[0]
             T = M + P - 1
-            fwd = jax.checkpoint(run_stage0) if remat else run_stage0
+
+            def run_head(x):
+                return head.run(head_vals, x) if head.pairs else x
+
+            body_fwd = (jax.checkpoint(body0.run) if remat else body0.run)
 
             def tick(h, t):
                 x_t = lax.dynamic_index_in_dim(xs, jnp.clip(t, 0, M - 1), 0,
                                                keepdims=False)
-                inp = jnp.where(stage == 0, x_t, h)
-                out = fwd(p_local, inp)
+                inp = jnp.where(stage == 0, run_head(x_t), h)
+                out = body_fwd(p_local, inp)
                 h_next = lax.ppermute(
                     out, "pp", [(i, (i + 1) % P) for i in range(P)])
                 return h_next, out
 
-            h0 = jnp.zeros_like(xs[0])
+            h_struct = jax.eval_shape(run_head, xs[0])
+            h0 = jnp.zeros(h_struct.shape, h_struct.dtype)
             _, outs = lax.scan(tick, h0, jnp.arange(T))
             # microbatch m exits the last stage at tick m + P - 1
             exit_outs = jnp.take(outs, jnp.arange(M) + P - 1, axis=0)
-            per = jax.vmap(loss_of_micro)(exit_outs, ys)
-            loss = jnp.mean(per.astype(jnp.float32))
-            loss = jnp.where(stage == P - 1, loss, 0.0)
-            loss = lax.psum(loss, "pp")
-            if other_axes:
-                loss = lax.pmean(loss, other_axes)
-            return loss
-
-        stk_specs = tuple(
-            PartitionSpec("pp", *([None] * stage0_params[j].ndim))
-            for j in range(n_per_stage)
-        )
+            # merge microbatches for the tail + loss: every rank computes in
+            # SPMD lockstep; only the last stage's value survives the mask
+            mb = exit_outs.shape[1]
+            merged = exit_outs.reshape(M * mb, *exit_outs.shape[2:])
+            logits = tail.run(tail_vals, merged) if tail.pairs else merged
+            ys_m = ys.reshape(M * ys.shape[1], *ys.shape[2:])
+            with tape.no_grad():
+                loss = loss_fn_t(Tensor(logits, stop_gradient=True),
+                                 Tensor(ys_m, stop_gradient=True))._value
+            loss = jnp.where(stage == P - 1, loss.astype(jnp.float32), 0.0)
+            return lax.psum(loss, "pp")
 
         def pipelined_loss(model_, x, y):
             from ....ops.dispatch import apply
 
-            def f(xv, yv, *stacked_vals):
+            def f(xv, yv, *param_vals):
+                stacked_vals = tuple(param_vals[:n_stacked])
+                aux_vals = tuple(param_vals[n_stacked:])
                 mb = xv.shape[0] // num_micro
                 xs = xv.reshape(num_micro, mb, *xv.shape[1:])
                 ys = yv.reshape(num_micro, mb, *yv.shape[1:])
-                data_spec = PartitionSpec(None, b_entry)
-                fn = _shard_map(local, mesh,
-                                in_specs=(tuple(stk_specs), data_spec, data_spec),
-                                out_specs=PartitionSpec())
-                return fn(tuple(stacked_vals), xs, ys)
+                fn = _shard_map_pp(
+                    local, mesh,
+                    in_specs=(stk_specs, (PartitionSpec(),) * n_aux,
+                              PartitionSpec(), PartitionSpec()),
+                    out_specs=PartitionSpec())
+                return fn(stacked_vals, aux_vals, xs, ys)
 
             return apply(f, x, y, *model_.parameters(), op_name="compiled_pipeline")
 
-        self._step = TrainStep(self._stacked, pipelined_loss, optimizer,
+        self._step = TrainStep(self._params_layer, pipelined_loss, optimizer,
                                scaler=scaler)
 
     @property
@@ -242,16 +477,25 @@ class CompiledPipelineTrainStep:
 
     def sync_to_model(self):
         """Write the stacked weights back into the per-stage Tensors (for
-        state_dict / eager eval parity)."""
-        for j, t in enumerate(self._stacked.stacked):
+        state_dict / eager eval parity). Head/tail params are shared objects
+        and already current."""
+        for j, t in enumerate(self._params_layer.stacked):
             host = np.asarray(t._value)
-            for s, ps in enumerate(self._stage_params):
-                sub = self._pipe._submeshes[s]
+            for s, seg in enumerate(self._body_segs):
+                p = seg.params[j]
+                sub = self._pipe._submeshes[s % self._pipe._num_stages]
                 val = jnp.asarray(host[s])
                 if sub is not None:
-                    val = jax.device_put(
-                        val, NamedSharding(sub, PartitionSpec(*([None] * val.ndim))))
-                ps[j]._value = val
+                    try:
+                        old = p._value.sharding.spec
+                    except Exception:
+                        old = None
+                    spec = PartitionSpec(*[
+                        e if e in sub.axis_names else None
+                        for e in (old or [None] * val.ndim)
+                    ]) if old else PartitionSpec(*([None] * val.ndim))
+                    val = jax.device_put(val, NamedSharding(sub, spec))
+                p._value = val
         return self._pipe
 
     def __call__(self, x, y):

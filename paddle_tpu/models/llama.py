@@ -415,18 +415,51 @@ class _PipeHead(nn.Layer):
         return self.lm_head(self.norm(hidden))
 
 
-def llama_pipeline_descs(config: LlamaConfig):
+class _PipeNorm(nn.Layer):
+    """Final RMSNorm as its own tail stage piece (used with tied embeddings,
+    where the logits matmul reuses the embedding weight)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.norm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+
+    def forward(self, hidden):
+        return self.norm(hidden)
+
+
+def _tied_logits(embed_layer, hidden):
+    """SharedLayerDesc forward_func for the tail occurrence of the shared
+    embedding: logits = hidden @ Wᵉᵐᵇᵀ (reference GPT tied-head contract,
+    pp_layers.py SharedLayerDesc:76)."""
+    from .. import matmul
+
+    w = embed_layer.embed_tokens.weight
+    return matmul(hidden.astype(w.dtype), w, transpose_y=True)
+
+
+def llama_pipeline_descs(config: LlamaConfig, tie_embeddings: bool = False):
     """LayerDescs for fleet's PipelineLayer: [embed] + L×[decoder] + [head].
 
     Compose with pp via ``PipelineLayer(layers=llama_pipeline_descs(cfg),
     num_stages=pp, loss_fn=...)`` under a hybrid dp×pp×mp mesh — the TP
     layers inside each stage shard on the stage's mp submesh (the 4-D hybrid
-    of BASELINE's GPT-3 rung)."""
-    from ..distributed.fleet.meta_parallel import LayerDesc
+    of BASELINE's GPT-3 rung).
 
-    return ([LayerDesc(_PipeEmbed, config)]
-            + [LayerDesc(_PipeDecoder, config) for _ in range(config.num_hidden_layers)]
-            + [LayerDesc(_PipeHead, config)])
+    ``tie_embeddings=True`` shares ONE embedding layer between the stage-0
+    lookup and the last-stage logits head via SharedLayerDesc — the compiled
+    pipeline psums its gradient across both uses (the reference's
+    shared-grad allreduce)."""
+    from ..distributed.fleet.meta_parallel import LayerDesc, SharedLayerDesc
+
+    decoders = [LayerDesc(_PipeDecoder, config)
+                for _ in range(config.num_hidden_layers)]
+    if tie_embeddings:
+        return ([SharedLayerDesc("embed", _PipeEmbed, None, "weight", config)]
+                + decoders
+                + [LayerDesc(_PipeNorm, config),
+                   SharedLayerDesc("embed", _PipeEmbed, _tied_logits, "weight",
+                                   config)])
+    return [LayerDesc(_PipeEmbed, config)] + decoders + [LayerDesc(_PipeHead, config)]
 
 
 class LlamaPretrainingCriterion(nn.Layer):

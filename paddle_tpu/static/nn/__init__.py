@@ -303,13 +303,26 @@ def data_norm(input, act=None, epsilon=1e-5, param_attr=None, shift=True,  # noq
         return (v - means) * scales
 
     out = apply(f, x, batch_size, batch_sum, batch_sq, op_name="data_norm")
+    # accumulate batch statistics (decayed, reference summary_decay_rate) so
+    # subsequent calls normalize with observed data; eager-mode only — in a
+    # captured Program the accumulators stay at their feed-time values for
+    # that execution (stats updates are a host-side training-loop concern)
+    if not isinstance(x._value, (jax.core.Tracer, jax.ShapeDtypeStruct)):
+        v = x._value.reshape(-1, c).astype(jnp.float32)
+        d = summary_decay_rate
+        rows = jnp.asarray(v.shape[0], jnp.float32)
+        batch_size._value = (batch_size._value.astype(jnp.float32) * d + rows).astype(batch_size._value.dtype)
+        batch_sum._value = (batch_sum._value.astype(jnp.float32) * d + v.sum(0)).astype(batch_sum._value.dtype)
+        batch_sq._value = (batch_sq._value.astype(jnp.float32) * d + (v * v).sum(0)).astype(batch_sq._value.dtype)
     return _act(out, act)
 
 
 def spectral_norm(weight, dim: int = 0, power_iters: int = 1, eps: float = 1e-12,
                   name=None):
     """parity: static/nn/common.py spectral_norm — weight / sigma_max via
-    power iteration, with persistent u/v vectors."""
+    power iteration. u/v persist across eager calls (written back after each
+    iteration) so sigma converges over training steps even with
+    power_iters=1, matching the reference's persistent u/v buffers."""
     from ...nn.initializer import Normal
 
     w = _as_t(weight)
@@ -329,9 +342,13 @@ def spectral_norm(weight, dim: int = 0, power_iters: int = 1, eps: float = 1e-12
             uv = m @ vv
             uv = uv / jnp.maximum(jnp.linalg.norm(uv), eps)
         sigma = uv @ m @ vv
-        return wv / sigma
+        return wv / sigma, uv, vv
 
-    return apply(f, w, u, v, op_name="spectral_norm")
+    out = apply(f, w, u, v, op_name="spectral_norm", n_outs=3)
+    wn, u_new, v_new = out[0], out[1], out[2]
+    if not isinstance(u_new._value, (jax.core.Tracer, jax.ShapeDtypeStruct)):
+        u._value, v._value = u_new._value, v_new._value
+    return wn
 
 
 # ------------------------------------------------------------------ misc ops

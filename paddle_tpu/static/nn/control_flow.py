@@ -372,8 +372,7 @@ def py_func(func: Callable, x, out, backward_func=None,
 
     ins = [_as_tensor(t) for t in (x if isinstance(x, (list, tuple)) else [x])]
     out_list = out if isinstance(out, (list, tuple)) else [out]
-    specs = [jax.ShapeDtypeStruct(tuple(t.shape), t._value.dtype
-                                  if not _is_sym(t) else t._value.dtype)
+    specs = [jax.ShapeDtypeStruct(tuple(t.shape), t._value.dtype)
              for t in out_list]
 
     def host(*arrs):

@@ -182,7 +182,11 @@ def sequence_expand(x, y, ref_level=-1, name=None):
     xt, yt = _as_t(x), _as_t(y)
 
     def f(a, b):
-        reps = b.shape[0] // max(a.shape[0], 1)
+        if b.shape[0] % a.shape[0] != 0:
+            raise ValueError(
+                f"sequence_expand: y rows ({b.shape[0]}) must be a multiple "
+                f"of x rows ({a.shape[0]}) in the padded-batch data model")
+        reps = b.shape[0] // a.shape[0]
         return jnp.repeat(a, reps, axis=0) if reps > 1 else a
 
     return apply(f, xt, yt, op_name="sequence_expand")

@@ -78,6 +78,12 @@ class Event:
             self._fenced = True
 
     def elapsed_time(self, end_event: "Event") -> float:
+        if not (self._enable_timing and end_event._enable_timing):
+            # CUDA parity: non-timing events cannot be timed — and here the
+            # timestamps would be unfenced dispatch noise, not device time
+            raise RuntimeError(
+                "events must be created with enable_timing=True to use "
+                "elapsed_time")
         if self._recorded_at is None or end_event._recorded_at is None:
             raise RuntimeError("both events must be recorded first")
         return (end_event._recorded_at - self._recorded_at) * 1e3

@@ -311,19 +311,24 @@ class ShardDataloader:
     def batch_sampler(self):
         return getattr(self._loader, "batch_sampler", None)
 
-    def _dim_for(self, mesh: ProcessMesh):
+    def _dim_for(self, mesh: ProcessMesh, mesh_index: int):
+        """Per-mesh shard dim: a list/tuple maps one entry per mesh
+        (reference contract — e.g. shard inputs on 'dp', labels None);
+        a single value applies to every mesh."""
         sd = self._shard_dims
         if sd is None:
             return None
         if isinstance(sd, (list, tuple)):
-            sd = sd[0]
+            sd = sd[min(mesh_index, len(sd) - 1)]
+        if sd is None:
+            return None
         if isinstance(sd, int):
             return mesh.dim_names[sd]
         return sd
 
-    def _place(self, value, mesh: ProcessMesh):
+    def _place(self, value, mesh: ProcessMesh, mesh_index: int = 0):
         t = value if isinstance(value, Tensor) else Tensor(np.asarray(value))
-        dim = self._dim_for(mesh)
+        dim = self._dim_for(mesh, mesh_index)
         placements = [Replicate() for _ in mesh.dim_names]
         if dim is not None and dim in mesh.dim_names:
             placements[mesh.dim_names.index(dim)] = Shard(0)
@@ -339,21 +344,23 @@ class ShardDataloader:
                 keys = self._input_keys or list(batch.keys())
                 out = {}
                 for i, k in enumerate(keys):
-                    mesh = self._meshes[min(i, len(self._meshes) - 1)]
-                    out[k] = self._place(batch[k], mesh)
+                    mi = min(i, len(self._meshes) - 1)
+                    out[k] = self._place(batch[k], self._meshes[mi], mi)
                 yield out
             elif isinstance(batch, (list, tuple)):
                 out = []
                 for i, item in enumerate(batch):
                     # inputs → first mesh, labels → last mesh
-                    mesh = self._meshes[0] if i == 0 else self._meshes[-1]
+                    mi = 0 if i == 0 else len(self._meshes) - 1
+                    mesh = self._meshes[mi]
                     if isinstance(item, (list, tuple)):
-                        out.append(type(item)(self._place(v, mesh) for v in item))
+                        out.append(type(item)(
+                            self._place(v, mesh, mi) for v in item))
                     else:
-                        out.append(self._place(item, mesh))
+                        out.append(self._place(item, mesh, mi))
                 yield type(batch)(out)
             else:
-                yield self._place(batch, self._meshes[0])
+                yield self._place(batch, self._meshes[0], 0)
 
 
 def shard_dataloader(dataloader, meshes, input_keys=None, shard_dims=None,

@@ -48,6 +48,7 @@ def init(role_maker=None, is_collective=False, strategy: Optional[DistributedStr
         pp=cfg.get("pp_degree", 1),
         sharding=cfg.get("sharding_degree", 1),
         sep=cfg.get("sep_degree", 1),
+        ep=cfg.get("ep_degree", 1),
     )
     set_hybrid_communicate_group(hcg)
     _fleet_initialized = True

@@ -15,6 +15,7 @@ class DistributedStrategy:
             "pp_degree": 1,
             "sharding_degree": 1,
             "sep_degree": 1,
+            "ep_degree": 1,
             "mp_configs": {},
             "pp_configs": {},
         }

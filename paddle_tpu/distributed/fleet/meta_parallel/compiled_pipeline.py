@@ -476,9 +476,27 @@ class CompiledPipelineTrainStep:
         return pipeline_bubble_fraction(self.num_micro, self.num_stages)
 
     def sync_to_model(self):
-        """Write the stacked weights back into the per-stage Tensors (for
-        state_dict / eager eval parity). Head/tail params are shared objects
-        and already current."""
+        """Write the stacked weights back into the per-stage Tensors and
+        re-place head/tail params on their stage submeshes, so the eager
+        per-stage engine (state_dict / eval parity) sees a consistent
+        placement again. A tied (shared head+tail) param belongs to two
+        stages at once and stays on the full mesh — the eager engine treats
+        shared layers as one object, so mixed-submesh eager eval of a tied
+        model should go through the compiled step instead."""
+
+        def put_sub(p, sub):
+            if sub is None:
+                return
+            try:
+                old = p._value.sharding.spec
+            except Exception:
+                old = None
+            spec = PartitionSpec(*[
+                e if e in sub.axis_names else None
+                for e in (old or [None] * p.ndim)
+            ]) if old else PartitionSpec(*([None] * p.ndim))
+            p._value = jax.device_put(np.asarray(p._value), NamedSharding(sub, spec))
+
         for j, t in enumerate(self._params_layer.stacked):
             host = np.asarray(t._value)
             for s, seg in enumerate(self._body_segs):
@@ -496,6 +514,15 @@ class CompiledPipelineTrainStep:
                     ]) if old else PartitionSpec(*([None] * val.ndim))
                     val = jax.device_put(val, NamedSharding(sub, spec))
                 p._value = val
+        head_ids = {id(p) for p in self._head.params}
+        tail_ids = {id(p) for p in self._tail.params}
+        shared = head_ids & tail_ids
+        for p in self._head.params:
+            if id(p) not in shared:
+                put_sub(p, self._pipe._submeshes[0])
+        for p in self._tail.params:
+            if id(p) not in shared:
+                put_sub(p, self._pipe._submeshes[self._pipe._num_stages - 1])
         return self._pipe
 
     def __call__(self, x, y):

@@ -92,11 +92,15 @@ class HybridCommunicateGroup:
     """parity: topology.py:178. Holds the named-axis mesh and exposes the
     reference's per-axis rank/world-size query surface."""
 
-    # reference axis order; jax mesh axis names use the fleet short names
-    AXES = ("dp", "pp", "sharding", "sep", "mp")
+    # reference axis order; jax mesh axis names use the fleet short names.
+    # 'ep' (expert parallel) extends the reference's 5-D topology — the
+    # reference gives MoE its own group built from dp ranks
+    # (moe_layer.py:263); here it is a first-class mesh axis so the ragged
+    # all-to-all dispatch rides ICI like every other collective.
+    AXES = ("dp", "pp", "sharding", "sep", "ep", "mp")
 
-    def __init__(self, dp=1, mp=1, pp=1, sharding=1, sep=1, devices=None):
-        dims = dict(dp=dp, pp=pp, sharding=sharding, sep=sep, mp=mp)
+    def __init__(self, dp=1, mp=1, pp=1, sharding=1, sep=1, ep=1, devices=None):
+        dims = dict(dp=dp, pp=pp, sharding=sharding, sep=sep, ep=ep, mp=mp)
         self._dims = dims
         n_needed = int(np.prod(list(dims.values())))
         devs = np.asarray(devices if devices is not None else jax.devices())
@@ -106,8 +110,9 @@ class HybridCommunicateGroup:
             )
         grid = devs[:n_needed].reshape([dims[a] for a in self.AXES])
         self._mesh = Mesh(grid, self.AXES)
-        self._topo = CommunicateTopology(("data", "pipe", "sharding", "sep", "model"),
-                                         [dims[a] for a in self.AXES])
+        self._topo = CommunicateTopology(
+            ("data", "pipe", "sharding", "sep", "expert", "model"),
+            [dims[a] for a in self.AXES])
         self.global_rank = jax.process_index()
 
     # ---- mesh access (TPU-native surface) ----
@@ -202,6 +207,17 @@ class HybridCommunicateGroup:
         from .communication.group import Group
 
         return Group.for_axis(self, "sep")
+
+    def get_expert_parallel_rank(self):
+        return self._axis_rank("ep")
+
+    def get_expert_parallel_world_size(self):
+        return self._dims["ep"]
+
+    def get_expert_parallel_group(self):
+        from .communication.group import Group
+
+        return Group.for_axis(self, "ep")
 
 
 _global_hcg: Optional[HybridCommunicateGroup] = None

@@ -287,10 +287,23 @@ class PTQ(Quantization):
         return self._wrap_model(model, inplace)
 
 
-# ------------------------------------------------- weight-only int8 tier
+# ------------------------------------------- weight-only int8 / fp8 tier
+_FP8_MAX = 448.0  # e4m3fn finite max
+
+
 def weight_quantize(w, algo="weight_only_int8", group_size=-1):
-    """-> (int8 weight, per-out-channel fp scales). w: [in, out]."""
+    """-> (quantized weight, per-out-channel fp scales). w: [in, out].
+
+    ``algo='weight_only_int8'`` → int8 rows scaled to ±127;
+    ``algo='weight_only_fp8'`` (or 'fp8'/'float8_e4m3fn') → float8_e4m3fn
+    storage scaled to ±448 (reference fp8 gemm tier:
+    /root/reference/paddle/phi/kernels/fusion/fp8_gemm/)."""
     wv = np.asarray(w._value if isinstance(w, Tensor) else w)
+    if algo in ("weight_only_fp8", "fp8", "float8_e4m3fn"):
+        scale = np.maximum(np.abs(wv).max(axis=0), 1e-9) / _FP8_MAX
+        q = jnp.asarray(np.clip(wv / scale, -_FP8_MAX, _FP8_MAX),
+                        jnp.float8_e4m3fn)
+        return Tensor(q), Tensor(jnp.asarray(scale.astype(np.float32)))
     scale = np.maximum(np.abs(wv).max(axis=0), 1e-9) / 127.0
     q = np.clip(np.round(wv / scale), -128, 127).astype(np.int8)
     return Tensor(jnp.asarray(q)), Tensor(jnp.asarray(scale.astype(np.float32)))
@@ -304,15 +317,23 @@ def weight_dequantize(qw, scale, algo="weight_only_int8"):
 
 
 def weight_only_linear(x, qweight, bias=None, weight_scale=None, weight_dtype="int8"):
-    """x @ dequant(qweight) + bias — int8 HBM storage, per-tile VMEM dequant
-    into bf16/fp32 MXU compute (Pallas kernel on TPU; jnp fallback)."""
+    """x @ dequant(qweight) + bias — quantized HBM storage, bf16/fp32 MXU
+    compute. int8 rides the Pallas per-tile-dequant kernel; fp8 (e4m3)
+    upcasts to the activation dtype at the matmul (weight-only fp8 = an HBM
+    bandwidth/footprint play; the MXU computes in bf16 either way on v5e)."""
+    if weight_dtype in ("fp8", "float8_e4m3fn", "weight_only_fp8"):
+        def f8(xv, q, s):
+            w = q.astype(xv.dtype) * s.astype(xv.dtype)
+            return xv @ w
 
-    def f(xv, q, s):
-        from ..ops.pallas.int8_matmul import int8_matmul
+        out = apply(f8, x, qweight, weight_scale, op_name="weight_only_linear_fp8")
+    else:
+        def f(xv, q, s):
+            from ..ops.pallas.int8_matmul import int8_matmul
 
-        return int8_matmul(xv, q, s)
+            return int8_matmul(xv, q, s)
 
-    out = apply(f, x, qweight, weight_scale, op_name="weight_only_linear")
+        out = apply(f, x, qweight, weight_scale, op_name="weight_only_linear")
     if bias is not None:
         from ..tensor import math as _m
 

@@ -48,6 +48,14 @@ class SparseCooTensor:
     is_sparse_csr = False
 
     def __init__(self, indices, values: Tensor, shape):
+        # keep a HOST copy of the pattern when the caller hands concrete
+        # indices: under a jit trace jnp conversion yields a tracer, but the
+        # pattern is static data the rulebook convs (sparse/nn) need on host
+        raw = indices._value if isinstance(indices, Tensor) else indices
+        if isinstance(raw, jax.core.Tracer):
+            self._indices_host = None
+        else:
+            self._indices_host = np.asarray(raw).astype(np.int32)
         self._indices = _idx(indices)
         self._values = values if isinstance(values, Tensor) else _t(values)
         self.shape = list(int(s) for s in shape)
@@ -194,11 +202,12 @@ def _creation_values(values, dtype, stop_gradient):
 
 def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
                       stop_gradient=True):
-    indices = _idx(indices)
     values = _creation_values(values, dtype, stop_gradient)
     if shape is None:
-        sp = np.asarray(jnp.max(indices, axis=1)) + 1
+        sp = np.asarray(jnp.max(_idx(indices), axis=1)) + 1
         shape = list(sp.astype(int)) + list(values._value.shape[1:])
+    # pass raw indices through: SparseCooTensor keeps the host copy (the
+    # static pattern) before any jnp conversion
     return SparseCooTensor(indices, values, shape)
 
 

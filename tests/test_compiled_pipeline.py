@@ -242,3 +242,20 @@ class TestCompiledPipelineRealModel:
         for _ in range(4):
             l1 = float(step(ids, ids).numpy())
         assert np.isfinite(l1) and l1 < l0
+
+    def test_sync_to_model_restores_eager_engine(self):
+        _init4d(dp=1, mp=1, pp=2)
+        P.seed(17)
+        cfg, pipe = self._llama()
+        opt = P.optimizer.AdamW(learning_rate=1e-3, parameters=pipe.parameters())
+        step = CompiledPipelineTrainStep(pipe, opt, num_micro=2)
+        ids = P.to_tensor(np.random.RandomState(5).randint(
+            0, cfg.vocab_size, (4, 16)).astype(np.int32))
+        compiled_loss = float(step(ids, ids).numpy())
+        step.sync_to_model()
+        # eager per-stage engine must run again after the placement restore
+        from paddle_tpu.models import LlamaPretrainingCriterion
+
+        crit = LlamaPretrainingCriterion()
+        eager_loss = float(crit(pipe.forward(ids), ids).numpy())
+        assert np.isfinite(eager_loss)

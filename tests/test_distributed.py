@@ -34,7 +34,8 @@ class TestTopology:
         hcg = hcg_2dp_4mp
         assert hcg.get_data_parallel_world_size() == 2
         assert hcg.get_model_parallel_world_size() == 4
-        assert dict(hcg.mesh.shape) == {"dp": 2, "pp": 1, "sharding": 1, "sep": 1, "mp": 4}
+        assert dict(hcg.mesh.shape) == {"dp": 2, "pp": 1, "sharding": 1, "sep": 1,
+                                        "ep": 1, "mp": 4}
 
     def test_comm_topology_groups(self):
         from paddle_tpu.distributed.topology import CommunicateTopology

@@ -77,15 +77,24 @@ class Event:
             synchronize()  # wait for work dispatched before record()
             self._fenced = True
 
+    _warned_untimed = False
+
     def elapsed_time(self, end_event: "Event") -> float:
-        if not (self._enable_timing and end_event._enable_timing):
-            # CUDA parity: non-timing events cannot be timed — and here the
-            # timestamps would be unfenced dispatch noise, not device time
-            raise RuntimeError(
-                "events must be created with enable_timing=True to use "
-                "elapsed_time")
         if self._recorded_at is None or end_event._recorded_at is None:
             raise RuntimeError("both events must be recorded first")
+        if not (self._enable_timing and end_event._enable_timing):
+            # non-timing events never fenced at record(): the delta is host
+            # dispatch wall-clock, not device time — warn once rather than
+            # silently passing it off as a device measurement
+            if not Event._warned_untimed:
+                Event._warned_untimed = True
+                import warnings
+
+                warnings.warn(
+                    "Event.elapsed_time on events created with "
+                    "enable_timing=False measures host dispatch wall-clock, "
+                    "not device time; create Event(enable_timing=True) for "
+                    "fenced timestamps")
         return (end_event._recorded_at - self._recorded_at) * 1e3
 
 
@@ -99,7 +108,10 @@ class Stream:
         self.priority = priority
 
     def record_event(self, event: Event = None) -> Event:
-        event = event or Event()
+        # timing-enabled by default: record_event's dominant use in ported
+        # code is stream timing, and a non-timing event here could never
+        # legally reach elapsed_time
+        event = event or Event(enable_timing=True)
         event.record(self)
         return event
 

@@ -501,19 +501,8 @@ class CompiledPipelineTrainStep:
             host = np.asarray(t._value)
             for s, seg in enumerate(self._body_segs):
                 p = seg.params[j]
-                sub = self._pipe._submeshes[s % self._pipe._num_stages]
-                val = jnp.asarray(host[s])
-                if sub is not None:
-                    try:
-                        old = p._value.sharding.spec
-                    except Exception:
-                        old = None
-                    spec = PartitionSpec(*[
-                        e if e in sub.axis_names else None
-                        for e in (old or [None] * val.ndim)
-                    ]) if old else PartitionSpec(*([None] * val.ndim))
-                    val = jax.device_put(val, NamedSharding(sub, spec))
-                p._value = val
+                p._value = jnp.asarray(host[s])
+                put_sub(p, self._pipe._submeshes[s % self._pipe._num_stages])
         head_ids = {id(p) for p in self._head.params}
         tail_ids = {id(p) for p in self._tail.params}
         shared = head_ids & tail_ids

@@ -26,6 +26,10 @@ from .master import KVClient, KVServer
 __all__ = ["Controller"]
 
 
+class _Rejoin(Exception):
+    """Elastic rendezvous must restart at a bumped epoch."""
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("", 0))
@@ -42,7 +46,17 @@ def _hostname_ip() -> str:
 class Controller:
     def __init__(self, args):
         self.args = args
-        self.nnodes = int(args.nnodes)
+        # --nnodes N, or elastic MIN:MAX (reference elastic manager contract:
+        # membership change → rewrite rank envs, restart at the new world
+        # size, fleet/elastic/manager.py:124,176)
+        spec = str(args.nnodes)
+        if ":" in spec:
+            lo, hi = spec.split(":", 1)
+            self.nnodes_min, self.nnodes_max = int(lo), int(hi)
+        else:
+            self.nnodes_min = self.nnodes_max = int(spec)
+        self.elastic = self.nnodes_min < self.nnodes_max
+        self.nnodes = self.nnodes_max
         self.nproc = int(args.nproc_per_node)
         self.node_rank = int(args.rank)
         self.max_restart = int(args.max_restart)
@@ -52,6 +66,10 @@ class Controller:
         self._master_server: Optional[KVServer] = None
         self._kv: Optional[KVClient] = None
         self.restarts = 0  # == the cluster-wide rendezvous epoch
+        self._members: List[int] = []  # node ranks in the current epoch
+        self._node_ttl = float(os.environ.get("PADDLE_ELASTIC_NODE_TTL", 6.0))
+        self._rdzv_window = float(os.environ.get("PADDLE_ELASTIC_RDZV_WINDOW", 5.0))
+        self._last_beat = 0.0
 
     # -------------------------------------------------- restart coordination
     def _shared_epoch(self) -> int:
@@ -72,10 +90,11 @@ class Controller:
         if self._kv is None:
             return
         self._kv.put("/fail/terminal", str(rc))
-        if self._master_server is not None and self.nnodes > 1:
+        n_peers = (len(self._members) if self._members else self.nnodes) - 1
+        if self._master_server is not None and n_peers > 0:
             deadline = time.time() + 15
             while time.time() < deadline:
-                if len(self._kv.get_prefix("/fail/ack/")) >= self.nnodes - 1:
+                if len(self._kv.get_prefix("/fail/ack/")) >= n_peers:
                     break
                 time.sleep(0.5)
 
@@ -85,10 +104,18 @@ class Controller:
 
     # ------------------------------------------------------------ rendezvous
     def _rendezvous(self) -> Dict[str, str]:
-        """Returns {PADDLE env updates}; single-node short-circuits."""
+        """Returns {PADDLE env updates}; loops on elastic rejoin."""
+        while True:
+            try:
+                return self._rendezvous_once()
+            except _Rejoin:
+                time.sleep(0.5)
+                continue
+
+    def _rendezvous_once(self) -> Dict[str, str]:
         ip = _hostname_ip()
         local_eps = [f"{ip}:{_free_port()}" for _ in range(self.nproc)]
-        if self.nnodes <= 1:
+        if self.nnodes_max <= 1:
             return {
                 "PADDLE_TRAINER_ENDPOINTS": ",".join(local_eps),
                 "_LOCAL_EPS": local_eps,
@@ -103,18 +130,66 @@ class Controller:
         if self._kv is None:
             self._kv = KVClient(master)
         kv = self._kv
+        if self.elastic:
+            # join the job at its CURRENT epoch (scale-out: a late node must
+            # not rendezvous into a stale namespace)
+            self.restarts = max(self.restarts, self._shared_epoch())
         epoch = self.restarts  # new namespace per restart round
         kv.put(f"/rdzv/{epoch}/node/{self.node_rank}", ",".join(local_eps))
-        nodes = kv.wait_n(f"/rdzv/{epoch}/node/", self.nnodes, abort_key="/fail/terminal")
-        ordered = [nodes[f"/rdzv/{epoch}/node/{i}"] for i in range(self.nnodes)]
+
+        if not self.elastic:
+            nodes = kv.wait_n(f"/rdzv/{epoch}/node/", self.nnodes,
+                              abort_key="/fail/terminal")
+            member_ranks = list(range(self.nnodes))
+        else:
+            nodes, member_ranks = self._elastic_wait(kv, epoch)
+        self._members = member_ranks
+        my_pos = member_ranks.index(self.node_rank)
+        ordered = [nodes[f"/rdzv/{epoch}/node/{i}"] for i in member_ranks]
         all_eps: List[str] = []
         for eps in ordered:
             all_eps.extend(eps.split(","))
         return {
             "PADDLE_TRAINER_ENDPOINTS": ",".join(all_eps),
             "_LOCAL_EPS": local_eps,
-            "_RANK_OFFSET": self.node_rank * self.nproc,
+            "_RANK_OFFSET": my_pos * self.nproc,
         }
+
+    def _elastic_wait(self, kv, epoch):
+        """Elastic sign-in: the lowest-ranked registrant COMMITS the
+        membership once max nodes arrive or the window closes with >= min —
+        everyone else adopts the committed list (single source of truth, so
+        no node computes a different world size)."""
+        prefix = f"/rdzv/{epoch}/node/"
+        commit_key = f"/rdzv/{epoch}/commit"
+        deadline = time.time() + 300
+        window_end = None
+        while time.time() < deadline:
+            commit = kv.get(commit_key)
+            if commit:
+                member_ranks = [int(r) for r in commit.split(",")]
+                if self.node_rank not in member_ranks:
+                    # we signed in after the commit: force the next epoch so
+                    # the running members re-rendezvous with us (scale-out)
+                    self.restarts = epoch + 1
+                    self._signal_restart(self.restarts)
+                    raise _Rejoin()
+                nodes = kv.get_prefix(prefix)
+                return nodes, member_ranks
+            if kv.get("/fail/terminal") is not None:
+                raise TimeoutError("rendezvous aborted: peer failed terminally")
+            got = kv.get_prefix(prefix)
+            ranks = sorted(int(k.rsplit("/", 1)[-1]) for k in got)
+            if ranks and window_end is None:
+                window_end = time.time() + self._rdzv_window
+            complete = len(ranks) >= self.nnodes_max
+            window_ok = (window_end is not None and time.time() >= window_end
+                         and len(ranks) >= self.nnodes_min)
+            if (complete or window_ok) and ranks and ranks[0] == self.node_rank:
+                kv.put(commit_key, ",".join(str(r) for r in ranks))
+                return got, ranks
+            time.sleep(0.2)
+        raise TimeoutError("elastic rendezvous timed out")
 
     # ------------------------------------------------------------ processes
     def _spawn(self):
@@ -122,7 +197,9 @@ class Controller:
         eps = rdzv["PADDLE_TRAINER_ENDPOINTS"]
         local_eps = rdzv["_LOCAL_EPS"]
         offset = rdzv["_RANK_OFFSET"]
-        world = self.nnodes * self.nproc
+        n_nodes = len(self._members) if self._members else self.nnodes
+        world = n_nodes * self.nproc
+        self._spawned_at = time.time()
         if self.log_dir:
             os.makedirs(self.log_dir, exist_ok=True)
         for i in range(self.nproc):
@@ -169,6 +246,37 @@ class Controller:
             f.close()
         self._procs, self._logs = [], []
 
+    def _stale_members(self) -> List[int]:
+        """Current-epoch member nodes whose controller heartbeat expired.
+
+        Heartbeat keys are scoped to the rendezvous epoch (a rejoining node's
+        pre-crash beats can't poison the new epoch), and staleness is judged
+        by OUR clock watching for the value to change — producer timestamps
+        are opaque tokens, so cross-host clock skew cannot fake a death. A
+        member that never beat in this epoch counts as stale only after a
+        startup grace of 2×TTL from our own spawn."""
+        now = time.time()
+        beats = self._kv.get_prefix(f"/hb/{self.restarts}/node/")
+        out = []
+        grace_over = now - getattr(self, "_spawned_at", now) > 2 * self._node_ttl
+        seen = getattr(self, "_beat_seen", None)
+        if seen is None or seen.get("_epoch") != self.restarts:
+            seen = self._beat_seen = {"_epoch": self.restarts}
+        for r in self._members:
+            if r == self.node_rank:
+                continue
+            v = beats.get(f"/hb/{self.restarts}/node/{r}")
+            if v is None:
+                if grace_over:
+                    out.append(r)
+                continue
+            prev = seen.get(r)
+            if prev is None or prev[0] != v:
+                seen[r] = (v, now)  # value changed: alive as of now (our clock)
+            elif now - prev[1] > self._node_ttl:
+                out.append(r)
+        return out
+
     def _check_procs(self) -> Optional[int]:
         """None while healthy/running; 0 when all exited cleanly; else the
         first failing exit code (parity: LauncherInterface._check_procs)."""
@@ -198,6 +306,15 @@ class Controller:
                 time.sleep(0.2)
                 ticks += 1
                 rc = self._check_procs()
+                if rc is None and self._kv is not None and self.elastic:
+                    now = time.time()
+                    if now - self._last_beat >= 1.0:
+                        # epoch-scoped + monotonically counted: staleness is
+                        # judged by the OBSERVER's clock watching for value
+                        # changes, so producer clock skew can't fake a death
+                        self._kv.put(f"/hb/{self.restarts}/node/{self.node_rank}",
+                                     str(now))
+                        self._last_beat = now
                 if rc is None and self._kv is not None and ticks % 5 == 0:
                     terminal = self._kv.get("/fail/terminal")
                     if terminal is not None:
@@ -213,6 +330,26 @@ class Controller:
                         self._kill_all()
                         self.restarts = peer_epoch
                         rejoin = True
+                    elif self.elastic:
+                        dead = self._stale_members()
+                        alive = len(self._members) - len(dead)
+                        if dead and alive >= self.nnodes_min:
+                            # membership change: scale-in — rewrite rank envs
+                            # and restart at the smaller world size
+                            self.restarts += 1
+                            self._signal_restart(self.restarts)
+                            print(f"[launch] node(s) {sorted(dead)} lost; "
+                                  f"scaling in to {alive} node(s), epoch "
+                                  f"{self.restarts}", file=sys.stderr, flush=True)
+                            self._kill_all()
+                            rejoin = True
+                        elif dead:
+                            print(f"[launch] node(s) {sorted(dead)} lost and "
+                                  f"only {alive} < min {self.nnodes_min} "
+                                  "remain; failing", file=sys.stderr, flush=True)
+                            self._broadcast_terminal(1)
+                            self._kill_all()
+                            return 1
             if rejoin:
                 continue
             if rc == 0:
@@ -246,9 +383,10 @@ class Controller:
         if self._kv is None:
             return "done"
         self._kv.put(f"/done/{self.restarts}/node/{self.node_rank}", "0")
+        n_members = len(self._members) if self._members else self.nnodes
         deadline = time.time() + timeout
         while time.time() < deadline:
-            if len(self._kv.get_prefix(f"/done/{self.restarts}/node/")) >= self.nnodes:
+            if len(self._kv.get_prefix(f"/done/{self.restarts}/node/")) >= n_members:
                 return "done"
             if self._kv.get("/fail/terminal") is not None:
                 return "failed"

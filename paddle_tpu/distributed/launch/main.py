@@ -27,7 +27,11 @@ def _parser() -> argparse.ArgumentParser:
         prog="paddle_tpu.distributed.launch",
         description="paddle_tpu distributed launcher",
     )
-    p.add_argument("--nnodes", type=int, default=1, help="number of nodes")
+    p.add_argument("--nnodes", type=str, default="1",
+                   help="number of nodes, or an elastic range 'MIN:MAX' — "
+                        "with a range, a dead node triggers re-rendezvous at "
+                        "the smaller world size (scale-in) and a joining "
+                        "node triggers scale-out")
     p.add_argument("--nproc_per_node", type=int, default=1,
                    help="worker processes per node")
     p.add_argument("--rank", type=int, default=0, help="this node's rank")

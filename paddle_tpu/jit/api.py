@@ -236,6 +236,19 @@ class StaticFunction:
             b <<= 1
         return b
 
+    def _run_segmented(self, args, kwargs):
+        """Graph-break execution: record ops lazily, compile one segment per
+        host-read boundary (jit.lazy_segments)."""
+        from . import lazy_segments
+        from .hlo_dump import dump_dir
+
+        name = getattr(self._fn, "__name__", "fn")
+        out, nseg = lazy_segments.run_segmented(
+            self._fn, args, kwargs, name=name,
+            dump_name=f"to_static_{name}" if dump_dir() else None)
+        self.last_segment_count = nseg
+        return out
+
     def __call__(self, *args, **kwargs):
         if not _to_static_enabled:
             return self._fn(*args, **kwargs)  # jit.enable_to_static(False)
@@ -264,7 +277,7 @@ class StaticFunction:
         state_tensors = self._state_tensors()
         key = self._guards(arg_tensors, spec, training)
         if key in self._fallback_keys:
-            return self._fn(*args, **kwargs)  # cached graph-break: stay eager
+            return self._run_segmented(args, kwargs)  # cached graph-break
         entry = self._cache.get(key)
         n_state = len(state_tensors)
         new_entry = entry is None
@@ -285,10 +298,13 @@ class StaticFunction:
             raw_outs = entry["fwd"](rng_key, flat_vals)
         except _TRACE_BREAK_ERRORS as e:
             # graph break: the function does data-dependent Python (e.g.
-            # .numpy()/bool() on a traced value). Fall back to eager for this
-            # specialization and remember it — the SOT capability contract
-            # (trace Python, resume eagerly at breaks) without the bytecode
-            # interpreter. full_graph=True keeps the reference's strict mode.
+            # .numpy()/bool() on a traced value). Switch this specialization
+            # to SEGMENTED execution — ops before each host read compile as
+            # one program, the read runs on the materialized value, and the
+            # ops after form the next compiled segment (the SOT
+            # split-at-the-failing-op contract, opcode_executor.py:1594,
+            # without a bytecode interpreter). full_graph=True keeps the
+            # reference's strict mode.
             if self._full_graph:
                 raise
             self._fallback_keys.add(key)
@@ -300,9 +316,10 @@ class StaticFunction:
                 name = getattr(self._fn, "__name__", "fn")
                 warnings.warn(
                     f"to_static({name}): graph break "
-                    f"({type(e).__name__}); falling back to eager for this "
-                    "input signature. Pass full_graph=True to error instead.")
-            return self._fn(*args, **kwargs)
+                    f"({type(e).__name__}); splitting this input signature "
+                    "into compiled segments at host reads. Pass "
+                    "full_graph=True to error instead.")
+            return self._run_segmented(args, kwargs)
         meta = entry["meta"]
         out_spec = meta["out_spec"]
         updated_buffers = meta["updated_buffers"]

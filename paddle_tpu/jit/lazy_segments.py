@@ -1,0 +1,290 @@
+"""Mid-function graph break: segmented lazy execution (reference analog: the
+SOT bytecode executor's split-at-the-failing-op resume,
+/root/reference/python/paddle/jit/sot/opcode_translator/executor/opcode_executor.py:1594
++ pycode_generator.py resume functions).
+
+TPU-native formulation (LazyTensor-style): the reference rewrites bytecode so
+the compiled prefix hands control back to eager Python at the breaking op and
+a resume function re-enters compilation. Here Python always runs the WHOLE
+function, but ops dispatched while a :class:`SegmentContext` is active don't
+execute — they record into the current segment with abstract
+(ShapeDtypeStruct) results. A host read (``.numpy()``, ``bool()``, ``item``,
+…) on a pending tensor FLUSHES the segment: the recorded ops replay as one
+XLA computation, pending tensors materialize, and Python proceeds with
+concrete values — then subsequent ops open the next segment. One ``.numpy()``
+mid-model therefore yields exactly two compiled segments instead of dropping
+the whole function to per-op eager.
+
+Guards are per segment: each flush re-traces the recorded ops to a jaxpr
+(cheap abstract eval) whose printed form + input avals key the compiled-
+executable cache; the jaxpr's constants are passed as runtime arguments, so
+per-call constants (fresh RNG keys, host-read scalars folded into later
+segments) hit the same executable instead of recompiling.
+
+Backward: each flushed segment becomes ONE tape GradNode over its external
+inputs (params included), so ``loss.backward()`` through a segmented forward
+matches full-eager — host-read values are constants w.r.t. grad in both
+worlds.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd import tape
+
+__all__ = ["SegmentContext", "current", "run_segmented"]
+
+# compiled segment executables keyed by (jaxpr text, const avals, in avals) —
+# process-global so every StaticFunction shares hits. Host-read Python
+# scalars folded into later segments appear as jaxpr literals, so such a
+# segment re-specializes per distinct value — the SOT value-guard semantics
+# (executor_cache.py guards on read values); the LRU bound keeps that from
+# growing without limit.
+from collections import OrderedDict
+
+_segment_cache: "OrderedDict[Any, Any]" = OrderedDict()
+_SEGMENT_CACHE_MAX = 256
+
+
+def _cache_get(key):
+    hit = _segment_cache.get(key)
+    if hit is not None:
+        _segment_cache.move_to_end(key)
+    return hit
+
+
+def _cache_put(key, fn):
+    _segment_cache[key] = fn
+    if len(_segment_cache) > _SEGMENT_CACHE_MAX:
+        _segment_cache.popitem(last=False)
+    return fn
+
+
+def current() -> Optional["SegmentContext"]:
+    from ..ops import dispatch
+
+    return dispatch._lazy_ctx
+
+
+class SegmentContext:
+    def __init__(self, name: str = "fn", dump_name: Optional[str] = None):
+        self.name = name
+        self.dump_name = dump_name
+        # one queued segment: (fn, input value-refs, output abstract refs)
+        self.ops: List[Tuple[Callable, List, List]] = []
+        # identity of every PENDING abstract value object -> its holder
+        # tensors (tensors whose ._value is that abstract); op inputs and
+        # host reads resolve by VALUE identity, so rewraps and in-place
+        # adoptions of a pending value are all covered
+        self.pending: Dict[int, List] = {}
+        # abstract-value id -> concrete result, for values from past flushes
+        self.materialized: Dict[int, Any] = {}
+        self.segments_run = 0
+
+    def alias(self, target, result) -> None:
+        """``target`` adopted ``result``'s pending value (in-place op): the
+        flush must materialize (and grad-wire) target too."""
+        holders = self.pending.get(id(result._value))
+        # identity membership (``in`` would run Tensor.__eq__ elementwise)
+        if holders is not None and all(h is not target for h in holders):
+            holders.append(target)
+
+    def _resolve(self, t):
+        """Fix up a tensor whose value was materialized by an earlier flush."""
+        v = t._value
+        hit = self.materialized.get(id(v))
+        if hit is not None:
+            t._value = hit
+        return t._value
+
+    # ------------------------------------------------------------ recording
+    def __enter__(self):
+        from ..ops import dispatch
+
+        self._prev = dispatch._lazy_ctx
+        dispatch._lazy_ctx = self
+        return self
+
+    def __exit__(self, *exc):
+        from ..ops import dispatch
+
+        dispatch._lazy_ctx = self._prev
+        return False
+
+    def record(self, fn: Callable, inputs, op_name: str):
+        """Defer one op: abstract-eval the result, queue the application."""
+        from ..tensor.tensor import Tensor
+
+        in_vals = [self._resolve(t) for t in inputs]
+        metas = [
+            v if isinstance(v, jax.ShapeDtypeStruct)
+            else jax.ShapeDtypeStruct(jnp.shape(v), jnp.result_type(v))
+            for v in in_vals
+        ]
+        out = jax.eval_shape(fn, *metas)
+        multi = isinstance(out, (tuple, list))
+        outs = list(out) if multi else [out]
+        stop = all(t.stop_gradient for t in inputs) or not tape.grad_enabled()
+        out_tensors = [Tensor(o, stop_gradient=stop) for o in outs]
+        # capture the out VALUE refs NOW — the out tensor may later adopt a
+        # different pending value (in-place ops), but the dataflow is by ref
+        out_pairs = [(t, t._value) for t in out_tensors]
+        for t, ref in out_pairs:
+            self.pending[id(ref)] = [t]
+        # the op keeps (input VALUE refs, grad-relevant input tensors)
+        self.ops.append((fn, list(zip(in_vals, inputs)), out_pairs))
+        if multi:
+            return out_tensors if isinstance(out, list) else tuple(out_tensors)
+        return out_tensors[0]
+
+    # -------------------------------------------------------------- flushing
+    def flush(self):
+        """Compile + run the queued segment; materialize pending tensors."""
+        if not self.ops:
+            return
+        from ..ops import dispatch
+
+        ops, self.ops = self.ops, []
+        pending, self.pending = self.pending, {}
+        saved_ctx, dispatch._lazy_ctx = dispatch._lazy_ctx, None
+        try:
+            self._flush_impl(ops, pending)
+        finally:
+            dispatch._lazy_ctx = saved_ctx
+        self.segments_run += 1
+
+    def _flush_impl(self, ops, pending):
+        # env is keyed by VALUE-object identity (abstract refs for produced
+        # values, concrete arrays for externals)
+        produced = set()
+        for _, _, outs in ops:
+            produced.update(id(ref) for _, ref in outs)
+        ext_vals_list, ext_tensors, seen = [], [], set()
+        for _, ins, _ in ops:
+            for vref, t in ins:
+                if id(vref) not in produced and id(vref) not in seen:
+                    seen.add(id(vref))
+                    ext_vals_list.append(vref)
+                    ext_tensors.append(t)
+        flat_pairs = [pair for _, _, outs in ops for pair in outs]
+        flat_outs = [t for t, _ in flat_pairs]
+        out_refs = [ref for _, ref in flat_pairs]
+
+        def replay(*ext_in):
+            env = {id(v): x for v, x in zip(ext_vals_list, ext_in)}
+            for fn, ins, outs in ops:
+                vals = [env[id(vref)] if id(vref) in env else vref
+                        for vref, _ in ins]
+                res = fn(*vals)
+                rs = list(res) if isinstance(res, (tuple, list)) else [res]
+                for (_, ref), r in zip(outs, rs):
+                    env[id(ref)] = r
+            return tuple(env[id(r)] for r in out_refs)
+
+        ext = ext_tensors
+        ext_vals = ext_vals_list
+        needs_grad = tape.grad_enabled() and any(not t.stop_gradient for t in ext)
+
+        # one compiled executable per segment, fwd and (lazily keyed) bwd —
+        # jaxpr text + avals are the per-segment guards; consts ride as
+        # runtime args so per-call constants reuse the executable
+        closed = jax.make_jaxpr(replay)(*ext_vals)
+        const_avals = tuple((jnp.shape(c), str(jnp.result_type(c)))
+                            for c in closed.consts)
+        in_avals = tuple((jnp.shape(v), str(jnp.result_type(v))) for v in ext_vals)
+        key = (str(closed.jaxpr), const_avals, in_avals)
+        fwd = _cache_get(key)
+        if fwd is None:
+            def run_jaxpr(consts, args, _jaxpr=closed.jaxpr):
+                return jax.core.eval_jaxpr(_jaxpr, consts, *args)
+
+            fwd = _cache_put(key, jax.jit(run_jaxpr))
+        self._maybe_dump(replay, ext_vals)
+        out_vals = fwd(list(closed.consts), list(ext_vals))
+
+        node = None
+        if needs_grad:
+            bkey = (key, "bwd")
+            bwd = _cache_get(bkey)
+            if bwd is None:
+                def run_bwd(consts, args, cots, _jaxpr=closed.jaxpr):
+                    # recompute-forward vjp in ONE program (remat — the
+                    # TPU-favored memory/compute tradeoff, same as
+                    # StaticFunction's fwd_bwd)
+                    _, vjp = jax.vjp(
+                        lambda *a: tuple(jax.core.eval_jaxpr(_jaxpr, consts, *a)),
+                        *args)
+                    return vjp(tuple(cots))
+
+                bwd = _cache_put(bkey, jax.jit(run_bwd))
+            consts_now, ext_now = list(closed.consts), list(ext_vals)
+
+            def vjp_fn(cots, _bwd=bwd, _c=consts_now, _e=ext_now):
+                return _bwd(_c, _e, list(cots))
+
+            node = tape.GradNode(vjp_fn, ext, list(out_vals),
+                                 name=f"segment_{self.segments_run}", fn=replay,
+                                 out_struct="tuple")
+
+        for i, (t, ref, v) in enumerate(zip(flat_outs, out_refs, out_vals)):
+            self.materialized[id(ref)] = v
+            for holder in pending.get(id(ref), [t]):
+                holder._value = v
+                if node is not None and not holder.stop_gradient:
+                    holder._grad_node = node
+                    holder._out_index = i
+
+    def _maybe_dump(self, replay, ext_vals):
+        if self.dump_name is None:
+            return
+        from .hlo_dump import dump_dir, maybe_dump
+
+        if dump_dir():
+            maybe_dump(f"{self.dump_name}_seg{self.segments_run}",
+                       jax.jit(lambda *vs: replay(*vs)), tuple(ext_vals))
+
+
+def materialize_if_lazy(t) -> None:
+    """Host-read hook: flush the active segment when ``t`` is pending, or
+    fix up a value materialized by an earlier flush."""
+    ctx = current()
+    if ctx is None:
+        return
+    vid = id(t._value)
+    if vid in ctx.pending:
+        ctx.flush()
+    hit = ctx.materialized.get(vid)
+    if hit is not None:
+        t._value = hit
+
+
+def run_segmented(fn: Callable, args, kwargs, name: str = "fn",
+                  dump_name: Optional[str] = None):
+    """Execute ``fn`` with op recording + flush-on-host-read; returns
+    (output, segment_count)."""
+    ctx = SegmentContext(name=name, dump_name=dump_name)
+    with ctx:
+        out = fn(*args, **kwargs)
+    ctx.flush()  # trailing segment (also materializes the outputs)
+    # fix up output leaves that hold already-materialized refs (rewraps)
+    from ..tensor.tensor import Tensor
+
+    def fix(o):
+        if isinstance(o, Tensor):
+            hit = ctx.materialized.get(id(o._value))
+            if hit is not None:
+                o._value = hit
+        elif isinstance(o, (list, tuple)):
+            for x in o:
+                fix(x)
+        elif isinstance(o, dict):
+            for x in o.values():
+                fix(x)
+
+    fix(out)
+    return out, ctx.segments_run

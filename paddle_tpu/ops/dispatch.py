@@ -26,6 +26,20 @@ _stats_sink = None
 # instead of executing (see paddle_tpu.static)
 _static_capture = False
 
+# mid-function graph break (jit.lazy_segments.SegmentContext): when set, ops
+# record into the current segment instead of executing; host reads flush
+_lazy_ctx = None
+
+# abstract-eval failures that mean "this op is inherently data-dependent"
+# (e.g. masked_select's dynamic shape) — the segment flushes and the op runs
+# eagerly on the materialized values
+_LAZY_BREAK_ERRORS = tuple(
+    getattr(jax.errors, n)
+    for n in ("TracerArrayConversionError", "TracerBoolConversionError",
+              "TracerIntegerConversionError", "ConcretizationTypeError")
+    if hasattr(jax.errors, n)
+)
+
 
 def _wrap(val, node, index, stop_gradient):
     from ..tensor.tensor import Tensor
@@ -73,6 +87,18 @@ def apply(fn: Callable, *inputs, op_name: str = "", n_outs: int = 1):
         from ..static import _capture
 
         return _capture(fn, inputs, op_name)
+    if _lazy_ctx is not None:
+        ctx = _lazy_ctx
+
+        def amp_fn(*vs, _fn=fn, _op=op_name):
+            return _fn(*_amp_cast_vals(_op, vs))
+
+        try:
+            return ctx.record(amp_fn, inputs, op_name)
+        except _LAZY_BREAK_ERRORS:
+            # op can't abstract-eval (data-dependent shape): flush the
+            # segment so its inputs are concrete, then run it eagerly below
+            ctx.flush()
     vals = tuple(t._value for t in inputs)
     vals = _amp_cast_vals(op_name, vals)
     needs_grad = tape.grad_enabled() and any(not t.stop_gradient for t in inputs)

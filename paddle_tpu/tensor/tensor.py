@@ -25,6 +25,8 @@ import jax.numpy as jnp
 from ..autograd import tape
 from ..framework import dtype as dtype_mod
 
+_dispatch_mod = None  # lazily bound ops.dispatch (host-read barrier fast path)
+
 __all__ = ["Tensor"]
 
 _name_counter = itertools.count()
@@ -138,31 +140,58 @@ class Tensor:
         self.is_parameter = bool(v)
 
     # ---------------- conversion ----------------
+    def _sync_for_host(self):
+        """Host-read barrier: in segmented-lazy mode (jit.lazy_segments) a
+        pending tensor forces its segment to compile+run before the value
+        crosses to Python — the mid-function graph-break point."""
+        global _dispatch_mod
+        if _dispatch_mod is None:
+            from ..ops import dispatch as _d
+
+            _dispatch_mod = _d
+        ctx = _dispatch_mod._lazy_ctx
+        if ctx is None:
+            return
+        vid = id(self._value)
+        if vid in ctx.pending:
+            ctx.flush()
+        hit = ctx.materialized.get(vid)
+        if hit is not None:
+            self._value = hit
+
     def numpy(self) -> np.ndarray:
+        self._sync_for_host()
         return np.asarray(self._value)
 
     def __array__(self, dtype=None):
+        self._sync_for_host()
         a = np.asarray(self._value)
         return a.astype(dtype) if dtype is not None else a
 
     def item(self, *args):
+        self._sync_for_host()
         if args:
             return self._value[args].item() if len(args) > 1 else np.asarray(self._value).flat[args[0]].item()
         return self._value.item()
 
     def tolist(self):
+        self._sync_for_host()
         return np.asarray(self._value).tolist()
 
     def __float__(self):
+        self._sync_for_host()
         return float(self._value)
 
     def __int__(self):
+        self._sync_for_host()
         return int(self._value)
 
     def __bool__(self):
+        self._sync_for_host()
         return bool(self._value)
 
     def __index__(self):
+        self._sync_for_host()
         return int(self._value)
 
     def __len__(self):
@@ -285,6 +314,17 @@ class Tensor:
         self._grad_node = result._grad_node
         self._out_index = result._out_index
         self._version += 1
+        # segmented-lazy mode: the adopted value may be PENDING — alias this
+        # tensor to the recorded result so the flush materializes both (else
+        # a later host read on self wouldn't trigger, and the update is lost)
+        global _dispatch_mod
+        if _dispatch_mod is None:
+            from ..ops import dispatch as _d
+
+            _dispatch_mod = _d
+        ctx = _dispatch_mod._lazy_ctx
+        if ctx is not None and id(result._value) in ctx.pending:
+            ctx.alias(self, result)
         return self
 
     def set_value(self, value):

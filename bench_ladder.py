@@ -144,21 +144,42 @@ def bench_llama_decode():
     model.eval()
     ids = P.to_tensor(np.random.RandomState(0).randint(
         0, cfg.vocab_size, (batch, prompt)).astype(np.int32))
-    # whole decode loop compiled into ONE program (single dispatch)
-    out = greedy_decode(model, ids, max_new_tokens=new, max_length=prompt + new)
-    out.numpy()  # compile + warm
-    t0 = time.perf_counter()
-    out = greedy_decode(model, ids, max_new_tokens=new, max_length=prompt + new)
-    out.numpy()
-    dt = time.perf_counter() - t0
-    tps = batch * out.shape[1] / dt
+
+    # whole decode loop compiled into ONE program. Per-step time comes from
+    # the SLOPE between two decode lengths: through a remote/tunneled chip a
+    # single call carries a large fixed dispatch+sync overhead (measured
+    # ~130 ms here) that is an artifact of the dev link, not the serving
+    # step — the slope isolates the real per-token cost.
+    ring = prompt + (3 * new if on_accel else new)
+
+    def run(n):
+        out = greedy_decode(model, ids, max_new_tokens=n, max_length=ring)
+        out.numpy()  # compile + warm
+        best = 1e9
+        for _ in range(2 if on_accel else 1):
+            t0 = time.perf_counter()
+            out = greedy_decode(model, ids, max_new_tokens=n, max_length=ring)
+            out.numpy()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_lo = run(new)
+    if on_accel:
+        t_hi = run(3 * new)
+        per_step = (t_hi - t_lo) / (2 * new)
+    else:
+        per_step = t_lo / new
+    tps = batch / per_step
     print(json.dumps({
         "metric": "llama_1b_decode_tokens_per_sec_per_chip",
         "value": round(tps, 1),
         "unit": "tokens/s",
         "extra": {"backend": backend, "batch": batch, "prompt": prompt,
-                  "new_tokens": int(out.shape[1]),
-                  "ms_per_token_per_seq": round(dt / out.shape[1] * 1e3, 2)},
+                  "new_tokens": new, "ring": ring,
+                  "ms_per_token_per_seq": round(per_step * 1e3, 2),
+                  "method": "slope over decode lengths (removes fixed "
+                            "dispatch overhead of the tunneled dev chip)",
+                  "single_call_s": round(t_lo, 3)},
     }))
 
 

@@ -386,6 +386,14 @@ class Controller:
         n_members = len(self._members) if self._members else self.nnodes
         deadline = time.time() + timeout
         while time.time() < deadline:
+            if self.elastic:
+                # keep beating: peers still training must not mistake our
+                # clean finish for a node death (spurious scale-in)
+                now = time.time()
+                if now - self._last_beat >= 1.0:
+                    self._kv.put(f"/hb/{self.restarts}/node/{self.node_rank}",
+                                 str(now))
+                    self._last_beat = now
             if len(self._kv.get_prefix(f"/done/{self.restarts}/node/")) >= n_members:
                 return "done"
             if self._kv.get("/fail/terminal") is not None:

@@ -252,6 +252,13 @@ class StaticFunction:
     def __call__(self, *args, **kwargs):
         if not _to_static_enabled:
             return self._fn(*args, **kwargs)  # jit.enable_to_static(False)
+        from ..ops import dispatch as _dispatch
+
+        if _dispatch._lazy_ctx is not None:
+            # called from inside a segmented (graph-broken) outer function:
+            # inline — our ops record into the OUTER segment; invoking the
+            # compiled entry would hand it pending abstract values
+            return self._fn(*args, **kwargs)
         training = self._layer.training if self._layer is not None else True
         arg_tensors, spec = flatten_tensors((args, kwargs))
 

@@ -39,6 +39,7 @@ from ..autograd import tape
 
 __all__ = ["SegmentContext", "current", "run_segmented"]
 
+
 # compiled segment executables keyed by (jaxpr text, const avals, in avals) —
 # process-global so every StaticFunction shares hits. Host-read Python
 # scalars folded into later segments appear as jaxpr literals, so such a
@@ -82,9 +83,25 @@ class SegmentContext:
         # host reads resolve by VALUE identity, so rewraps and in-place
         # adoptions of a pending value are all covered
         self.pending: Dict[int, List] = {}
-        # abstract-value id -> concrete result, for values from past flushes
+        # abstract-value id -> (ref, concrete result) for values from past
+        # flushes; the REF is kept alive on purpose — keying by id() of a
+        # collected object would let CPython reuse the address for a fresh
+        # abstract value and silently substitute a stale array
         self.materialized: Dict[int, Any] = {}
         self.segments_run = 0
+
+    def resolve_tensor(self, t) -> None:
+        """Fix up a tensor whose abstract value a past flush materialized."""
+        hit = self.materialized.get(id(t._value))
+        if hit is not None:
+            t._value = hit[1]
+
+    def forget_holder(self, t) -> None:
+        """A raw value overwrite (set_value/zero_/fill_) on a pending tensor:
+        drop it from the holder list so the flush won't clobber the write."""
+        holders = self.pending.get(id(t._value))
+        if holders is not None:
+            holders[:] = [h for h in holders if h is not t]
 
     def alias(self, target, result) -> None:
         """``target`` adopted ``result``'s pending value (in-place op): the
@@ -96,10 +113,9 @@ class SegmentContext:
 
     def _resolve(self, t):
         """Fix up a tensor whose value was materialized by an earlier flush."""
-        v = t._value
-        hit = self.materialized.get(id(v))
+        hit = self.materialized.get(id(t._value))
         if hit is not None:
-            t._value = hit
+            t._value = hit[1]
         return t._value
 
     # ------------------------------------------------------------ recording
@@ -232,7 +248,7 @@ class SegmentContext:
                                  out_struct="tuple")
 
         for i, (t, ref, v) in enumerate(zip(flat_outs, out_refs, out_vals)):
-            self.materialized[id(ref)] = v
+            self.materialized[id(ref)] = (ref, v)  # ref kept alive (id reuse)
             for holder in pending.get(id(ref), [t]):
                 holder._value = v
                 if node is not None and not holder.stop_gradient:
@@ -249,20 +265,6 @@ class SegmentContext:
                        jax.jit(lambda *vs: replay(*vs)), tuple(ext_vals))
 
 
-def materialize_if_lazy(t) -> None:
-    """Host-read hook: flush the active segment when ``t`` is pending, or
-    fix up a value materialized by an earlier flush."""
-    ctx = current()
-    if ctx is None:
-        return
-    vid = id(t._value)
-    if vid in ctx.pending:
-        ctx.flush()
-    hit = ctx.materialized.get(vid)
-    if hit is not None:
-        t._value = hit
-
-
 def run_segmented(fn: Callable, args, kwargs, name: str = "fn",
                   dump_name: Optional[str] = None):
     """Execute ``fn`` with op recording + flush-on-host-read; returns
@@ -276,9 +278,7 @@ def run_segmented(fn: Callable, args, kwargs, name: str = "fn",
 
     def fix(o):
         if isinstance(o, Tensor):
-            hit = ctx.materialized.get(id(o._value))
-            if hit is not None:
-                o._value = hit
+            ctx.resolve_tensor(o)
         elif isinstance(o, (list, tuple)):
             for x in o:
                 fix(x)

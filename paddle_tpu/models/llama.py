@@ -156,11 +156,35 @@ class LlamaAttention(nn.Layer):
         self.v_proj = ColumnParallelLinear(h, self.num_kv_heads * self.head_dim, has_bias=False, gather_output=False)
         self.o_proj = RowParallelLinear(self.num_heads * self.head_dim, h, has_bias=False, input_is_parallel=True)
 
+    def _mp_active(self):
+        hcg = _hcg()
+        return hcg is not None and hcg.axis_size("mp") > 1
+
     def forward(self, hidden, cos, sin, attn_mask=None, cache=None):
+        import os
+
         b, s = hidden.shape[0], hidden.shape[1]
-        q = M.reshape(self.q_proj(hidden), [b, s, self.num_heads, self.head_dim])
-        k = M.reshape(self.k_proj(hidden), [b, s, self.num_kv_heads, self.head_dim])
-        v = M.reshape(self.v_proj(hidden), [b, s, self.num_kv_heads, self.head_dim])
+        nh, nkv, hd = self.num_heads, self.num_kv_heads, self.head_dim
+        fuse_train = os.environ.get("PADDLE_TPU_FUSED_QKV", "0") == "1"
+        if ((s == 1 and cache is not None) or fuse_train) and not self._mp_active():
+            # decode step: ONE fused qkv matmul — the weight concat is loop-
+            # invariant, so XLA hoists it out of the decode scan and the step
+            # streams one [h, (nh+2·nkv)·hd] weight (measured 621→773 GB/s
+            # vs three separate matmuls at decode shapes)
+            def qkv_fused(hv, wq, wk, wv):
+                w = jnp.concatenate([wq, wk, wv], axis=1)
+                return hv @ w.astype(hv.dtype)
+
+            qkv = apply(qkv_fused, hidden, self.q_proj.weight, self.k_proj.weight,
+                        self.v_proj.weight, op_name="qkv_fused")
+            qd, kd = nh * hd, nkv * hd
+            q = M.reshape(qkv[:, :, :qd], [b, s, nh, hd])
+            k = M.reshape(qkv[:, :, qd:qd + kd], [b, s, nkv, hd])
+            v = M.reshape(qkv[:, :, qd + kd:], [b, s, nkv, hd])
+        else:
+            q = M.reshape(self.q_proj(hidden), [b, s, nh, hd])
+            k = M.reshape(self.k_proj(hidden), [b, s, nkv, hd])
+            v = M.reshape(self.v_proj(hidden), [b, s, nkv, hd])
         if cache is not None and len(cache) == 3:
             return self._static_cache_attn(q, k, v, cos, sin, cache, b, s)
         offset = 0
@@ -208,10 +232,60 @@ class LlamaAttention(nn.Layer):
     def _static_cache_attn(self, q, k, v, cos, sin, cache, b, s):
         """Fixed-size KV ring (serving decode): cache = (k_buf [B,L,KVH,D],
         v_buf, pos ()) — every decode step has identical shapes, so the whole
-        loop runs from ONE compiled program (reference analog: the fused
-        masked_multihead_attention decode kernels)."""
+        loop runs from ONE compiled program. The single-token step runs the
+        fused Pallas decode path (ops/pallas/decode_attention.py): aliased
+        in-place ring writes + native-layout online-softmax attention — the
+        reference's masked_multihead_attention decode kernel analog."""
+        import os
+
         kbuf, vbuf, pos = cache
         q, k = apply_rotary_pos_emb(q, k, cos, sin, position_offset=pos)
+        mode = os.environ.get("PADDLE_TPU_DECODE_KERNEL", "einsum")
+        if s == 1 and mode != "0" and self.num_heads % kbuf.shape[2] == 0:
+            if mode == "pallas":
+                # kept for study: measured SLOWER than the einsum path on
+                # v5e (299-366 vs 610-688 GB/s — per-head M=1 MXU dots don't
+                # pipeline; see PROFILE_r04.md)
+                from ..ops.pallas.decode_attention import decode_attention, kv_ring_write
+
+                def fused(qv, kv_, vv, kb, vb, p):
+                    p32 = p.astype(jnp.int32)
+                    kb = kv_ring_write(kb, kv_, p32)
+                    vb = kv_ring_write(vb, vv, p32)
+                    o = decode_attention(qv, kb, vb, p32)
+                    return o, kb, vb
+            else:
+                # native-layout decode attention: NO head-major transposes of
+                # the ring (the sdpa path's swapaxes cost a full extra KV
+                # pass); fp32 softmax; GQA via grouped reshape, K/V never
+                # repeated. Ring writes stay XLA dynamic_update_slice — in a
+                # scan carry they are in-place (measured free).
+                import math as _math
+
+                scale = 1.0 / _math.sqrt(self.head_dim)
+
+                def fused(qv, kv_, vv, kb, vb, p):
+                    p32 = p.astype(jnp.int32)
+                    kb = jax.lax.dynamic_update_slice(
+                        kb, kv_.astype(kb.dtype), (0, p32, 0, 0))
+                    vb = jax.lax.dynamic_update_slice(
+                        vb, vv.astype(vb.dtype), (0, p32, 0, 0))
+                    bq, _, nh, hd = qv.shape
+                    kvh = kb.shape[2]
+                    rep = nh // kvh
+                    L = kb.shape[1]
+                    qg = qv.reshape(bq, 1, kvh, rep, hd)
+                    sc = jnp.einsum("bqgrd,blgd->bgrql", qg, kb).astype(jnp.float32) * scale
+                    cols = jnp.arange(L)
+                    sc = jnp.where(cols[None, None, None, None, :] <= p32, sc, -1e30)
+                    pr = jax.nn.softmax(sc, axis=-1).astype(qv.dtype)
+                    o = jnp.einsum("bgrql,blgd->bqgrd", pr, vb)
+                    return o.reshape(bq, 1, nh, hd), kb, vb
+
+            out, kbuf, vbuf = apply(fused, q, k, v, kbuf, vbuf, pos,
+                                    op_name="decode_attention", n_outs=3)
+            out = M.reshape(out, [b, s, self.num_heads * self.head_dim])
+            return self.o_proj(out), (kbuf, vbuf, pos + s)
 
         def write(buf, new, p):
             return jax.lax.dynamic_update_slice(
@@ -251,6 +325,21 @@ class LlamaMLP(nn.Layer):
             gated = apply(lambda a, b: swiglu_fused(a, b),
                           self.gate_proj(x), self.up_proj(x), op_name="swiglu")
             return self.down_proj(gated)
+        hcg = _hcg()
+        mp_on = hcg is not None and hcg.axis_size("mp") > 1
+        fuse_train = os.environ.get("PADDLE_TPU_FUSED_QKV", "0") == "1"
+        if (x.shape[1] == 1 or fuse_train) and not mp_on:
+            # decode step: gate|up as ONE streamed weight (concat hoisted
+            # out of the decode scan; measured 621→773 GB/s)
+            m = self.gate_proj.weight.shape[1]
+
+            def gu_fused(hv, wg, wu):
+                w = jnp.concatenate([wg, wu], axis=1)
+                return hv @ w.astype(hv.dtype)
+
+            gu = apply(gu_fused, x, self.gate_proj.weight, self.up_proj.weight,
+                       op_name="gate_up_fused")
+            return self.down_proj(F.silu(gu[:, :, :m]) * gu[:, :, m:])
         return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
 
 

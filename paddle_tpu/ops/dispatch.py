@@ -97,8 +97,12 @@ def apply(fn: Callable, *inputs, op_name: str = "", n_outs: int = 1):
             return ctx.record(amp_fn, inputs, op_name)
         except _LAZY_BREAK_ERRORS:
             # op can't abstract-eval (data-dependent shape): flush the
-            # segment so its inputs are concrete, then run it eagerly below
+            # segment so its inputs are concrete, then run it eagerly below.
+            # Inputs that merely SHARE a pending value (rewraps/detach) are
+            # not holders — resolve them through the materialized map.
             ctx.flush()
+            for t in inputs:
+                ctx.resolve_tensor(t)
     vals = tuple(t._value for t in inputs)
     vals = _amp_cast_vals(op_name, vals)
     needs_grad = tape.grad_enabled() and any(not t.stop_gradient for t in inputs)

@@ -1,0 +1,97 @@
+"""Fused AdamW update — one Pallas pass per parameter (reference analog:
+/root/reference/paddle/phi/kernels/gpu/adamw_kernel.cu — the fused multi-
+tensor AdamW the reference runs instead of an op-per-expression chain).
+
+Measured motivation (v5e, slope method): the jnp AdamW expression chain runs
+at ~160 GB/s effective — XLA materializes intermediates between the moment
+updates — while the ideal is ONE read-modify-write pass over grad (bf16),
+master/m/v (fp32) at streaming bandwidth. This kernel does exactly that
+pass: read g,w,m,v → write p(bf16),w,m,v, with the bias-correction factors
+computed host-side per step and prefetched as scalars.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+# flattened [rows, 512] tiles, 256 rows per block → 512KB fp32 per operand
+_LANES = 512
+_ROWS = 256
+
+
+def _kernel(scal_ref, g_ref, w_ref, m_ref, v_ref, p_out, w_out, m_out, v_out,
+            *, b1: float, b2: float, eps: float, wd: float):
+    lr = scal_ref[0]
+    c1 = scal_ref[1]  # 1 - b1**t
+    c2 = scal_ref[2]  # 1 - b2**t
+    gf = g_ref[...].astype(jnp.float32)
+    w = w_ref[...] * (1.0 - lr * wd)
+    m = b1 * m_ref[...] + (1.0 - b1) * gf
+    v = b2 * v_ref[...] + (1.0 - b2) * gf * gf
+    upd = (m / c1) / (jnp.sqrt(v / c2) + eps)
+    w = w - lr * upd
+    w_out[...] = w
+    m_out[...] = m
+    v_out[...] = v
+    p_out[...] = w.astype(p_out.dtype)
+
+
+def fused_adamw_supported(n: int) -> bool:
+    return _HAS_PALLAS and n % (_LANES * _ROWS) == 0
+
+
+def fused_adamw(param, master, m, v, grad, lr, beta1_pow_t, beta2_pow_t, *,
+                b1: float, b2: float, eps: float, wd: float, interpret=False):
+    """One-pass AdamW with decoupled weight decay.
+
+    param: bf16/fp32 [*shape]; master/m/v: fp32; grad: any float dtype.
+    ``lr``/``beta?_pow_t`` may be traced scalars (beta?_pow_t = b?**t).
+    Returns (new_param, new_master, new_m, new_v); master/m/v alias their
+    inputs (donated in the compiled train step).
+    """
+    n = param.size
+    shape = param.shape
+    rows = n // _LANES
+    grid = (rows // _ROWS,)
+    scal = jnp.stack([
+        jnp.asarray(lr, jnp.float32),
+        1.0 - jnp.asarray(beta1_pow_t, jnp.float32),
+        1.0 - jnp.asarray(beta2_pow_t, jnp.float32),
+    ])
+
+    def r2(x, dt=None):
+        return x.reshape(rows, _LANES) if dt is None else x.reshape(rows, _LANES).astype(dt)
+
+    kernel = functools.partial(_kernel, b1=b1, b2=b2, eps=eps, wd=wd)
+    spec = pl.BlockSpec((_ROWS, _LANES), lambda i, s_ref: (i, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[spec, spec, spec, spec],
+        out_specs=[spec, spec, spec, spec],
+    )
+    p_new, w_new, m_new, v_new = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, _LANES), param.dtype),
+            jax.ShapeDtypeStruct((rows, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((rows, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((rows, _LANES), jnp.float32),
+        ],
+        # master/m/v update in place (operand order: scal, g, w, m, v)
+        input_output_aliases={2: 1, 3: 2, 4: 3},
+        interpret=interpret,
+    )(scal, r2(grad), r2(master, jnp.float32), r2(m), r2(v))
+    return (p_new.reshape(shape), w_new.reshape(shape),
+            m_new.reshape(shape), v_new.reshape(shape))

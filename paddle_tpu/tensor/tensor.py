@@ -152,12 +152,9 @@ class Tensor:
         ctx = _dispatch_mod._lazy_ctx
         if ctx is None:
             return
-        vid = id(self._value)
-        if vid in ctx.pending:
+        if id(self._value) in ctx.pending:
             ctx.flush()
-        hit = ctx.materialized.get(vid)
-        if hit is not None:
-            self._value = hit
+        ctx.resolve_tensor(self)
 
     def numpy(self) -> np.ndarray:
         self._sync_for_host()
@@ -327,9 +324,23 @@ class Tensor:
             ctx.alias(self, result)
         return self
 
+    def _forget_pending(self):
+        """Raw value overwrite while segmented-lazy mode holds this tensor as
+        a pending holder: deregister first, or the flush would clobber the
+        new value with the old op's result."""
+        global _dispatch_mod
+        if _dispatch_mod is None:
+            from ..ops import dispatch as _d
+
+            _dispatch_mod = _d
+        ctx = _dispatch_mod._lazy_ctx
+        if ctx is not None and id(self._value) in ctx.pending:
+            ctx.forget_holder(self)
+
     def set_value(self, value):
         if isinstance(value, Tensor):
             value = value._value
+        self._forget_pending()
         self._value = jnp.asarray(value, dtype=self._value.dtype).reshape(self._value.shape)
         self._version += 1
         return self
@@ -338,11 +349,13 @@ class Tensor:
         return self.set_value(other)
 
     def zero_(self):
+        self._forget_pending()
         self._value = jnp.zeros_like(self._value)
         self._version += 1
         return self
 
     def fill_(self, v):
+        self._forget_pending()
         self._value = jnp.full_like(self._value, v)
         self._version += 1
         return self

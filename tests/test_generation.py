@@ -128,3 +128,49 @@ def test_static_cache_rejects_beyond_rope_table():
         greedy_decode(m, ids, max_new_tokens=6)
     with _pt.raises(ValueError, match="max_position_embeddings"):
         generate(m, ids, max_new_tokens=6, use_static_cache=True)
+
+
+class TestDecodeAttentionPaths:
+    """The fused decode path (native-layout einsum + fused qkv/gate-up) must
+    be numerically equivalent to the sdpa reference path (VERDICT r3 item 2:
+    numerics matched vs the current path)."""
+
+    def _greedy(self, monkeypatch, mode):
+        import paddle_tpu as P
+        from paddle_tpu.models import LlamaForCausalLM, greedy_decode, llama_tiny
+
+        monkeypatch.setenv("PADDLE_TPU_DECODE_KERNEL", mode)
+        P.seed(7)
+        cfg = llama_tiny()
+        model = LlamaForCausalLM(cfg)
+        model.eval()
+        ids = P.to_tensor(np.random.RandomState(1).randint(
+            0, cfg.vocab_size, (2, 12)).astype(np.int32))
+        out = greedy_decode(model, ids, max_new_tokens=10, max_length=40)
+        return np.asarray(out.numpy())
+
+    def test_einsum_path_matches_sdpa_path(self, monkeypatch):
+        a = self._greedy(monkeypatch, "0")
+        b = self._greedy(monkeypatch, "einsum")
+        np.testing.assert_array_equal(a, b)
+
+    def test_pallas_ref_matches_sdpa_path(self, monkeypatch):
+        # the pallas kernel's jnp reference (used on CPU) must agree too
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops.pallas.decode_attention import ref_decode_attention
+
+        rng = np.random.RandomState(3)
+        q = jnp.asarray(rng.randn(2, 1, 4, 32), jnp.float32)
+        kb = jnp.asarray(rng.randn(2, 16, 4, 32), jnp.float32)
+        vb = jnp.asarray(rng.randn(2, 16, 4, 32), jnp.float32)
+        import paddle_tpu as P
+        from paddle_tpu.nn import functional as F
+
+        pos = 9
+        out = np.asarray(ref_decode_attention(q, kb, vb, jnp.int32(pos)))
+        mask = jnp.where(jnp.arange(16)[None, None, None, :] <= pos, 0.0, -1e30)
+        ref = F.scaled_dot_product_attention(
+            P.to_tensor(q), P.to_tensor(kb), P.to_tensor(vb),
+            attn_mask=P.to_tensor(mask))
+        np.testing.assert_allclose(out, np.asarray(ref.numpy()), rtol=1e-4, atol=1e-5)

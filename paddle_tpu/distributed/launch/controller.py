@@ -246,6 +246,15 @@ class Controller:
             f.close()
         self._procs, self._logs = [], []
 
+    def _maybe_beat(self):
+        """Epoch-scoped heartbeat (~1 s): staleness is judged by the
+        OBSERVER's clock watching for value changes, so producer clock skew
+        can't fake a death."""
+        now = time.time()
+        if now - self._last_beat >= 1.0:
+            self._kv.put(f"/hb/{self.restarts}/node/{self.node_rank}", str(now))
+            self._last_beat = now
+
     def _stale_members(self) -> List[int]:
         """Current-epoch member nodes whose controller heartbeat expired.
 
@@ -307,14 +316,7 @@ class Controller:
                 ticks += 1
                 rc = self._check_procs()
                 if rc is None and self._kv is not None and self.elastic:
-                    now = time.time()
-                    if now - self._last_beat >= 1.0:
-                        # epoch-scoped + monotonically counted: staleness is
-                        # judged by the OBSERVER's clock watching for value
-                        # changes, so producer clock skew can't fake a death
-                        self._kv.put(f"/hb/{self.restarts}/node/{self.node_rank}",
-                                     str(now))
-                        self._last_beat = now
+                    self._maybe_beat()
                 if rc is None and self._kv is not None and ticks % 5 == 0:
                     terminal = self._kv.get("/fail/terminal")
                     if terminal is not None:
@@ -389,11 +391,7 @@ class Controller:
             if self.elastic:
                 # keep beating: peers still training must not mistake our
                 # clean finish for a node death (spurious scale-in)
-                now = time.time()
-                if now - self._last_beat >= 1.0:
-                    self._kv.put(f"/hb/{self.restarts}/node/{self.node_rank}",
-                                 str(now))
-                    self._last_beat = now
+                self._maybe_beat()
             if len(self._kv.get_prefix(f"/done/{self.restarts}/node/")) >= n_members:
                 return "done"
             if self._kv.get("/fail/terminal") is not None:

@@ -18,3 +18,18 @@ def table_push(table_name, ids, grads, lr):
 
 def table_size(table_name):
     return TABLES[table_name].size()
+
+
+def table_push_delta(table_name, ids, deltas):
+    TABLES[table_name].push_delta(ids, deltas)
+    return True
+
+
+def table_save(table_name, path):
+    TABLES[table_name].save(path)
+    return True
+
+
+def table_load(table_name, path):
+    TABLES[table_name].load(path)
+    return True

@@ -113,9 +113,7 @@ class SegmentContext:
 
     def _resolve(self, t):
         """Fix up a tensor whose value was materialized by an earlier flush."""
-        hit = self.materialized.get(id(t._value))
-        if hit is not None:
-            t._value = hit[1]
+        self.resolve_tensor(t)
         return t._value
 
     # ------------------------------------------------------------ recording

@@ -1,6 +1,6 @@
 """paddle.audio parity (/root/reference/python/paddle/audio/__init__.py):
 features, functional, backends (wav io), datasets."""
 from . import backends, datasets, features, functional  # noqa: F401
-from .backends import load, save  # noqa: F401
+from .backends import info, load, save  # noqa: F401
 
-__all__ = ["features", "functional", "backends", "datasets", "load", "save"]
+__all__ = ["features", "functional", "backends", "datasets", "load", "save", "info"]

@@ -66,3 +66,28 @@ def get_current_backend():
 def set_backend(backend_name: str):
     if backend_name != "wave":
         raise ValueError("only the stdlib 'wave' backend is available")
+
+
+class AudioInfo:
+    """parity: paddle.audio.info result (backends/backend.py AudioInfo)."""
+
+    def __init__(self, sample_rate, num_samples, num_channels, bits_per_sample,
+                 encoding="PCM_S"):
+        self.sample_rate = sample_rate
+        self.num_frames = num_samples
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+
+def info(filepath: str) -> AudioInfo:
+    """Wave-file metadata without decoding the samples (parity:
+    paddle.audio.info over the wave backend)."""
+    import wave
+
+    with wave.open(filepath, "rb") as w:
+        return AudioInfo(sample_rate=w.getframerate(),
+                         num_samples=w.getnframes(),
+                         num_channels=w.getnchannels(),
+                         bits_per_sample=w.getsampwidth() * 8)

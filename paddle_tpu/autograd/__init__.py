@@ -18,3 +18,120 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
     if grad_tensors is not None and not isinstance(grad_tensors, (list, tuple)):
         grad_tensors = [grad_tensors]
     run_backward(list(tensors), grad_tensors, retain_graph=retain_graph)
+
+
+def jacobian(ys, xs, batch_axis=None):
+    """Dense Jacobian ∂ys/∂xs (parity:
+    python/paddle/autograd/autograd.py jacobian — the reference returns a
+    lazily-evaluated Jacobian; here it is computed eagerly row-by-row
+    through the tape, with ``batch_axis=0`` giving the batched form).
+
+    ys: Tensor [*out]; xs: Tensor or list. Returns Tensor [out_numel,
+    in_numel] (or [B, out/B, in/B] with batch_axis=0), matching the
+    reference's flattened layout.
+    """
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from ..tensor.tensor import Tensor
+
+    single = not isinstance(xs, (list, tuple))
+    xs_list = [xs] if single else list(xs)
+    y_flat_n = int(np.prod(ys.shape)) if ys.shape else 1
+    rows = []
+    for k in range(y_flat_n):
+        seed = jnp.zeros((y_flat_n,), jnp.float32).at[k].set(1.0).reshape(
+            ys.shape if ys.shape else ())
+        gs = grad([ys], xs_list, grad_outputs=[Tensor(seed.astype(ys._value.dtype))],
+                  retain_graph=True, allow_unused=True)
+        row = []
+        for x, g in zip(xs_list, gs):
+            n = int(np.prod(x.shape)) if x.shape else 1
+            row.append(jnp.zeros((n,), jnp.float32) if g is None
+                       else g._value.reshape(-1).astype(jnp.float32))
+        rows.append(jnp.concatenate(row))
+    jac = Tensor(jnp.stack(rows))  # [y_numel, x_numel]
+    if batch_axis == 0:
+        # batched form [B, out/B, in/B]: rows of batch b depend only on
+        # inputs of batch b, so take the block diagonal of the full Jacobian
+        b = ys.shape[0]
+        yn, xn = jac.shape
+        blocks = jac._value.reshape(b, yn // b, b, xn // b)
+        diag = jnp.diagonal(blocks, axis1=0, axis2=2)  # [out/B, in/B, B]
+        return Tensor(jnp.moveaxis(diag, -1, 0))
+    return jac
+
+
+def hessian(ys, xs, batch_axis=None):
+    """Dense Hessian of a SCALAR ys w.r.t. xs (parity: autograd.py hessian):
+    grads computed with ``create_graph=True``, then the Jacobian of the
+    gradient — second order through the same tape. ``batch_axis`` is not
+    supported (raises) — per-sample Hessians compose from per-sample calls."""
+    import numpy as np
+
+    if batch_axis is not None:
+        raise NotImplementedError(
+            "hessian(batch_axis=...) is not supported; call hessian per "
+            "sample (ys must be scalar)")
+
+    import jax.numpy as jnp
+
+    from ..tensor.tensor import Tensor
+
+    single = not isinstance(xs, (list, tuple))
+    xs_list = [xs] if single else list(xs)
+    if int(np.prod(ys.shape or [1])) != 1:
+        raise ValueError("hessian expects a scalar ys")
+    g1 = grad([ys], xs_list, create_graph=True, retain_graph=True,
+              allow_unused=False)
+    flat_g = g1[0] if len(g1) == 1 else None
+    if flat_g is None:
+        from ..tensor import manipulation as M
+
+        flat_g = M.concat([M.reshape(g, [-1]) for g in g1])
+    else:
+        from ..tensor import manipulation as M
+
+        flat_g = M.reshape(flat_g, [-1])
+    n = flat_g.shape[0]
+    rows = []
+    for k in range(n):
+        seed = jnp.zeros((n,), jnp.float32).at[k].set(1.0)
+        g2 = grad([flat_g], xs_list, grad_outputs=[Tensor(seed.astype(flat_g._value.dtype))],
+                  retain_graph=True, allow_unused=True)
+        row = []
+        for x, g in zip(xs_list, g2):
+            m = int(np.prod(x.shape)) if x.shape else 1
+            row.append(jnp.zeros((m,), jnp.float32) if g is None
+                       else g._value.reshape(-1).astype(jnp.float32))
+        rows.append(jnp.concatenate(row))
+    return Tensor(jnp.stack(rows))
+
+
+class saved_tensors_hooks:
+    """parity: paddle.autograd.saved_tensors_hooks — transform tensors as
+    they are saved for backward (pack) and restore them on use (unpack).
+
+    Scope on this stack: the hooks apply to PyLayer's
+    ``save_for_backward``/``saved_tensor`` storage — the one place user
+    tensors are explicitly stashed for backward. Ordinary taped ops hold
+    their residuals inside XLA vjp closures, which are not Python-visible;
+    use ``jax.checkpoint`` / the recompute wrappers for activation-memory
+    savings there."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        from . import tape
+
+        tape._saved_tensor_hooks.append((self.pack_hook, self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        from . import tape
+
+        tape._saved_tensor_hooks.pop()
+        return False

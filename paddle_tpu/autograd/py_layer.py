@@ -21,9 +21,20 @@ class PyLayerContext:
         self._extra = {}
 
     def save_for_backward(self, *tensors):
-        self._saved = list(tensors)
+        from . import tape
+
+        if tape._saved_tensor_hooks:
+            pack, _ = tape._saved_tensor_hooks[-1]
+            self._saved_hooks = tape._saved_tensor_hooks[-1]
+            self._saved = [pack(t) for t in tensors]
+        else:
+            self._saved_hooks = None
+            self._saved = list(tensors)
 
     def saved_tensor(self):
+        if getattr(self, "_saved_hooks", None) is not None:
+            _, unpack = self._saved_hooks
+            return tuple(unpack(p) for p in self._saved)
         return tuple(self._saved)
 
     def mark_not_inplace(self, *args):

@@ -112,6 +112,12 @@ class enable_grad:
         return wrapper
 
 
+# saved_tensors_hooks stack (paddle.autograd.saved_tensors_hooks): the top
+# (pack, unpack) pair transforms tensors as PyLayer/GradNode storage saves
+# them for backward and restores them on use
+_saved_tensor_hooks = []
+
+
 class GradNode:
     """One taped op application.
 

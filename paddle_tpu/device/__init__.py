@@ -134,3 +134,96 @@ def is_compiled_with_custom_device(device_type: str) -> bool:
 def synchronize(device=None):
     """Block until all dispatched work completes (stream sync analog)."""
     (jax.device_put(0) + 0).block_until_ready()
+
+
+# ---------------------------------------------------- surface-parity tail
+# (parity: python/paddle/device/__init__.py __all__)
+from .cuda import Event, Stream  # noqa: E402,F401
+
+
+class XPUPlace(Place):
+    def __init__(self, index: int = 0):
+        super().__init__("xpu", index)
+
+
+class IPUPlace(Place):
+    def __init__(self, index: int = 0):
+        super().__init__("ipu", index)
+
+
+def current_stream(device=None) -> Stream:
+    """The one device stream view (XLA serializes per-device dispatch);
+    shares device.cuda's registry so both spellings agree."""
+    from . import cuda as _cuda
+
+    return _cuda.current_stream(device)
+
+
+def get_all_device_type():
+    return ["cpu", "tpu"]
+
+
+def get_all_custom_device_type():
+    return ["tpu"]  # the PJRT-plugin device (reference: CustomDevice slot)
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices() if d.platform != "cpu"]
+
+
+def get_cudnn_version():
+    return None  # no cudnn on TPU (reference returns None when absent)
+
+
+def is_compiled_with_cinn() -> bool:
+    return False  # the XLA stack replaces CINN wholesale
+
+
+def is_compiled_with_distribute() -> bool:
+    return True  # collectives are always compiled in (XLA)
+
+
+__all__ += ["Event", "Stream", "XPUPlace", "IPUPlace", "current_stream",
+            "get_all_device_type", "get_all_custom_device_type",
+            "get_available_device", "get_available_custom_device",
+            "get_cudnn_version", "is_compiled_with_cinn",
+            "is_compiled_with_distribute"]
+
+
+def is_compiled_with_ipu() -> bool:
+    return False
+
+
+def set_stream(stream: Stream = None) -> Stream:
+    """parity: device.set_stream — XLA exposes one serialized device stream;
+    the call records the handle (in device.cuda's single registry) and
+    returns the previous one."""
+    from . import cuda as _cuda
+
+    prev = _cuda.current_stream()
+    if stream is not None:
+        _cuda.set_stream(stream)
+    return prev
+
+
+class stream_guard:
+    """parity: device.stream_guard — scope a 'current' stream handle (all
+    handles view the same XLA dispatch stream)."""
+
+    def __init__(self, stream: Stream = None):
+        self.stream = stream
+
+    def __enter__(self):
+        self._prev = set_stream(self.stream)
+        return self.stream
+
+    def __exit__(self, *exc):
+        set_stream(self._prev)
+        return False
+
+
+__all__ += ["is_compiled_with_ipu", "set_stream", "stream_guard"]

@@ -135,6 +135,14 @@ def current_stream(device=None) -> Stream:
     return _current
 
 
+def set_stream(stream: Stream) -> Stream:
+    """Install ``stream`` as the current handle; returns the previous one."""
+    global _current
+    prev = _current
+    _current = stream
+    return prev
+
+
 @contextlib.contextmanager
 def stream_guard(stream: Stream):
     """parity: device.cuda.stream_guard — a no-op scope (one device stream)."""

@@ -8,6 +8,7 @@ from typing import Optional
 
 from .. import env as _env
 from ..topology import (
+    CommunicateTopology,
     HybridCommunicateGroup,
     get_hybrid_communicate_group,
     set_hybrid_communicate_group,
@@ -17,6 +18,14 @@ from . import mp_layers  # noqa: F401
 from . import meta_parallel  # noqa: F401
 from . import dataset  # noqa: F401
 from .dataset import InMemoryDataset, QueueDataset  # noqa: F401
+from .role_maker import (  # noqa: F401
+    MultiSlotDataGenerator,
+    MultiSlotStringDataGenerator,
+    PaddleCloudRoleMaker,
+    Role,
+    UserDefinedRoleMaker,
+    UtilBase,
+)
 from .mp_layers import (  # noqa: F401
     ColumnParallelLinear,
     ParallelCrossEntropy,
@@ -26,8 +35,11 @@ from .mp_layers import (  # noqa: F401
 
 __all__ = [
     "init", "DistributedStrategy", "distributed_model", "distributed_optimizer",
-    "get_hybrid_communicate_group", "HybridCommunicateGroup", "worker_num", "worker_index",
-    "is_first_worker", "barrier_worker",
+    "get_hybrid_communicate_group", "HybridCommunicateGroup", "CommunicateTopology",
+    "worker_num", "worker_index", "is_first_worker", "barrier_worker",
+    "Fleet", "Role", "PaddleCloudRoleMaker", "UserDefinedRoleMaker", "UtilBase",
+    "MultiSlotDataGenerator", "MultiSlotStringDataGenerator",
+    "InMemoryDataset", "QueueDataset",
 ]
 
 _fleet_initialized = False
@@ -146,3 +158,47 @@ def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
     axis = "sharding" if (hcg is not None and hcg.axis_size("sharding") > 1) else "dp"
     opt = shard_optimizer(optimizer, levels[level](axis, mesh))
     return model, opt, scaler
+
+
+class Fleet:
+    """The fleet orchestrator CLASS (parity: fleet.py:99 — the reference
+    exposes a module-level singleton of this). Methods delegate to the
+    module-level functions, so `Fleet().init(...)` and `fleet.init(...)`
+    are the same object graph."""
+
+    def __init__(self):
+        self._role_maker = None
+
+    def init(self, role_maker=None, is_collective=False, strategy=None,
+             log_level="INFO"):
+        self._role_maker = role_maker or PaddleCloudRoleMaker(
+            is_collective=is_collective)
+        return init(role_maker, is_collective, strategy, log_level)
+
+    def is_first_worker(self):
+        return is_first_worker()
+
+    def worker_num(self):
+        return worker_num()
+
+    def worker_index(self):
+        return worker_index()
+
+    def is_worker(self):
+        return self._role_maker.is_worker() if self._role_maker else True
+
+    def is_server(self):
+        return self._role_maker.is_server() if self._role_maker else False
+
+    def barrier_worker(self):
+        return barrier_worker()
+
+    def distributed_model(self, model):
+        return distributed_model(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return distributed_optimizer(optimizer, strategy)
+
+    @property
+    def util(self) -> UtilBase:
+        return UtilBase()

@@ -1,0 +1,186 @@
+"""Role makers + fleet util surface (parity:
+/root/reference/python/paddle/distributed/fleet/base/role_maker.py:34 Role,
+:542 PaddleCloudRoleMaker, :1204 UserDefinedRoleMaker;
+fleet/base/util_factory.py UtilBase; fleet/dataset/*.py
+MultiSlotDataGenerator).
+
+TPU-native: role assignment is read from the ``PADDLE_TRAINER_*`` env
+contract the launcher writes (the reference's PaddleCloud env contract);
+SERVER roles come from the PS tier's env (``PADDLE_PSERVER_*``). There is
+no brpc gloo init here — host-side barriers ride the launch KV master.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+__all__ = ["Role", "RoleMakerBase", "PaddleCloudRoleMaker",
+           "UserDefinedRoleMaker", "UtilBase", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._role = Role.WORKER
+
+    def _is_worker(self) -> bool:
+        return self._role == Role.WORKER
+
+    def _is_server(self) -> bool:
+        return self._role == Role.SERVER
+
+    def _worker_index(self) -> int:
+        return 0
+
+    def _worker_num(self) -> int:
+        return 1
+
+    def _server_num(self) -> int:
+        return 0
+
+    # public spellings used by fleet users
+    is_worker = _is_worker
+    is_server = _is_server
+    worker_index = _worker_index
+    worker_num = _worker_num
+    server_num = _server_num
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """Role from the launcher env contract (parity: role_maker.py:542)."""
+
+    def __init__(self, is_collective: bool = False, **kwargs):
+        super().__init__()
+        self._is_collective = is_collective
+        training_role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+        self._role = Role.SERVER if training_role == "PSERVER" else Role.WORKER
+        self._cur_id = int(os.environ.get(
+            "PADDLE_PSERVER_ID" if self._role == Role.SERVER
+            else "PADDLE_TRAINER_ID", 0))
+        self._workers = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+        eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        self._server_eps: List[str] = [e for e in eps.split(",") if e]
+
+    def _worker_index(self) -> int:
+        return self._cur_id if self._role == Role.WORKER else 0
+
+    def _worker_num(self) -> int:
+        return self._workers
+
+    def _server_num(self) -> int:
+        return len(self._server_eps)
+
+    def _get_pserver_endpoints(self) -> List[str]:
+        return list(self._server_eps)
+
+    worker_index = _worker_index
+    worker_num = _worker_num
+    server_num = _server_num
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """Explicit role assignment (parity: role_maker.py:1204)."""
+
+    def __init__(self, is_collective: bool = False, current_id: int = 0,
+                 role: int = Role.WORKER, worker_num: int = 1,
+                 server_endpoints: Optional[List[str]] = None, **kwargs):
+        super().__init__(is_collective=is_collective)
+        self._role = role
+        self._cur_id = current_id
+        self._workers = worker_num
+        self._server_eps = list(server_endpoints or [])
+
+
+class UtilBase:
+    """parity: fleet/base/util_factory.py UtilBase — cross-worker object
+    reductions + filesystem helpers, over the eager collective tier."""
+
+    def all_reduce(self, input, mode: str = "sum", comm_world: str = "worker"):  # noqa: A002
+        import numpy as np
+
+        from .. import communication as C
+        from ...tensor.tensor import Tensor
+
+        t = Tensor(np.asarray(input, np.float64))
+        op = {"sum": C.ReduceOp.SUM, "max": C.ReduceOp.MAX,
+              "min": C.ReduceOp.MIN}[mode]
+        C.all_reduce(t, op=op)
+        return np.asarray(t._value)
+
+    def all_gather(self, input, comm_world: str = "worker"):  # noqa: A002
+        out: List = []
+        from .. import communication as C
+        from ...tensor.tensor import Tensor
+        import numpy as np
+
+        C.all_gather(out, Tensor(np.asarray(input)))
+        return [np.asarray(t._value) for t in out]
+
+    def barrier(self, comm_world: str = "worker"):
+        from .. import communication as C
+
+        C.barrier()
+
+    def get_file_shard(self, files: List[str]) -> List[str]:
+        """Split a filelist evenly across workers (parity:
+        util_factory.get_file_shard)."""
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        world = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+        return [f for i, f in enumerate(sorted(files)) if i % world == rank]
+
+    def print_on_rank(self, message: str, rank_id: int = 0):
+        if int(os.environ.get("PADDLE_TRAINER_ID", 0)) == rank_id:
+            print(message)
+
+
+class MultiSlotDataGenerator:
+    """Slot-data generator base (parity: fleet/data_generator — user
+    subclasses implement ``generate_sample``; ``run_from_stdin``/
+    ``run_from_files`` emit the MultiSlotDataFeed line format the
+    InMemoryDataset/QueueDataset parsers consume)."""
+
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "subclass MultiSlotDataGenerator and implement generate_sample")
+
+    def _format(self, record) -> str:
+        # record: [(slot_name, [values...]), ...] -> "n v1..vn n v1..vn"
+        parts = []
+        for _, values in record:
+            parts.append(str(len(values)))
+            parts.extend(self._fmt_val(v) for v in values)
+        return " ".join(parts)
+
+    @staticmethod
+    def _fmt_val(v) -> str:
+        return repr(v) if isinstance(v, float) else str(v)
+
+    def run_from_files(self, files: List[str], output):
+        for path in files:
+            with open(path) as f:
+                for line in f:
+                    gen = self.generate_sample(line.rstrip("\n"))
+                    for record in (gen() if callable(gen) else gen):
+                        output.write(self._format(record) + "\n")
+
+    def run_from_stdin(self):
+        import sys
+
+        for line in sys.stdin:
+            gen = self.generate_sample(line.rstrip("\n"))
+            for record in (gen() if callable(gen) else gen):
+                sys.stdout.write(self._format(record) + "\n")
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    @staticmethod
+    def _fmt_val(v) -> str:
+        return str(v)

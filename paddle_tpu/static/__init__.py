@@ -38,9 +38,21 @@ __all__ = [
     "set_program_state", "serialize_program", "serialize_persistables",
     "deserialize_persistables", "load_from_file", "save_to_file",
     "normalize_program", "WeightNormParamAttr",
+    "PassBase", "PassManager", "DeadCodeEliminationPass",
+    "CommonSubexpressionEliminationPass", "ConstantFoldingPass",
+    "print_program", "program_to_str",
 ]
 
 from ..jit.api import InputSpec  # noqa: E402  (shared spec type)
+from .passes import (  # noqa: E402
+    CommonSubexpressionEliminationPass,
+    ConstantFoldingPass,
+    DeadCodeEliminationPass,
+    PassBase,
+    PassManager,
+    print_program,
+    program_to_str,
+)
 
 Variable = Tensor  # static-graph "Variable" is the same symbolic Tensor
 
@@ -90,6 +102,11 @@ class Program:
         p.ops = list(self.ops)
         p.feeds = list(self.feeds)
         return p
+
+    def __str__(self):
+        from .passes import program_to_str
+
+        return program_to_str(self)
 
     def __repr__(self):
         return f"Program(id={self.id}, ops={len(self.ops)}, feeds={len(self.feeds)})"
@@ -223,6 +240,8 @@ class Executor:
         if compiled is None:
             fetch_ids = [id(t) for t in fetch_list]
 
+            fetch_fallback = {id(t): t for t in fetch_list}
+
             def replay(feed_in, const_in):
                 env = {id(t): v for t, v in zip(feed_ts, feed_in)}
                 env.update({id(t): v for t, v in zip(const_ts, const_in)})
@@ -232,7 +251,10 @@ class Executor:
                     rs = list(res) if isinstance(res, (tuple, list)) else [res]
                     for o, r in zip(outs, rs):
                         env[id(o)] = r
-                return [env[i] for i in fetch_ids]
+                # a fetch target may have been constant-folded out of the op
+                # list (static.passes): its value is concrete on the tensor
+                return [env[i] if i in env else fetch_fallback[i]._value
+                        for i in fetch_ids]
 
             compiled = jax.jit(replay)
             self._cache[key] = compiled
@@ -251,6 +273,7 @@ class Executor:
         rest = [t for t in const_ts if id(t) not in param_ids]
         loss_t = program._loss
         fetch_ids = [id(t) for t in fetch_list]
+        fetch_map = {id(t): t for t in fetch_list}
 
         key = (program.id, "train", len(program.ops), tuple(t.name for t in feed_ts),
                tuple(v.shape for v in feed_vals), tuple(fetch_ids))
@@ -267,7 +290,8 @@ class Executor:
                     for o, r in zip(outs, rs):
                         env[id(o)] = r
                 loss = env[id(loss_t)]
-                return loss, [env[i] for i in fetch_ids]
+                return loss, [env[i] if i in env else fetch_map[i]._value
+                              for i in fetch_ids]
 
             compiled = jax.jit(jax.value_and_grad(loss_and_fetch, has_aux=True))
             self._cache[key] = compiled

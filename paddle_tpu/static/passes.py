@@ -1,0 +1,187 @@
+"""Program transform & inspection passes (reference analog: the PIR pass
+infrastructure — pir::PassManager, paddle/pir/include/pass/pass_manager.h:35,
+with the general transforms of paddle/fluid/pir/transforms/general/
+{dead_code_elimination_pass, common_subexpression_elimination_pass,
+constant_folding_pass}.cc).
+
+TPU-native position: the captured ``static.Program`` is a linear op list the
+Executor replays as ONE jitted computation, so XLA performs the heavy
+optimization (fusion, layout, scheduling, CSE inside the compiled program).
+What a pass layer still buys on top:
+
+* **inspection** — ``Program.__str__``/:func:`print_program` give a readable
+  IR dump (op name, inputs, outputs) for debugging captured graphs;
+* **host-side graph surgery XLA can't do** — dropping ops whose results are
+  never fetched (smaller trace → faster compile), folding concrete-input
+  subgraphs at build time (they'd otherwise re-execute per run), and
+  deduplicating repeated captures before tracing cost is paid.
+
+Passes rewrite the op list in place and report statistics, mirroring the
+reference's pass instrumentation (print_stats).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+
+__all__ = ["PassBase", "PassManager", "DeadCodeEliminationPass",
+           "CommonSubexpressionEliminationPass", "ConstantFoldingPass",
+           "print_program", "program_to_str"]
+
+
+# ------------------------------------------------------------- inspection
+def program_to_str(program) -> str:
+    """Readable IR dump of a captured Program (PIR printer analog)."""
+    names: Dict[int, str] = {}
+
+    def name_of(t):
+        if id(t) not in names:
+            tag = "feed" if t in program.feeds else (
+                "param" if getattr(t, "is_parameter", False) else "v")
+            names[id(t)] = f"%{tag}{len(names)}"
+        return names[id(t)]
+
+    lines = [f"program(id={program.id}, ops={len(program.ops)}, "
+             f"feeds={[t.name for t in program.feeds]})"]
+    for fn, ins, outs, op_name in program.ops:
+        shape = lambda t: "x".join(str(s) for s in t.shape)  # noqa: E731
+        in_s = ", ".join(f"{name_of(t)}:{shape(t)}" for t in ins)
+        out_s = ", ".join(f"{name_of(t)}:{shape(t)}" for t in outs)
+        lines.append(f"  {out_s} = {op_name or 'op'}({in_s})")
+    return "\n".join(lines)
+
+
+def print_program(program) -> None:
+    print(program_to_str(program))
+
+
+# ------------------------------------------------------------------ passes
+class PassBase:
+    """One rewrite over a Program's op list (parity: pir::Pass)."""
+
+    name = "pass"
+
+    def run(self, program) -> int:
+        """Apply; returns the number of ops changed/removed."""
+        raise NotImplementedError
+
+
+class DeadCodeEliminationPass(PassBase):
+    """Drop ops whose outputs nothing reads (parity:
+    dead_code_elimination_pass.cc). ``keep`` marks fetch targets; the
+    program's loss and feeds are always live."""
+
+    name = "dead_code_elimination"
+
+    def __init__(self, keep: Sequence = ()):
+        self.keep = list(keep)
+
+    def run(self, program) -> int:
+        live = {id(t) for t in self.keep}
+        if program._loss is not None:
+            live.add(id(program._loss))
+        changed = True
+        kept: List = list(program.ops)
+        while changed:
+            changed = False
+            used = set(live)
+            for _, ins, _, _ in kept:
+                used.update(id(t) for t in ins)
+            nxt = []
+            for op in kept:
+                _, _, outs, _ = op
+                if any(id(o) in used for o in outs):
+                    nxt.append(op)
+                    continue
+                changed = True
+            # inputs of removed ops may free further ops next iteration
+            kept = nxt
+        removed = len(program.ops) - len(kept)
+        program.ops = kept
+        return removed
+
+
+class CommonSubexpressionEliminationPass(PassBase):
+    """Merge ops with the same pure fn + identical inputs (parity:
+    common_subexpression_elimination_pass.cc). The op fns recorded at the
+    dispatch chokepoint are pure by contract, so (fn identity, input ids)
+    is a sound value key; RNG-bearing ops close over distinct keys and thus
+    distinct fn objects, keeping them un-merged."""
+
+    name = "common_subexpression_elimination"
+
+    def run(self, program) -> int:
+        seen: Dict = {}
+        replace: Dict[int, object] = {}
+        kept = []
+        merged = 0
+        for fn, ins, outs, op_name in program.ops:
+            ins = [replace.get(id(t), t) for t in ins]
+            key = (id(fn), tuple(id(t) for t in ins), op_name)
+            prev = seen.get(key)
+            if prev is not None and len(prev) == len(outs):
+                for old, new in zip(outs, prev):
+                    replace[id(old)] = new
+                # externally held handles (fetch targets) must stay valid:
+                # keep an identity alias op instead of orphaning the outputs
+                # (the PIR passes do ReplaceAllUsesWith; a fetch list is a
+                # use the pass cannot see)
+                kept.append((lambda *vs: vs[0] if len(vs) == 1 else vs,
+                             list(prev), outs, f"{op_name}_cse_alias"))
+                merged += 1
+                continue
+            seen[key] = outs
+            kept.append((fn, ins, outs, op_name))
+        program.ops = kept
+        if replace and program._loss is not None:
+            program._loss = replace.get(id(program._loss), program._loss)
+        return merged
+
+
+class ConstantFoldingPass(PassBase):
+    """Execute ops whose inputs are all CONCRETE at build time (parity:
+    constant_folding_pass.cc): their outputs become constants the replay
+    closes over, instead of recomputing every Executor.run."""
+
+    name = "constant_folding"
+
+    def run(self, program) -> int:
+        folded = 0
+        kept = []
+        for fn, ins, outs, op_name in program.ops:
+            concrete = all(not isinstance(t._value, jax.ShapeDtypeStruct)
+                           for t in ins)
+            if concrete:
+                res = fn(*[t._value for t in ins])
+                rs = list(res) if isinstance(res, (tuple, list)) else [res]
+                for o, r in zip(outs, rs):
+                    o._value = r  # symbolic -> constant; later ops see it
+                folded += 1
+                continue
+            kept.append((fn, ins, outs, op_name))
+        program.ops = kept
+        return folded
+
+
+class PassManager:
+    """Ordered pass pipeline with statistics (parity: pir::PassManager)."""
+
+    def __init__(self, passes: Optional[Sequence[PassBase]] = None,
+                 print_stats: bool = False):
+        self.passes: List[PassBase] = list(passes or [])
+        self.print_stats = print_stats
+        self.stats: List[tuple] = []
+
+    def add_pass(self, p: PassBase) -> "PassManager":
+        self.passes.append(p)
+        return self
+
+    def run(self, program) -> Dict[str, int]:
+        self.stats = []
+        for p in self.passes:
+            n = p.run(program)
+            self.stats.append((p.name, n))
+            if self.print_stats:
+                print(f"[pass] {p.name}: {n} ops affected")
+        return dict(self.stats)

@@ -3,3 +3,50 @@ from . import datasets  # noqa: F401
 from . import models  # noqa: F401
 from . import ops  # noqa: F401
 from . import transforms  # noqa: F401
+
+# image backend registry (parity: python/paddle/vision/image.py —
+# set_image_backend/get_image_backend/image_load over PIL|cv2)
+_image_backend = "pil"
+
+
+def set_image_backend(backend: str):
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(f"unsupported image backend {backend!r} "
+                         "(expected 'pil'|'cv2'|'tensor')")
+    global _image_backend
+    _image_backend = backend
+
+
+def get_image_backend() -> str:
+    return _image_backend
+
+
+def image_load(path: str, backend: str = None):
+    """Load an image via the configured backend (parity: image.py:image_load).
+    'tensor' returns an HWC uint8 paddle Tensor; 'pil' a PIL.Image; 'cv2' a
+    BGR ndarray when cv2 is installed."""
+    b = backend or _image_backend
+    if b not in ("pil", "cv2", "tensor"):
+        raise ValueError(f"unsupported image backend {b!r} "
+                         "(expected 'pil'|'cv2'|'tensor')")
+    if b == "cv2":
+        try:
+            import cv2
+        except ImportError as e:
+            raise RuntimeError("cv2 backend requested but OpenCV is not "
+                               "installed") from e
+        return cv2.imread(path)
+    from PIL import Image
+
+    img = Image.open(path)
+    if b == "tensor":
+        import numpy as np
+
+        from .. import to_tensor as _tt
+
+        return _tt(np.asarray(img))
+    return img
+
+
+__all__ = ["datasets", "models", "ops", "transforms", "set_image_backend",
+           "get_image_backend", "image_load"]

@@ -263,3 +263,64 @@ class TestSpawn:
     def test_spawn_two_procs(self):
         ctx = dist.spawn(_spawn_target, args=(42,), nprocs=2)
         assert all(p.exitcode == 0 for p in ctx.processes)
+
+
+class TestFleetRoleMakerAndUtils:
+    def test_paddlecloud_role_from_env(self, monkeypatch):
+        fleet = dist.fleet
+        monkeypatch.setenv("TRAINING_ROLE", "TRAINER")
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "4")
+        rm = fleet.PaddleCloudRoleMaker()
+        assert rm.is_worker() and not rm.is_server()
+        assert rm.worker_index() == 2 and rm.worker_num() == 4
+        monkeypatch.setenv("TRAINING_ROLE", "PSERVER")
+        monkeypatch.setenv("PADDLE_PSERVERS_IP_PORT_LIST", "a:1,b:2")
+        rm = fleet.PaddleCloudRoleMaker()
+        assert rm.is_server() and rm.server_num() == 2
+
+    def test_user_defined_role_maker(self):
+        fleet = dist.fleet
+        rm = fleet.UserDefinedRoleMaker(current_id=1, role=fleet.Role.WORKER,
+                                        worker_num=3,
+                                        server_endpoints=["h:1"])
+        assert rm.worker_index() == 1 and rm.worker_num() == 3
+        assert rm.server_num() == 1
+
+    def test_util_base_file_shard(self, monkeypatch):
+        fleet = dist.fleet
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+        shard = fleet.UtilBase().get_file_shard(["a", "b", "c", "d"])
+        assert shard == ["b", "d"]
+
+    def test_multislot_data_generator_roundtrip(self, tmp_path):
+        fleet = dist.fleet
+
+        class Gen(fleet.MultiSlotDataGenerator):
+            def generate_sample(self, line):
+                def reader():
+                    a, b = line.split(",")
+                    yield [("ids", [int(a), int(b)]), ("label", [int(b) % 2])]
+
+                return reader
+
+        raw = tmp_path / "raw.txt"
+        raw.write_text("1,2\n3,4\n")
+        out = tmp_path / "slots.txt"
+        with open(out, "w") as f:
+            Gen().run_from_files([str(raw)], f)
+        # the emitted lines parse with the slot-dataset pipeline
+        ds = dist.InMemoryDataset()
+        ds.init(batch_size=2, use_var=["ids", "label"])
+        ds.set_filelist([str(out)])
+        ds.load_into_memory()
+        batches = list(ds)
+        assert batches[0]["ids"].shape == (2, 2)
+        np.testing.assert_array_equal(batches[0]["label"].reshape(-1), [0, 0])
+
+    def test_fleet_class_delegates(self):
+        fleet = dist.fleet
+        f = fleet.Fleet()
+        assert f.worker_num() >= 1
+        assert isinstance(f.util, fleet.UtilBase)

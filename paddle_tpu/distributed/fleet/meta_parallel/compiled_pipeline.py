@@ -43,8 +43,13 @@ for each body slot) and any pre-existing accumulator/master state is
 restacked ``[P, ...]`` so a mid-training switch to the compiled engine keeps
 optimizer momentum.
 
-Remaining scope limit: VPP interleave (num_chunks > 1) stays on the eager
-engine — the compiled ring models one chunk per stage.
+VPP chunks (num_chunks > 1) compile too: weights stack [C, P, ...] (dim 0 =
+virtual chunk) and the schedule runs chunk-SEQUENTIAL rings — each
+microbatch set circles the pp ring once per chunk, exits hopping from the
+last stage back to stage 0 via one extra ppermute. The reference's
+interleaved-1F1B ORDERING is a scheduling choice; here cross-chunk overlap
+is left to XLA's scheduler inside the single program, while the VPP
+memory/partition contract (per-device virtual stages) is kept exactly.
 """
 from __future__ import annotations
 
@@ -140,18 +145,19 @@ def _decompose(pipe) -> Tuple[_Segment, List[_Segment], _Segment]:
     0's prefix before its first body layer, the tail is the last stage's
     suffix after its last body layer. Every stage must carry the same number
     of body layers with identical signatures."""
+    n_seg = pipe._num_segments  # = num_stages * num_chunks (VPP)
     P = pipe._num_stages
     pairs = [list(zip(pipe._stage_layers[s], pipe._stage_fwd_funcs[s]))
-             for s in range(P)]
+             for s in range(n_seg)]
     shared_ids = {id(l) for l in pipe._shared_layers.values()}
     type_stages: Dict[str, set] = {}
-    for s in range(P):
+    for s in range(n_seg):
         for layer, _ in pairs[s]:
             if id(layer) in shared_ids:
                 continue  # one OBJECT on many stages (tied weights) ≠ a body
             type_stages.setdefault(type(layer).__name__, set()).add(s)
-    body_types = {t for t, ss in type_stages.items() if len(ss) == P}
-    if not body_types and P > 1:
+    body_types = {t for t, ss in type_stages.items() if len(ss) == n_seg}
+    if not body_types and n_seg > 1:
         # fall back: types on >1 stage (short pipes where the trunk doesn't
         # reach every stage can't be stacked)
         body_types = {t for t, ss in type_stages.items() if len(ss) > 1}
@@ -178,13 +184,13 @@ def _decompose(pipe) -> Tuple[_Segment, List[_Segment], _Segment]:
             last_body = i
             break
     if last_body is None:
-        raise ValueError(f"compiled pipeline: stage {P - 1} has no body layers")
+        raise ValueError(f"compiled pipeline: segment {n_seg - 1} has no body layers")
     tail_pairs = pairs[-1][last_body + 1:]
 
     body_segs = []
-    for s in range(P):
+    for s in range(n_seg):
         lo = first_body if s == 0 else 0
-        hi = last_body + 1 if s == P - 1 else len(pairs[s])
+        hi = last_body + 1 if s == n_seg - 1 else len(pairs[s])
         seg_pairs = pairs[s][lo:hi]
         if any(not is_body(l) for l, _ in seg_pairs):
             raise ValueError(
@@ -193,7 +199,7 @@ def _decompose(pipe) -> Tuple[_Segment, List[_Segment], _Segment]:
         body_segs.append(_Segment(seg_pairs))
 
     ref = body_segs[0].sig()
-    for s in range(1, P):
+    for s in range(1, n_seg):
         if body_segs[s].sig() != ref:
             raise ValueError(
                 f"compiled pipeline needs a homogeneous body; stage {s} "
@@ -219,13 +225,17 @@ def _full_mesh_put(p: Tensor, mesh):
 
 
 class _PipeParams(Layer):
-    """Parameter container the TrainStep compiles against: stacked [P, ...]
-    body weights (canonical storage, pp-sharded) + the head/tail params."""
+    """Parameter container the TrainStep compiles against: stacked body
+    weights — [P, ...] pp-sharded, or [C, P, ...] with VPP chunks (dim 0 =
+    virtual chunk, dim 1 = pp) — plus the head/tail params."""
 
-    def __init__(self, body_segs: List[_Segment], aux_params: List[Tensor], mesh):
+    def __init__(self, body_segs: List[_Segment], aux_params: List[Tensor],
+                 mesh, num_stages: int):
         super().__init__()
         self._mesh = mesh
-        P = len(body_segs)
+        P = num_stages
+        C = len(body_segs) // P
+        self.num_chunks = C
         self.stacked: List[Tensor] = []
         self.stacked_specs: List[PartitionSpec] = []
         for j, p0 in enumerate(body_segs[0].params):
@@ -238,7 +248,12 @@ class _PipeParams(Layer):
             except Exception:
                 inner = ()
             inner = tuple(inner) + (None,) * (p0.ndim - len(inner))
-            spec = PartitionSpec("pp", *inner)
+            if C > 1:
+                # segment g = c*P + d  ->  [C, P, ...]
+                vals = vals.reshape(C, P, *vals.shape[1:])
+                spec = PartitionSpec(None, "pp", *inner)
+            else:
+                spec = PartitionSpec("pp", *inner)
             sh = NamedSharding(mesh, spec)
             t = Tensor(jax.device_put(jnp.asarray(vals), sh), stop_gradient=False)
             t.name = f"pipe_stacked_{j}"
@@ -270,10 +285,12 @@ def _remesh_value(v, mesh):
 
 def _rewire_optimizer(optimizer, body_segs: List[_Segment],
                       stacked: List[Tensor], aux_ids: set, mesh,
-                      stacked_specs: List[PartitionSpec]):
+                      stacked_specs: List[PartitionSpec], num_stages: int):
     """Re-point param groups at stacked weights (per-group hyperparameters
-    kept) and restack any pre-existing optimizer state [P, ...]."""
-    P = len(body_segs)
+    kept) and restack any pre-existing optimizer state [P, ...] (or
+    [C, P, ...] with VPP chunks, matching _PipeParams)."""
+    P = len(body_segs)  # total SEGMENTS = num_stages * num_chunks
+    C = P // num_stages
     slot_of: Dict[int, Tuple[int, int]] = {}
     for s, seg in enumerate(body_segs):
         for j, p in enumerate(seg.params):
@@ -336,6 +353,8 @@ def _rewire_optimizer(optimizer, body_segs: List[_Segment],
             return
         # per-stage values live on different stage submeshes — stack on host
         arr = np.stack([np.asarray(v) for v in vals])
+        if C > 1:
+            arr = arr.reshape(C, num_stages, *arr.shape[1:])  # match [C,P,...]
         spec = (stacked_specs[j] if arr.ndim == len(stacked_specs[j])
                 else PartitionSpec(*([None] * arr.ndim)))
         d[id(target)] = jax.device_put(jnp.asarray(arr), NamedSharding(mesh, spec))
@@ -371,12 +390,17 @@ class CompiledPipelineTrainStep:
         hcg = get_hybrid_communicate_group()
         if hcg is None or hcg.axis_size("pp") <= 1:
             raise ValueError("compiled pipeline needs an active mesh with pp > 1")
-        if model._num_chunks != 1:
-            raise ValueError("compiled pipeline does not support VPP chunks; "
-                             "use the eager engine for interleaved schedules")
         self.mesh = mesh = hcg.mesh
         self.num_micro = num_micro
         self.num_stages = P = model._num_stages
+        # VPP: C virtual chunks per device, weights [C, P, ...]; the compiled
+        # schedule runs chunk-SEQUENTIAL rings (each microbatch set circles
+        # the ring once per chunk, exits hop from the last stage back to
+        # stage 0). The interleaved-1F1B ORDERING is a scheduling choice the
+        # reference makes explicitly; here cross-chunk overlap is left to
+        # XLA's scheduler within the single program — the memory/partition
+        # semantics (per-device virtual stages) are the VPP contract kept.
+        C = self.num_chunks = model._num_chunks
         self._pipe = model
         if model._loss_fn is None:
             raise ValueError("PipelineLayer built without loss_fn")
@@ -391,7 +415,7 @@ class CompiledPipelineTrainStep:
             if id(p) not in seen:
                 seen.add(id(p))
                 aux.append(p)
-        self._params_layer = _PipeParams(body_segs, aux, mesh)
+        self._params_layer = _PipeParams(body_segs, aux, mesh, P)
         stacked = self._params_layer.stacked
         n_stacked = len(stacked)
         n_aux = len(aux)
@@ -400,7 +424,7 @@ class CompiledPipelineTrainStep:
         tail_idx = [aux_index[id(p)] for p in tail.params]
 
         _rewire_optimizer(optimizer, body_segs, stacked, set(aux_index), mesh,
-                          self._params_layer.stacked_specs)
+                          self._params_layer.stacked_specs, P)
 
         body0 = body_segs[0]
 
@@ -409,11 +433,12 @@ class CompiledPipelineTrainStep:
         self._head = head
         self._tail = tail
 
-        stk_specs = tuple(PartitionSpec("pp") for _ in range(n_stacked))
+        stk_specs = tuple(
+            PartitionSpec("pp") if C == 1 else PartitionSpec(None, "pp")
+            for _ in range(n_stacked))
 
         def local(stacked_vals, aux_vals, xs, ys):
             stage = lax.axis_index("pp")
-            p_local = [a[0] for a in stacked_vals]
             head_vals = [aux_vals[k] for k in head_idx]
             tail_vals = [aux_vals[k] for k in tail_idx]
             M = xs.shape[0]
@@ -423,21 +448,35 @@ class CompiledPipelineTrainStep:
                 return head.run(head_vals, x) if head.pairs else x
 
             body_fwd = (jax.checkpoint(body0.run) if remat else body0.run)
+            ring_perm = [(i, (i + 1) % P) for i in range(P)]
 
-            def tick(h, t):
-                x_t = lax.dynamic_index_in_dim(xs, jnp.clip(t, 0, M - 1), 0,
-                                               keepdims=False)
-                inp = jnp.where(stage == 0, run_head(x_t), h)
-                out = body_fwd(p_local, inp)
-                h_next = lax.ppermute(
-                    out, "pp", [(i, (i + 1) % P) for i in range(P)])
-                return h_next, out
+            def run_chunk(p_chunk, xs_in, first_chunk):
+                def tick(h, t):
+                    x_t = lax.dynamic_index_in_dim(xs_in, jnp.clip(t, 0, M - 1),
+                                                   0, keepdims=False)
+                    inp0 = run_head(x_t) if first_chunk else x_t
+                    inp = jnp.where(stage == 0, inp0, h)
+                    out = body_fwd(p_chunk, inp)
+                    return lax.ppermute(out, "pp", ring_perm), out
 
-            h_struct = jax.eval_shape(run_head, xs[0])
-            h0 = jnp.zeros(h_struct.shape, h_struct.dtype)
-            _, outs = lax.scan(tick, h0, jnp.arange(T))
-            # microbatch m exits the last stage at tick m + P - 1
-            exit_outs = jnp.take(outs, jnp.arange(M) + P - 1, axis=0)
+                h_struct = jax.eval_shape(
+                    run_head if first_chunk else (lambda v: v), xs_in[0])
+                h0 = jnp.zeros(h_struct.shape, h_struct.dtype)
+                _, outs = lax.scan(tick, h0, jnp.arange(T))
+                # microbatch m exits the LAST stage at tick m + P - 1
+                return jnp.take(outs, jnp.arange(M) + P - 1, axis=0)
+
+            xs_c = xs
+            for c in range(C):
+                if C == 1:
+                    p_chunk = [a[0] for a in stacked_vals]          # [P,...] local
+                else:
+                    p_chunk = [a[c, 0] for a in stacked_vals]       # [C,P,...] local
+                exit_outs = run_chunk(p_chunk, xs_c, c == 0)
+                if c < C - 1:
+                    # exits live on the last stage; one ring hop delivers
+                    # them to stage 0 as the next chunk's inputs
+                    xs_c = lax.ppermute(exit_outs, "pp", ring_perm)
             # merge microbatches for the tail + loss: every rank computes in
             # SPMD lockstep; only the last stage's value survives the mask
             mb = exit_outs.shape[1]
@@ -497,12 +536,15 @@ class CompiledPipelineTrainStep:
             ]) if old else PartitionSpec(*([None] * p.ndim))
             p._value = jax.device_put(np.asarray(p._value), NamedSharding(sub, spec))
 
+        P = self._pipe._num_stages
         for j, t in enumerate(self._params_layer.stacked):
             host = np.asarray(t._value)
+            if self.num_chunks > 1:  # [C, P, ...] -> flat segment order
+                host = host.reshape(-1, *host.shape[2:])
             for s, seg in enumerate(self._body_segs):
                 p = seg.params[j]
                 p._value = jnp.asarray(host[s])
-                put_sub(p, self._pipe._submeshes[s % self._pipe._num_stages])
+                put_sub(p, self._pipe._submeshes[s % P])
         head_ids = {id(p) for p in self._head.params}
         tail_ids = {id(p) for p in self._tail.params}
         shared = head_ids & tail_ids

@@ -259,3 +259,83 @@ class TestCompiledPipelineRealModel:
         crit = LlamaPretrainingCriterion()
         eager_loss = float(crit(pipe.forward(ids), ids).numpy())
         assert np.isfinite(eager_loss)
+
+
+class TestCompiledVPP:
+    """VPP chunks compiled (closing the r4 scope note): weights [C, P, ...],
+    chunk-sequential rings with exit hop back to stage 0."""
+
+    def test_vpp_matches_sequential_and_trains(self):
+        _init(dp=2, pp=2)
+        P.seed(21)
+        # 8 layers, pp=2, 2 virtual chunks -> 4 segments of 2 layers
+        pipe = PipelineLayer(layers=_mlp_descs(8), num_stages=2,
+                             num_virtual_pipeline_stages=2,
+                             loss_fn=lambda o, y: F.mse_loss(o, y))
+        assert pipe._num_chunks == 2 and pipe._num_segments == 4
+        w0 = [np.asarray(p._value) for s in range(4)
+              for l in pipe._stage_layers[s] for p in l.parameters()]
+        opt = P.optimizer.SGD(0.0, parameters=pipe.parameters())  # zero-LR parity
+        step = CompiledPipelineTrainStep(pipe, opt, num_micro=2)
+        assert step.num_chunks == 2
+        x, y = P.randn([4, 16]), P.randn([4, 16])
+        compiled = float(step(x, y).numpy())
+        # sequential single-device reference with identical weights
+        set_hybrid_communicate_group(None)
+        layers = [nn.Linear(16, 16) for _ in range(8)]
+        for p, v in zip([p for l in layers for p in l.parameters()], w0):
+            p._value = P.to_tensor(v)._value
+        ref = float(F.mse_loss(nn.Sequential(*layers)(x), y).numpy())
+        np.testing.assert_allclose(compiled, ref, rtol=1e-4)
+        # trains with a real LR
+        _init(dp=2, pp=2)
+        pipe2 = PipelineLayer(layers=_mlp_descs(8), num_stages=2,
+                              num_virtual_pipeline_stages=2,
+                              loss_fn=lambda o, y: F.mse_loss(o, y))
+        opt2 = P.optimizer.AdamW(learning_rate=0.02, parameters=pipe2.parameters())
+        step2 = CompiledPipelineTrainStep(pipe2, opt2, num_micro=2)
+        l0 = float(step2(x, y).numpy())
+        for _ in range(8):
+            l1 = float(step2(x, y).numpy())
+        assert l1 < l0
+        # accumulators carry the [C, P, ...] leading dims
+        accs = opt2._accumulators["moment1"]
+        assert any(tuple(v.shape[:2]) == (2, 2) for v in accs.values())
+
+    def test_vpp_sync_to_model(self):
+        _init(dp=1, pp=2)
+        P.seed(23)
+        pipe = PipelineLayer(layers=_mlp_descs(8), num_stages=2,
+                             num_virtual_pipeline_stages=2,
+                             loss_fn=lambda o, y: F.mse_loss(o, y))
+        opt = P.optimizer.SGD(0.05, parameters=pipe.parameters())
+        step = CompiledPipelineTrainStep(pipe, opt, num_micro=2)
+        x, y = P.randn([4, 16]), P.randn([4, 16])
+        step(x, y)
+        before = np.asarray(pipe._stage_layers[3][0].parameters()[0]._value).copy()
+        step.sync_to_model()
+        after = np.asarray(pipe._stage_layers[3][0].parameters()[0]._value)
+        assert not np.allclose(before, after)
+
+    def test_vpp_existing_state_restacks_cpxx(self):
+        """Eager-accumulated optimizer state restacks [C, P, ...] (review
+        regression: it previously stacked [C*P, ...])."""
+        _init(dp=1, pp=2)
+        P.seed(29)
+        pipe = PipelineLayer(layers=_mlp_descs(8), num_stages=2,
+                             num_virtual_pipeline_stages=2,
+                             loss_fn=lambda o, y: F.mse_loss(o, y))
+        opt = P.optimizer.AdamW(learning_rate=0.01, parameters=pipe.parameters())
+        x, y = P.randn([4, 16]), P.randn([4, 16])
+        # a few eager 1F1B-engine steps accumulate per-segment state
+        for _ in range(2):
+            loss = F.mse_loss(pipe.forward(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        step = CompiledPipelineTrainStep(pipe, opt, num_micro=2)
+        accs = opt._accumulators["moment1"]
+        stacked_shapes = [tuple(v.shape) for v in accs.values() if np.ndim(v) >= 3]
+        assert any(s[:2] == (2, 2) for s in stacked_shapes), stacked_shapes
+        l = float(step(x, y).numpy())
+        assert np.isfinite(l)

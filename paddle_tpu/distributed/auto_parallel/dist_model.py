@@ -149,16 +149,24 @@ class DistModel:
         # tensor or a list of tensors.
         self._n_inputs = 1
         self._n_labels = 1
+        self._lazy_split = False
         if loader is not None:
-            try:
-                first = next(iter(loader))
-                if isinstance(first, (list, tuple)) and len(first) >= 2:
-                    self._n_inputs = len(_tree_tensors(first[0]))
-                    self._n_labels = len(_tree_tensors(first[1]))
-                else:
-                    self._n_labels = 0
-            except StopIteration:
-                pass
+            it = iter(loader)
+            if it is loader:
+                # one-shot iterator/generator: a probe would silently drop
+                # the first batch from training — fall back to the lazy
+                # len(args)-based split in _split_batch instead
+                self._lazy_split = True
+            else:
+                try:
+                    first = next(it)
+                    if isinstance(first, (list, tuple)) and len(first) >= 2:
+                        self._n_inputs = len(_tree_tensors(first[0]))
+                        self._n_labels = len(_tree_tensors(first[1]))
+                    else:
+                        self._n_labels = 0
+                except StopIteration:
+                    pass
 
         if optimizer is not None and loss is not None:
             self.train()
@@ -189,7 +197,13 @@ class DistModel:
 
     # ------------------------------------------------------------- running
     def _split_batch(self, args):
-        n_in = self._n_inputs if len(args) > self._n_inputs else max(len(args) - self._n_labels, 1)
+        if self._lazy_split:
+            # no probe ran (one-shot loader): everything but the trailing
+            # label(s) feeds the model
+            n_in = max(len(args) - self._n_labels, 1)
+        else:
+            n_in = (self._n_inputs if len(args) > self._n_inputs
+                    else max(len(args) - self._n_labels, 1))
         inputs, labels = list(args[:n_in]), list(args[n_in:])
         return inputs, labels
 

@@ -12,8 +12,15 @@ __all__ = ["EntryAttr", "ProbabilityEntry", "CountFilterEntry", "ShowClickEntry"
 
 
 class EntryAttr:
+    #: True when ``admit`` is a pure function of the row id (one-shot draw):
+    #: a rejection is then permanent and the caller may skip count keeping.
+    one_shot = False
+
     def __init__(self):
         self._name = None
+
+    def admit(self, count: int, rng=None, rid=None) -> bool:
+        raise NotImplementedError
 
     def _to_attr(self) -> str:
         raise NotImplementedError
@@ -22,16 +29,33 @@ class EntryAttr:
 class ProbabilityEntry(EntryAttr):
     """Admit a new row with the given probability (feature-hash sampling)."""
 
-    def __init__(self, probability: float):
+    one_shot = True
+
+    def __init__(self, probability: float, seed: int = 0):
         super().__init__()
         if not isinstance(probability, float) or not (0.0 < probability < 1.0):
             raise ValueError("probability must be a float in (0,1)")
         self._name = "probability_entry"
         self._probability = probability
+        self._seed = seed
 
-    def admit(self, count: int, rng=None) -> bool:
+    def admit(self, count: int, rng=None, rid=None) -> bool:
         import random
 
+        if rid is not None:
+            # one-shot admission: the draw is a pure function of (entry,
+            # row id) — stable across processes and restarts (md5, not the
+            # salted builtin hash) — so a feature pushed n times has
+            # admission probability p, not 1-(1-p)^n (reference samples once
+            # per new feature). The per-entry salt (probability + seed)
+            # keeps two tables' admission decisions independent; pass
+            # distinct seeds to decorrelate entries with equal p.
+            import hashlib
+
+            h = int(hashlib.md5(
+                f"entry_admit:{self._probability}:{self._seed}:{rid}"
+                .encode()).hexdigest(), 16)
+            return (h / float(1 << 128)) < self._probability
         return (rng or random).random() < self._probability
 
     def _to_attr(self) -> str:
@@ -48,7 +72,7 @@ class CountFilterEntry(EntryAttr):
         self._name = "count_filter_entry"
         self._count_filter = count_filter
 
-    def admit(self, count: int, rng=None) -> bool:
+    def admit(self, count: int, rng=None, rid=None) -> bool:
         return count >= self._count_filter
 
     def _to_attr(self) -> str:
@@ -66,7 +90,7 @@ class ShowClickEntry(EntryAttr):
         self._show_name = show_name
         self._click_name = click_name
 
-    def admit(self, count: int, rng=None) -> bool:
+    def admit(self, count: int, rng=None, rid=None) -> bool:
         return True
 
     def _to_attr(self) -> str:

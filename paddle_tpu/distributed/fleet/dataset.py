@@ -14,6 +14,7 @@ Line format (MultiSlotDataFeed parity): per line, for each slot in order,
 """
 from __future__ import annotations
 
+import hashlib
 import os
 import random
 from typing import Dict, List, Optional, Sequence
@@ -152,16 +153,107 @@ class InMemoryDataset(DatasetBase):
     def local_shuffle(self):
         random.shuffle(self._memory)
 
-    def global_shuffle(self, fleet=None, thread_num: Optional[int] = None):
-        """Cross-rank shuffle: each rank keeps the samples hashed to it.
-        Single process degenerates to local_shuffle (reference contract:
-        after global_shuffle each sample lives on exactly one rank)."""
+    def global_shuffle(self, fleet=None, thread_num: Optional[int] = None,
+                       seed: int = 0):
+        """Cross-rank shuffle (reference contract: samples are REDISTRIBUTED
+        across trainers; afterwards each sample lives on exactly one rank).
+
+        Two channels:
+        - ``PADDLE_MASTER`` set: a real exchange over the launch KV master —
+          each rank posts the samples hashed to other ranks and collects its
+          own (the TPU-native stand-in for the reference's gloo shuffle).
+        - no master: only valid when EVERY rank loaded the identical
+          filelist; the shared order makes a deterministic index-hash a
+          correct partition. Requires the caller to assert that via
+          ``PADDLE_DATASET_IDENTICAL_FILELIST=1``; raises otherwise, because
+          with disjoint per-rank shards a local filter would silently drop
+          ~(world-1)/world of the data.
+        """
         rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
         world = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
         if world > 1:
-            self._memory = [s for i, s in enumerate(self._memory)
-                            if (hash(i) % world) == rank]
+            master = (os.environ.get("PADDLE_MASTER")
+                      or os.environ.get("PADDLE_MASTER_ENDPOINT"))
+            if master:
+                self._memory = self._kv_global_shuffle(master, rank, world, seed)
+            elif os.environ.get("PADDLE_DATASET_IDENTICAL_FILELIST") == "1":
+                # hash the sample CONTENT, not its position: a prior
+                # local_shuffle permutes each rank's order differently, so an
+                # index hash would duplicate/drop samples even with identical
+                # filelists
+                self._memory = [s for s in self._memory
+                                if self._sample_hash(s, seed) % world == rank]
+            else:
+                raise RuntimeError(
+                    "global_shuffle with PADDLE_TRAINERS_NUM>1 needs a cross-"
+                    "rank channel: set PADDLE_MASTER to the launch KV master "
+                    "for a real redistribution, or set "
+                    "PADDLE_DATASET_IDENTICAL_FILELIST=1 to assert every rank "
+                    "loaded the identical filelist (then a shared index hash "
+                    "partitions it)")
         random.shuffle(self._memory)
+
+    @staticmethod
+    def _sample_hash(sample, seed: int) -> int:
+        import pickle
+
+        return int(hashlib.md5(
+            str(seed).encode() + pickle.dumps(sample)).hexdigest(), 16)
+
+    # process-wide exchange counter: every global_shuffle in this process —
+    # whichever dataset instance runs it — bumps it, so interleaved exchanges
+    # on different datasets (train_ds, eval_ds, ...) get distinct namespaces
+    # as long as ranks perform the same sequence of calls (they must: the
+    # exchange is collective). Stale keys from a crashed previous run are a
+    # non-issue in the launch flow — the KV master lives in the job's
+    # controller and dies with it — but jobs sharing a long-lived external
+    # master should set PADDLE_GLOBAL_SHUFFLE_NS to a job-unique token.
+    _gshuffle_round = 0
+
+    def _kv_global_shuffle(self, master: str, rank: int, world: int, seed: int,
+                           _round: Optional[int] = None):
+        """Redistribute ``self._memory`` across ranks via the KV master:
+        rank r posts buckets r->d for every d, waits for all world^2 buckets
+        of the current ROUND, then collects column r; rank 0 janitors the
+        round's keys after every rank signs off. Payloads ride single HTTP
+        PUTs — fine for the in-memory datasets this tier serves; an
+        industrial-scale shuffle would stream via the PS tier instead.
+        ``_round`` overrides the process-wide counter (tests simulating
+        several ranks inside one process)."""
+        import base64
+        import pickle
+
+        from ..launch.master import KVClient
+
+        if _round is None:
+            InMemoryDataset._gshuffle_round += 1
+            _round = InMemoryDataset._gshuffle_round
+        job = os.environ.get("PADDLE_GLOBAL_SHUFFLE_NS", "job")
+        ns = f"/gshuffle/{job}-{seed}-{_round}"
+        kv = KVClient(master)
+        buckets: List[List] = [[] for _ in range(world)]
+        for s in self._memory:
+            buckets[self._sample_hash(s, seed) % world].append(s)
+        for d, b in enumerate(buckets):
+            payload = base64.b64encode(pickle.dumps(b)).decode()
+            # size-aware timeout: ~150s floor, more for multi-GB buckets
+            if not kv.put(f"{ns}/{rank}-{d}", payload,
+                          timeout=max(150.0, len(payload) / 2e6)):
+                raise RuntimeError("global_shuffle: KV master unreachable")
+        got = kv.wait_n(f"{ns}/", world * world, timeout=300.0)
+        out: List = []
+        for src in range(world):
+            out.extend(pickle.loads(base64.b64decode(got[f"{ns}/{src}-{rank}"])))
+        # cleanup: deleting before every peer's wait_n has seen all buckets
+        # would starve them, so ranks sign off and rank 0 janitors the round
+        kv.put(f"{ns}-done/{rank}", "1")
+        if rank == 0:
+            kv.wait_n(f"{ns}-done/", world, timeout=300.0)
+            for src in range(world):
+                for dst in range(world):
+                    kv.delete(f"{ns}/{src}-{dst}")
+                kv.delete(f"{ns}-done/{src}")
+        return out
 
     def release_memory(self):
         self._memory = []

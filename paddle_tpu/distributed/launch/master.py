@@ -84,10 +84,10 @@ class KVClient:
     def __init__(self, endpoint: str):
         self.base = f"http://{endpoint}"
 
-    def put(self, key: str, value: str) -> bool:
+    def put(self, key: str, value: str, timeout: float = 5) -> bool:
         req = urllib.request.Request(f"{self.base}{key}", data=value.encode(), method="PUT")
         try:
-            with urllib.request.urlopen(req, timeout=5) as r:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
                 return r.status == 200
         except OSError:
             return False

@@ -98,11 +98,17 @@ class Table:
             for i, g in zip(ids, np.asarray(grads, np.float32)):
                 rid = int(i)
                 if self.entry is not None and rid not in self.rows:
-                    n = self._push_counts.get(rid, 0) + 1
-                    self._push_counts[rid] = n
-                    if not self.entry.admit(n):
-                        continue  # not admitted yet: drop the update
-                    self._push_counts.pop(rid, None)
+                    if getattr(self.entry, "one_shot", False):
+                        # rid-keyed draw: rejection is permanent, keep no
+                        # per-feature count state for dropped rows
+                        if not self.entry.admit(1, rid=rid):
+                            continue
+                    else:
+                        n = self._push_counts.get(rid, 0) + 1
+                        self._push_counts[rid] = n
+                        if not self.entry.admit(n, rid=rid):
+                            continue  # not admitted yet: drop the update
+                        self._push_counts.pop(rid, None)
                 self._apply(rid, g, lr)
 
     def push_delta(self, ids: Sequence[int], deltas: np.ndarray):

@@ -264,12 +264,26 @@ def _lower_while(c, b, carry0, max_iters: Optional[int]):
 
     def step(carry, _):
         cont = c(carry)
-        new = b(carry)
+        # double-where: the body also runs on dead iterations (after cont
+        # goes False), so feed it the INITIAL carry there — a point where
+        # the body IS in-domain, because the outer lax.cond guarantees the
+        # first iteration was live — instead of the final carry, which may
+        # have left the body's domain (shrinking denominators, walked-off
+        # indices). Without this, dead-branch NaN/Inf residuals poison
+        # reverse-mode gradients despite the output mask.
+        safe_in = tuple(jnp.where(cont, cv, c0) for cv, c0 in zip(carry, carry0))
+        new = b(safe_in)
         merged = tuple(jnp.where(cont, nv, cv) for nv, cv in zip(new, carry))
         return merged, None
 
-    out, _ = lax.scan(step, tuple(carry0), None, length=int(max_iters))
-    return out
+    def run(c0):
+        out, _ = lax.scan(step, c0, None, length=int(max_iters))
+        return out
+
+    # if the condition fails already at entry the body need not be total at
+    # carry0 either — skip the scan entirely (matches the reference loop,
+    # which returns loop_vars untouched)
+    return lax.cond(c(tuple(carry0)), run, lambda c0: c0, tuple(carry0))
 
 
 # --------------------------------------------------------------------- case

@@ -107,6 +107,29 @@ class TestDistModel:
         assert set(model_keys) == set(model.state_dict().keys())
         dm.set_state_dict(sd)
 
+    def test_one_shot_loader_keeps_first_batch(self):
+        """ADVICE r4: a generator-backed loader must not lose its first batch
+        to the input/label-split probe."""
+        model = _MLP()
+        loss = nn.CrossEntropyLoss()
+        opt = P.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+        seen = []
+
+        def gen():
+            for _ in range(3):
+                x = np.random.randn(4, 8).astype(np.float32)
+                y = np.random.randint(0, 4, (4, 1)).astype(np.int64)
+                seen.append((x, y))
+                yield x, y
+
+        g = gen()
+        dm = dist.to_static(model, g, loss, opt)
+        consumed = list(g)
+        assert len(consumed) == 3 and len(seen) == 3  # probe ate nothing
+        # lazy split still trains
+        lv = dm(P.to_tensor(consumed[0][0]), P.to_tensor(consumed[0][1]))
+        assert np.isfinite(float(np.asarray(lv.numpy())))
+
     def test_sharded_strategy_wraps_optimizer(self):
         from paddle_tpu.distributed.auto_parallel.api import _ShardOptimizer
 
@@ -200,6 +223,61 @@ class TestDatasets:
         ds.global_shuffle()  # world=1 → local shuffle
         assert ds.get_memory_data_size() == 8
 
+    def test_global_shuffle_multirank_requires_channel(self, tmp_path, monkeypatch):
+        """ADVICE r4: a local index filter silently dropped (world-1)/world of
+        the data when ranks load disjoint shards — must raise without a
+        cross-rank channel."""
+        ds = dist.InMemoryDataset()
+        ds.init(batch_size=2, use_var=["ids", "label"])
+        ds.set_filelist(self._write_files(tmp_path))
+        ds.load_into_memory()
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+        monkeypatch.delenv("PADDLE_MASTER", raising=False)
+        monkeypatch.delenv("PADDLE_MASTER_ENDPOINT", raising=False)
+        with pytest.raises(RuntimeError, match="cross-rank"):
+            ds.global_shuffle()
+        # identical-filelist assertion path: a shared index hash partitions
+        monkeypatch.setenv("PADDLE_DATASET_IDENTICAL_FILELIST", "1")
+        ds.load_into_memory()
+        n_total = ds.get_memory_data_size()
+        ds.global_shuffle()
+        kept0 = ds.get_memory_data_size()
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+        ds.load_into_memory()
+        ds.global_shuffle()
+        kept1 = ds.get_memory_data_size()
+        assert kept0 + kept1 == n_total  # exact partition, nothing dropped
+
+    def test_global_shuffle_kv_exchange(self, tmp_path):
+        """Real redistribution over the launch KV master: the union of what
+        both ranks hold afterwards is exactly the union of what they loaded."""
+        from paddle_tpu.distributed.launch.master import KVServer
+
+        srv = KVServer(0).start()
+        try:
+            master = f"127.0.0.1:{srv.port}"
+            ds0 = dist.InMemoryDataset()
+            ds0.init(batch_size=2, use_var=["ids", "label"])
+            ds1 = dist.InMemoryDataset()
+            ds1.init(batch_size=2, use_var=["ids", "label"])
+            # disjoint per-rank loads (the standard filelist-shard setup)
+            ds0._memory = [("r0", i) for i in range(5)]
+            ds1._memory = [("r1", i) for i in range(3)]
+            # both ranks must post before either can collect — run concurrently
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(2) as ex:
+                # _round pinned: both "ranks" live in this one process, so
+                # the process-wide round counter must not double-bump
+                f0 = ex.submit(ds0._kv_global_shuffle, master, 0, 2, 7, 1)
+                f1 = ex.submit(ds1._kv_global_shuffle, master, 1, 2, 7, 1)
+                out0, out1 = f0.result(timeout=60), f1.result(timeout=60)
+            assert sorted(out0 + out1) == sorted(
+                [("r0", i) for i in range(5)] + [("r1", i) for i in range(3)])
+        finally:
+            srv.stop()
+
 
 class TestEntries:
     def test_entry_attrs(self):
@@ -213,6 +291,20 @@ class TestEntries:
             dist.ProbabilityEntry(1.5)
         with pytest.raises(ValueError):
             dist.CountFilterEntry(-1)
+
+    def test_probability_entry_one_shot_admission(self):
+        """ADVICE r4: the draw must be a pure function of the row id — a
+        feature pushed n times is admitted with probability p, not
+        1-(1-p)^n."""
+        p = dist.ProbabilityEntry(0.5)
+        draws = [p.admit(1, rid=rid) for rid in range(200)]
+        redraws = [p.admit(k, rid=rid) for k, rid in enumerate(range(200))]
+        assert draws == redraws  # deterministic per feature, any push count
+        assert 40 < sum(draws) < 160  # still ~p overall
+        # independent entries must draw independently (per-entry salt)
+        q = dist.ProbabilityEntry(0.5, seed=1)
+        qdraws = [q.admit(1, rid=rid) for rid in range(200)]
+        assert qdraws != draws
 
 
 class TestGloo:

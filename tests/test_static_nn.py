@@ -102,6 +102,50 @@ class TestCondStatic:
             losses.append(float(np.reshape(lv, ())))
         assert losses[-1] < losses[0] * 0.7
 
+    def test_while_max_iters_dead_branch_gradient_safe(self):
+        """ADVICE r4 (double-where): the body also executes on dead
+        iterations after the condition goes False; with a domain-constrained
+        body (sqrt of a shrinking value) the dead-branch NaN residuals must
+        not poison reverse-mode gradients."""
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.static.nn.control_flow import _lower_while
+
+        def run(x0):
+            out = _lower_while(
+                lambda c: c[0] < 2,
+                lambda c: (c[0] + 1, jnp.sqrt(c[1]) - 0.8),
+                (jnp.int32(0), x0), 4)
+            return out[1]
+
+        v, g = jax.value_and_grad(run)(jnp.float32(1.0))
+        # live iterations: 1 -> sqrt(1)-0.8=0.2 -> sqrt(0.2)-0.8 (negative:
+        # a further body application would NaN)
+        np.testing.assert_allclose(float(v), np.sqrt(0.2) - 0.8, rtol=1e-5)
+        expect_g = 1.0 / (2 * np.sqrt(0.2)) * 0.5
+        assert np.isfinite(float(g))
+        np.testing.assert_allclose(float(g), expect_g, rtol=1e-4)
+
+    def test_while_max_iters_entry_false_gradient(self):
+        """Condition already False at entry: the body need not be total at
+        carry0; loop_vars pass through with identity gradient."""
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.static.nn.control_flow import _lower_while
+
+        def run(x0):
+            out = _lower_while(
+                lambda c: c[1] > 0,
+                lambda c: (c[0] + 1, jnp.sqrt(c[1]) - 1.0),  # NaN at x0<0
+                (jnp.int32(0), x0), 3)
+            return out[1]
+
+        v, g = jax.value_and_grad(run)(jnp.float32(-2.0))
+        np.testing.assert_allclose(float(v), -2.0)
+        np.testing.assert_allclose(float(g), 1.0)
+
 
 class TestCondTraced:
     def test_cond_under_to_static(self):

@@ -183,6 +183,123 @@ def bench_llama_decode():
     }))
 
 
+def bench_serving_mixed():
+    """Continuous-batching serving rung (VERDICT r4 item 1): steady-state
+    full-batch decode over the paged-KV cache with MIXED per-sequence
+    context lengths. Device cost comes from an in-graph lax.scan of the
+    engine's pure-decode step (one program, n steps) timed by the SLOPE
+    between two scan lengths — the only valid method through the tunneled
+    dev chip (PROFILE_r04.md). A short engine.run() with staggered
+    admissions cross-checks end-to-end behavior."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    import paddle_tpu as P
+    from paddle_tpu.inference import ServingEngine
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    backend = jax.default_backend()
+    on_accel = backend in ("tpu", "axon")
+    P.seed(0)
+    if on_accel:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2560,
+                          intermediate_size=8192, num_hidden_layers=9,
+                          num_attention_heads=10,
+                          max_position_embeddings=2048, dtype="bfloat16")
+        B, block, budget, max_seq = 8, 64, 64, 512
+        ctx0 = [128, 192, 256, 320, 128, 192, 256, 320]  # mixed lengths
+        n_lo, n_hi = 32, 96
+    else:
+        cfg = LlamaConfig(vocab_size=512, hidden_size=128,
+                          intermediate_size=352, num_hidden_layers=2,
+                          num_attention_heads=4, max_position_embeddings=256)
+        B, block, budget, max_seq = 4, 8, 16, 64
+        ctx0 = [8, 12, 16, 20]
+        n_lo, n_hi = 4, 12
+    model = LlamaForCausalLM(cfg)
+    if on_accel:
+        model.bfloat16()
+    model.eval()
+    eng = ServingEngine(model, max_batch_size=B, max_seq_len=max_seq,
+                        block_size=block, token_budget=budget)
+
+    # fill the paged caches to the mixed context lengths via real prefills
+    rng = np.random.RandomState(0)
+    for c in ctx0:
+        eng.add_request(rng.randint(0, cfg.vocab_size, (c,)).tolist(),
+                        max_new_tokens=max_seq - c - 1)
+    eng.step()  # admission happens inside step()
+    while eng._queue or any(r.in_prefill for r in eng._active.values()):
+        eng.step()
+
+    # steady-state decode: scan the raw step body n times in ONE program.
+    # Engine decode convention: the freshly sampled token is fed (and its
+    # KV cached) at position context_len - 1.
+    enc = jnp.zeros((B,), jnp.int32)
+    now = jnp.ones((B,), jnp.int32)
+    cu = jnp.arange(B + 1, dtype=jnp.int32)
+    bt = jnp.asarray(eng.block_tables)
+    by_slot = sorted(eng._active.values(), key=lambda r: r.slot)
+    dec0 = jnp.asarray([r.context_len - 1 for r in by_slot], jnp.int32)
+    toks0 = jnp.asarray([r.generated[-1] for r in by_slot], jnp.int32)
+
+    def run_n(n):
+        def body(carry, _):
+            toks, kcs, vcs, dec = carry
+            nxt, kcs, vcs = eng._step_raw(
+                eng._weights, kcs, vcs, eng._rope, toks, enc, dec, now, cu,
+                bt, 1)
+            return (nxt, kcs, vcs, dec + 1), nxt[0]
+
+        @jax.jit
+        def prog(kcs, vcs):
+            (_, kcs, vcs, _), out = lax.scan(
+                body, (toks0, list(kcs), list(vcs), dec0), None, length=n)
+            return out[-1]
+
+        o = prog(eng.key_caches, eng.value_caches)  # compile + warm
+        float(o)
+        best = 1e9
+        for _ in range(2):
+            t0 = time.perf_counter()
+            float(prog(eng.key_caches, eng.value_caches))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_lo, t_hi = run_n(n_lo), run_n(n_hi)
+    per_step = max((t_hi - t_lo) / (n_hi - n_lo), 1e-9)
+    tps = B / per_step
+
+    # end-to-end cross-check: staggered mixed-length service completes
+    eng2 = ServingEngine(model, max_batch_size=B, max_seq_len=max_seq,
+                         block_size=block, token_budget=budget)
+    pr = [rng.randint(0, cfg.vocab_size, (c,)).tolist()
+          for c in ([5, 17, 9, 13] if not on_accel else [64, 200, 96, 150])]
+    t0 = time.perf_counter()
+    outs = {}
+    r0 = eng2.add_request(pr[0], max_new_tokens=8)
+    r1 = eng2.add_request(pr[1], max_new_tokens=8)
+    eng2.step()
+    r2 = eng2.add_request(pr[2], max_new_tokens=8)
+    r3 = eng2.add_request(pr[3], max_new_tokens=8)
+    outs = eng2.run()
+    e2e_s = time.perf_counter() - t0
+    ok = all(len(outs[r]) == 8 for r in (r0, r1, r2, r3))
+
+    print(json.dumps({
+        "metric": "serving_mixed_decode_tokens_per_sec_per_chip",
+        "value": round(tps, 1),
+        "unit": "tokens/s",
+        "extra": {"backend": backend, "batch": B, "ctx_lengths": ctx0,
+                  "block_size": block, "paged_cache": True,
+                  "ms_per_step": round(per_step * 1e3, 3),
+                  "method": "slope over in-graph scan lengths "
+                            f"({n_lo} vs {n_hi} steps)",
+                  "e2e_staggered_admission_ok": ok,
+                  "e2e_wallclock_s_incl_tunnel_dispatch": round(e2e_s, 2)},
+    }))
+
+
 def bench_pipeline_compiled_vs_eager():
     """Compiled-vs-eager pipeline rung: the same dp2×mp2×pp2 llama microbatch
     schedule through the eager per-op 1F1B engine vs CompiledPipelineTrainStep
@@ -271,5 +388,7 @@ if __name__ == "__main__":
         bench_bert_base()
     if which in ("all", "decode"):
         bench_llama_decode()
+    if which in ("all", "serving"):
+        bench_serving_mixed()
     if which in ("all", "pipeline"):
         bench_pipeline_compiled_vs_eager()

@@ -13,6 +13,7 @@ import jax.numpy as jnp
 
 from ....nn import functional as F
 from ....ops.dispatch import apply
+from ....tensor import manipulation as M
 from ....tensor._helpers import to_tensor_like
 from ....tensor.tensor import Tensor
 
@@ -261,11 +262,276 @@ def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
                      act_type=act_type)
 
 
-def fused_multi_transformer(*args, **kwargs):
-    raise NotImplementedError(
-        "fused_multi_transformer is an inference mega-kernel; compose "
-        "paddle_tpu.nn.TransformerEncoder (XLA fuses the chain) or use the "
-        "models.llama stack for decoder inference")
+def fused_multi_transformer(
+    x, ln_scales, ln_biases, qkv_weights, qkv_biases, linear_weights,
+    linear_biases, ffn_ln_scales, ffn_ln_biases, ffn1_weights, ffn1_biases,
+    ffn2_weights, ffn2_biases, pre_layer_norm=True, epsilon=1e-5,
+    residual_alpha=1.0, cache_kvs=None, beam_offset=None, pre_caches=None,
+    rotary_embs=None, time_step=None, seq_lens=None, attn_mask=None,
+    dropout_rate=0.0, rotary_emb_dims=0, activation="gelu", training=False,
+    mode="upscale_in_train", trans_qkvw=True, ring_id=-1,
+    norm_type="layernorm", use_neox_rotary_style=False, gqa_group_size=-1,
+    name=None):
+    """N pre/post-LN decoder layers with KV-cache generation support
+    (parity: /root/reference/python/paddle/incubate/nn/functional/fused_multi_transformer.py,
+    kernel fused_multi_transformer_op.cu).
+
+    TPU-native: the whole stack is a chain of jnp ops one ``jit``/``to_static``
+    compiles into a single XLA program — the fusion the reference gets from
+    its mega-kernel. Two phases, the reference's cache contract:
+    - prefill (``time_step is None``): causal attention over ``src``
+      [B, S, E]; ``cache_kvs[i]`` [2, B, H, max_seq, D] rows [0, S) are
+      written in place.
+    - decode (``time_step`` scalar): one token per sequence attends the
+      cache at positions [0, time_step], writes row ``time_step``.
+    Supports rope (``rotary_embs`` [2, B, 1|S, 1, D/2] cos/sin,
+    interleaved or neox), ``pre_caches`` prefixes, additive ``attn_mask``,
+    layernorm/rmsnorm, residual_alpha, MHA (for GQA serving use the paged
+    ``block_multihead_attention`` path). Returns out, or (out, cache_kvs)
+    in-place-updated when caches are passed.
+    """
+    if gqa_group_size > 0:
+        raise NotImplementedError(
+            "fused_multi_transformer: use block_multihead_attention / the "
+            "inference serving engine for GQA serving")
+    if beam_offset is not None:
+        raise NotImplementedError(
+            "fused_multi_transformer: beam_offset (beam-search cache "
+            "reordering) is not supported")
+
+    x = to_tensor_like(x)
+    num_layers = len(qkv_weights)
+    decode = time_step is not None
+    B, S = x.shape[0], x.shape[1]
+
+    def _norm(h, scale, bias):
+        if norm_type == "rmsnorm":
+            out = fused_rms_norm(h, scale, norm_bias=bias, epsilon=epsilon)[0]
+            return out
+        dim = h.shape[-1]
+        return F.layer_norm(h, [dim], scale, bias, epsilon)
+
+    if decode:
+        ts = to_tensor_like(time_step)
+        step = jnp.asarray(ts._value).reshape(()).astype(jnp.int32)
+
+    def _rope_pair(qv, kv_, rot, pos0):
+        # rot [2, B, Sr, 1, D/2]; qv/kv_ [B, S, H, D]; pos0: int offset
+        from ....ops.paged_attention import rope_rotate
+
+        cos = rot[0, :, :, 0, :]
+        sin = rot[1, :, :, 0, :]
+        Sq = qv.shape[1]
+        cos = jax.lax.dynamic_slice_in_dim(cos, pos0, Sq, axis=1)[:, :, None, :]
+        sin = jax.lax.dynamic_slice_in_dim(sin, pos0, Sq, axis=1)[:, :, None, :]
+        return (rope_rotate(qv, cos, sin, use_neox_rotary_style),
+                rope_rotate(kv_, cos, sin, use_neox_rotary_style))
+
+    out = x
+    new_caches = []
+    for i in range(num_layers):
+        residual = out
+        h = _norm(out, ln_scales[i], ln_biases[i] if ln_biases else None) \
+            if pre_layer_norm else out
+        qkvw = to_tensor_like(qkv_weights[i])
+        if not trans_qkvw:
+            raise NotImplementedError(
+                "fused_multi_transformer: trans_qkvw=False layout not "
+                "supported; pass [3, num_head, head_dim, embed] weights")
+        nh, hd = qkvw.shape[1], qkvw.shape[2]  # [3, nh, hd, E]
+        qb = to_tensor_like(qkv_biases[i]) if qkv_biases else None
+        cache = to_tensor_like(cache_kvs[i]) if cache_kvs is not None else None
+        pre_c = (to_tensor_like(pre_caches[i])
+                 if pre_caches is not None else None)
+        rot = to_tensor_like(rotary_embs) if rotary_embs is not None else None
+
+        qkv_args = [h, qkvw] + ([qb] if qb is not None else [])
+
+        def qkv_fn(hv, wv, *b):
+            o = jnp.einsum("bse,xhde->bsxhd", hv, wv)
+            if b:
+                o = o + b[0][None, None]
+            return o[:, :, 0], o[:, :, 1], o[:, :, 2]
+
+        q, k, v = apply(lambda *a: tuple(qkv_fn(*a)), *qkv_args,
+                        op_name="fmt_qkv", n_outs=3)
+
+        if not decode:
+            # ----- prefill: causal attention, write cache rows [0, S)
+            mask_t = to_tensor_like(attn_mask) if attn_mask is not None else None
+            sl = to_tensor_like(seq_lens) if seq_lens is not None else None
+            args = [q, k, v] + ([rot] if rot is not None else []) \
+                + ([mask_t] if mask_t is not None else []) \
+                + ([pre_c] if pre_c is not None else []) \
+                + ([cache] if cache is not None else []) \
+                + ([sl] if sl is not None else [])
+
+            def attn_fn(qv, kv_, vv, *rest):
+                rest = list(rest)
+                rt = rest.pop(0) if rot is not None else None
+                mv = rest.pop(0) if mask_t is not None else None
+                pc = rest.pop(0) if pre_c is not None else None
+                cv = rest.pop(0) if cache is not None else None
+                slv = rest.pop(0) if sl is not None else None
+                if rt is not None and rotary_emb_dims > 0:
+                    qv, kv_ = _rope_pair(qv, kv_, rt, 0)
+                if slv is not None:
+                    # per-sequence true lengths: padded tail tokens neither
+                    # attend nor get attended, and their K/V rows are zeroed
+                    # before the cache write
+                    live = (jnp.arange(S)[None, :]
+                            < slv.reshape(-1)[:, None])  # [B, S]
+                    kv_ = jnp.where(live[:, :, None, None], kv_, 0)
+                    vv = jnp.where(live[:, :, None, None], vv, 0)
+                keys, vals = kv_, vv
+                plen = 0
+                if pc is not None:  # [2, B, H, P, D]
+                    plen = pc.shape[3]
+                    keys = jnp.concatenate(
+                        [jnp.transpose(pc[0], (0, 2, 1, 3)), keys], axis=1)
+                    vals = jnp.concatenate(
+                        [jnp.transpose(pc[1], (0, 2, 1, 3)), vals], axis=1)
+                lg = jnp.einsum("bshd,blhd->bhsl", qv.astype(jnp.float32),
+                                keys.astype(jnp.float32)) / (hd ** 0.5)
+                kpos = jnp.arange(lg.shape[-1]) - plen
+                viz = kpos[None, :] <= jnp.arange(S)[:, None]
+                lg = jnp.where(viz[None, None], lg, -1e30)
+                if slv is not None:
+                    kl = (kpos[None, :] < slv.reshape(-1)[:, None]) | (
+                        kpos[None, :] < 0)  # pre-cache cols always live
+                    lg = jnp.where(kl[:, None, None, :], lg, -1e30)
+                if mv is not None:
+                    m = mv.astype(jnp.float32)
+                    need = lg.shape[-1]
+                    if m.shape[-1] < need:
+                        # pre-cache columns sit left of the mask: pad with 0
+                        # (prefix always attendable)
+                        m = jnp.pad(m, ((0, 0),) * (m.ndim - 1)
+                                    + ((need - m.shape[-1], 0),))
+                    else:
+                        m = m[..., -need:]
+                    lg = lg + m[..., -lg.shape[-2]:, :]
+                p = jax.nn.softmax(lg, axis=-1)
+                o = jnp.einsum("bhsl,blhd->bshd", p, vals.astype(jnp.float32))
+                outs = [o.astype(qv.dtype)]
+                if cv is not None:
+                    kc = jnp.transpose(kv_, (0, 2, 1, 3))  # [B, H, S, D]
+                    vc = jnp.transpose(vv, (0, 2, 1, 3))
+                    ncv = jax.lax.dynamic_update_slice(
+                        cv, jnp.stack([kc, vc])[:, :, :, :cv.shape[3]].astype(cv.dtype),
+                        (0, 0, 0, 0, 0))
+                    outs.append(ncv)
+                return tuple(outs)
+
+            n_outs = 2 if cache is not None else 1
+            res = apply(lambda *a: attn_fn(*a), *args,
+                        op_name="fmt_prefill", n_outs=n_outs)
+            if cache is not None:
+                attn_out, new_cache = res
+                cache._value = new_cache._value
+                new_caches.append(cache)
+            else:
+                attn_out = res if isinstance(res, Tensor) else res[0]
+        else:
+            # ----- decode: one token per sequence against the cache
+            if cache is None:
+                raise ValueError("decode (time_step) needs cache_kvs")
+            sl = (to_tensor_like(seq_lens) if seq_lens is not None else None)
+            mask_t = to_tensor_like(attn_mask) if attn_mask is not None else None
+            args = [q, k, v, cache] + ([rot] if rot is not None else []) \
+                + ([pre_c] if pre_c is not None else []) \
+                + ([sl] if sl is not None else []) \
+                + ([mask_t] if mask_t is not None else [])
+
+            def dec_fn(qv, kv_, vv, cv, *rest):
+                rest = list(rest)
+                rt = rest.pop(0) if rot is not None else None
+                pc = rest.pop(0) if pre_c is not None else None
+                slv = rest.pop(0) if sl is not None else None
+                mv = rest.pop(0) if mask_t is not None else None
+                pos = (slv.reshape(-1).astype(jnp.int32) if slv is not None
+                       else jnp.full((B,), step, jnp.int32))
+                if rt is not None and rotary_emb_dims > 0:
+                    # decode rope row: absolute position == write position;
+                    # rot may carry 1 row (pre-sliced) or the full table
+                    if rt.shape[2] == 1:
+                        qv, kv_ = _rope_pair(qv, kv_, rt, 0)
+                    else:
+                        from ....ops.paged_attention import rope_rotate
+
+                        cosb = rt[0, :, :, 0, :][jnp.arange(B), pos][:, None, None, :]
+                        sinb = rt[1, :, :, 0, :][jnp.arange(B), pos][:, None, None, :]
+                        qv = rope_rotate(qv, cosb, sinb, use_neox_rotary_style)
+                        kv_ = rope_rotate(kv_, cosb, sinb, use_neox_rotary_style)
+                bidx = jnp.arange(B)
+                kc = cv[0].at[bidx, :, pos].set(
+                    jnp.transpose(kv_, (0, 2, 1, 3))[bidx, :, 0].astype(cv.dtype))
+                vc = cv[1].at[bidx, :, pos].set(
+                    jnp.transpose(vv, (0, 2, 1, 3))[bidx, :, 0].astype(cv.dtype))
+                Smax = cv.shape[3]
+                keys, vals = kc, vc  # [B, H, Smax, D]
+                plen = 0
+                if pc is not None:
+                    plen = pc.shape[3]
+                    keys = jnp.concatenate([pc[0].astype(kc.dtype), keys], axis=2)
+                    vals = jnp.concatenate([pc[1].astype(vc.dtype), vals], axis=2)
+                lg = jnp.einsum("bhd,bhld->bhl",
+                                qv[:, 0].astype(jnp.float32),
+                                keys.astype(jnp.float32)) / (hd ** 0.5)
+                valid = (jnp.arange(Smax + plen)[None, :] - plen) <= pos[:, None]
+                lg = jnp.where(valid[:, None, :], lg, -1e30)
+                if mv is not None:
+                    # additive decode mask [B, 1|H, 1, Lm], keys aligned at
+                    # column 0 (pre-cache prefix occupies the first plen
+                    # columns when present)
+                    m = mv.astype(jnp.float32).reshape(B, -1, mv.shape[-1])
+                    need = lg.shape[-1]
+                    if m.shape[-1] < need:
+                        m = jnp.pad(m, ((0, 0), (0, 0), (0, need - m.shape[-1])))
+                    else:
+                        m = m[..., :need]
+                    lg = lg + m
+                p = jax.nn.softmax(lg, axis=-1)
+                o = jnp.einsum("bhl,bhld->bhd", p, vals.astype(jnp.float32))
+                # token-major [B, 1, H, D] so the common reshape below works
+                return (o[:, None].astype(qv.dtype),
+                        jnp.stack([kc, vc]).astype(cv.dtype))
+
+            attn_out, new_cache = apply(lambda *a: dec_fn(*a), *args,
+                                        op_name="fmt_decode", n_outs=2)
+            cache._value = new_cache._value
+            new_caches.append(cache)
+
+        # common tail: out proj + residual + FFN
+        ho = M.reshape(attn_out, [B, S if not decode else 1, nh * hd])
+        ho = F.linear(ho, linear_weights[i],
+                      linear_biases[i] if linear_biases else None)
+        if training and dropout_rate > 0:
+            ho = F.dropout(ho, p=dropout_rate, training=True, mode=mode)
+        out = residual * residual_alpha + ho
+        if not pre_layer_norm:
+            out = _norm(out, ln_scales[i], ln_biases[i] if ln_biases else None)
+        residual2 = out
+        h2 = _norm(out, ffn_ln_scales[i],
+                   ffn_ln_biases[i] if ffn_ln_biases else None) \
+            if pre_layer_norm else out
+        h2 = F.linear(h2, ffn1_weights[i],
+                      ffn1_biases[i] if ffn1_biases else None)
+        h2 = getattr(F, activation)(h2)
+        if training and dropout_rate > 0:
+            h2 = F.dropout(h2, p=dropout_rate, training=True, mode=mode)
+        h2 = F.linear(h2, ffn2_weights[i],
+                      ffn2_biases[i] if ffn2_biases else None)
+        if training and dropout_rate > 0:
+            h2 = F.dropout(h2, p=dropout_rate, training=True, mode=mode)
+        out = residual2 * residual_alpha + h2
+        if not pre_layer_norm:
+            out = _norm(out, ffn_ln_scales[i],
+                        ffn_ln_biases[i] if ffn_ln_biases else None)
+
+    if cache_kvs is not None:
+        return out, new_caches
+    return out
 
 
 def variable_length_memory_efficient_attention(query, key, value, seq_lens=None,
@@ -482,12 +748,106 @@ def fused_gate_attention(query, key=None, query_weight=None, key_weight=None,
                  out, ow, ob, op_name="gate_out")
 
 
-def block_multihead_attention(*args, **kwargs):
-    """reference: block_multihead_attention (paged-KV serving attention with
-    block tables + quant variants). The TPU serving path uses the static KV
-    ring decode (models.generate / greedy_decode) instead; paged block tables
-    are not implemented."""
-    raise NotImplementedError(
-        "block_multihead_attention (paged KV blocks) is not implemented; use "
-        "models.generate(use_static_cache=True) / models.greedy_decode for "
-        "TPU serving decode")
+def block_multihead_attention(
+    qkv, key_cache, value_cache, seq_lens_encoder, seq_lens_decoder,
+    seq_lens_this_time, padding_offsets, cum_offsets, cu_seqlens_q,
+    cu_seqlens_k, block_tables, pre_key_cache=None, pre_value_cache=None,
+    cache_k_quant_scales=None, cache_v_quant_scales=None,
+    cache_k_dequant_scales=None, cache_v_dequant_scales=None,
+    qkv_out_scale=None, qkv_bias=None, out_shift=None, out_smooth=None,
+    max_enc_len_this_time=None, max_dec_len_this_time=None, rope_emb=None,
+    mask=None, tgt_mask=None, max_seq_len=-1, block_size=64,
+    use_neox_style=False, use_dynamic_cachekv_quant=False,
+    quant_round_type=1, quant_max_bound=127.0, quant_min_bound=-127.0,
+    out_scale=-1.0, compute_dtype="default"):
+    """Paged-KV serving attention (parity:
+    /root/reference/python/paddle/incubate/nn/functional/block_multihead_attention.py:19).
+
+    TPU-native: scatter/gather over a global block pool + one padded-batch
+    masked-attention einsum chain (see ops/paged_attention.py for the design
+    notes). Caches, and in dynamic quant mode the scale tensors, are updated
+    IN PLACE on the passed Tensors — the reference kernel's inplace
+    contract — and also returned: (out, qkv, key_cache, value_cache).
+    Supports MHA/GQA, mixed prefill+decode batches, in-kernel rope,
+    pre-caches, int8 cache quant (static + dynamic), int32-qkv dequant and
+    int8 output quant.
+    """
+    import numpy as _np
+
+    from ....ops.paged_attention import blha_attention
+
+    qkv_t = to_tensor_like(qkv)
+    kc_t = to_tensor_like(key_cache)
+    vc_t = to_tensor_like(value_cache)
+    KV, bsz_blocks, D = kc_t.shape[1], kc_t.shape[2], kc_t.shape[3]
+    if int(bsz_blocks) != int(block_size):
+        raise ValueError(
+            f"block_size={block_size} does not match key_cache block axis "
+            f"({bsz_blocks})")
+    H = qkv_t.shape[1] // D - 2 * KV
+
+    def val(x):
+        return None if x is None else to_tensor_like(x)._value
+
+    lens_now = _np.asarray(val(seq_lens_this_time)).reshape(-1)
+    max_q_len = int(lens_now.max()) if lens_now.size else 1
+    # bucket the static padded-query length to the next power of two: a
+    # serving loop with naturally varying chunk lengths otherwise compiles
+    # one program per distinct max length (padded rows are masked, so this
+    # only costs a bounded amount of dead compute)
+    max_q_len = 1 << max(max_q_len - 1, 0).bit_length()
+
+    if use_dynamic_cachekv_quant and cache_k_quant_scales is not None:
+        cache_quant = "dynamic"
+        for t in (cache_k_quant_scales, cache_v_quant_scales,
+                  cache_k_dequant_scales, cache_v_dequant_scales):
+            if not isinstance(t, Tensor):
+                raise TypeError(
+                    "use_dynamic_cachekv_quant=True refreshes the scale "
+                    "tensors IN PLACE (reference contract) — pass Tensors, "
+                    "not raw arrays, or the updated scales would be lost")
+    elif cache_k_quant_scales is not None or cache_k_dequant_scales is not None:
+        cache_quant = "static"
+    else:
+        cache_quant = "none"
+
+    if compute_dtype == "default":
+        cdt = qkv_t._value.dtype
+        if cdt == jnp.int32:
+            raise ValueError(
+                "int32 qkv needs an explicit compute_dtype (e.g. 'fp16')")
+    else:
+        cdt = {"fp16": jnp.float16, "float16": jnp.float16,
+               "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+               "fp32": jnp.float32, "float32": jnp.float32}[compute_dtype]
+
+    outs = blha_attention(
+        qkv_t._value, kc_t._value, vc_t._value,
+        jnp.asarray(val(seq_lens_encoder)).reshape(-1),
+        jnp.asarray(val(seq_lens_decoder)).reshape(-1),
+        jnp.asarray(val(seq_lens_this_time)).reshape(-1),
+        jnp.asarray(val(cu_seqlens_q)).reshape(-1),
+        val(block_tables),
+        num_heads=int(H), kv_num_heads=int(KV), head_dim=int(D),
+        block_size=int(block_size), max_q_len=max_q_len,
+        use_neox_style=bool(use_neox_style), cache_quant=cache_quant,
+        round_ties_away=(quant_round_type == 1), compute_dtype=cdt,
+        has_out_quant=(out_scale > 0),
+        qkv_out_scale=val(qkv_out_scale), qkv_bias=val(qkv_bias),
+        rope_emb=val(rope_emb), mask=val(mask), tgt_mask=val(tgt_mask),
+        pre_key_cache=val(pre_key_cache), pre_value_cache=val(pre_value_cache),
+        cache_k_quant_scales=val(cache_k_quant_scales),
+        cache_v_quant_scales=val(cache_v_quant_scales),
+        cache_k_dequant_scales=val(cache_k_dequant_scales),
+        cache_v_dequant_scales=val(cache_v_dequant_scales),
+        out_shift=val(out_shift), out_smooth=val(out_smooth),
+        out_scale=float(out_scale), quant_max_bound=float(quant_max_bound),
+        quant_min_bound=float(quant_min_bound))
+    out, new_kc, new_vc, kq, vq, kd, vd = outs
+    kc_t._value = new_kc
+    vc_t._value = new_vc
+    if cache_quant == "dynamic":
+        for t, v in ((cache_k_quant_scales, kq), (cache_v_quant_scales, vq),
+                     (cache_k_dequant_scales, kd), (cache_v_dequant_scales, vd)):
+            t._value = v  # Tensor-ness validated up front
+    return (Tensor(out), qkv_t, kc_t, vc_t)

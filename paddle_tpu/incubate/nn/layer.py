@@ -233,29 +233,100 @@ class FusedTransformerEncoderLayer(nn.Layer):
 
 
 class FusedMultiTransformer(nn.Layer):
-    """parity: fused_transformer.py:1431 — N pre-LN decoder layers in one
-    module (the inference-serving stack of fused_multi_transformer)."""
+    """parity: fused_transformer.py:994 — N pre-LN decoder layers as
+    per-layer weight LISTS over the fused_multi_transformer functional,
+    including the reference's KV-cache generation contract (prefill writes
+    `caches[i]` [2, B, H, max_seq, D] in place; `time_step` switches to
+    single-token decode against the cache)."""
 
     def __init__(self, embed_dim, num_heads, dim_feedforward, dropout_rate=0.0,
-                 activation="gelu", normalize_before=True, num_layers=1,
-                 epsilon=1e-5, name=None, **kw):
+                 activation="gelu", normalize_before=True,
+                 ln_scale_attrs=None, ln_bias_attrs=None,
+                 qkv_weight_attrs=None, qkv_bias_attrs=None,
+                 linear_weight_attrs=None, linear_bias_attrs=None,
+                 ffn_ln_scale_attrs=None, ffn_ln_bias_attrs=None,
+                 ffn1_weight_attrs=None, ffn1_bias_attrs=None,
+                 ffn2_weight_attrs=None, ffn2_bias_attrs=None,
+                 epsilon=1e-5, residual_alpha=1.0, num_layers=-1,
+                 nranks=1, trans_qkvw=True, ring_id=-1, norm_type="layernorm",
+                 use_neox_rotary_style=False, gqa_group_size=-1, name=None):
         super().__init__()
-        assert normalize_before, "FusedMultiTransformer is pre-LN (reference contract)"
-        self.layers = nn.LayerList([
-            FusedTransformerEncoderLayer(
-                embed_dim, num_heads, dim_feedforward, dropout_rate=dropout_rate,
-                activation=activation, normalize_before=True)
-            for _ in range(num_layers)
-        ])
+        assert embed_dim % num_heads == 0
+        if num_layers == -1:
+            num_layers = (len(qkv_weight_attrs)
+                          if isinstance(qkv_weight_attrs, (list, tuple)) else 1)
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.dropout_rate = dropout_rate
+        self.activation = activation
+        self.normalize_before = normalize_before
+        self._epsilon = epsilon
+        self._residual_alpha = residual_alpha
+        self._trans_qkvw = trans_qkvw
+        self._ring_id = ring_id
+        self._norm_type = norm_type
+        self._use_neox_rotary_style = use_neox_rotary_style
+        self._gqa_group_size = gqa_group_size
+        self.num_layers = num_layers
 
-    def forward(self, src, attn_mask=None, caches=None, **kw):
-        if caches is not None:
-            raise NotImplementedError(
-                "FusedMultiTransformer cache decode is not implemented; use "
-                "models.generate with a causal LM for KV-cache decoding")
-        out = src
-        for layer in self.layers:
-            out = layer(out, src_mask=attn_mask)
+        def pick(attrs, i):
+            return attrs[i] if isinstance(attrs, (list, tuple)) else attrs
+
+        one = nn.initializer.Constant(1.0)
+        (self.ln_scales, self.ln_biases, self.qkv_weights, self.qkv_biases,
+         self.linear_weights, self.linear_biases, self.ffn_ln_scales,
+         self.ffn_ln_biases, self.ffn1_weights, self.ffn1_biases,
+         self.ffn2_weights, self.ffn2_biases) = ([] for _ in range(12))
+        nh, hd, E = num_heads, self.head_dim, embed_dim
+        for i in range(num_layers):
+            mk = self.create_parameter
+            self.ln_scales.append(mk([E], attr=pick(ln_scale_attrs, i),
+                                     default_initializer=one))
+            self.ln_biases.append(mk([E], attr=pick(ln_bias_attrs, i), is_bias=True))
+            self.qkv_weights.append(mk([3, nh, hd, E],
+                                       attr=pick(qkv_weight_attrs, i)))
+            self.qkv_biases.append(mk([3, nh, hd], attr=pick(qkv_bias_attrs, i),
+                                      is_bias=True))
+            self.linear_weights.append(mk([E, E],
+                                          attr=pick(linear_weight_attrs, i)))
+            self.linear_biases.append(mk([E], attr=pick(linear_bias_attrs, i),
+                                         is_bias=True))
+            self.ffn_ln_scales.append(mk([E], attr=pick(ffn_ln_scale_attrs, i),
+                                         default_initializer=one))
+            self.ffn_ln_biases.append(mk([E], attr=pick(ffn_ln_bias_attrs, i),
+                                         is_bias=True))
+            self.ffn1_weights.append(mk([E, dim_feedforward],
+                                        attr=pick(ffn1_weight_attrs, i)))
+            self.ffn1_biases.append(mk([dim_feedforward],
+                                       attr=pick(ffn1_bias_attrs, i), is_bias=True))
+            self.ffn2_weights.append(mk([dim_feedforward, E],
+                                        attr=pick(ffn2_weight_attrs, i)))
+            self.ffn2_biases.append(mk([E], attr=pick(ffn2_bias_attrs, i),
+                                       is_bias=True))
+            # register under structured names (create_parameter already adds
+            # them to the layer; lists keep the reference's attribute API)
+
+    def forward(self, src, attn_mask=None, caches=None, pre_caches=None,
+                rotary_embs=None, rotary_emb_dims=0, beam_offset=None,
+                seq_lens=None, time_step=None):
+        from ..nn.functional import fused_multi_transformer as fmt
+
+        out = fmt(
+            src, self.ln_scales, self.ln_biases, self.qkv_weights,
+            self.qkv_biases, self.linear_weights, self.linear_biases,
+            self.ffn_ln_scales, self.ffn_ln_biases, self.ffn1_weights,
+            self.ffn1_biases, self.ffn2_weights, self.ffn2_biases,
+            pre_layer_norm=self.normalize_before, epsilon=self._epsilon,
+            residual_alpha=self._residual_alpha, cache_kvs=caches,
+            beam_offset=beam_offset, pre_caches=pre_caches,
+            rotary_embs=rotary_embs, time_step=time_step, seq_lens=seq_lens,
+            attn_mask=attn_mask, dropout_rate=self.dropout_rate,
+            rotary_emb_dims=rotary_emb_dims, activation=self.activation,
+            training=self.training, trans_qkvw=self._trans_qkvw,
+            ring_id=self._ring_id, norm_type=self._norm_type,
+            use_neox_rotary_style=self._use_neox_rotary_style,
+            gqa_group_size=self._gqa_group_size, name=None)
         return out
 
 

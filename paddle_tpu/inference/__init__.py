@@ -19,7 +19,10 @@ import jax.numpy as jnp
 
 from ..tensor.tensor import Tensor
 
-__all__ = ["Config", "create_predictor", "Predictor", "PredictorPool"]
+__all__ = ["Config", "create_predictor", "Predictor", "PredictorPool",
+           "BlockManager", "ServingEngine", "ServingRequest"]
+
+from .serving import BlockManager, ServingEngine, ServingRequest  # noqa: E402
 
 
 class Config:

@@ -614,9 +614,13 @@ def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,
 
 
 def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
-    raise NotImplementedError(
-        "py_func embeds host Python in the graph; use jax.pure_callback via "
-        "a custom op, or eager mode")
+    """parity: static.py_func — host Python inside the graph. Shares the
+    ``static.nn.py_func`` implementation (jax.pure_callback + custom_vjp for
+    the backward hook)."""
+    from .nn.control_flow import py_func as _py_func
+
+    return _py_func(func, x, out, backward_func=backward_func,
+                    skip_vars_in_backward_input=skip_vars_in_backward_input)
 
 
 # ------------------------------------------------ program state save/load

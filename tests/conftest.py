@@ -23,6 +23,14 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "quick: fast cross-subsystem verification tier (~3 min total; "
+        "run with -m quick to re-check a round's claims without the full "
+        "suite)")
+
+
 @pytest.fixture(autouse=True)
 def _seed_everything():
     import paddle_tpu as P

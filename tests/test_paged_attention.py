@@ -1,0 +1,366 @@
+"""Parity tests for the paged-KV serving attention
+(block_multihead_attention) against a naive dense reference — mirrors the
+reference's test matrix (test/legacy_test/test_block_multihead_attention.py:
+EncDec, GQA, RoPE, PreCache, cache-KV quant) plus a mixed prefill+decode
+batch, which is the continuous-batching serving case."""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu.incubate.nn.functional import block_multihead_attention
+from paddle_tpu.ops.paged_attention import build_padding_metadata
+
+pytestmark = pytest.mark.quick
+
+
+def naive_attn(q, k, v, cache_k=None, cache_v=None, pre_k=None, pre_v=None,
+               mask=None, causal=False):
+    """Dense attention oracle: q [B,H,S,D], k/v [B,KV,S,D]; caches
+    [B,KV,L,D] prepend along the key axis; fp32 softmax; GQA tiles KV
+    heads."""
+    B, H, S, D = q.shape
+    KV = k.shape[1]
+    rep = H // KV
+
+    def expand(x):
+        return np.repeat(x, rep, axis=1) if x.shape[1] != H else x
+
+    keys = expand(k)
+    vals = expand(v)
+    offset = 0
+    if cache_k is not None:
+        keys = np.concatenate([expand(cache_k), keys], axis=2)
+        vals = np.concatenate([expand(cache_v), vals], axis=2)
+        offset = cache_k.shape[2]
+    pre = 0
+    if pre_k is not None:
+        keys = np.concatenate([expand(pre_k), keys], axis=2)
+        vals = np.concatenate([expand(pre_v), vals], axis=2)
+        pre = pre_k.shape[2]
+    logits = np.einsum("bhsd,bhld->bhsl", q.astype(np.float64),
+                       keys.astype(np.float64)) / np.sqrt(D)
+    if causal:
+        L = keys.shape[2]
+        qpos = offset + np.arange(S)
+        kpos = np.arange(L) - pre
+        viz = kpos[None, :] <= qpos[:, None]
+        logits = np.where(viz[None, None], logits, -1e30)
+    if mask is not None:
+        logits = logits + mask.astype(np.float64)
+    w = np.exp(logits - logits.max(-1, keepdims=True))
+    w = w / w.sum(-1, keepdims=True)
+    return np.einsum("bhsl,bhld->bhsd", w, vals.astype(np.float64))
+
+
+def pack_qkv(q, k, v):
+    """[B,H,S,D]x3 -> [sum(S), (H+2KV)D] packed tokens (all seqs full S)."""
+    B, H, S, D = q.shape
+    KV = k.shape[1]
+
+    def flat(x, nh):
+        return x.transpose(0, 2, 1, 3).reshape(B * S, nh * D)
+
+    return np.concatenate([flat(q, H), flat(k, KV), flat(v, KV)], axis=1)
+
+
+def make_blocks(B, blocks_per_seq):
+    """Sequential free-list allocation like the reference test."""
+    bt = np.zeros((B, blocks_per_seq), np.int32)
+    nxt = 0
+    for i in range(B):
+        for j in range(blocks_per_seq):
+            bt[i, j] = nxt
+            nxt += 1
+    return bt, nxt
+
+
+def paged_to_dense(cache, bt, length):
+    """[NB,KV,bs,D] + block table row-major -> [B,KV,length,D]."""
+    NB, KV, bs, D = cache.shape
+    B = bt.shape[0]
+    out = np.zeros((B, KV, length, D), np.float32)
+    for i in range(B):
+        for j in range(length):
+            out[i, :, j] = np.asarray(cache[bt[i, j // bs], :, j % bs],
+                                      np.float32)
+    return out
+
+
+def run_blha(qkv, kc, vc, enc, dec, now, bt, block_size, **kw):
+    _, _, cu, _ = build_padding_metadata(now)
+    kc_t, vc_t = P.to_tensor(kc), P.to_tensor(vc)
+    out = block_multihead_attention(
+        P.to_tensor(qkv), kc_t, vc_t,
+        P.to_tensor(np.asarray(enc, np.int32)),
+        P.to_tensor(np.asarray(dec, np.int32)),
+        P.to_tensor(np.asarray(now, np.int32)),
+        None, None, P.to_tensor(cu), P.to_tensor(cu),
+        P.to_tensor(bt), block_size=block_size, **kw)
+    return (np.asarray(out[0].numpy()), np.asarray(out[2].numpy()),
+            np.asarray(out[3].numpy()))
+
+
+class TestEncDec:
+    B, H, S, D, bs = 2, 4, 16, 32, 8
+
+    def setup_method(self, _):
+        self.rng = np.random.RandomState(7)
+        self.blocks_per_seq = (self.S + 8 + self.bs - 1) // self.bs
+        self.bt, self.nb = make_blocks(self.B, self.blocks_per_seq)
+
+    def _rand(self, shape):
+        return self.rng.uniform(-1, 1, shape).astype(np.float32)
+
+    def test_prefill_then_decode_parity(self):
+        B, H, S, D = self.B, self.H, self.S, self.D
+        kc = np.zeros((self.nb, H, self.bs, D), np.float32)
+        vc = np.zeros_like(kc)
+        q, k, v = self._rand((B, H, S, D)), self._rand((B, H, S, D)), self._rand((B, H, S, D))
+        out, kc, vc = run_blha(pack_qkv(q, k, v), kc, vc,
+                               [S] * B, [0] * B, [S] * B, self.bt, self.bs)
+        ref = naive_attn(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            out, ref.transpose(0, 2, 1, 3).reshape(B * S, H * D),
+            rtol=2e-4, atol=2e-4)
+        # the paged cache now holds this step's K/V
+        np.testing.assert_allclose(paged_to_dense(kc, self.bt, S),
+                                   k, rtol=1e-5, atol=1e-5)
+
+        # --- decode step: 1 token per sequence, random additive tgt_mask
+        q1, k1, v1 = (self._rand((B, H, 1, D)) for _ in range(3))
+        tgt = self._rand((B, H, 1, S + 1))
+        out1, kc, vc = run_blha(pack_qkv(q1, k1, v1), kc, vc,
+                                [0] * B, [S] * B, [1] * B, self.bt, self.bs,
+                                tgt_mask=P.to_tensor(tgt))
+        cache_k = paged_to_dense(kc, self.bt, S)
+        cache_v = paged_to_dense(vc, self.bt, S)
+        ref1 = naive_attn(q1, k1, v1, cache_k, cache_v, mask=tgt)
+        np.testing.assert_allclose(
+            out1, ref1.transpose(0, 2, 1, 3).reshape(B, H * D),
+            rtol=2e-4, atol=2e-4)
+
+    def test_gqa(self):
+        B, H, S, D, KV = self.B, self.H, self.S, self.D, 2
+        kc = np.zeros((self.nb, KV, self.bs, D), np.float32)
+        vc = np.zeros_like(kc)
+        q = self._rand((B, H, S, D))
+        k, v = self._rand((B, KV, S, D)), self._rand((B, KV, S, D))
+        out, kc2, vc2 = run_blha(pack_qkv(q, k, v), kc, vc,
+                                 [S] * B, [0] * B, [S] * B, self.bt, self.bs)
+        ref = naive_attn(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            out, ref.transpose(0, 2, 1, 3).reshape(B * S, H * D),
+            rtol=2e-4, atol=2e-4)
+        # decode on the GQA cache
+        q1 = self._rand((B, H, 1, D))
+        k1, v1 = self._rand((B, KV, 1, D)), self._rand((B, KV, 1, D))
+        out1, _, _ = run_blha(pack_qkv(q1, k1, v1), kc2, vc2,
+                              [0] * B, [S] * B, [1] * B, self.bt, self.bs)
+        ck = paged_to_dense(kc2, self.bt, S)[:, :KV]
+        cv = paged_to_dense(vc2, self.bt, S)[:, :KV]
+        ref1 = naive_attn(q1, k1, v1, ck, cv, causal=True)
+        np.testing.assert_allclose(
+            out1, ref1.transpose(0, 2, 1, 3).reshape(B, H * D),
+            rtol=2e-4, atol=2e-4)
+
+    def test_mixed_prefill_and_decode_one_call(self):
+        """Sequence 0 decodes (ctx=S) while sequence 1 prefills — one call,
+        outputs match the two phases run against the dense oracle."""
+        B, H, S, D = self.B, self.H, self.S, self.D
+        kc = np.zeros((self.nb, H, self.bs, D), np.float32)
+        vc = np.zeros_like(kc)
+        # pre-populate seq 0's context via a normal prefill of both
+        q0, k0, v0 = (self._rand((B, H, S, D)) for _ in range(3))
+        _, kc, vc = run_blha(pack_qkv(q0, k0, v0), kc, vc,
+                             [S] * B, [0] * B, [S] * B, self.bt, self.bs)
+        # now: seq0 1 decode token; seq1 re-prefills S2 fresh tokens
+        S2 = 6
+        qd, kd, vd = (self._rand((1, H, 1, D)) for _ in range(3))
+        qp, kp, vp = (self._rand((1, H, S2, D)) for _ in range(3))
+        tok0 = np.concatenate([
+            qd.transpose(0, 2, 1, 3).reshape(1, H * D),
+            kd.transpose(0, 2, 1, 3).reshape(1, H * D),
+            vd.transpose(0, 2, 1, 3).reshape(1, H * D)], axis=1)
+        tokp = np.concatenate([
+            qp.transpose(0, 2, 1, 3).reshape(S2, H * D),
+            kp.transpose(0, 2, 1, 3).reshape(S2, H * D),
+            vp.transpose(0, 2, 1, 3).reshape(S2, H * D)], axis=1)
+        qkv = np.concatenate([tok0, tokp], axis=0)  # [1+S2, 3HD]
+        out, kc, vc = run_blha(qkv, kc, vc,
+                               [0, S2], [S, 0], [1, S2], self.bt, self.bs)
+        # seq 0: decode against its cached context
+        ck = paged_to_dense(kc, self.bt, S)[0:1]
+        cv = paged_to_dense(vc, self.bt, S)[0:1]
+        ref0 = naive_attn(qd, kd, vd, ck, cv, causal=True)
+        np.testing.assert_allclose(out[0], ref0.transpose(0, 2, 1, 3).reshape(H * D),
+                                   rtol=2e-4, atol=2e-4)
+        # seq 1: fresh causal prefill (its block rows were overwritten)
+        ref1 = naive_attn(qp, kp, vp, causal=True)
+        np.testing.assert_allclose(
+            out[1:], ref1.transpose(0, 2, 1, 3).reshape(S2, H * D),
+            rtol=2e-4, atol=2e-4)
+
+    def test_rope_interleaved(self):
+        """In-kernel rope, reference layout [2, B, Smax, 1, D/2] with
+        interleaved (non-neox) rotation."""
+        B, H, S, D = self.B, self.H, self.S, self.D
+        kc = np.zeros((self.nb, H, self.bs, D), np.float32)
+        vc = np.zeros_like(kc)
+        q, k, v = (self._rand((B, H, S, D)) for _ in range(3))
+        pos = np.arange(S + 8)
+        inv = 10000.0 ** (-np.arange(0, D, 2) / D)
+        freqs = np.einsum("i,j->ij", pos, inv)  # [Smax, D/2]
+        rope = np.stack([np.cos(freqs), np.sin(freqs)])[:, None, :, None, :]
+        out, _, _ = run_blha(pack_qkv(q, k, v), kc, vc,
+                             [S] * B, [0] * B, [S] * B, self.bt, self.bs,
+                             rope_emb=P.to_tensor(rope.astype(np.float32)))
+
+        def rot(x):  # interleaved pairs at absolute position
+            c = np.cos(freqs)[:S][None, None]
+            s = np.sin(freqs)[:S][None, None]
+            x1, x2 = x[..., 0::2], x[..., 1::2]
+            o = np.stack([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+            return o.reshape(x.shape)
+
+        qs = rot(q.transpose(0, 1, 2, 3))  # [B,H,S,D] rotate over S axis
+        ks = rot(k)
+        ref = naive_attn(qs, ks, v, causal=True)
+        np.testing.assert_allclose(
+            out, ref.transpose(0, 2, 1, 3).reshape(B * S, H * D),
+            rtol=2e-4, atol=2e-4)
+
+    def test_pre_cache(self):
+        B, H, S, D = self.B, self.H, self.S, self.D
+        P_len = 4
+        kc = np.zeros((self.nb, H, self.bs, D), np.float32)
+        vc = np.zeros_like(kc)
+        q, k, v = (self._rand((B, H, S, D)) for _ in range(3))
+        pk, pv = self._rand((B, H, P_len, D)), self._rand((B, H, P_len, D))
+        out, _, _ = run_blha(pack_qkv(q, k, v), kc, vc,
+                             [S] * B, [0] * B, [S] * B, self.bt, self.bs,
+                             pre_key_cache=P.to_tensor(pk),
+                             pre_value_cache=P.to_tensor(pv))
+        ref = naive_attn(q, k, v, pre_k=pk, pre_v=pv, causal=True)
+        np.testing.assert_allclose(
+            out, ref.transpose(0, 2, 1, 3).reshape(B * S, H * D),
+            rtol=2e-4, atol=2e-4)
+
+    def test_qkv_bias_and_int32_dequant(self):
+        B, H, S, D = self.B, self.H, 4, self.D
+        kc = np.zeros((self.nb, H, self.bs, D), np.float32)
+        vc = np.zeros_like(kc)
+        q, k, v = (self._rand((B, H, S, D)) for _ in range(3))
+        bias = self.rng.uniform(-0.5, 0.5, (3 * H * D,)).astype(np.float32)
+        scale = np.full((3 * H * D,), 0.01, np.float32)
+        qkv_f = pack_qkv(q, k, v)
+        qkv_i = np.round(qkv_f / 0.01).astype(np.int32)
+        out, _, _ = run_blha(qkv_i, kc, vc, [S] * B, [0] * B, [S] * B,
+                             self.bt, self.bs,
+                             qkv_out_scale=P.to_tensor(scale),
+                             qkv_bias=P.to_tensor(bias),
+                             compute_dtype="fp32")
+
+        def unpack(x, o, nh):
+            return x[:, o:o + nh * D].reshape(B, S, nh, D).transpose(0, 2, 1, 3)
+
+        deq = qkv_i.astype(np.float32) * 0.01 + bias[None]
+        ref = naive_attn(unpack(deq, 0, H), unpack(deq, H * D, H),
+                         unpack(deq, 2 * H * D, H), causal=True)
+        np.testing.assert_allclose(
+            out, ref.transpose(0, 2, 1, 3).reshape(B * S, H * D),
+            rtol=5e-3, atol=5e-3)
+
+
+class TestCacheQuant:
+    B, H, S, D, bs = 2, 4, 16, 32, 8
+
+    def setup_method(self, _):
+        self.rng = np.random.RandomState(3)
+        self.blocks_per_seq = (self.S + 8 + self.bs - 1) // self.bs
+        self.bt, self.nb = make_blocks(self.B, self.blocks_per_seq)
+
+    def _run_quant(self, dynamic):
+        B, H, S, D = self.B, self.H, self.S, self.D
+        kc = np.zeros((self.nb, H, self.bs, D), np.uint8)
+        vc = np.zeros_like(kc)
+        q, k, v = (self.rng.uniform(-1, 1, (B, H, S, D)).astype(np.float32)
+                   for _ in range(3))
+        if dynamic:
+            shape = (B, H)
+            kq = P.to_tensor(np.zeros(shape, np.float32))
+            vq = P.to_tensor(np.zeros(shape, np.float32))
+            kd = P.to_tensor(np.zeros(shape, np.float32))
+            vd = P.to_tensor(np.zeros(shape, np.float32))
+        else:
+            kmax = np.abs(k).max(axis=(0, 2, 3)) + 1e-6  # per head
+            vmax = np.abs(v).max(axis=(0, 2, 3)) + 1e-6
+            kq = P.to_tensor((127.0 / kmax).astype(np.float32))
+            vq = P.to_tensor((127.0 / vmax).astype(np.float32))
+            kd = P.to_tensor((kmax / 127.0).astype(np.float32))
+            vd = P.to_tensor((vmax / 127.0).astype(np.float32))
+        out, kc, vc = run_blha(
+            pack_qkv(q, k, v), kc, vc, [S] * B, [0] * B, [S] * B,
+            self.bt, self.bs, cache_k_quant_scales=kq,
+            cache_v_quant_scales=vq, cache_k_dequant_scales=kd,
+            cache_v_dequant_scales=vd, use_dynamic_cachekv_quant=dynamic)
+        # prefill output itself is full precision
+        ref = naive_attn(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            out, ref.transpose(0, 2, 1, 3).reshape(B * S, H * D),
+            rtol=2e-4, atol=2e-4)
+        assert kc.dtype == np.uint8
+        # decode reads the dequantized cache: compare against dequant oracle
+        q1, k1, v1 = (self.rng.uniform(-1, 1, (B, H, 1, D)).astype(np.float32)
+                      for _ in range(3))
+        out1, _, _ = run_blha(
+            pack_qkv(q1, k1, v1), kc, vc, [0] * B, [S] * B, [1] * B,
+            self.bt, self.bs, cache_k_quant_scales=kq,
+            cache_v_quant_scales=vq, cache_k_dequant_scales=kd,
+            cache_v_dequant_scales=vd, use_dynamic_cachekv_quant=dynamic)
+        kdv = np.asarray(kd.numpy())
+        vdv = np.asarray(vd.numpy())
+        if dynamic:
+            kdq = (paged_to_dense(kc, self.bt, S) - 128.0) * kdv[:, :, None, None]
+            vdq = (paged_to_dense(vc, self.bt, S) - 128.0) * vdv[:, :, None, None]
+        else:
+            kdq = (paged_to_dense(kc, self.bt, S) - 128.0) * kdv[None, :, None, None]
+            vdq = (paged_to_dense(vc, self.bt, S) - 128.0) * vdv[None, :, None, None]
+        ref1 = naive_attn(q1, k1, v1, kdq, vdq, causal=True)
+        np.testing.assert_allclose(
+            out1, ref1.transpose(0, 2, 1, 3).reshape(B, H * D),
+            rtol=0.05, atol=0.05)
+        # quantization error vs the fp cache stays small
+        np.testing.assert_allclose(kdq, k, atol=2.5 / 127.0)
+
+    def test_static_quant(self):
+        self._run_quant(dynamic=False)
+
+    def test_dynamic_quant(self):
+        self._run_quant(dynamic=True)
+
+    def test_dynamic_quant_writes_scales_inplace(self):
+        B, H, S, D = self.B, self.H, self.S, self.D
+        kc = np.zeros((self.nb, H, self.bs, D), np.uint8)
+        vc = np.zeros_like(kc)
+        q, k, v = (self.rng.uniform(-1, 1, (B, H, S, D)).astype(np.float32)
+                   for _ in range(3))
+        kq, vq, kd, vd = (P.to_tensor(np.zeros((B, H), np.float32))
+                          for _ in range(4))
+        run_blha(pack_qkv(q, k, v), kc, vc, [S] * B, [0] * B, [S] * B,
+                 self.bt, self.bs, cache_k_quant_scales=kq,
+                 cache_v_quant_scales=vq, cache_k_dequant_scales=kd,
+                 cache_v_dequant_scales=vd, use_dynamic_cachekv_quant=True)
+        expect = np.abs(k).max(axis=(2, 3)) / 127.0  # [B, H]
+        np.testing.assert_allclose(np.asarray(kd.numpy()), expect, rtol=1e-4)
+        assert (np.asarray(kq.numpy()) > 0).all()
+
+
+class TestBlhaGetMaxLen:
+    def test_max_lens(self):
+        from paddle_tpu.incubate.nn.functional import blha_get_max_len
+
+        enc = P.to_tensor(np.array([3, 9, 0], np.int32))
+        dec = P.to_tensor(np.array([5, 0, 2], np.int32))
+        me, md = blha_get_max_len(enc, dec, P.to_tensor(np.array([3])))
+        assert int(np.asarray(me.numpy())[0]) == 9
+        assert int(np.asarray(md.numpy())[0]) == 5

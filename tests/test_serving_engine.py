@@ -1,0 +1,92 @@
+"""Continuous-batching serving engine tests: greedy parity with
+models.generate, mixed-length admission/retirement across steps WITHOUT
+recompilation, and block-pool recycling (VERDICT r4 item 1 done-criteria)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu.inference import ServingEngine
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+pytestmark = pytest.mark.quick
+
+
+@pytest.fixture(scope="module")
+def model():
+    P.seed(11)
+    return LlamaForCausalLM(llama_tiny())
+
+
+def ref_greedy(model, prompt, n):
+    from paddle_tpu.models.generation import generate
+
+    ids = P.to_tensor(np.asarray(prompt, np.int32)[None, :])
+    out = generate(model, ids, max_new_tokens=n, do_sample=False)
+    return list(np.asarray(out.numpy()).reshape(-1))
+
+
+class TestServingEngine:
+    def test_single_request_matches_generate(self, model):
+        eng = ServingEngine(model, max_batch_size=2, max_seq_len=64,
+                            block_size=8, token_budget=16)
+        prompt = [3, 17, 101, 7, 250]
+        rid = eng.add_request(prompt, max_new_tokens=8)
+        out = eng.run()
+        assert out[rid] == ref_greedy(model, prompt, 8)
+
+    def test_mixed_lengths_no_recompile(self, model):
+        """Admit sequences of different lengths at different times; the whole
+        service runs from ONE compiled step program."""
+        eng = ServingEngine(model, max_batch_size=3, max_seq_len=64,
+                            block_size=8, token_budget=12)
+        p1 = [3, 17, 101, 7, 250, 9, 12]
+        p2 = [42, 5]
+        p3 = [400, 401, 402, 403, 404, 405, 406, 407, 408, 409, 410]
+        r1 = eng.add_request(p1, max_new_tokens=6)
+        r2 = eng.add_request(p2, max_new_tokens=4)
+        # a few steps in, admit a third request mid-flight
+        eng.step()
+        eng.step()
+        r3 = eng.add_request(p3, max_new_tokens=5)
+        out = eng.run()
+        assert out[r1] == ref_greedy(model, p1, 6)
+        assert out[r2] == ref_greedy(model, p2, 4)
+        assert out[r3] == ref_greedy(model, p3, 5)
+        if hasattr(eng._step_fn, "_cache_size"):
+            # exactly two programs regardless of traffic: the mixed/prefill
+            # step (mq=T) and the tight pure-decode step (mq=1)
+            assert eng._step_fn._cache_size() <= 2
+
+    def test_eviction_recycles_blocks_for_queued_requests(self, model):
+        """More requests than slots/blocks: later requests wait, get admitted
+        as earlier ones retire, and still decode correctly."""
+        eng = ServingEngine(model, max_batch_size=2, max_seq_len=32,
+                            block_size=8, token_budget=8,
+                            num_blocks=8)  # tight pool: 2 seqs of 4 blocks
+        prompts = [[3, 17, 101], [42, 5, 7, 9], [250, 4], [88, 13, 77]]
+        rids = [eng.add_request(p, max_new_tokens=4) for p in prompts]
+        assert eng.num_active <= 2
+        out = eng.run()
+        for rid, p in zip(rids, prompts):
+            assert out[rid] == ref_greedy(model, p, 4)
+        assert eng.blocks.num_free == 8  # everything returned to the pool
+
+    def test_eos_early_retirement(self, model):
+        prompt = [3, 17, 101, 7]
+        full = ref_greedy(model, prompt, 8)
+        eos = full[2]  # force early stop at the 3rd generated token
+        eng = ServingEngine(model, max_batch_size=2, max_seq_len=64,
+                            block_size=8, token_budget=16)
+        rid = eng.add_request(prompt, max_new_tokens=8, eos_token_id=eos)
+        out = eng.run()
+        assert out[rid] == full[:3]
+
+    def test_chunked_prefill_long_prompt(self, model):
+        """Prompt longer than the token budget: prefill spans several steps,
+        output still matches."""
+        eng = ServingEngine(model, max_batch_size=2, max_seq_len=64,
+                            block_size=8, token_budget=8)
+        prompt = list(range(30, 50))  # 20 tokens > budget 8
+        rid = eng.add_request(prompt, max_new_tokens=5)
+        out = eng.run()
+        assert out[rid] == ref_greedy(model, prompt, 5)

@@ -298,7 +298,47 @@ class ModelAverage:
                 p._value = self._backup.pop(id(p))
 
 
-def inference(*a, **k):
-    raise NotImplementedError(
-        "incubate.jit.inference decorator: use paddle_tpu.inference.Config + "
-        "create_predictor (AOT-compiled serving) instead")
+def inference(function=None, cache_static_model=False, **kwargs):
+    """parity: incubate.jit.inference — decorate a Layer (or its forward)
+    so calls run through the compiled no-grad inference path. The
+    reference swaps in its Paddle-Inference engine; here the equivalent is
+    ``jit.to_static`` under ``no_grad`` (one XLA executable, weights traced
+    as constants-by-reference). Extra reference knobs (trt/...) are
+    accepted and ignored — XLA owns those decisions."""
+
+    def wrap(fn_or_layer):
+        from ..autograd import tape
+        from ..jit import to_static
+
+        from ..nn import Layer
+
+        if isinstance(fn_or_layer, Layer):
+            layer = fn_or_layer
+            compiled = to_static(layer)
+
+            def fwd(*args, **kw):
+                with tape.no_grad():
+                    return compiled(*args, **kw)
+
+            layer.forward = fwd
+            return layer
+
+        compiled = to_static(fn_or_layer)
+
+        def fwd(*args, **kw):
+            with tape.no_grad():
+                return compiled(*args, **kw)
+
+        return fwd
+
+    if function is not None:
+        return wrap(function)
+    return wrap
+
+
+# expose the reference's ``paddle.incubate.jit`` namespace
+class _JitNamespace:
+    inference = staticmethod(inference)
+
+
+jit = _JitNamespace()

@@ -143,10 +143,86 @@ def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train", name=
     return F.dropout(x, p, training=training, mode=mode) + to_tensor_like(y)
 
 
-def fused_multi_head_attention(*args, **kwargs):
-    raise NotImplementedError(
-        "use paddle_tpu.nn.functional.flash_attention / MultiHeadAttention (fused on TPU)"
-    )
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None, ln_bias=None,
+                               pre_ln_epsilon=1e-05, qkv_bias=None,
+                               linear_bias=None, cache_kv=None, attn_mask=None,
+                               dropout_rate=0.5, attn_dropout_rate=0.5,
+                               ln_epsilon=1e-05, training=True,
+                               mode="upscale_in_train", ring_id=-1,
+                               add_residual=True, num_heads=-1,
+                               transpose_qkv_wb=False, name=None):
+    """Fused self-attention block (parity:
+    /root/reference/python/paddle/incubate/nn/functional/fused_transformer.py:502):
+    [pre-]LN -> qkv matmul(+bias) -> scaled attention(+mask, dropout) ->
+    output projection -> dropout(+residual) [-> post-LN]. With ``cache_kv``
+    [2, B, H, S, D], this step's K/V are appended (generation decode).
+    One XLA fusion chain on TPU (the reference fuses it into one kernel)."""
+    x = to_tensor_like(x)
+    qkvw = to_tensor_like(qkv_weight)
+    B, S, E = x.shape
+    if transpose_qkv_wb:
+        if num_heads <= 0:
+            raise ValueError("transpose_qkv_wb=True needs num_heads")
+        nh = num_heads
+        hd = E // nh
+    else:
+        nh, hd = qkvw.shape[1], qkvw.shape[2]
+
+    residual = x
+    h = x
+    if pre_layer_norm:
+        h = F.layer_norm(h, [E], pre_ln_scale, pre_ln_bias, pre_ln_epsilon)
+
+    qb = to_tensor_like(qkv_bias) if qkv_bias is not None else None
+    args = [h, qkvw] + ([qb] if qb is not None else [])
+
+    def qkv_fn(hv, wv, *b):
+        if transpose_qkv_wb:
+            o = hv @ wv  # [B, S, 3E]
+            if b:
+                o = o + b[0]
+            o = o.reshape(B, S, 3, nh, hd)
+        else:
+            o = jnp.einsum("bse,xhde->bsxhd", hv, wv)
+            if b:
+                o = o + b[0][None, None]
+        return o[:, :, 0], o[:, :, 1], o[:, :, 2]
+
+    q, k, v = apply(lambda *a: tuple(qkv_fn(*a)), *args,
+                    op_name="fused_mha_qkv", n_outs=3)
+
+    new_cache = None
+    if cache_kv is not None:
+        cache_t = to_tensor_like(cache_kv)
+
+        def cat_cache(kv, vv, cv):
+            ck = jnp.transpose(cv[0], (0, 2, 1, 3))  # [B, S0, H, D]
+            cvv = jnp.transpose(cv[1], (0, 2, 1, 3))
+            kk = jnp.concatenate([ck.astype(kv.dtype), kv], axis=1)
+            vn = jnp.concatenate([cvv.astype(vv.dtype), vv], axis=1)
+            nc = jnp.stack([jnp.transpose(kk, (0, 2, 1, 3)),
+                            jnp.transpose(vn, (0, 2, 1, 3))])
+            return kk, vn, nc.astype(cv.dtype)
+
+        k, v, new_cache = apply(lambda *a: tuple(cat_cache(*a)), k, v, cache_t,
+                                op_name="fused_mha_cache", n_outs=3)
+
+    out = F.scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask,
+        dropout_p=attn_dropout_rate if training else 0.0,
+        is_causal=False, training=training)
+    out = M.reshape(out, [B, S, nh * hd])
+    out = F.linear(out, linear_weight, linear_bias)
+    out = F.dropout(out, p=dropout_rate, training=training, mode=mode)
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, [E], ln_scale, ln_bias, ln_epsilon)
+    if cache_kv is not None:
+        return out, new_cache
+    return out
 
 
 def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False, name=None):

@@ -126,10 +126,21 @@ class FusedMultiHeadAttention(nn.Layer):
 
     def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
         if cache is not None:
-            raise NotImplementedError(
-                "FusedMultiHeadAttention cache decode is not implemented; use "
-                "models.generate with a causal LM (GPT/Llama) for KV-cache "
-                "decoding")
+            # generation decode: route through the functional, which appends
+            # this step's K/V to the [2, B, H, S, D] cache
+            from ..nn.functional import fused_multi_head_attention as fmha
+
+            return fmha(query, self.qkv_weight, self.linear_weight,
+                        pre_layer_norm=self.normalize_before,
+                        pre_ln_scale=self.pre_ln_scale,
+                        pre_ln_bias=self.pre_ln_bias,
+                        ln_scale=self.ln_scale, ln_bias=self.ln_bias,
+                        pre_ln_epsilon=self._epsilon,
+                        qkv_bias=self.qkv_bias, linear_bias=self.linear_bias,
+                        cache_kv=cache, attn_mask=attn_mask,
+                        dropout_rate=self.dropout_rate,
+                        attn_dropout_rate=self.attn_dropout_rate,
+                        ln_epsilon=self._epsilon, training=self.training)
         residual = query
         x = query
         if self.normalize_before:

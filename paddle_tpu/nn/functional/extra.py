@@ -642,24 +642,162 @@ def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False, return_softmax=False,
 
 def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
                                 max_seqlen_k, scale=None, dropout=0.0,
-                                causal=False, return_softmax=False, **kw):
-    raise NotImplementedError(
-        "varlen flash attention: pad to max_seqlen and use flash_attn_qkvpacked "
-        "(TPU kernels are static-shape; ragged batches should be bucketed)")
+                                causal=False, return_softmax=False,
+                                fixed_seed_offset=None, rng_name="",
+                                varlen_padded=True, training=True, name=None):
+    """parity: flash_attn_varlen_qkvpacked (flash_attention.py:863) — packed
+    qkv [total, num_heads/num_heads_k + 2, num_heads_k, head_dim]; the
+    first (H/KV) groups are query heads, the last two are K and V.
+    ``varlen_padded=True``: tokens live at ``b*max_seqlen + i`` with padding
+    rows uncomputed (the reference contract). Returns (out [total, H, D],
+    None)."""
+    import jax.numpy as jnp
+
+    from .flash_attention import varlen_attention_core
+
+    qkv = _t(qkv)
+    cu_q = _t(cu_seqlens_q)
+    cu_k = _t(cu_seqlens_k)
+    drop = float(dropout) if training else 0.0
+    drop_key = None
+    if drop > 0.0:
+        from ...framework.random import default_generator
+
+        drop_key = default_generator().next_key()
+
+    def f(pk, cq, ck):
+        total, G, KV, D = pk.shape
+        q = pk[:, :G - 2].reshape(total, (G - 2) * KV, D)
+        k = pk[:, G - 2]
+        v = pk[:, G - 1]
+        return varlen_attention_core(
+            q, k, v, cq.reshape(-1).astype(jnp.int32),
+            ck.reshape(-1).astype(jnp.int32), int(max_seqlen_q),
+            int(max_seqlen_k), scale, causal, drop, drop_key,
+            padded_layout=bool(varlen_padded))
+
+    out = apply(f, qkv, cu_q, cu_k, op_name="flash_attn_varlen_qkvpacked")
+    return out, None
 
 
-def flash_attention_with_sparse_mask(query, key, value, attn_mask_start_row_indices,
+def flash_attention_with_sparse_mask(query, key, value,
+                                     attn_mask_start_row_indices,
                                      attn_mask_start_row=0, dropout_p=0.0,
-                                     is_causal=True, **kw):
-    raise NotImplementedError(
-        "sparse-mask flash attention: supply a dense mask via "
-        "nn.functional.scaled_dot_product_attention, or use causal flash_attention")
+                                     is_causal=True, return_softmax=False,
+                                     return_softmax_lse=False,
+                                     return_seed_offset=False, training=True,
+                                     name=None):
+    """parity: flash_attention_with_sparse_mask (flash_attention.py:1113) —
+    column-wise mask-start rows: score[i, j] is masked when
+    ``i >= attn_mask_start_row_indices[b, h, j]`` (on top of the causal
+    triangle). This is the reference's packed-sequence/startend-row sparse
+    mask; lowered to one masked fp32-softmax attention (XLA fuses the mask —
+    measured faster than custom kernels on this chip, PROFILE_r04.md)."""
+    import jax
+    import jax.numpy as jnp
+
+    q, k, v = _t(query), _t(key), _t(value)
+    idx = _t(attn_mask_start_row_indices)
+    drop = float(dropout_p) if training else 0.0
+    drop_key = None
+    if drop > 0.0:
+        from ...framework.random import default_generator
+
+        drop_key = default_generator().next_key()
+
+    def f(qv, kv, vv, ix):
+        B, S, H, D = qv.shape
+        KV = kv.shape[2]
+        if KV != H:
+            kv = jnp.repeat(kv, H // KV, axis=2)
+            vv = jnp.repeat(vv, H // KV, axis=2)
+        qh = jnp.moveaxis(qv, 2, 1).astype(jnp.float32)  # [B,H,S,D]
+        kh = jnp.moveaxis(kv, 2, 1).astype(jnp.float32)
+        vh = jnp.moveaxis(vv, 2, 1).astype(jnp.float32)
+        logits = jnp.einsum("bhid,bhjd->bhij", qh, kh) / (D ** 0.5)
+        i = jnp.arange(S, dtype=jnp.int32)
+        allowed = i[:, None] < ix[:, :, None, :]  # [B,H,S(i),S(j)]
+        if is_causal:
+            allowed = allowed & (i[None, None, :, None] >= i[None, None, None, :])
+        logits = jnp.where(allowed, logits, -1e30)
+        p = jax.nn.softmax(logits, axis=-1)
+        if drop > 0.0 and drop_key is not None:
+            keep = jax.random.bernoulli(drop_key, 1.0 - drop, p.shape)
+            p = jnp.where(keep, p / (1.0 - drop), 0.0)
+        o = jnp.einsum("bhij,bhjd->bhid", p, vh)
+        return jnp.moveaxis(o, 1, 2).astype(qv.dtype)
+
+    return apply(f, q, k, v, idx, op_name="flash_attention_sparse_mask")
 
 
-def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns, **kw):
-    raise NotImplementedError(
-        "block-sparse attention is not implemented; causal/dense flash "
-        "attention covers the supported patterns on TPU")
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """CSR block-sparse attention (parity:
+    /root/reference/python/paddle/nn/functional/sparse_attention.py:22):
+    q/k/v [B, H, S, D]; offset [B, H, S+1] + columns [B, H, nnz] select
+    which key columns each query row attends. TPU-native: the fixed nnz
+    layout is a static gather — per-edge logits + segment-softmax
+    (segment_max/segment_sum over the row ids), all MXU/VPU friendly and
+    jit-safe (the reference needs a CUDA-11.3 cusparse kernel)."""
+    import jax
+    import jax.numpy as jnp
+
+    q, k, v = _t(query), _t(key), _t(value)
+    off = _t(sparse_csr_offset)
+    cols = _t(sparse_csr_columns)
+    kpm = _t(key_padding_mask) if key_padding_mask is not None else None
+    am = _t(attn_mask) if attn_mask is not None else None
+    args = [q, k, v, off, cols] + [t for t in (kpm, am) if t is not None]
+
+    def f(qv, kv, vv, ov, cv, *rest):
+        rest = list(rest)
+        kp = rest.pop(0) if kpm is not None else None
+        ms = rest.pop(0) if am is not None else None
+        B, H, S, D = qv.shape
+        nnz = cv.shape[-1]
+        if kp is not None and kp.ndim == 2:  # [B, S] -> broadcast heads
+            kp = jnp.broadcast_to(kp[:, None, :], (B, H, S))
+        if ms is not None and ms.ndim == 2:  # [S, S] -> broadcast (B, H)
+            ms = jnp.broadcast_to(ms[None, None], (B, H, S, S))
+
+        def one(qh, kh, vh, oh, ch, kph, msh=None):
+            # row id of each CSR edge; edges past offset[-1] are dead padding
+            e = jnp.arange(nnz, dtype=jnp.int32)
+            row = jnp.clip(
+                jnp.searchsorted(oh.astype(jnp.int32), e, side="right") - 1,
+                0, S - 1).astype(jnp.int32)
+            live = e < oh[-1]
+            col = jnp.clip(ch.astype(jnp.int32), 0, S - 1)
+            lg = jnp.sum(qh[row].astype(jnp.float32)
+                         * kh[col].astype(jnp.float32), -1) / (D ** 0.5)
+            # reference mask semantics (fused sparse-attention kernel):
+            # value == 0 means FULLY MASKED, nonzero means attendable —
+            # these are 0/1 masks, not additive biases
+            lg = jnp.where(kph[col] == 0, -1e30, lg)
+            if msh is not None:  # [S, S] 0/1 mask, gathered per edge
+                lg = jnp.where(msh[row, col] == 0, -1e30, lg)
+            lg = jnp.where(live, lg, -1e30)
+            mx = jax.ops.segment_max(lg, row, num_segments=S)
+            ex = jnp.where(live, jnp.exp(lg - mx[row]), 0.0)
+            den = jax.ops.segment_sum(ex, row, num_segments=S)
+            w = ex / jnp.maximum(den[row], 1e-30)
+            out = jax.ops.segment_sum(w[:, None] * vh[col].astype(jnp.float32),
+                                      row, num_segments=S)
+            return out.astype(qh.dtype)
+
+        def flat(t, nbatch=2):
+            return t.reshape((B * H,) + t.shape[nbatch:])
+
+        kp_full = flat(kp) if kp is not None else jnp.ones(
+            (B * H, S), jnp.float32)  # ones = nothing masked
+        base = (flat(qv), flat(kv), flat(vv), flat(ov), flat(cv), kp_full)
+        if ms is not None:
+            outs = jax.vmap(one)(*base, flat(ms))
+        else:
+            outs = jax.vmap(lambda *a: one(*a))(*base)
+        return outs.reshape(B, H, S, D)
+
+    return apply(f, *args, op_name="sparse_attention")
 
 
 # ------------------------------------------------------- in-place activations

@@ -314,10 +314,12 @@ def blha_attention(
     # ---- 8. gather back to the packed token buffer ---------------------
     out = out_pad.at[bs_idx, lc_idx].get(mode="fill", fill_value=0)  # [T, H, D]
     out = out.reshape(T, H * D)
-    if out_smooth is not None:
-        out = out * out_smooth[None, :].astype(out.dtype)
+    # smooth-quant epilogue: (x + shift) * smooth — the reference kernel's
+    # order (shift first, then the per-channel smoothing scale)
     if out_shift is not None:
         out = out + out_shift[None, :].astype(out.dtype)
+    if out_smooth is not None:
+        out = out * out_smooth[None, :].astype(out.dtype)
     if has_out_quant:
         vq = out.astype(jnp.float32) * out_scale * quant_max_bound
         if round_ties_away:

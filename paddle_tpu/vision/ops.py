@@ -436,15 +436,24 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0, dilation=1,
             cols = jax.vmap(lambda a, b, c: sample_one(a, b, c))(xp, oy, ox)
         # cols: [N, C, oh, ow, kh, kw] -> matmul with weight [O, C/groups, kh, kw]
         O = wv.shape[0]
-        wflat = wv.reshape(O, -1)  # groups==1 path
-        cflat = jnp.transpose(cols, (0, 2, 3, 1, 4, 5)).reshape(N, oh, ow, -1)
-        out = jnp.einsum("nhwc,oc->nohw", cflat, wflat)
+        if groups == 1:
+            wflat = wv.reshape(O, -1)
+            cflat = jnp.transpose(cols, (0, 2, 3, 1, 4, 5)).reshape(N, oh, ow, -1)
+            out = jnp.einsum("nhwc,oc->nohw", cflat, wflat)
+        else:
+            # grouped conv: output-channel group g reads input-channel
+            # slice g (reference layout: weight [O, C/groups, kh, kw] with
+            # output channels blocked by group)
+            cgrp = C // groups
+            wg = wv.reshape(groups, O // groups, cgrp * kh * kw)
+            cflat = jnp.transpose(cols, (0, 2, 3, 1, 4, 5)).reshape(
+                N, oh, ow, groups, cgrp * kh * kw)
+            out = jnp.einsum("nhwgc,goc->ngohw", cflat, wg).reshape(
+                N, O, oh, ow)
         if bv is not None:
             out = out + bv[None, :, None, None]
         return out
 
-    if groups != 1:
-        raise NotImplementedError("deform_conv2d: groups>1 not supported yet")
     return apply(f, *args, op_name="deform_conv2d")
 
 
@@ -519,13 +528,128 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
     return Tensor(boxes), Tensor(scores)
 
 
+def _xywh_iou(b1, b2):
+    """[..., 4] center-form xywh IoU, broadcasting leading dims."""
+    l1 = b1[..., 0] - b1[..., 2] / 2
+    r1 = b1[..., 0] + b1[..., 2] / 2
+    t1 = b1[..., 1] - b1[..., 3] / 2
+    bo1 = b1[..., 1] + b1[..., 3] / 2
+    l2 = b2[..., 0] - b2[..., 2] / 2
+    r2 = b2[..., 0] + b2[..., 2] / 2
+    t2 = b2[..., 1] - b2[..., 3] / 2
+    bo2 = b2[..., 1] + b2[..., 3] / 2
+    # clamp at 0 only: decoded pred boxes (exp(logit)*anchor) can exceed 1
+    # in normalized coords, and capping the intersection would underestimate
+    # their IoU against the ignore threshold
+    iw = jnp.maximum(jnp.minimum(r1, r2) - jnp.maximum(l1, l2), 0.0)
+    ih = jnp.maximum(jnp.minimum(bo1, bo2) - jnp.maximum(t1, t2), 0.0)
+    inter = iw * ih
+    union = (r1 - l1) * (bo1 - t1) + (r2 - l2) * (bo2 - t2) - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
 def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
               ignore_thresh, downsample_ratio, gt_score=None,
               use_label_smooth=True, name=None, scale_x_y=1.0):
-    raise NotImplementedError(
-        "yolo_loss: compose yolo_box decoding with the standard detection "
-        "losses (bce/iou) in model code; the fused CUDA loss kernel is not "
-        "replicated")
+    """YOLOv3 training loss (parity:
+    /root/reference/python/paddle/vision/ops.py:69, kernel
+    paddle/phi/kernels/cpu/yolo_loss_kernel.cc): per-gt anchor matching by
+    wh-IoU, sigmoid-CE on x/y, L1 on w/h (scaled by 2-gw*gh), objectness CE
+    with ignore region (pred IoU > ignore_thresh), class CE with optional
+    label smoothing. x [N, mask*(5+C), H, W]; gt_box [N, B, 4] normalized
+    center-xywh; returns per-image loss [N]."""
+    x_t, gtb_t, gtl_t = _t(x), _t(gt_box), _t(gt_label)
+    gts_t = _t(gt_score) if gt_score is not None else None
+    mask = list(anchor_mask)
+    an_num = len(anchors) // 2
+    mask_num = len(mask)
+
+    def f(xv, gtb, gtl, *rest):
+        gts = rest[0] if gts_t is not None else None
+        N, _, h, w = xv.shape
+        B = gtb.shape[1]
+        input_size = downsample_ratio * h
+        xr = xv.reshape(N, mask_num, 5 + class_num, h, w).transpose(
+            0, 1, 3, 4, 2).astype(jnp.float32)
+        if gts is None:
+            gts = jnp.ones((N, B), jnp.float32)
+        gts = gts.astype(jnp.float32)
+        bias_xy = -0.5 * (scale_x_y - 1.0)
+        smooth = min(1.0 / class_num, 1.0 / 40)
+        pos_l = 1.0 - smooth if use_label_smooth else 1.0
+        neg_l = smooth if use_label_smooth else 0.0
+
+        def sce(logit, label):
+            # stable sigmoid cross-entropy
+            return jnp.maximum(logit, 0) - logit * label + jnp.log1p(
+                jnp.exp(-jnp.abs(logit)))
+
+        # ---- decoded pred boxes (for the ignore mask only: the decision is
+        # argmax-like, so it rides stop_gradient)
+        gx = jnp.arange(w, dtype=jnp.float32)[None, :]
+        gy = jnp.arange(h, dtype=jnp.float32)[:, None]
+        ms = jnp.asarray([(anchors[2 * m] / input_size,
+                           anchors[2 * m + 1] / input_size) for m in mask],
+                         jnp.float32)
+        px = (gx + jax.nn.sigmoid(xr[..., 0]) * scale_x_y + bias_xy) / w
+        py = (gy + jax.nn.sigmoid(xr[..., 1]) * scale_x_y + bias_xy) / h
+        pw = jnp.exp(xr[..., 2]) * ms[None, :, None, None, 0]
+        ph = jnp.exp(xr[..., 3]) * ms[None, :, None, None, 1]
+        pred_box = jax.lax.stop_gradient(
+            jnp.stack([px, py, pw, ph], -1).reshape(N, -1, 4))
+        ious = _xywh_iou(pred_box[:, :, None, :], gtb[:, None, :, :])
+        ious_max = jnp.max(ious, axis=-1)  # [N, mask*h*w]
+        ignore = ious_max > ignore_thresh
+
+        # ---- gt -> anchor matching by wh IoU against ALL anchors
+        all_an = jnp.asarray([(anchors[2 * i] / input_size,
+                               anchors[2 * i + 1] / input_size)
+                              for i in range(an_num)], jnp.float32)
+        gshift = jnp.concatenate([jnp.zeros_like(gtb[..., :2]),
+                                  gtb[..., 2:]], -1)
+        abox = jnp.concatenate([jnp.zeros_like(all_an), all_an], -1)
+        an_iou = _xywh_iou(gshift[:, :, None, :], abox[None, None, :, :])
+        best = jnp.argmax(an_iou, axis=-1)  # [N, B]
+        mask_arr = jnp.asarray(mask, jnp.int32)
+        in_mask = (best[:, :, None] == mask_arr[None, None, :])
+        an_idx = jnp.argmax(in_mask, axis=-1)  # [N, B] position in mask
+        valid = (gtb[..., 2] + gtb[..., 3] > 0) & in_mask.any(-1)
+
+        gi = jnp.clip((gtb[..., 0] * w).astype(jnp.int32), 0, w - 1)
+        gj = jnp.clip((gtb[..., 1] * h).astype(jnp.int32), 0, h - 1)
+        tx = gtb[..., 0] * w - gi
+        ty = gtb[..., 1] * h - gj
+        man_w = ms[an_idx, 0]
+        man_h = ms[an_idx, 1]
+        tw = jnp.log(jnp.maximum(gtb[..., 2], 1e-9) / man_w)
+        th = jnp.log(jnp.maximum(gtb[..., 3], 1e-9) / man_h)
+        scale = (2.0 - gtb[..., 2] * gtb[..., 3]) * gts
+        bidx = jnp.arange(N)[:, None]
+        picked = xr[bidx, an_idx, gj, gi]  # [N, B, 5+C]
+        coord = (sce(picked[..., 0], tx) + sce(picked[..., 1], ty)
+                 + jnp.abs(picked[..., 2] - tw)
+                 + jnp.abs(picked[..., 3] - th)) * scale
+        onehot = (jnp.arange(class_num)[None, None, :]
+                  == gtl[..., None].astype(jnp.int32))
+        cls_t = jnp.where(onehot, pos_l, neg_l)
+        cls = jnp.sum(sce(picked[..., 5:], cls_t), -1) * gts
+        loss = jnp.sum(jnp.where(valid, coord + cls, 0.0), axis=1)
+
+        # ---- objectness: positives overwrite in gt order (last wins, the
+        # reference's sequential semantics); ignores contribute nothing
+        objness = jnp.where(ignore, -1.0, 0.0)
+        flat = an_idx * h * w + gj * w + gi  # [N, B]
+        for j in range(B):
+            tgt = jnp.where(valid[:, j], gts[:, j],
+                            objness[bidx[:, 0], flat[:, j]])
+            objness = objness.at[bidx[:, 0], flat[:, j]].set(tgt)
+        pred_obj = xr[..., 4].reshape(N, -1)
+        obj_l = jnp.where(objness > 0, sce(pred_obj, 1.0) * objness,
+                          jnp.where(objness == 0, sce(pred_obj, 0.0), 0.0))
+        return loss + jnp.sum(obj_l, axis=1)
+
+    args = [x_t, gtb_t, gtl_t] + ([gts_t] if gts_t is not None else [])
+    return apply(f, *args, op_name="yolo_loss")
 
 
 def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
@@ -554,9 +678,69 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
                        pre_nms_top_n=6000, post_nms_top_n=1000, nms_thresh=0.5,
                        min_size=0.1, eta=1.0, pixel_offset=False,
                        return_rois_num=False, name=None):
-    raise NotImplementedError(
-        "generate_proposals: compose box_coder + nms; the fused RPN kernel "
-        "is not replicated")
+    """RPN proposal generation (parity:
+    /root/reference/python/paddle/vision/ops.py:2108, kernel
+    paddle/phi/kernels/cpu/generate_proposals_kernel.cc): decode anchors with
+    variance-scaled deltas, clip to image, drop tiny boxes, top-k -> NMS ->
+    top-k. Detection post-processing is host-side (the serving pattern), so
+    this composes numpy decode + the repo's nms.
+
+    scores [N, A, H, W]; bbox_deltas [N, 4A, H, W]; anchors/variances
+    [H, W, A, 4]. Returns (rpn_rois [R, 4], rpn_roi_probs [R, 1][, rois_num
+    [N]])."""
+    sc = np.asarray(_t(scores)._value, np.float32)
+    dl = np.asarray(_t(bbox_deltas)._value, np.float32)
+    im = np.asarray(_t(img_size)._value, np.float32)
+    an = np.asarray(_t(anchors)._value, np.float32).reshape(-1, 4)
+    va = np.asarray(_t(variances)._value, np.float32).reshape(-1, 4)
+    N, A, H, W = sc.shape
+    offset = 1.0 if pixel_offset else 0.0
+    clip_w = float(np.log(1000.0 / 16.0))
+
+    all_rois, all_probs, nums = [], [], []
+    for i in range(N):
+        # [A,H,W] -> [H,W,A] -> flat, matching the anchors' [H,W,A,4] layout
+        s = sc[i].transpose(1, 2, 0).reshape(-1)
+        d = dl[i].reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        aw = an[:, 2] - an[:, 0] + offset
+        ah = an[:, 3] - an[:, 1] + offset
+        ax = an[:, 0] + 0.5 * aw
+        ay = an[:, 1] + 0.5 * ah
+        cx = va[:, 0] * d[:, 0] * aw + ax
+        cy = va[:, 1] * d[:, 1] * ah + ay
+        bw = np.exp(np.minimum(va[:, 2] * d[:, 2], clip_w)) * aw
+        bh = np.exp(np.minimum(va[:, 3] * d[:, 3], clip_w)) * ah
+        x1 = cx - 0.5 * bw
+        y1 = cy - 0.5 * bh
+        x2 = cx + 0.5 * bw - offset
+        y2 = cy + 0.5 * bh - offset
+        ih, iw = im[i, 0], im[i, 1]
+        x1 = np.clip(x1, 0, iw - offset)
+        y1 = np.clip(y1, 0, ih - offset)
+        x2 = np.clip(x2, 0, iw - offset)
+        y2 = np.clip(y2, 0, ih - offset)
+        keep = ((x2 - x1 + offset) >= min_size) & ((y2 - y1 + offset) >= min_size)
+        boxes = np.stack([x1, y1, x2, y2], 1)[keep]
+        probs = s[keep]
+        order = np.argsort(-probs, kind="stable")[: int(pre_nms_top_n)]
+        boxes, probs = boxes[order], probs[order]
+        if len(boxes):
+            kept = np.asarray(nms(Tensor(jnp.asarray(boxes)),
+                                  iou_threshold=float(nms_thresh),
+                                  scores=Tensor(jnp.asarray(probs)),
+                                  top_k=int(post_nms_top_n))._value)
+        else:
+            kept = np.zeros((0,), np.int64)
+        all_rois.append(boxes[kept])
+        all_probs.append(probs[kept].reshape(-1, 1))
+        nums.append(len(kept))
+    rois = Tensor(jnp.asarray(np.concatenate(all_rois, 0)
+                              if all_rois else np.zeros((0, 4), np.float32)))
+    probs = Tensor(jnp.asarray(np.concatenate(all_probs, 0)
+                               if all_probs else np.zeros((0, 1), np.float32)))
+    if return_rois_num:
+        return rois, probs, Tensor(jnp.asarray(np.asarray(nums, np.int32)))
+    return rois, probs
 
 
 def read_file(filename, name=None):
@@ -566,6 +750,27 @@ def read_file(filename, name=None):
 
 
 def decode_jpeg(x, mode="unchanged", name=None):
-    raise NotImplementedError(
-        "decode_jpeg needs an image codec; none is bundled in this "
-        "environment (reference binds nvjpeg)")
+    """Decode a JPEG byte tensor to CHW uint8 (parity:
+    /root/reference/python/paddle/vision/ops.py decode_jpeg, nvjpeg-backed).
+    TPU-native stance: image decode is host-side data-pipeline work (the
+    DataLoader tier), so this rides the bundled PIL codec; the device never
+    sees JPEG bytes."""
+    import io
+
+    from PIL import Image
+
+    data = np.asarray(_t(x)._value, np.uint8).tobytes()
+    img = Image.open(io.BytesIO(data))
+    if mode in ("unchanged", "rgb", "RGB"):
+        if mode != "unchanged" and img.mode != "RGB":
+            img = img.convert("RGB")
+    elif mode in ("gray", "grey", "L"):
+        img = img.convert("L")
+    else:
+        raise ValueError(f"decode_jpeg: unsupported mode {mode!r}")
+    arr = np.asarray(img, np.uint8)
+    if arr.ndim == 2:
+        arr = arr[None, :, :]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))

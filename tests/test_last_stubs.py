@@ -1,0 +1,160 @@
+"""r5 final stub graduations: fused_multi_head_attention (with cache),
+sparse_attention (CSR), incubate.jit.inference decorator."""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.nn as nn
+
+pytestmark = pytest.mark.quick
+
+
+class TestFusedMHAFunctional:
+    def _weights(self, E, H, seed=0):
+        rng = np.random.RandomState(seed)
+        hd = E // H
+        return (rng.randn(3, H, hd, E).astype(np.float32) * 0.2,
+                rng.randn(3, H, hd).astype(np.float32) * 0.1,
+                rng.randn(E, E).astype(np.float32) * 0.2,
+                rng.randn(E).astype(np.float32) * 0.1)
+
+    def test_matches_composed_ops(self):
+        from paddle_tpu.incubate.nn.functional import fused_multi_head_attention
+
+        E, H, B, S = 16, 4, 2, 5
+        qkvw, qkvb, lw, lb = self._weights(E, H)
+        rng = np.random.RandomState(1)
+        x = rng.randn(B, S, E).astype(np.float32)
+        ones = np.ones(E, np.float32)
+        zeros = np.zeros(E, np.float32)
+        out = fused_multi_head_attention(
+            P.to_tensor(x), P.to_tensor(qkvw), P.to_tensor(lw),
+            pre_layer_norm=True, pre_ln_scale=P.to_tensor(ones),
+            pre_ln_bias=P.to_tensor(zeros), qkv_bias=P.to_tensor(qkvb),
+            linear_bias=P.to_tensor(lb), dropout_rate=0.0,
+            attn_dropout_rate=0.0, training=False)
+        # oracle: LN -> qkv -> softmax attention -> proj -> +residual
+        mu = x.mean(-1, keepdims=True)
+        sd = x.std(-1, keepdims=True)
+        h = (x - mu) / np.sqrt(sd ** 2 + 1e-5)
+        qkv = np.einsum("bse,xhde->bsxhd", h, qkvw) + qkvb[None, None]
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        hd = E // H
+        lg = np.einsum("bshd,bthd->bhst", q, k) / np.sqrt(hd)
+        w = np.exp(lg - lg.max(-1, keepdims=True))
+        w /= w.sum(-1, keepdims=True)
+        att = np.einsum("bhst,bthd->bshd", w, v).reshape(B, S, E)
+        ref = x + att @ lw + lb
+        np.testing.assert_allclose(np.asarray(out.numpy()), ref,
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_cache_decode_incremental(self):
+        """Layer-level cache decode equals the full-sequence forward at the
+        appended position (post-LN self-attn block)."""
+        from paddle_tpu.incubate.nn import FusedMultiHeadAttention
+
+        P.seed(4)
+        E, H, B, S = 16, 4, 1, 4
+        layer = FusedMultiHeadAttention(E, H, dropout_rate=0.0,
+                                        attn_dropout_rate=0.0,
+                                        normalize_before=True)
+        layer.eval()
+        rng = np.random.RandomState(5)
+        full = rng.randn(B, S + 1, E).astype(np.float32)
+        ref = np.asarray(layer(P.to_tensor(full)).numpy())
+        hd = E // H
+        # build the cache from the first S tokens' K/V (pre-LN projections)
+        x0 = full[:, :S]
+        mu = x0.mean(-1, keepdims=True)
+        sd = x0.std(-1, keepdims=True)
+        h0 = (x0 - mu) / np.sqrt(sd ** 2 + 1e-5)
+        qw = np.asarray(layer.qkv_weight.numpy())
+        qb = np.asarray(layer.qkv_bias.numpy())
+        qkv = np.einsum("bse,xhde->bsxhd", h0, qw) + qb[None, None]
+        cache = np.stack([qkv[:, :, 1].transpose(0, 2, 1, 3),
+                          qkv[:, :, 2].transpose(0, 2, 1, 3)])  # [2,B,H,S,D]
+        out, new_cache = layer(P.to_tensor(full[:, S:S + 1]),
+                               cache=P.to_tensor(cache.astype(np.float32)))
+        np.testing.assert_allclose(np.asarray(out.numpy())[:, 0],
+                                   ref[:, S], rtol=2e-4, atol=2e-4)
+        assert tuple(new_cache.shape) == (2, B, H, S + 1, hd)
+
+
+class TestSparseAttention:
+    def test_csr_matches_dense_mask(self):
+        from paddle_tpu.nn.functional.extra import sparse_attention
+
+        rng = np.random.RandomState(2)
+        B, H, S, D = 1, 2, 8, 4
+        q = rng.randn(B, H, S, D).astype(np.float32)
+        k = rng.randn(B, H, S, D).astype(np.float32)
+        v = rng.randn(B, H, S, D).astype(np.float32)
+        # random CSR pattern: each row keeps a random nonempty subset
+        offs = np.zeros((B, H, S + 1), np.int32)
+        cols_l = [[] for _ in range(B * H)]
+        dense = np.full((B, H, S, S), -1e30, np.float32)
+        for b in range(B):
+            for hh in range(H):
+                cur = 0
+                for i in range(S):
+                    sel = sorted({0} | set(rng.choice(
+                        S, rng.randint(1, S + 1), replace=False).tolist()))
+                    cols_l[b * H + hh].extend(sel)
+                    cur += len(sel)
+                    offs[b, hh, i + 1] = cur
+                    dense[b, hh, i, sel] = 0.0
+        nnz = max(len(c) for c in cols_l)
+        cols = np.zeros((B, H, nnz), np.int32)
+        for b in range(B):
+            for hh in range(H):
+                c = cols_l[b * H + hh]
+                cols[b, hh, :len(c)] = c
+        out = sparse_attention(P.to_tensor(q), P.to_tensor(k), P.to_tensor(v),
+                               P.to_tensor(offs), P.to_tensor(cols))
+        lg = np.einsum("bhid,bhjd->bhij", q, k) / np.sqrt(D) + dense
+        w = np.exp(lg - lg.max(-1, keepdims=True))
+        w /= w.sum(-1, keepdims=True)
+        ref = np.einsum("bhij,bhjd->bhid", w, v)
+        np.testing.assert_allclose(np.asarray(out.numpy()), ref,
+                                   rtol=2e-4, atol=2e-4)
+        # key_padding_mask: 0 = masked key (reference 0/1 semantics). The
+        # CSR pattern keeps every row attending col 0, so zero it out.
+        kpm = np.ones((B, S), np.float32)
+        kpm[:, -1] = 0.0
+        out2 = sparse_attention(P.to_tensor(q), P.to_tensor(k),
+                                P.to_tensor(v), P.to_tensor(offs),
+                                P.to_tensor(cols),
+                                key_padding_mask=P.to_tensor(kpm))
+        dense2 = dense.copy()
+        dense2[..., -1] = -1e30
+        # rows whose every kept column is masked would renormalize over
+        # nothing — the random pattern keeps ≥1 live col per row here
+        lg2 = np.einsum("bhid,bhjd->bhij", q, k) / np.sqrt(D) + dense2
+        w2 = np.exp(lg2 - lg2.max(-1, keepdims=True))
+        w2 /= w2.sum(-1, keepdims=True)
+        ref2 = np.einsum("bhij,bhjd->bhid", w2, v)
+        np.testing.assert_allclose(np.asarray(out2.numpy()), ref2,
+                                   rtol=2e-4, atol=2e-4)
+
+
+class TestIncubateJitInference:
+    def test_decorates_layer_and_function(self):
+        import paddle_tpu.incubate as incubate
+
+        P.seed(6)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+        x = P.to_tensor(np.random.RandomState(7).randn(4, 8).astype(np.float32))
+        ref = np.asarray(net(x).numpy())
+        opt = incubate.jit.inference(net)
+        out = opt(x)
+        np.testing.assert_allclose(np.asarray(out.numpy()), ref,
+                                   rtol=1e-5, atol=1e-5)
+        assert out.stop_gradient  # no-grad inference path
+
+        @incubate.jit.inference
+        def fn(a):
+            return a * 2.0 + 1.0
+
+        np.testing.assert_allclose(
+            np.asarray(fn(P.to_tensor(np.ones((2, 2), np.float32))).numpy()),
+            3.0)

@@ -15,32 +15,28 @@ import paddle_tpu as P
 
 pytestmark = pytest.mark.quick
 
-# (import path, attribute, minimal call) — call must raise NotImplementedError
-KNOWN_STUBS = [
-    ("paddle_tpu.nn.functional.extra", "sparse_attention",
-     lambda f: f(None, None, None, None, None)),
-    ("paddle_tpu.nn.functional.flash_attention", "flash_attn_unpadded",
-     lambda f: f()),
-    ("paddle_tpu.nn.functional.extra", "flash_attn_varlen_qkvpacked",
-     lambda f: f(None, None, None, None, None)),
-    ("paddle_tpu.nn.functional.extra", "flash_attention_with_sparse_mask",
-     lambda f: f(None, None, None, None)),
-    ("paddle_tpu.vision.ops", "generate_proposals",
-     lambda f: f(None, None, None, None, None)),
-    ("paddle_tpu.vision.ops", "yolo_loss",
-     lambda f: f(None, None, None, None, None, None, None, None)),
-    ("paddle_tpu.vision.ops", "decode_jpeg", lambda f: f(None)),
-    ("paddle_tpu.incubate.nn.functional", "fused_multi_head_attention",
-     lambda f: f()),
-    ("paddle_tpu.incubate", "inference", lambda f: f()),
-]
+# (import path, attribute, minimal call) — call must raise NotImplementedError.
+# r5 closed EVERY entry from r4's honest stub list (VERDICT copy-paste
+# section): the ledger is empty.
+KNOWN_STUBS = []
 
 # r4 stubs that must now be REAL (regression guard: resolving is no longer
-# enough — these must not raise NotImplementedError on resolution)
+# enough — these must not raise NotImplementedError on resolution). Behavior
+# tests: test_paged_attention, test_fused_multi_transformer, test_static_nn,
+# test_varlen_attention, test_detection_ops, test_last_stubs.
 GRADUATED = [
     ("paddle_tpu.incubate.nn.functional", "block_multihead_attention"),
     ("paddle_tpu.incubate.nn.functional", "fused_multi_transformer"),
+    ("paddle_tpu.incubate.nn.functional", "fused_multi_head_attention"),
     ("paddle_tpu.static", "py_func"),
+    ("paddle_tpu.nn.functional.flash_attention", "flash_attn_unpadded"),
+    ("paddle_tpu.nn.functional.extra", "flash_attn_varlen_qkvpacked"),
+    ("paddle_tpu.nn.functional.extra", "flash_attention_with_sparse_mask"),
+    ("paddle_tpu.nn.functional.extra", "sparse_attention"),
+    ("paddle_tpu.vision.ops", "generate_proposals"),
+    ("paddle_tpu.vision.ops", "yolo_loss"),
+    ("paddle_tpu.vision.ops", "decode_jpeg"),
+    ("paddle_tpu.incubate", "inference"),
 ]
 
 
@@ -60,7 +56,7 @@ class TestStubLedger:
 
     def test_ledger_only_shrinks(self):
         # the committed ceiling; lower it whenever a stub graduates
-        assert len(KNOWN_STUBS) <= 9
+        assert len(KNOWN_STUBS) == 0
 
     def test_graduated_names_are_callable_objects(self):
         for mod_path, attr in GRADUATED:

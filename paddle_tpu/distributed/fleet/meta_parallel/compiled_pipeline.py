@@ -62,6 +62,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec
 
+from ...multihost import global_device_put
+
 from ....autograd import tape
 from ....nn.layer.layers import Layer
 from ....tensor.tensor import Tensor
@@ -221,7 +223,7 @@ def _full_mesh_put(p: Tensor, mesh):
         e if (e in mesh.axis_names or isinstance(e, tuple)) else None
         for e in (old or [None] * p.ndim)
     ]) if old else PartitionSpec(*([None] * p.ndim))
-    p._value = jax.device_put(np.asarray(p._value), NamedSharding(mesh, spec))
+    p._value = global_device_put(np.asarray(p._value), NamedSharding(mesh, spec))
 
 
 class _PipeParams(Layer):
@@ -255,7 +257,7 @@ class _PipeParams(Layer):
             else:
                 spec = PartitionSpec("pp", *inner)
             sh = NamedSharding(mesh, spec)
-            t = Tensor(jax.device_put(jnp.asarray(vals), sh), stop_gradient=False)
+            t = Tensor(global_device_put(vals, sh), stop_gradient=False)
             t.name = f"pipe_stacked_{j}"
             self.stacked.append(t)
             self.stacked_specs.append(spec)
@@ -280,7 +282,7 @@ def _remesh_value(v, mesh):
         e if (e in mesh.axis_names or isinstance(e, tuple)) else None
         for e in (old or [None] * np.ndim(v))
     ]) if old else PartitionSpec(*([None] * np.ndim(v)))
-    return jax.device_put(jnp.asarray(np.asarray(v)), NamedSharding(mesh, spec))
+    return global_device_put(np.asarray(v), NamedSharding(mesh, spec))
 
 
 def _rewire_optimizer(optimizer, body_segs: List[_Segment],
@@ -348,8 +350,8 @@ def _rewire_optimizer(optimizer, body_segs: List[_Segment],
             # scalar accumulators (step counters like beta_pow) advanced in
             # lockstep across stages — keep one, don't stack (stacking would
             # break broadcasting against the [P, ...] moments)
-            d[id(target)] = jax.device_put(jnp.asarray(np.asarray(vals[0])),
-                                           NamedSharding(mesh, PartitionSpec()))
+            d[id(target)] = global_device_put(
+                np.asarray(vals[0]), NamedSharding(mesh, PartitionSpec()))
             return
         # per-stage values live on different stage submeshes — stack on host
         arr = np.stack([np.asarray(v) for v in vals])
@@ -357,7 +359,7 @@ def _rewire_optimizer(optimizer, body_segs: List[_Segment],
             arr = arr.reshape(C, num_stages, *arr.shape[1:])  # match [C,P,...]
         spec = (stacked_specs[j] if arr.ndim == len(stacked_specs[j])
                 else PartitionSpec(*([None] * arr.ndim)))
-        d[id(target)] = jax.device_put(jnp.asarray(arr), NamedSharding(mesh, spec))
+        d[id(target)] = global_device_put(arr, NamedSharding(mesh, spec))
 
     for name, d in optimizer._accumulators.items():
         for j, t in enumerate(stacked):
@@ -522,6 +524,16 @@ class CompiledPipelineTrainStep:
         stages at once and stays on the full mesh — the eager engine treats
         shared layers as one object, so mixed-submesh eager eval of a tied
         model should go through the compiled step instead."""
+        from ...multihost import is_multi_controller
+
+        if is_multi_controller():
+            # materializing the pp-sharded stack needs shards owned by other
+            # processes; use the distributed checkpoint (per-host shards +
+            # reshard-on-load) to move state between engines across hosts
+            raise NotImplementedError(
+                "sync_to_model under multi-controller: save with "
+                "paddle_tpu.distributed.save_state_dict (per-host shards) "
+                "and reload instead")
 
         def put_sub(p, sub):
             if sub is None:

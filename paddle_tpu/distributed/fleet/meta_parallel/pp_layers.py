@@ -17,6 +17,8 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from ...multihost import global_device_put, is_multi_controller
+
 from ....nn.layer.layers import Layer
 from ...topology import get_hybrid_communicate_group
 
@@ -155,6 +157,18 @@ class PipelineLayer(Layer):
         return Mesh(devs, names)
 
     def _place_stages(self):
+        if is_multi_controller():
+            # multi-process job: eager per-stage placement would pin params
+            # on submeshes other processes cannot address (breaking the host
+            # materialization the compiled engine's stacking needs). Leave
+            # params process-local-replicated; the compiled pipeline's
+            # [P, ...] pp-sharded stacking is the real placement.
+            for s in range(self._num_segments):
+                for layer in self._stage_layers[s]:
+                    if isinstance(layer, Layer):
+                        for p in layer.parameters():
+                            p._pp_stage = s  # type: ignore[attr-defined]
+            return
         for s in range(self._num_segments):
             sub = self._submeshes[s]
             if sub is None:
@@ -175,7 +189,8 @@ class PipelineLayer(Layer):
                         e if e in sub.axis_names or (isinstance(e, tuple)) else None
                         for e in (old_spec or [None] * p.ndim)
                     ]) if old_spec else PartitionSpec(*([None] * p.ndim))
-                    p._value = jax.device_put(p._value, NamedSharding(sub, spec))
+                    p._value = global_device_put(p._value,
+                                                 NamedSharding(sub, spec))
                     p._pp_stage = s  # type: ignore[attr-defined]
 
     # ---------------------------------------------------------------- run

@@ -1,0 +1,39 @@
+"""Multi-controller array placement helpers.
+
+Under multi-process JAX (one controller per host — the regime of real TPU
+pods and of the 2-process CPU CI job), ``jax.device_put(host_value,
+sharding)`` is only legal when every device of the sharding is addressable
+from this process. Pipeline stages and cross-host shardings violate that, so
+placement goes through ``jax.make_array_from_callback``: every process
+supplies just the shards it owns and JAX assembles the global array.
+Single-process, this degrades to a plain device_put (same semantics, less
+overhead).
+
+Reference analog: the per-rank tensor placement the reference does with
+NCCL broadcast + per-rank allocations
+(paddle/fluid/distributed/collective/process_group_nccl.cc).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+__all__ = ["global_device_put", "is_multi_controller"]
+
+
+def is_multi_controller() -> bool:
+    return jax.process_count() > 1
+
+
+def global_device_put(value, sharding):
+    """Place a full host value under ``sharding`` (which may span devices of
+    other processes). Every process must pass the SAME value — each keeps
+    only its addressable shards. Single-process, the value goes straight to
+    device_put (device-to-device when it is already a jax array — no host
+    round-trip)."""
+    if not is_multi_controller():
+        return jax.device_put(value, sharding)
+    arr = np.asarray(value)  # the callback needs numpy slicing
+    return jax.make_array_from_callback(arr.shape, sharding,
+                                        lambda idx: arr[idx])

@@ -16,7 +16,8 @@ import time
 from typing import Callable, Dict, List, Optional
 
 __all__ = ["AutoTuner", "GridSearch", "Recorder", "default_candidates",
-           "MemoryCostModel", "prune_by_memory", "prune_by_mp"]
+           "MemoryCostModel", "StepCostModel", "prune_by_memory",
+           "prune_by_mp", "prune_by_cost"]
 
 
 def _divisors(n: int) -> List[int]:
@@ -97,6 +98,80 @@ def prune_by_memory(cfg: Dict, model: MemoryCostModel, hbm_bytes: float) -> bool
     return model.estimate(cfg) > hbm_bytes
 
 
+class StepCostModel:
+    """Per-step TIME estimate in seconds: compute + TP/DP/sharding
+    communication + the pipeline bubble (parity: the reference's
+    auto_tuner/cost_model.py, which prices candidates beyond the memory
+    check). Roofline-style — meant for RANKING candidates and pruning the
+    clearly-bad tail, not for absolute accuracy.
+
+    Model: tokens/step = global_batch * seq_len.
+    - compute: 6*N*tokens FLOPs (8*N with full recompute) split over all
+      chips, at ``flops_per_chip`` effective throughput.
+    - TP comm: 4 activation all-reduces per layer on the mp group
+      (2 fwd + 2 bwd, Megatron pattern), ring cost bytes*(mp-1)/mp at ICI
+      bandwidth, per microbatch per local layer.
+    - DP/sharding grad sync: 2*params_bytes*(g-1)/g over the dp*sharding
+      group (reduce-scatter + all-gather), once per step; sharding stage 3
+      adds a parameter all-gather per microbatch.
+    - PP bubble: compute inflated by (M+P-1)/M (synchronous 1F1B bound).
+    """
+
+    def __init__(self, n_params: float, hidden: int = 4096, layers: int = 32,
+                 seq_len: int = 2048, global_batch_size: int = 8,
+                 flops_per_chip: float = 100e12, ici_bw: float = 4e10,
+                 bytes_per_param: int = 2):
+        self.n_params = n_params
+        self.hidden = hidden
+        self.layers = layers
+        self.seq_len = seq_len
+        self.gb = global_batch_size
+        self.flops = flops_per_chip
+        self.bw = ici_bw
+        self.bpp = bytes_per_param
+
+    def estimate(self, cfg: Dict) -> float:
+        dp = cfg.get("dp_degree", 1)
+        mp = cfg.get("mp_degree", 1)
+        pp = cfg.get("pp_degree", 1)
+        sh = cfg.get("sharding_degree", 1)
+        stage = cfg.get("sharding_stage", 1)
+        mbs = max(int(cfg.get("micro_batch_size", 1)), 1)
+        recompute = cfg.get("use_recompute", False)
+        chips = dp * mp * pp * sh
+        tokens = self.gb * self.seq_len
+        num_micro = max(self.gb // (dp * sh * mbs), 1)
+
+        flops_total = (8.0 if recompute else 6.0) * self.n_params * tokens
+        t_compute = flops_total / (chips * self.flops)
+        if pp > 1:  # synchronous pipeline bubble
+            t_compute *= (num_micro + pp - 1) / num_micro
+
+        t_tp = 0.0
+        if mp > 1:
+            act_bytes = mbs * self.seq_len * self.hidden * self.bpp
+            per_layer = 4.0 * act_bytes * (mp - 1) / mp / self.bw
+            t_tp = per_layer * (self.layers / pp) * num_micro
+
+        g = dp * sh
+        t_dp = 0.0
+        params_bytes = self.n_params * self.bpp / (mp * pp)
+        if g > 1:
+            t_dp = 2.0 * params_bytes * (g - 1) / g / self.bw
+        if stage >= 3 and sh > 1:
+            t_dp += params_bytes * (sh - 1) / sh / self.bw * num_micro
+
+        return t_compute + t_tp + t_dp
+
+
+def prune_by_cost(cfg: Dict, model: "StepCostModel", best_estimate: float,
+                  ratio: float = 4.0) -> bool:
+    """True -> prune: estimated step time is ``ratio``x worse than the best
+    estimate among surviving candidates (the reference's cost-model prune
+    keeps measurement budget for the plausible region)."""
+    return model.estimate(cfg) > ratio * best_estimate
+
+
 def prune_by_mp(cfg: Dict, num_attention_heads: Optional[int] = None,
                 vocab_size: Optional[int] = None) -> bool:
     mp = cfg.get("mp_degree", 1)
@@ -163,8 +238,10 @@ class AutoTuner:
         self.task_limit = int(self.cfg.get("task_limit", 100))
         self.cur_task_id = 1
         algo = self.cfg.get("search_algo", {"name": "grid"})
-        if (algo.get("name") if isinstance(algo, dict) else algo) != "grid":
-            raise NotImplementedError("search_algo: only grid is implemented")
+        algo_name = algo.get("name") if isinstance(algo, dict) else algo
+        if algo_name not in ("grid", "cost_model"):
+            raise NotImplementedError(
+                "search_algo: grid and cost_model are implemented")
         self.algo = GridSearch(self.cfg)
         self.recorder = Recorder(self.cfg.get("metric", "throughput"),
                                  self.cfg.get("higher_is_better", True))
@@ -173,6 +250,29 @@ class AutoTuner:
         self._hbm = float(self.cfg.get("hbm_bytes", 16e9))
         self._heads = self.cfg.get("num_attention_heads")
         self._vocab = self.cfg.get("vocab_size")
+        self._cost_model = self.cfg.get("cost_model")
+        # cost pruning is on by default when the search is cost-guided
+        self._cost_prune_ratio = float(self.cfg.get(
+            "cost_prune_ratio", 4.0 if algo_name == "cost_model" else 0))
+        if algo_name == "cost_model":
+            if self._cost_model is None:
+                raise ValueError("search_algo=cost_model needs a 'cost_model' "
+                                 "(StepCostModel) in the tuner config")
+            # measure most-promising candidates first: sorted by estimated
+            # step time ascending (the reference's cost-guided ordering)
+            self.algo.all.sort(key=self._cost_model.estimate)
+        # anchor the prune threshold to the best FEASIBLE candidate —
+        # mp/memory-pruned ones can never run, so they must not drag the
+        # threshold below every runnable config
+        self._best_cost_est = 0.0
+        if self._cost_model is not None:
+            feasible = [c for c in self.algo.all
+                        if not prune_by_mp(c, self._heads, self._vocab)
+                        and not (self._mem_model is not None
+                                 and prune_by_memory(c, self._mem_model,
+                                                     self._hbm))]
+            self._best_cost_est = min((self._cost_model.estimate(c)
+                                       for c in feasible), default=0.0)
 
     def search_once(self) -> Optional[Dict]:
         while self.cur_task_id <= self.task_limit:
@@ -185,6 +285,13 @@ class AutoTuner:
                 continue
             if self._mem_model is not None and prune_by_memory(cfg, self._mem_model, self._hbm):
                 self.recorder.add(cfg, None, error="pruned: memory model predicts OOM")
+                continue
+            if (self._cost_model is not None and self._cost_prune_ratio > 0
+                    and prune_by_cost(cfg, self._cost_model,
+                                      self._best_cost_est,
+                                      self._cost_prune_ratio)):
+                self.recorder.add(cfg, None, error="pruned: cost model "
+                                  "predicts step time far off the best")
                 continue
             return cfg
         return None

@@ -47,6 +47,7 @@ class TestNumericGradients:
         check_grad(lambda t: P.sigmoid(t) if hasattr(P, "sigmoid") else P.tanh(t), x)
         check_grad(lambda t: t * t * t, x)
 
+    @pytest.mark.quick
     def test_matmul_grad(self):
         w = np.random.randn(4, 5)
         check_grad(lambda t: P.matmul(t, P.to_tensor(w.astype(np.float32))), np.random.randn(3, 4))

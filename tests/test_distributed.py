@@ -30,6 +30,7 @@ class TestTopology:
 
         assert len(jax.devices()) == 8
 
+    @pytest.mark.quick
     def test_hcg_mesh(self, hcg_2dp_4mp):
         hcg = hcg_2dp_4mp
         assert hcg.get_data_parallel_world_size() == 2

@@ -44,6 +44,7 @@ class TestLogProbVsScipy:
     def test_matches(self, name, mk, ref, xs):
         np.testing.assert_allclose(_lp(mk(), xs), ref(xs), rtol=2e-4, atol=2e-5)
 
+    @pytest.mark.quick
     def test_categorical(self):
         logits = np.array([0.1, 1.2, -0.5], np.float32)
         d = D.Categorical(logits=logits)

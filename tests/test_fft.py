@@ -30,6 +30,7 @@ class TestValuesVsNumpy:
         back = F.irfft(r, n=8, norm=norm)
         np.testing.assert_allclose(_v(back), X1, rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.quick
     def test_hfft_ihfft(self):
         h = np.fft.ihfft(X1)
         np.testing.assert_allclose(_v(F.ihfft(X1)), h, rtol=1e-4, atol=1e-5)

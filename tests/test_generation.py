@@ -1,6 +1,7 @@
 """Autoregressive generation with KV caches (PaddleNLP generate-surface
 capability; exercises the cache decode path + top_p_sampling)."""
 import numpy as np
+import pytest
 
 import paddle_tpu as P
 from paddle_tpu.models import LlamaForCausalLM, generate, llama_tiny
@@ -13,6 +14,7 @@ def _model():
     return m
 
 
+@pytest.mark.quick
 def test_greedy_matches_full_forward():
     m = _model()
     ids = P.to_tensor(np.random.RandomState(0).randint(0, 512, (2, 8)).astype(np.int32))

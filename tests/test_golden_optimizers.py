@@ -52,6 +52,7 @@ def test_momentum_matches_torch():
           lambda ps: torch.optim.SGD(ps, lr=0.1, momentum=0.9))
 
 
+@pytest.mark.quick
 def test_adam_matches_torch():
     _pair(lambda ps: P.optimizer.Adam(learning_rate=0.01, beta1=0.9, beta2=0.999,
                                       epsilon=1e-8, parameters=ps),

@@ -32,6 +32,7 @@ class TestToStatic:
         static = P.jit.to_static(net)(x).numpy()
         np.testing.assert_allclose(eager, static, rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.quick
     def test_backward_matches_eager(self):
         net = SmallNet()
         net.eval()

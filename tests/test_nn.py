@@ -19,6 +19,7 @@ class TestFunctional:
         g = F.gelu(x).numpy()
         assert g[0] < 0.01 and abs(g[-1] - 3) < 0.01
 
+    @pytest.mark.quick
     def test_linear(self):
         x = np.random.randn(4, 8).astype(np.float32)
         w = np.random.randn(8, 3).astype(np.float32)

@@ -11,6 +11,7 @@ import paddle_tpu as P
 from paddle_tpu import nn
 
 
+@pytest.mark.quick
 def test_memory_stats_api_shape():
     import paddle_tpu.device as device
 

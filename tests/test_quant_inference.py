@@ -27,6 +27,7 @@ class TestFakeQuant:
         P.sum(out).backward()
         np.testing.assert_allclose(np.asarray(x.grad._value), np.ones((4, 4)), rtol=1e-6)
 
+    @pytest.mark.quick
     def test_quant_error_small(self):
         q = Q.FakeQuanterWithAbsMaxObserver()
         x = P.to_tensor(RNG.randn(32).astype(np.float32))

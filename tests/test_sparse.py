@@ -29,6 +29,7 @@ class TestCreationAndConvert:
         dense[idx[0], idx[1]] = vals
         np.testing.assert_allclose(_v(sp.to_dense()), dense)
 
+    @pytest.mark.quick
     def test_coo_csr_roundtrip(self):
         sp, idx, vals = rand_coo()
         csr = sp.to_sparse_csr()

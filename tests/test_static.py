@@ -34,6 +34,7 @@ class TestCapture:
         (out,) = exe.run(main, feed={"x": feed}, fetch_list=[y])
         np.testing.assert_allclose(out, feed * 2 + 1)
 
+    @pytest.mark.quick
     def test_multi_op_graph(self):
         main = fresh_program()
         with P.static.program_guard(main):

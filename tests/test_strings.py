@@ -1,6 +1,7 @@
 """String tensor tier (reference: paddle/phi/kernels/strings/,
 strings_ops.yaml)."""
 import numpy as np
+import pytest
 
 import paddle_tpu as P
 from paddle_tpu import strings
@@ -16,6 +17,7 @@ def test_empty_and_copy():
     assert like.shape == [2, 2] and like[1, 1] == ""
 
 
+@pytest.mark.quick
 def test_lower_upper_ascii_and_utf8():
     t = strings.StringTensor(["Hello World", "ABC-def", "Ünïcode Ü"])
     lo = strings.lower(t)

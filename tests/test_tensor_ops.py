@@ -15,6 +15,7 @@ def check(t, expected, rtol=1e-3, atol=1e-5):
 
 
 class TestCreation:
+    @pytest.mark.quick
     def test_to_tensor(self):
         t = P.to_tensor([[1.0, 2.0], [3.0, 4.0]])
         assert t.shape == [2, 2]

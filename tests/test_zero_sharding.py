@@ -73,6 +73,7 @@ class TestZeroStages:
         _, _, got = _train(stage)
         np.testing.assert_allclose(got, base, rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.quick
     def test_stage1_accumulator_memory_shrinks(self):
         net, opt, _ = _train(1)
         w = net[0].weight  # [64, 64] divisible by 8

@@ -6,9 +6,17 @@ Prints ONE JSON line:
 
 Metric: Llama pretrain tokens/sec/chip (BASELINE.json headline). The model
 size auto-scales to the visible chip (tiny on CPU so the script always runs;
-~350M-class decoder on a single v5e chip). vs_baseline is achieved MFU /
-0.35 (the north-star MFU target), since the reference publishes no absolute
+~1B-class decoder on a single v5e chip). vs_baseline is achieved MFU / 0.35
+(the north-star MFU target), since the reference publishes no absolute
 in-tree numbers (BASELINE.md).
+
+Two permanent on-accel geometries (VERDICT r4 item 3):
+- headline: heads=10 / head_dim=256 — the MXU-shaped config every round
+  since r2 reports, kept for cross-round comparability (the perf gate FAILS
+  on drift of this workload);
+- honest: heads=20 / head_dim=128 — real Llama attention geometry; its
+  tokens/s + MFU ride in extra.honest_geometry so the headline number stops
+  being the only story.
 """
 import json
 import os
@@ -22,31 +30,26 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def main():
-    backend = jax.default_backend()
-    on_accel = backend in ("tpu", "axon")
-
+def run_config(heads: int, batch: int, seq: int, steps: int, on_accel: bool,
+               loss_mode: str):
     import paddle_tpu as P
-    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, LlamaPretrainingCriterion
+    from paddle_tpu.models import (
+        LlamaConfig,
+        LlamaForCausalLM,
+        LlamaPretrainingCriterion,
+    )
 
     P.seed(0)
     if on_accel:
-        # ~1B decoder sized to the chip: wide hidden/MLP GEMMs utilize the
-        # MXU better than deep-narrow at equal params (measured: this shape
-        # gives ~0.43 MFU vs 0.38 for h=2048/L=15). fp32 AdamW master
-        # weights + moments (14 bytes/param) -> ~13.5GB optimizer state.
-        heads = int(os.environ.get("PADDLE_TPU_BENCH_HEADS", 10))
         cfg = LlamaConfig(
             vocab_size=32000, hidden_size=2560, intermediate_size=8192,
             num_hidden_layers=9, num_attention_heads=heads,
             max_position_embeddings=2048, dtype="bfloat16", recompute=True,
         )
-        batch, seq, steps = int(os.environ.get("PADDLE_TPU_BENCH_BATCH", 8)), 2048, 20
     else:
         cfg = LlamaConfig(vocab_size=512, hidden_size=128, intermediate_size=352,
-                          num_hidden_layers=2, num_attention_heads=4,
+                          num_hidden_layers=2, num_attention_heads=heads,
                           max_position_embeddings=256)
-        batch, seq, steps = 2, 128, 5
 
     model = LlamaForCausalLM(cfg)
     if cfg.dtype == "bfloat16":
@@ -57,7 +60,6 @@ def main():
     # loss path: "unfused" materializes [N, vocab] logits (faster at batch 8:
     # XLA fuses the softmax; measured 0.435 vs 0.399 MFU for chunked);
     # "fused" streams the lm head in chunks (−3GB HBM, for larger batches)
-    loss_mode = os.environ.get("PADDLE_TPU_BENCH_LOSS", "unfused")
     if loss_mode == "fused":
         n_chunks = int(os.environ.get("PADDLE_TPU_BENCH_CHUNKS",
                                       max(8, (batch * seq) // 2048)))
@@ -72,8 +74,6 @@ def main():
     if os.environ.get("PADDLE_TPU_BENCH_MULTI", "1") == "1":
         # whole window as ONE compiled scan (TrainStep.run_steps): per-
         # dispatch host/marshalling overhead paid once, like a real loop
-        import jax.numpy as jnp
-
         stack = P.to_tensor(jnp.broadcast_to(ids._value, (steps, *ids._value.shape)))
         loss = step.run_steps(stack)[-1:]
         loss.numpy()
@@ -83,8 +83,7 @@ def main():
         float(loss.numpy()[0])
         dt = (time.perf_counter() - t0) / steps
     else:
-        # compile + warmup
-        loss = step(ids)
+        loss = step(ids)  # compile + warmup
         loss.numpy()
         t0 = time.perf_counter()
         for _ in range(steps):
@@ -95,25 +94,85 @@ def main():
     tokens_per_sec = batch * seq / dt
     # 6ND per token (fwd+bwd) + attention term
     flops_per_token = 6 * n_params + 12 * cfg.num_hidden_layers * cfg.hidden_size * seq * 0.5
-    achieved_flops = tokens_per_sec * flops_per_token
+    achieved = tokens_per_sec * flops_per_token
     peak = 197e12 if on_accel else 1e12  # v5e bf16 peak
-    mfu = achieved_flops / peak
+    return {
+        "tokens_per_sec": tokens_per_sec,
+        "mfu": achieved / peak,
+        "dt": dt,
+        "loss": float(np.asarray(loss.numpy()).reshape(-1)[-1]),
+        "params": n_params,
+        "cfg": cfg,
+    }
 
-    print(json.dumps({
+
+def main():
+    backend = jax.default_backend()
+    on_accel = backend in ("tpu", "axon")
+
+    heads = int(os.environ.get("PADDLE_TPU_BENCH_HEADS", 10 if on_accel else 4))
+    if on_accel:
+        batch = int(os.environ.get("PADDLE_TPU_BENCH_BATCH", 8))
+        seq, steps = 2048, 20
+    else:
+        batch, seq, steps = 2, 128, 5
+    loss_mode = os.environ.get("PADDLE_TPU_BENCH_LOSS", "unfused")
+
+    head = run_config(heads, batch, seq, steps, on_accel, loss_mode)
+    cfg = head["cfg"]
+
+    honest = None
+    if on_accel and os.environ.get("PADDLE_TPU_BENCH_HONEST", "1") == "1":
+        # real-Llama attention geometry: head_dim=128 (heads=20 @ hidden
+        # 2560); same everything else. Runs in a SUBPROCESS: ~13.5 GB of
+        # params+optimizer state per geometry can't coexist on one 16 GB
+        # chip, and process exit is the only airtight free.
+        import gc
+        import subprocess
+
+        # drop the parent's ~13.5 GB (params+opt state live only inside
+        # run_config's frame; collect before the child needs the chip)
+        gc.collect()
+        env = dict(os.environ)
+        env["PADDLE_TPU_BENCH_HEADS"] = "20"
+        env["PADDLE_TPU_BENCH_HONEST"] = "0"
+        r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                           env=env, capture_output=True, text=True,
+                           timeout=3600)
+        try:
+            if r.returncode != 0 or not r.stdout.strip():
+                raise ValueError((r.stderr or "no output")[-400:])
+            child = json.loads(r.stdout.strip().splitlines()[-1])
+            if child["extra"]["backend"] != backend:
+                # e.g. the child lost the device and fell back to CPU —
+                # never let CPU numbers masquerade as chip data
+                raise ValueError(
+                    f"child ran on {child['extra']['backend']!r}, parent on "
+                    f"{backend!r}")
+            honest = {
+                "tokens_per_sec": child["value"],
+                "mfu": child["extra"]["mfu"],
+                "dt": child["extra"]["step_ms"] / 1e3,
+                "params": child["extra"]["params"],
+            }
+        except (ValueError, KeyError, json.JSONDecodeError) as e:
+            honest = {"error": str(e)[-400:]}
+
+    out = {
         "metric": "llama_pretrain_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec, 1),
+        "value": round(head["tokens_per_sec"], 1),
         "unit": "tokens/s",
-        "vs_baseline": round(mfu / 0.35, 4),
+        "vs_baseline": round(head["mfu"] / 0.35, 4),
         "extra": {
             "backend": backend,
-            "params": n_params,
+            "params": head["params"],
             "batch": batch,
             "seq_len": seq,
-            "step_ms": round(dt * 1e3, 2),
-            "mfu": round(mfu, 4),
-            "loss": float(np.asarray(loss.numpy()).reshape(-1)[-1]),
+            "step_ms": round(head["dt"] * 1e3, 2),
+            "mfu": round(head["mfu"], 4),
+            "loss": head["loss"],
             # workload identity so cross-round comparisons (tools/perf_gate.py)
-            # can detect mismatched configs instead of comparing apples/oranges
+            # can FAIL on mismatched configs instead of comparing apples/oranges
             "workload": {
                 "heads": cfg.num_attention_heads,
                 "hidden": cfg.hidden_size,
@@ -122,7 +181,21 @@ def main():
                 "loss_mode": loss_mode if on_accel else "unfused",
             },
         },
-    }))
+    }
+    if honest is not None:
+        if "error" in honest:
+            out["extra"]["honest_geometry"] = {"heads": 20, "head_dim": 128,
+                                               "error": honest["error"]}
+        else:
+            out["extra"]["honest_geometry"] = {
+                "heads": 20, "head_dim": 128,
+                "tokens_per_sec": round(honest["tokens_per_sec"], 1),
+                "mfu": round(honest["mfu"], 4),
+                "step_ms": round(honest["dt"] * 1e3, 2),
+                "params": honest["params"],
+                "mfu_ratio_vs_headline": round(honest["mfu"] / head["mfu"], 4),
+            }
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -468,17 +468,103 @@ class CompiledPipelineTrainStep:
                 # microbatch m exits the LAST stage at tick m + P - 1
                 return jnp.take(outs, jnp.arange(M) + P - 1, axis=0)
 
-            xs_c = xs
-            for c in range(C):
-                if C == 1:
-                    p_chunk = [a[0] for a in stacked_vals]          # [P,...] local
-                else:
-                    p_chunk = [a[c, 0] for a in stacked_vals]       # [C,P,...] local
-                exit_outs = run_chunk(p_chunk, xs_c, c == 0)
-                if c < C - 1:
-                    # exits live on the last stage; one ring hop delivers
-                    # them to stage 0 as the next chunk's inputs
-                    xs_c = lax.ppermute(exit_outs, "pp", ring_perm)
+            import os as _os
+
+            # OPT-IN (measured decision, PROFILE_r05.md §1): the explicit
+            # interleaved ordering reaches a 0.94-tick bubble (below even
+            # the 1.5 interleaved bound) but its per-tick lax.switch costs
+            # +43% steady-state per-microbatch time on the CPU mesh — a net
+            # loss at every measured M. Chunk-sequential stays the default.
+            want_interleave = _os.environ.get(
+                "PADDLE_TPU_VPP_INTERLEAVED") == "1"
+            interleave = want_interleave and C > 1 and M % P == 0
+            if want_interleave and not interleave:
+                import warnings
+
+                warnings.warn(
+                    f"PADDLE_TPU_VPP_INTERLEAVED=1 ignored: needs VPP "
+                    f"chunks (C={C}) and num_micro divisible by pp stages "
+                    f"(M={M}, P={P}); running chunk-sequential",
+                    stacklevel=2)
+            if interleave:
+                # ---- explicit interleaved-VPP ordering (r5, VERDICT item
+                # 5): ONE scan whose stage-0 feed alternates chunks in
+                # groups of P microbatches — (c, m)'s dependency, chunk
+                # c-1's exit of the same microbatch, is fed exactly P ticks
+                # earlier and rides the ring's P-1→0 wrap back to stage 0
+                # just in time, so the feed is dense (zero stalls) and the
+                # whole-schedule bubble is P-1 CHUNK-times = (P-1)/C
+                # microbatch-times, the Megatron interleaved bound — instead
+                # of the chunk-sequential C*(P-1).
+                CM = C * M
+                feed_c = np.zeros(CM, np.int32)
+                feed_m = np.zeros(CM, np.int32)
+                pos = 0
+                for blk in range(M // P):
+                    for c in range(C):
+                        for off in range(P):
+                            feed_c[pos] = c
+                            feed_m[pos] = blk * P + off
+                            pos += 1
+                c_arr = jnp.asarray(feed_c)
+                m_arr = jnp.asarray(feed_m)
+                T_i = CM + P - 1
+
+                branches = [
+                    (lambda c: (lambda v: body_fwd(
+                        [a[c, 0] for a in stacked_vals], v)))(c)
+                    for c in range(C)
+                ]
+
+                def itick(carry, t):
+                    h, pending = carry
+                    # exit of the item fed at t-P arrives on the ring; park
+                    # non-final chunks' exits as the next chunk's feed
+                    tp = t - P
+                    tpc = jnp.clip(tp, 0, CM - 1)
+                    ret_c = c_arr[tpc]
+                    ret_m = jnp.clip(m_arr[tpc], 0, M - 1)
+                    store = (tp >= 0) & (ret_c < C - 1)
+                    slot = lax.dynamic_index_in_dim(pending, ret_m, 0,
+                                                    keepdims=False)
+                    pending = lax.dynamic_update_index_in_dim(
+                        pending, jnp.where(store, h, slot), ret_m, 0)
+                    # this stage's work item: the one stage 0 fed s ticks ago
+                    ti = jnp.clip(t - stage, 0, CM - 1)
+                    my_c = c_arr[ti]
+                    my_m = jnp.clip(m_arr[ti], 0, M - 1)
+                    x_t = lax.dynamic_index_in_dim(xs, my_m, 0,
+                                                   keepdims=False)
+                    pend_m = lax.dynamic_index_in_dim(pending, my_m, 0,
+                                                      keepdims=False)
+                    inp0 = jnp.where(my_c == 0, run_head(x_t), pend_m)
+                    inp = jnp.where(stage == 0, inp0, h)
+                    out = lax.switch(my_c, branches, inp)
+                    return (lax.ppermute(out, "pp", ring_perm), pending), out
+
+                h_struct = jax.eval_shape(run_head, xs[0])
+                h0 = jnp.zeros(h_struct.shape, h_struct.dtype)
+                pend0 = jnp.zeros((M, *h_struct.shape), h_struct.dtype)
+                _, outs = lax.scan(itick, (h0, pend0), jnp.arange(T_i))
+                # final-chunk microbatch m finishes the last stage at
+                # t_fed(C-1, m) + P - 1
+                t_fed = np.zeros(M, np.int64)
+                for pos in range(CM):
+                    if feed_c[pos] == C - 1:
+                        t_fed[feed_m[pos]] = pos
+                exit_outs = jnp.take(outs, jnp.asarray(t_fed + P - 1), axis=0)
+            else:
+                xs_c = xs
+                for c in range(C):
+                    if C == 1:
+                        p_chunk = [a[0] for a in stacked_vals]      # [P,...] local
+                    else:
+                        p_chunk = [a[c, 0] for a in stacked_vals]   # [C,P,...] local
+                    exit_outs = run_chunk(p_chunk, xs_c, c == 0)
+                    if c < C - 1:
+                        # exits live on the last stage; one ring hop delivers
+                        # them to stage 0 as the next chunk's inputs
+                        xs_c = lax.ppermute(exit_outs, "pp", ring_perm)
             # merge microbatches for the tail + loss: every rank computes in
             # SPMD lockstep; only the last stage's value survives the mask
             mb = exit_outs.shape[1]

@@ -302,6 +302,29 @@ class TestCompiledVPP:
         accs = opt2._accumulators["moment1"]
         assert any(tuple(v.shape[:2]) == (2, 2) for v in accs.values())
 
+    def test_vpp_interleaved_matches_chunk_sequential(self, monkeypatch):
+        """r5: the explicit interleaved ordering (opt-in,
+        PADDLE_TPU_VPP_INTERLEAVED=1 — measured tradeoff in PROFILE_r05.md)
+        computes the SAME loss as the chunk-sequential rings."""
+        x, y = P.randn([8, 16]), P.randn([8, 16])
+
+        def run(sequential):
+            if sequential:
+                monkeypatch.delenv("PADDLE_TPU_VPP_INTERLEAVED", raising=False)
+            else:
+                monkeypatch.setenv("PADDLE_TPU_VPP_INTERLEAVED", "1")
+            _init(dp=2, pp=2)
+            P.seed(33)
+            pipe = PipelineLayer(layers=_mlp_descs(8), num_stages=2,
+                                 num_virtual_pipeline_stages=2,
+                                 loss_fn=lambda o, y: F.mse_loss(o, y))
+            opt = P.optimizer.SGD(0.0, parameters=pipe.parameters())
+            step = CompiledPipelineTrainStep(pipe, opt, num_micro=4)
+            return float(step(x, y).numpy())
+
+        np.testing.assert_allclose(run(sequential=True),
+                                   run(sequential=False), rtol=1e-5)
+
     def test_vpp_sync_to_model(self):
         _init(dp=1, pp=2)
         P.seed(23)

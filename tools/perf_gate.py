@@ -53,10 +53,13 @@ def main() -> int:
     pw = (prev.get("extra") or {}).get("workload")
     cw = (cur.get("extra") or {}).get("workload")
     if pw is not None and cw is not None and pw != cw:
-        print(f"perf-gate: WARNING — workload configs differ between r{pn} "
-              f"{pw} and r{cn} {cw}; vs_baseline comparison is not "
-              f"apples-to-apples, skipping gate")
-        return 0
+        # the headline series is only meaningful on a pinned workload — a
+        # drifted config is a FAILURE, not a skip (VERDICT r4 item 3)
+        print(f"perf-gate: FAIL — workload configs differ between r{pn} "
+              f"{pw} and r{cn} {cw}; the headline metric must be measured "
+              "on the pinned workload (set PADDLE_TPU_BENCH_* back, or "
+              "consciously reset the baseline series)")
+        return 1
     pv, cv = prev["vs_baseline"], cur["vs_baseline"]
     drop = (pv - cv) / pv if pv > 0 else 0.0
     print(f"perf-gate: r{pn} {pv:.4f} -> r{cn} {cv:.4f} "

@@ -136,10 +136,10 @@ def main():
         env = dict(os.environ)
         env["PADDLE_TPU_BENCH_HEADS"] = "20"
         env["PADDLE_TPU_BENCH_HONEST"] = "0"
-        r = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                           env=env, capture_output=True, text=True,
-                           timeout=3600)
         try:
+            r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                               env=env, capture_output=True, text=True,
+                               timeout=3600)
             if r.returncode != 0 or not r.stdout.strip():
                 raise ValueError((r.stderr or "no output")[-400:])
             child = json.loads(r.stdout.strip().splitlines()[-1])
@@ -155,7 +155,9 @@ def main():
                 "dt": child["extra"]["step_ms"] / 1e3,
                 "params": child["extra"]["params"],
             }
-        except (ValueError, KeyError, json.JSONDecodeError) as e:
+        except (ValueError, KeyError, json.JSONDecodeError,
+                subprocess.TimeoutExpired, OSError) as e:
+            # never lose the already-measured headline to a child failure
             honest = {"error": str(e)[-400:]}
 
     out = {

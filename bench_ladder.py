@@ -206,9 +206,11 @@ def bench_serving_mixed():
                           intermediate_size=8192, num_hidden_layers=9,
                           num_attention_heads=10,
                           max_position_embeddings=2048, dtype="bfloat16")
-        B, block, budget, max_seq = 8, 64, 64, 512
+        B, block, budget, max_seq = 8, 64, 64, 448
         ctx0 = [128, 192, 256, 320, 128, 192, 256, 320]  # mixed lengths
-        n_lo, n_hi = 32, 96
+        # scan lengths kept small: the tunneled remote-compile service
+        # breaks (broken pipe) on the larger 32/96-iteration scan programs
+        n_lo, n_hi = 8, 24
     else:
         cfg = LlamaConfig(vocab_size=512, hidden_size=128,
                           intermediate_size=352, num_hidden_layers=2,
@@ -244,25 +246,29 @@ def bench_serving_mixed():
     toks0 = jnp.asarray([r.generated[-1] for r in by_slot], jnp.int32)
 
     def run_n(n):
-        def body(carry, _):
+        def body(weights, carry, _):
             toks, kcs, vcs, dec = carry
             nxt, kcs, vcs = eng._step_raw(
-                eng._weights, kcs, vcs, eng._rope, toks, enc, dec, now, cu,
+                weights, kcs, vcs, eng._rope, toks, enc, dec, now, cu,
                 bt, 1)
             return (nxt, kcs, vcs, dec + 1), nxt[0]
 
         @jax.jit
-        def prog(kcs, vcs):
+        def prog(weights, kcs, vcs):
+            # weights MUST be arguments: closing over the ~2 GB pytree
+            # embeds it as program constants, which the tunneled remote
+            # compile service cannot swallow (broken pipe)
             (_, kcs, vcs, _), out = lax.scan(
-                body, (toks0, list(kcs), list(vcs), dec0), None, length=n)
+                lambda c, x: body(weights, c, x),
+                (toks0, list(kcs), list(vcs), dec0), None, length=n)
             return out[-1]
 
-        o = prog(eng.key_caches, eng.value_caches)  # compile + warm
+        o = prog(eng._weights, eng.key_caches, eng.value_caches)  # compile
         float(o)
         best = 1e9
         for _ in range(2):
             t0 = time.perf_counter()
-            float(prog(eng.key_caches, eng.value_caches))
+            float(prog(eng._weights, eng.key_caches, eng.value_caches))
             best = min(best, time.perf_counter() - t0)
         return best
 

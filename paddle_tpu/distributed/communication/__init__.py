@@ -144,8 +144,37 @@ def all_gather(tensor_list, tensor, group: Optional[Group] = None, sync_op=True)
 
 
 def all_gather_object(object_list, obj, group=None):
+    """Gather arbitrary picklable objects from every process (parity:
+    communication/all_gather.py all_gather_object). Multi-host: the object
+    pickles to bytes, lengths equalize by padding, and the bytes ride the
+    JAX multihost allgather (the runtime's cross-host channel — no side
+    rendezvous needed)."""
     if jax.process_count() > 1:
-        raise NotImplementedError("all_gather_object over multi-host is not wired yet")
+        import pickle
+
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        if group is not None and group.nranks not in (0, jax.process_count()):
+            # process_allgather is a WORLD collective; letting a subgroup
+            # fall through would deadlock the participants
+            raise NotImplementedError(
+                "all_gather_object over a strict subgroup of processes is "
+                "not supported; use the world group (group=None)")
+        payload = pickle.dumps(obj)
+        n_ln = multihost_utils.process_allgather(
+            jnp.asarray([len(payload)], jnp.int32))
+        max_len = int(np.max(np.asarray(n_ln)))
+        buf = np.zeros((max_len,), np.uint8)
+        buf[: len(payload)] = np.frombuffer(payload, np.uint8)
+        gathered = np.asarray(multihost_utils.process_allgather(
+            jnp.asarray(buf)))
+        lens = np.asarray(n_ln).reshape(-1)
+        object_list.clear()
+        object_list.extend(
+            pickle.loads(gathered[i, : int(lens[i])].tobytes())
+            for i in range(gathered.shape[0]))
+        return
     n = group.nranks if group is not None else 1
     object_list.clear()
     object_list.extend(obj for _ in range(n))

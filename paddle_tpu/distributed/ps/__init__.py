@@ -182,11 +182,8 @@ class ShardedEmbedding:
         self.table_name = table_name
         self.dim = dim
         self.servers = list(servers)
-        # prefetch pool for pull_async; threads spawn on first use
-        from concurrent.futures import ThreadPoolExecutor
-
-        self._prefetch_pool = ThreadPoolExecutor(
-            max_workers=2, thread_name_prefix="ps-prefetch")
+        self._pool_lock = threading.Lock()
+        self._prefetch_pool = None  # built lazily by pull_async
 
     def _shard(self, ids: np.ndarray):
         ids = np.asarray(ids).reshape(-1).astype(np.int64)
@@ -236,12 +233,21 @@ class ShardedEmbedding:
         didn't overlap). Returns a future; ``.result()`` gives the same
         array ``pull`` would. Call :meth:`close` (or drain futures) before
         ``rpc.shutdown()`` so in-flight prefetches don't race teardown."""
+        with self._pool_lock:
+            if self._prefetch_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._prefetch_pool = ThreadPoolExecutor(
+                    max_workers=2, thread_name_prefix="ps-prefetch")
         ids = np.asarray(ids).copy()  # caller may mutate its buffer
         return self._prefetch_pool.submit(self.pull, ids)
 
     def close(self):
-        """Drain and stop the prefetch pool."""
-        self._prefetch_pool.shutdown(wait=True)
+        """Drain and stop the prefetch pool (if one was ever started)."""
+        with self._pool_lock:
+            if self._prefetch_pool is not None:
+                self._prefetch_pool.shutdown(wait=True)
+                self._prefetch_pool = None
 
 
     # ---------------------------------------------------------- persistence
@@ -280,6 +286,19 @@ class GeoShardedEmbedding(ShardedEmbedding):
         self._cache: Dict[int, np.ndarray] = {}
         self._delta: Dict[int, np.ndarray] = {}
         self._step = 0
+
+    def pull_async(self, ids):
+        """Geo mode keeps an UNSYNCHRONIZED local cache that push/geo_sync
+        mutate, so a background prefetch would race the trainer thread —
+        resolve synchronously instead (same future-shaped contract)."""
+        from concurrent.futures import Future
+
+        fut = Future()
+        try:
+            fut.set_result(self.pull(ids))
+        except Exception as e:  # match executor semantics
+            fut.set_exception(e)
+        return fut
 
     def pull(self, ids) -> np.ndarray:
         arr = np.asarray(ids)

@@ -52,6 +52,10 @@ class TestMultiProcess:
         assert all(np.isfinite(losses))
         # each host wrote its own checkpoint shard
         assert {"shard_0.npz", "shard_1.npz"} <= set(res[0]["shard_file"])
+        # all_gather_object crossed the process boundary (r5: was unwired)
+        for r in res:
+            assert r["gathered_objs"] == [{"rank": 0, "tag": "host0"},
+                                          {"rank": 1, "tag": "host1"}]
         # resume from the per-host shards continues the run (tolerance: the
         # recompiled step may pick a different-but-equivalent GSPMD layout,
         # so reductions can differ by ulps)

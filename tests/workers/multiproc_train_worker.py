@@ -193,8 +193,13 @@ def main():
     globalize_model_and_opt(model2, opt2, mesh)
     losses_resume = run_steps(step2, 2, 4)
 
+    # cross-host object gather rides the same runtime channel
+    objs = []
+    dist.all_gather_object(objs, {"rank": rank, "tag": f"host{rank}"})
+
     json.dump({"rank": rank, "losses_a": losses_a, "losses_b": losses_b,
                "losses_resume": losses_resume,
+               "gathered_objs": objs,
                "shard_file": sorted(os.listdir(ckpt))},
               open(os.path.join(workdir, f"result_{rank}.json"), "w"))
 

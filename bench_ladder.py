@@ -248,7 +248,7 @@ def bench_serving_mixed():
     def run_n(n):
         def body(weights, carry, _):
             toks, kcs, vcs, dec = carry
-            nxt, kcs, vcs = eng._step_raw(
+            nxt, kcs, vcs, _ = eng._step_raw(
                 weights, kcs, vcs, eng._rope, toks, enc, dec, now, cu,
                 bt, 1)
             return (nxt, kcs, vcs, dec + 1), nxt[0]

@@ -184,6 +184,7 @@ class ShardedEmbedding:
         self.servers = list(servers)
         self._pool_lock = threading.Lock()
         self._prefetch_pool = None  # built lazily by pull_async
+        self._prefetch_closed = False
 
     def _shard(self, ids: np.ndarray):
         ids = np.asarray(ids).reshape(-1).astype(np.int64)
@@ -234,6 +235,9 @@ class ShardedEmbedding:
         array ``pull`` would. Call :meth:`close` (or drain futures) before
         ``rpc.shutdown()`` so in-flight prefetches don't race teardown."""
         with self._pool_lock:
+            if self._prefetch_closed:
+                raise RuntimeError(
+                    "pull_async after close(): the prefetch pool is shut down")
             if self._prefetch_pool is None:
                 from concurrent.futures import ThreadPoolExecutor
 
@@ -243,8 +247,9 @@ class ShardedEmbedding:
         return self._prefetch_pool.submit(self.pull, ids)
 
     def close(self):
-        """Drain and stop the prefetch pool (if one was ever started)."""
+        """Drain and stop the prefetch pool; later pull_async calls raise."""
         with self._pool_lock:
+            self._prefetch_closed = True
             if self._prefetch_pool is not None:
                 self._prefetch_pool.shutdown(wait=True)
                 self._prefetch_pool = None

@@ -90,7 +90,8 @@ class ServingEngine:
 
     def __init__(self, model, max_batch_size: int = 4, max_seq_len: int = 256,
                  block_size: int = 16, token_budget: int = 32,
-                 num_blocks: Optional[int] = None, cache_dtype=None):
+                 num_blocks: Optional[int] = None, cache_dtype=None,
+                 cache_quant: str = "none"):
         cfg = model.config
         self.cfg = cfg
         self.B = int(max_batch_size)
@@ -105,7 +106,19 @@ class ServingEngine:
         self.D = cfg.head_dim
         self.E = cfg.hidden_size
         self.L = cfg.num_hidden_layers
-        if cache_dtype is None:
+        if cache_quant not in ("none", "int8"):
+            raise ValueError("cache_quant must be 'none' or 'int8'")
+        self.cache_quant = cache_quant
+        if cache_quant == "int8" and cache_dtype is not None:
+            raise ValueError(
+                "cache_quant='int8' fixes the cache dtype to uint8 — don't "
+                "pass cache_dtype with it")
+        if cache_quant == "int8":
+            # paged int8 KV (the reference's cache_int8 serving mode):
+            # uint8 blocks + per-(slot, kv-head) dynamic scales refreshed by
+            # the prefill rows (ops/paged_attention.py quant contract)
+            cache_dtype = jnp.uint8
+        elif cache_dtype is None:
             cache_dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
         self._compute_dtype = (jnp.bfloat16 if cfg.dtype == "bfloat16"
                                else jnp.float32)
@@ -116,6 +129,12 @@ class ServingEngine:
                            for _ in range(self.L)]
         self.value_caches = [jnp.zeros_like(self.key_caches[0])
                              for _ in range(self.L)]
+        if cache_quant == "int8":
+            self.cache_scales = [
+                {k: jnp.zeros((self.B, self.KV), jnp.float32)
+                 for k in ("kq", "vq", "kd", "vd")} for _ in range(self.L)]
+        else:
+            self.cache_scales = None
         self.block_tables = np.full((self.B, self.P), -1, np.int32)
 
         self._queue: List[ServingRequest] = []
@@ -176,26 +195,37 @@ class ServingEngine:
             nrm = xf * jax.lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True) + eps)
             return (nrm * w.astype(jnp.float32)).astype(x.dtype)
 
+        quant = self.cache_quant
+
         def step(weights, key_caches, value_caches, rope, token_ids,
-                 enc, dec, now, cu, bt, mq):
+                 enc, dec, now, cu, bt, mq, scales=None):
             # mq (static): padded per-sequence query length for the attention
             # compute — T for steps carrying prefill chunks, 1 for pure
             # decode steps (avoids T× padded-query attention waste). Two
             # compiled programs total, still shape-stable across requests.
             hidden = weights["embed"][token_ids]  # [T, E]
+            new_scales = []
             for li, lw in enumerate(weights["layers"]):
                 h = rms(hidden, lw["ln1"])
                 q = h @ lw["wq"]
                 k = h @ lw["wk"]
                 v = h @ lw["wv"]
                 qkv = jnp.concatenate([q, k, v], axis=-1)
-                out, kc, vc, *_ = blha_attention(
+                sc = scales[li] if scales is not None else {}
+                out, kc, vc, kq, vq, kd, vd = blha_attention(
                     qkv, key_caches[li], value_caches[li], enc, dec, now,
                     cu, bt, num_heads=H, kv_num_heads=KV, head_dim=D,
                     block_size=bs, max_q_len=mq, use_neox_style=True,
-                    compute_dtype=hidden.dtype, rope_emb=rope)
+                    compute_dtype=hidden.dtype, rope_emb=rope,
+                    cache_quant=quant if quant != "int8" else "dynamic",
+                    cache_k_quant_scales=sc.get("kq"),
+                    cache_v_quant_scales=sc.get("vq"),
+                    cache_k_dequant_scales=sc.get("kd"),
+                    cache_v_dequant_scales=sc.get("vd"))
                 key_caches[li] = kc
                 value_caches[li] = vc
+                if scales is not None:
+                    new_scales.append({"kq": kq, "vq": vq, "kd": kd, "vd": vd})
                 hidden = hidden + out @ lw["wo"]
                 h2 = rms(hidden, lw["ln2"])
                 g = h2 @ lw["wg"]
@@ -206,7 +236,7 @@ class ServingEngine:
             rows = jnp.clip(cu[1:] - 1, 0, token_ids.shape[0] - 1)
             logits = hidden[rows] @ weights["head"]  # [B, V]
             nxt = jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32)
-            return nxt, key_caches, value_caches
+            return nxt, key_caches, value_caches, new_scales
 
         self._step_raw = step  # undonated body (in-graph benching/scans)
         return jax.jit(step, donate_argnums=(1, 2), static_argnames=("mq",))
@@ -221,6 +251,15 @@ class ServingEngine:
         if total > self.max_seq_len:
             raise ValueError(f"prompt+max_new_tokens={total} exceeds "
                              f"max_seq_len={self.max_seq_len}")
+        if self.cache_quant == "int8" and len(prompt) > self.T:
+            # dynamic per-sequence scales are frozen by the (one-shot)
+            # prefill — chunked prefills would quantize chunks under
+            # different scales than the final dequant (the reference's
+            # dynamic cache-quant mode has the same one-shot contract)
+            raise ValueError(
+                f"cache_quant='int8' needs the prompt ({len(prompt)} tokens) "
+                f"to prefill in one step (token_budget={self.T}); raise the "
+                "budget or use the unquantized cache")
         rid = self._next_rid
         self._next_rid += 1
         self._queue.append(ServingRequest(rid, prompt, max_new_tokens,
@@ -270,7 +309,13 @@ class ServingEngine:
                 budget -= 1
         for req in self._active.values():
             if req.in_prefill and budget > 0:
-                n = min(len(req.prompt) - req.prefill_pos, budget)
+                need = len(req.prompt) - req.prefill_pos
+                if self.cache_quant == "int8" and need > budget:
+                    # int8 dynamic scales freeze at prefill: the prefill must
+                    # land in ONE step, so wait for enough budget (bounded
+                    # wait — decoding slots retire and free it)
+                    continue
+                n = min(need, budget)
                 sched.append((req, n, req.prefill_pos + n >= len(req.prompt)))
                 budget -= n
         if not sched:
@@ -306,11 +351,13 @@ class ServingEngine:
             cu[slot + 1] = pos
 
         had_cache = self._step_fn._cache_size() if hasattr(self._step_fn, "_cache_size") else None
-        nxt, self.key_caches, self.value_caches = self._step_fn(
+        nxt, self.key_caches, self.value_caches, new_scales = self._step_fn(
             self._weights, self.key_caches, self.value_caches, self._rope,
             jnp.asarray(tokens), jnp.asarray(enc), jnp.asarray(dec),
             jnp.asarray(now), jnp.asarray(cu), jnp.asarray(self.block_tables),
-            mq=1 if decode_only else self.T)
+            mq=1 if decode_only else self.T, scales=self.cache_scales)
+        if self.cache_scales is not None:
+            self.cache_scales = new_scales
         if had_cache is not None:
             self.compile_count += self._step_fn._cache_size() - had_cache
         nxt = np.asarray(nxt)

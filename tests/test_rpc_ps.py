@@ -225,6 +225,9 @@ def test_pull_async_overlaps_and_matches_sync():
         rows_sync = emb.pull(ids)
         np.testing.assert_array_equal(rows_async, rows_sync)
         assert busy > 0
+        emb.close()
+        with pytest.raises(RuntimeError, match="close"):
+            emb.pull_async(ids)  # fail-loud after close, no pool resurrection
     finally:
         rpc.shutdown()
 

@@ -346,8 +346,13 @@ def bench_pipeline_compiled_vs_eager():
     )
 
     P.seed(0)
+    # old jax cannot mix the compiled pipeline's manual 'pp' axis with
+    # size>1 auto axes (see compiled_pipeline._pp_collectives_native) —
+    # degrade to a pp-only mesh there so the rung stays measurable; the
+    # mesh used is recorded in extra.mesh
+    dmp = 2 if hasattr(_jax, "shard_map") else 1
     s = dist.fleet.DistributedStrategy()
-    s.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 2,
+    s.hybrid_configs = {"dp_degree": dmp, "mp_degree": dmp, "pp_degree": 2,
                         "sharding_degree": 1, "sep_degree": 1}
     s.pipeline_configs = {"accumulate_steps": 4, "schedule_mode": "1F1B"}
     dist.fleet.init(is_collective=True, strategy=s)
@@ -379,7 +384,7 @@ def bench_pipeline_compiled_vs_eager():
         "metric": "pp_llama_step_ms_compiled_vs_eager",
         "value": round(comp_ms, 2),
         "unit": "ms/step",
-        "extra": {"backend": "cpu-mesh-8dev", "mesh": "dp2.mp2.pp2",
+        "extra": {"backend": "cpu-mesh-8dev", "mesh": f"dp{dmp}.mp{dmp}.pp2",
                   "eager_step_ms": round(eager_ms, 2),
                   "speedup_vs_eager": round(eager_ms / comp_ms, 2),
                   "num_micro": 4},

@@ -48,6 +48,7 @@ def default_candidates(cfg: Dict) -> Dict[str, List[int]]:
         "sharding_stage": cfg.get("sharding_stage", [1]) if isinstance(cfg.get("sharding_stage", [1]), list) else [cfg.get("sharding_stage")],
         "micro_batch_size": _divisors(gb) if mbs == "auto" else ([mbs] if isinstance(mbs, int) else list(mbs)),
         "use_recompute": cfg.get("use_recompute", [False]) if isinstance(cfg.get("use_recompute", [False]), list) else [cfg.get("use_recompute")],
+        "vpp_degree": cfg.get("vpp_degree", [1]) if isinstance(cfg.get("vpp_degree", [1]), list) else [cfg.get("vpp_degree")],
     }
 
 
@@ -114,7 +115,11 @@ class StepCostModel:
     - DP/sharding grad sync: 2*params_bytes*(g-1)/g over the dp*sharding
       group (reduce-scatter + all-gather), once per step; sharding stage 3
       adds a parameter all-gather per microbatch.
-    - PP bubble: compute inflated by (M+P-1)/M (synchronous 1F1B bound).
+    - PP bubble: compute inflated by (M+B)/M where B is the schedule's
+      bubble in microbatch-times: (P-1) for synchronous 1F1B, and
+      (P-1)/C for interleaved VPP with C chunks ('vpp_degree' in the cfg)
+      — the compiled engine auto-selects the interleaved schedule exactly
+      when C > 1 and M % P == 0, so the model prices it only then.
     """
 
     def __init__(self, n_params: float, hidden: int = 4096, layers: int = 32,
@@ -144,8 +149,14 @@ class StepCostModel:
 
         flops_total = (8.0 if recompute else 6.0) * self.n_params * tokens
         t_compute = flops_total / (chips * self.flops)
-        if pp > 1:  # synchronous pipeline bubble
-            t_compute *= (num_micro + pp - 1) / num_micro
+        if pp > 1:  # pipeline bubble (schedule-dependent)
+            vpp = max(int(cfg.get("vpp_degree",
+                                  cfg.get("num_chunks", 1)) or 1), 1)
+            if vpp > 1 and num_micro % pp == 0:
+                bubble = (pp - 1) / vpp  # interleaved-VPP (auto-selected)
+            else:
+                bubble = pp - 1          # synchronous 1F1B
+            t_compute *= (num_micro + bubble) / num_micro
 
         t_tp = 0.0
         if mp > 1:
@@ -195,6 +206,11 @@ class GridSearch:
         for combo in itertools.product(*(cands[k] for k in keys)):
             c = dict(zip(keys, combo))
             if c["dp_degree"] * c["mp_degree"] * c["pp_degree"] * c["sharding_degree"] != n:
+                continue
+            # vpp only means something on a real pipeline: vpp>1 with pp=1
+            # is the same physical config as vpp=1 — measuring both would
+            # double tuner wall-clock for nothing
+            if int(c.get("vpp_degree") or 1) > 1 and c["pp_degree"] == 1:
                 continue
             self.all.append(c)
         self._i = 0

@@ -21,8 +21,9 @@ TPU-native formulation (the GSPMD/shard_map pipeline):
   stacked ``[P, ...]`` and pp-sharded — each device holds exactly its
   stage's decoder weights. The HEAD (first-stage prefix) and TAIL
   (last-stage suffix) ride as ordinary pp-replicated (auto) arrays; under
-  SPMD every rank executes head/tail in lockstep and masks by
-  ``lax.axis_index('pp')``, so the redundant compute costs no wall-clock
+  SPMD every rank executes head/tail in lockstep and masks by the stage
+  id (a pp-sharded arange argument), so the redundant compute costs no
+  wall-clock
   (all ranks would be in that program region anyway) and ``jnp.where``
   keeps gradients exact.
 * **Tied embeddings (SharedLayerDesc)**: the shared layer's weight enters
@@ -44,12 +45,25 @@ restacked ``[P, ...]`` so a mid-training switch to the compiled engine keeps
 optimizer momentum.
 
 VPP chunks (num_chunks > 1) compile too: weights stack [C, P, ...] (dim 0 =
-virtual chunk) and the schedule runs chunk-SEQUENTIAL rings — each
-microbatch set circles the pp ring once per chunk, exits hopping from the
-last stage back to stage 0 via one extra ppermute. The reference's
-interleaved-1F1B ORDERING is a scheduling choice; here cross-chunk overlap
-is left to XLA's scheduler inside the single program, while the VPP
-memory/partition contract (per-device virtual stages) is kept exactly.
+virtual chunk). Two schedules exist:
+
+* **Interleaved-1F1B (default when legal)**: ONE scan whose stage-0 feed
+  alternates chunks in groups of P microbatches (Megatron's interleaved
+  order), reaching a (P-1)/C bubble. The tick body is BRANCH-FREE: the
+  active chunk's weights are selected from the stacked [C, P, ...] arrays
+  with ``lax.dynamic_index_in_dim`` — one fused program per tick, no
+  ``lax.switch`` over per-chunk branches (the r5 switch formulation paid
+  +43% steady-state per-microbatch time; see PROFILE_r05 §1 / r06 §1).
+  Requires ``num_micro % P == 0``. Chunk-program homogeneity is a hard
+  constructor invariant (every schedule path runs ONE body program per
+  tick); ``PADDLE_TPU_VPP_INTERLEAVED_IMPL=switch`` selects ``lax.switch``
+  weight selection instead of the gather, for A/B profiling of the
+  branch cost.
+* **Chunk-sequential rings**: each microbatch set circles the pp ring once
+  per chunk, exits hopping from the last stage back to stage 0 via one
+  extra ppermute; bubble ~(P-1) microbatch-times. Forced with
+  ``PADDLE_TPU_VPP_INTERLEAVED=0`` and used whenever the interleaved feed
+  cannot tile (``num_micro % P != 0``).
 """
 from __future__ import annotations
 
@@ -78,8 +92,19 @@ def pipeline_bubble_fraction(num_micro: int, num_stages: int) -> float:
 
 def _shard_map_pp(fn, mesh, in_specs, out_specs):
     """Manual over 'pp' only; every other mesh axis stays auto (GSPMD)."""
-    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                         axis_names={"pp"}, check_vma=False)
+    from ...shard_map_compat import shard_map_manual
+
+    return shard_map_manual(fn, mesh, in_specs, out_specs, {"pp"})
+
+
+def _pp_collectives_native(mesh) -> bool:
+    """Whether the ring collectives lower inside partial-manual shard_map
+    over 'pp' on this jax (see shard_map_compat.partial_manual_supported —
+    the constructor refuses unsupported meshes up front because the
+    failure mode is a fatal XLA abort, not an exception)."""
+    from ...shard_map_compat import partial_manual_supported
+
+    return partial_manual_supported(mesh, {"pp"})
 
 
 def _layer_sig(layer, ffunc):
@@ -381,7 +406,23 @@ class CompiledPipelineTrainStep:
     """loss + grads + optimizer update for the FULL microbatch pipeline
     schedule, compiled into one donated-buffer XLA program. Handles
     heterogeneous stages (embedding head / lm-head tail), SharedLayerDesc
-    tied weights, and optimizers with existing state / multiple groups."""
+    tied weights, and optimizers with existing state / multiple groups.
+
+    VPP schedule selection (r6): with ``num_chunks > 1`` the interleaved
+    ordering is chosen AUTOMATICALLY when ``num_micro % num_stages == 0``
+    (chunk-program homogeneity is a constructor invariant — every
+    schedule runs one body program per tick); its
+    tick body is branch-free — the active chunk's weights are gathered
+    from the stacked ``[C, P, ...]`` parameters with
+    ``lax.dynamic_index_in_dim`` instead of ``lax.switch`` over per-chunk
+    branches, which erased the r5 switch tick's +43% steady-state
+    per-microbatch tax (PROFILE_r06 §1). Chunk-sequential rings remain the
+    fallback (and can be forced with ``PADDLE_TPU_VPP_INTERLEAVED=0``);
+    ``PADDLE_TPU_VPP_INTERLEAVED_IMPL=switch`` selects ``lax.switch``
+    weight selection for A/B profiling of the branch cost. Optimizer
+    state restacks ``[C, P, ...]`` alongside the
+    weights and round-trips through :meth:`sync_to_model` unchanged under
+    either schedule."""
 
     def __init__(self, pipe, optimizer, num_micro: int, scaler=None, remat: bool = True):
         from ....jit.api import TrainStep
@@ -393,6 +434,16 @@ class CompiledPipelineTrainStep:
         if hcg is None or hcg.axis_size("pp") <= 1:
             raise ValueError("compiled pipeline needs an active mesh with pp > 1")
         self.mesh = mesh = hcg.mesh
+        if not _pp_collectives_native(mesh):
+            # on old jax the SPMD partitioner ABORTS the process (fatal
+            # check, not an exception) when the ring collectives' backward
+            # meets a real auto axis — refuse cleanly up front
+            raise NotImplementedError(
+                "compiled pipeline: this jax version cannot mix the manual "
+                "'pp' axis with size>1 auto mesh axes (dp/mp/sharding) — "
+                "XLA's SPMD partitioner aborts on the ring collectives' "
+                "backward. Use a pp-only mesh (dp=mp=sharding=1) or a jax "
+                "with top-level jax.shard_map (>=0.8).")
         self.num_micro = num_micro
         self.num_stages = P = model._num_stages
         # VPP: C virtual chunks per device, weights [C, P, ...]; the compiled
@@ -410,6 +461,22 @@ class CompiledPipelineTrainStep:
 
         head, body_segs, tail = _decompose(model)
         self._body_segs = body_segs
+        # chunk-program homogeneity: EVERY schedule path (branch-free
+        # gather, lax.switch, chunk-sequential rings) compiles body0's ONE
+        # program and varies only the weights, which is only sound when
+        # segment c*P + d runs the same program for every chunk c.
+        # _decompose's body check guarantees this today; re-checked as a
+        # hard error so a future relaxation of _decompose (e.g. per-chunk
+        # special layers) cannot silently mis-run chunks through any of
+        # the schedules — all of them would need extending first.
+        self._chunks_homogeneous = all(
+            body_segs[c * P + d].sig() == body_segs[d].sig()
+            for c in range(C) for d in range(P))
+        if not self._chunks_homogeneous:
+            raise ValueError(
+                "compiled pipeline: chunk programs differ across virtual "
+                "chunks; every schedule runs one body program per tick — "
+                "heterogeneous chunks are not supported")
         # head/tail params deduped — a SharedLayerDesc layer appearing in
         # both (tied embedding) enters the program exactly once
         aux, seen = [], set()
@@ -439,8 +506,11 @@ class CompiledPipelineTrainStep:
             PartitionSpec("pp") if C == 1 else PartitionSpec(None, "pp")
             for _ in range(n_stacked))
 
-        def local(stacked_vals, aux_vals, xs, ys):
-            stage = lax.axis_index("pp")
+        def local(stacked_vals, aux_vals, xs, ys, stage_ids):
+            # stage index arrives as a pp-sharded arange(P) argument — each
+            # device sees its own id — instead of lax.axis_index('pp'),
+            # which older jax cannot lower next to real auto axes
+            stage = stage_ids[0]
             head_vals = [aux_vals[k] for k in head_idx]
             tail_vals = [aux_vals[k] for k in tail_idx]
             M = xs.shape[0]
@@ -452,6 +522,11 @@ class CompiledPipelineTrainStep:
             body_fwd = (jax.checkpoint(body0.run) if remat else body0.run)
             ring_perm = [(i, (i + 1) % P) for i in range(P)]
 
+            def ring_shift(v):
+                """Advance v one hop around the pp ring (stage s receives
+                stage s-1's value)."""
+                return lax.ppermute(v, "pp", ring_perm)
+
             def run_chunk(p_chunk, xs_in, first_chunk):
                 def tick(h, t):
                     x_t = lax.dynamic_index_in_dim(xs_in, jnp.clip(t, 0, M - 1),
@@ -459,7 +534,7 @@ class CompiledPipelineTrainStep:
                     inp0 = run_head(x_t) if first_chunk else x_t
                     inp = jnp.where(stage == 0, inp0, h)
                     out = body_fwd(p_chunk, inp)
-                    return lax.ppermute(out, "pp", ring_perm), out
+                    return ring_shift(out), out
 
                 h_struct = jax.eval_shape(
                     run_head if first_chunk else (lambda v: v), xs_in[0])
@@ -470,15 +545,28 @@ class CompiledPipelineTrainStep:
 
             import os as _os
 
-            # OPT-IN (measured decision, PROFILE_r05.md §1): the explicit
-            # interleaved ordering reaches a 0.94-tick bubble (below even
-            # the 1.5 interleaved bound) but its per-tick lax.switch costs
-            # +43% steady-state per-microbatch time on the CPU mesh — a net
-            # loss at every measured M. Chunk-sequential stays the default.
-            want_interleave = _os.environ.get(
-                "PADDLE_TPU_VPP_INTERLEAVED") == "1"
-            interleave = want_interleave and C > 1 and M % P == 0
-            if want_interleave and not interleave:
+            # Schedule selection (r6): the interleaved-VPP ordering is
+            # AUTOMATIC whenever it is legal — VPP chunks and a feed that
+            # tiles exactly (M % P == 0); chunk-program homogeneity is
+            # already a constructor invariant.
+            # r5 shipped it opt-in because its per-tick lax.switch over
+            # chunk programs cost +43% steady-state per-microbatch time
+            # (PROFILE_r05 §1); the r6 tick instead gathers the active
+            # chunk's weights from the stacked [C, P, ...] arrays with
+            # lax.dynamic_index_in_dim — one fused, branch-free tick body
+            # (VERDICT r5 rec #8, measured in PROFILE_r06 §1).
+            # Env overrides:
+            #   PADDLE_TPU_VPP_INTERLEAVED=0  force chunk-sequential rings
+            #   PADDLE_TPU_VPP_INTERLEAVED=1  request interleaved (warns
+            #       when the schedule is illegal)
+            #   PADDLE_TPU_VPP_INTERLEAVED_IMPL=switch  select weights by
+            #       lax.switch instead of the gather (A/B isolating the
+            #       branch cost — NOT the full r5 tick: the pending-buffer
+            #       removal applies to both impls)
+            env_il = _os.environ.get("PADDLE_TPU_VPP_INTERLEAVED")
+            can_interleave = C > 1 and M % P == 0
+            interleave = can_interleave and env_il != "0"
+            if env_il == "1" and not can_interleave:
                 import warnings
 
                 warnings.warn(
@@ -486,6 +574,8 @@ class CompiledPipelineTrainStep:
                     f"chunks (C={C}) and num_micro divisible by pp stages "
                     f"(M={M}, P={P}); running chunk-sequential",
                     stacklevel=2)
+            use_indexed = (_os.environ.get(
+                "PADDLE_TPU_VPP_INTERLEAVED_IMPL", "indexed") != "switch")
             if interleave:
                 # ---- explicit interleaved-VPP ordering (r5, VERDICT item
                 # 5): ONE scan whose stage-0 feed alternates chunks in
@@ -510,42 +600,52 @@ class CompiledPipelineTrainStep:
                 m_arr = jnp.asarray(feed_m)
                 T_i = CM + P - 1
 
-                branches = [
-                    (lambda c: (lambda v: body_fwd(
-                        [a[c, 0] for a in stacked_vals], v)))(c)
-                    for c in range(C)
-                ]
+                if use_indexed:
+                    # branch-free body: gather the active chunk's weights
+                    # from the [C, 1, ...] local shards INSIDE the remat'd
+                    # function — the checkpoint then saves the
+                    # loop-invariant stacked arrays (no per-tick gathered
+                    # copies) and the backward recomputes the cheap gather
+                    def body_idx(stacked_local, c_idx, v):
+                        p_c = [lax.dynamic_index_in_dim(a, c_idx, 0,
+                                                        keepdims=False)[0]
+                               for a in stacked_local]
+                        return body0.run(p_c, v)
 
-                def itick(carry, t):
-                    h, pending = carry
-                    # exit of the item fed at t-P arrives on the ring; park
-                    # non-final chunks' exits as the next chunk's feed
-                    tp = t - P
-                    tpc = jnp.clip(tp, 0, CM - 1)
-                    ret_c = c_arr[tpc]
-                    ret_m = jnp.clip(m_arr[tpc], 0, M - 1)
-                    store = (tp >= 0) & (ret_c < C - 1)
-                    slot = lax.dynamic_index_in_dim(pending, ret_m, 0,
-                                                    keepdims=False)
-                    pending = lax.dynamic_update_index_in_dim(
-                        pending, jnp.where(store, h, slot), ret_m, 0)
+                    body_idx = jax.checkpoint(body_idx) if remat else body_idx
+                else:
+                    branches = [
+                        (lambda c: (lambda v: body_fwd(
+                            [a[c, 0] for a in stacked_vals], v)))(c)
+                        for c in range(C)
+                    ]
+
+                def itick(h, t):
                     # this stage's work item: the one stage 0 fed s ticks ago
                     ti = jnp.clip(t - stage, 0, CM - 1)
                     my_c = c_arr[ti]
                     my_m = jnp.clip(m_arr[ti], 0, M - 1)
                     x_t = lax.dynamic_index_in_dim(xs, my_m, 0,
                                                    keepdims=False)
-                    pend_m = lax.dynamic_index_in_dim(pending, my_m, 0,
-                                                      keepdims=False)
-                    inp0 = jnp.where(my_c == 0, run_head(x_t), pend_m)
+                    # the blocked feed is DENSE: (my_c, my_m)'s dependency
+                    # — chunk my_c-1's exit of the same microbatch — was
+                    # fed exactly P ticks earlier, so its last-stage output
+                    # rides the ring's (P-1)→0 wrap and IS the h arriving
+                    # at stage 0 THIS tick. No parking buffer is needed
+                    # (r6: the r5 formulation carried an [M, ...] pending
+                    # scatter/gather through the scan — pure overhead, and
+                    # a large share of its +43% steady-state tax).
+                    inp0 = jnp.where(my_c == 0, run_head(x_t), h)
                     inp = jnp.where(stage == 0, inp0, h)
-                    out = lax.switch(my_c, branches, inp)
-                    return (lax.ppermute(out, "pp", ring_perm), pending), out
+                    if use_indexed:
+                        out = body_idx(stacked_vals, my_c, inp)
+                    else:
+                        out = lax.switch(my_c, branches, inp)
+                    return ring_shift(out), out
 
                 h_struct = jax.eval_shape(run_head, xs[0])
                 h0 = jnp.zeros(h_struct.shape, h_struct.dtype)
-                pend0 = jnp.zeros((M, *h_struct.shape), h_struct.dtype)
-                _, outs = lax.scan(itick, (h0, pend0), jnp.arange(T_i))
+                _, outs = lax.scan(itick, h0, jnp.arange(T_i))
                 # final-chunk microbatch m finishes the last stage at
                 # t_fed(C-1, m) + P - 1
                 t_fed = np.zeros(M, np.int64)
@@ -564,7 +664,7 @@ class CompiledPipelineTrainStep:
                     if c < C - 1:
                         # exits live on the last stage; one ring hop delivers
                         # them to stage 0 as the next chunk's inputs
-                        xs_c = lax.ppermute(exit_outs, "pp", ring_perm)
+                        xs_c = ring_shift(exit_outs)
             # merge microbatches for the tail + loss: every rank computes in
             # SPMD lockstep; only the last stage's value survives the mask
             mb = exit_outs.shape[1]
@@ -589,9 +689,11 @@ class CompiledPipelineTrainStep:
                 fn = _shard_map_pp(
                     local, mesh,
                     in_specs=(stk_specs, (PartitionSpec(),) * n_aux,
-                              PartitionSpec(), PartitionSpec()),
+                              PartitionSpec(), PartitionSpec(),
+                              PartitionSpec("pp")),
                     out_specs=PartitionSpec())
-                return fn(stacked_vals, aux_vals, xs, ys)
+                stage_ids = jnp.arange(P, dtype=jnp.int32)
+                return fn(stacked_vals, aux_vals, xs, ys, stage_ids)
 
             return apply(f, x, y, *model_.parameters(), op_name="compiled_pipeline")
 

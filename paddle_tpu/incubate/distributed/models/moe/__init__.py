@@ -252,12 +252,14 @@ class MoELayer(nn.Layer):
             if fn is None:
                 tok_spec = PartitionSpec(axis, *([None] * (xv.ndim - 1)))
                 w_spec = lambda p: PartitionSpec(axis, *([None] * (p.ndim - 1)))  # noqa: E731
-                mapped = jax.shard_map(
-                    local, mesh=self._mesh,
+                from .....distributed.shard_map_compat import shard_map_manual
+
+                mapped = shard_map_manual(
+                    local, self._mesh,
                     in_specs=(tok_spec, tok_spec, w_spec(self.w1), w_spec(self.b1),
                               w_spec(self.w2), w_spec(self.b2)),
                     out_specs=(tok_spec, PartitionSpec()),
-                    axis_names={axis}, check_vma=False)
+                    manual_axes={axis})
                 # partial-manual shard_map needs a surrounding jit scope even
                 # for eager calls (auto axes resolve under the abstract mesh)
                 fn = jax.jit(mapped)
@@ -265,6 +267,18 @@ class MoELayer(nn.Layer):
             return fn(xv, gv, w1, b1, w2, b2)
 
         if self._ep_size > 1 and self.expert_axis == "ep":
+            from .....distributed.shard_map_compat import (
+                partial_manual_supported,
+            )
+
+            if not partial_manual_supported(self._mesh, {self.expert_axis}):
+                # old jax fatally aborts XLA on partial-manual all_to_all
+                # next to a size>1 auto axis — refuse cleanly instead
+                raise NotImplementedError(
+                    "expert-parallel MoE: this jax version cannot mix the "
+                    "manual 'ep' axis with size>1 auto mesh axes — use an "
+                    "ep-only mesh or a jax with top-level jax.shard_map "
+                    "(>=0.8)")
             impl = f_ep
         else:
             impl = f_ragged if mode == "ragged" else f

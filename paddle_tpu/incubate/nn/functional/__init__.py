@@ -143,6 +143,19 @@ def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train", name=
     return F.dropout(x, p, training=training, mode=mode) + to_tensor_like(y)
 
 
+def _tp_group_active() -> bool:
+    """True when a size>1 tensor-parallel (mp) group exists — the only
+    case where the reference's ring_id >= 0 all-reduce changes results
+    (over a 1-rank group it is the identity, so skipping it is exact)."""
+    try:
+        from ....distributed.topology import get_hybrid_communicate_group
+
+        hcg = get_hybrid_communicate_group()
+        return hcg is not None and hcg.axis_size("mp") > 1
+    except Exception:
+        return False
+
+
 def fused_multi_head_attention(x, qkv_weight, linear_weight,
                                pre_layer_norm=False, pre_ln_scale=None,
                                pre_ln_bias=None, ln_scale=None, ln_bias=None,
@@ -159,6 +172,17 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
     output projection -> dropout(+residual) [-> post-LN]. With ``cache_kv``
     [2, B, H, S, D], this step's K/V are appended (generation decode).
     One XLA fusion chain on TPU (the reference fuses it into one kernel)."""
+    if ring_id is not None and ring_id >= 0 and _tp_group_active():
+        # the reference runs a tensor-parallel all-reduce after the output
+        # projection for ring_id >= 0; silently skipping it would return
+        # partial sums on a TP mesh (with no mp group, or mp=1, skipping
+        # IS the reference semantics — an all-reduce over one rank)
+        raise NotImplementedError(
+            "fused_multi_head_attention: ring_id >= 0 with an active "
+            "tensor-parallel group (mp > 1) is not implemented — the "
+            "reference all-reduces the output projection over the TP "
+            "ring; use the distributed.fleet TP layers, or pass "
+            "ring_id=-1 for the single-group path")
     x = to_tensor_like(x)
     qkvw = to_tensor_like(qkv_weight)
     B, S, E = x.shape
@@ -366,6 +390,14 @@ def fused_multi_transformer(
     ``block_multihead_attention`` path). Returns out, or (out, cache_kvs)
     in-place-updated when caches are passed.
     """
+    if ring_id is not None and ring_id >= 0 and _tp_group_active():
+        # same contract as fused_multi_head_attention: the reference
+        # all-reduces the out-projection and ffn2 outputs over the TP ring
+        raise NotImplementedError(
+            "fused_multi_transformer: ring_id >= 0 with an active "
+            "tensor-parallel group (mp > 1) is not implemented — use the "
+            "distributed.fleet TP layers, or pass ring_id=-1 for the "
+            "single-group path")
     if gqa_group_size > 0:
         raise NotImplementedError(
             "fused_multi_transformer: use block_multihead_attention / the "
@@ -847,6 +879,15 @@ def block_multihead_attention(
     Supports MHA/GQA, mixed prefill+decode batches, in-kernel rope,
     pre-caches, int8 cache quant (static + dynamic), int32-qkv dequant and
     int8 output quant.
+
+    Compilation note: the padded-query length is a HOST-side read of
+    ``max(seq_lens_this_time)``, bucketed to the next power of two — one
+    XLA program per distinct bucket (a serving loop therefore compiles at
+    most log2(max_seq_len) programs: mq=1 pure decode, plus one per
+    prefill-chunk bucket). Because of that host read this op must be
+    called eagerly; under jit/to_static tracing ``seq_lens_this_time`` has
+    no concrete value and the call raises — use ``ServingEngine``, which
+    pins a static max_q_len per program, to serve from compiled code.
     """
     import numpy as _np
 
@@ -865,7 +906,15 @@ def block_multihead_attention(
     def val(x):
         return None if x is None else to_tensor_like(x)._value
 
-    lens_now = _np.asarray(val(seq_lens_this_time)).reshape(-1)
+    lens_val = val(seq_lens_this_time)
+    if isinstance(lens_val, jax.core.Tracer):
+        raise ValueError(
+            "block_multihead_attention reads max(seq_lens_this_time) on the "
+            "HOST to pick the padded-query bucket, so it cannot be traced "
+            "under jit/to_static — call it eagerly, or serve through "
+            "ServingEngine which compiles per-bucket programs with a static "
+            "max_q_len")
+    lens_now = _np.asarray(lens_val).reshape(-1)
     max_q_len = int(lens_now.max()) if lens_now.size else 1
     # bucket the static padded-query length to the next power of two: a
     # serving loop with naturally varying chunk lengths otherwise compiles

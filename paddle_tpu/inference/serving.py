@@ -377,7 +377,13 @@ class ServingEngine:
         return emitted
 
     def run(self, max_steps: int = 10_000) -> Dict[int, List[int]]:
-        """Drive until every queued/active request retires."""
+        """Drive until every queued/active request retires.
+
+        Raises ``RuntimeError`` when ``max_steps`` is exhausted with
+        requests still queued or active — a truncated run must not be
+        mistaken for completion (the returned dict would silently miss
+        the unfinished requests' tokens).
+        """
         for _ in range(max_steps):
             if not self._queue and not self._active:
                 break
@@ -396,6 +402,12 @@ class ServingEngine:
                     f"pool only has {self.blocks.num_blocks} total "
                     f"({self.blocks.num_free} free with nothing running) — "
                     "raise num_blocks/max_seq_len or shrink the request")
+        if self._queue or self._active:
+            raise RuntimeError(
+                f"ServingEngine.run: max_steps={max_steps} exhausted with "
+                f"{len(self._active)} active and {len(self._queue)} queued "
+                "request(s) unfinished — raise max_steps (or drain with "
+                "step() and read partial results from the request objects)")
         return dict(self._finished)
 
     @property

@@ -36,6 +36,15 @@ from .pallas.flash_attention import block_bwd, block_fwd
 NEG_INF = -1e30
 
 
+def _axis_size(axis_name) -> int:
+    """lax.axis_size is absent before jax 0.5; inside a bound axis context
+    old jax exposes the static size through jax.core.axis_frame."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    frame = jax.core.axis_frame(axis_name)
+    return frame if isinstance(frame, int) else frame.size
+
+
 # ------------------------------------------------------------ per-block body
 def _block_fwd(qb, kb, vb, causal, scale, kv_rep, interpret):
     """qb [BH, Sl, D], kb/vb [BHk, Sl, D] → (o f32 [BH,Sl,D], lse f32 [BH,Sl])."""
@@ -66,7 +75,7 @@ def _ring_local(q, k, v, axis_name, causal, scale, kv_rep, interpret):
 
 def _ring_local_fwd(q, k, v, axis_name, causal, scale, kv_rep, interpret):
     """q [B,Sl,H,D], k/v [B,Sl,Hk,D] local shards (inside shard_map)."""
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     B, Sl, H, D = q.shape
     Hk = k.shape[2]
@@ -111,7 +120,7 @@ def _ring_local_fwd(q, k, v, axis_name, causal, scale, kv_rep, interpret):
 
 def _ring_local_bwd(axis_name, causal, scale, kv_rep, interpret, res, g):
     q, k, v, acc, lse = res
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     B, Sl, H, D = q.shape
     Hk = k.shape[2]

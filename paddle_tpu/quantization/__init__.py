@@ -304,6 +304,13 @@ def weight_quantize(w, algo="weight_only_int8", group_size=-1):
         q = jnp.asarray(np.clip(wv / scale, -_FP8_MAX, _FP8_MAX),
                         jnp.float8_e4m3fn)
         return Tensor(q), Tensor(jnp.asarray(scale.astype(np.float32)))
+    if algo not in ("weight_only_int8", "int8"):
+        # an unknown algo must not silently produce int8 output labelled as
+        # something else (e.g. 'weight_only_int4' mislabelling the storage)
+        raise ValueError(
+            f"weight_quantize: unrecognized algo {algo!r}; supported: "
+            "'weight_only_int8'/'int8', "
+            "'weight_only_fp8'/'fp8'/'float8_e4m3fn'")
     scale = np.maximum(np.abs(wv).max(axis=0), 1e-9) / 127.0
     q = np.clip(np.round(wv / scale), -128, 127).astype(np.int8)
     return Tensor(jnp.asarray(q)), Tensor(jnp.asarray(scale.astype(np.float32)))

@@ -22,6 +22,29 @@ def make_model(E=32, H=4, FF=64, L=2, seed=0, norm_type="layernorm"):
 
 
 class TestFusedMultiTransformer:
+    def test_ring_id_raises_not_silently_skips(self):
+        """ADVICE r5 low #2: ring_id >= 0 with an ACTIVE TP group (mp > 1,
+        where the reference all-reduces out-proj/ffn2) must raise instead
+        of silently returning partial sums."""
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.topology import (
+            set_hybrid_communicate_group,
+        )
+        from paddle_tpu.incubate.nn.functional import fused_multi_transformer
+
+        s = dist.fleet.DistributedStrategy()
+        s.hybrid_configs = {"dp_degree": 1, "mp_degree": 2, "pp_degree": 1,
+                            "sharding_degree": 1, "sep_degree": 1}
+        dist.fleet.init(is_collective=True, strategy=s)
+        try:
+            with pytest.raises(NotImplementedError, match="ring_id"):
+                fused_multi_transformer(
+                    P.to_tensor(np.zeros((1, 2, 8), np.float32)),
+                    [], [], [], [], [], [], [], [], [], [], [], [],
+                    ring_id=0)
+        finally:
+            set_hybrid_communicate_group(None)
+
     def test_prefill_writes_cache_inplace(self):
         B, S, E, H, D, Smax = 2, 5, 32, 4, 8, 16
         m = make_model(E, H)

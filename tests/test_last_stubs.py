@@ -48,6 +48,44 @@ class TestFusedMHAFunctional:
         np.testing.assert_allclose(np.asarray(out.numpy()), ref,
                                    rtol=2e-4, atol=2e-4)
 
+    def test_ring_id_raises_not_silently_skips(self):
+        """ADVICE r5 low #2: with an ACTIVE TP group (mp > 1), ring_id >= 0
+        means the reference runs a TP all-reduce after the output
+        projection; returning partial sums silently would be wrong — it
+        must raise. With no TP group (the common ported-code pattern
+        nranks=1, ring_id=0) the all-reduce is the identity and the call
+        must still work."""
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.topology import (
+            set_hybrid_communicate_group,
+        )
+        from paddle_tpu.incubate.nn.functional import fused_multi_head_attention
+
+        E, H, B, S = 16, 4, 2, 5
+        qkvw, _, lw, _ = self._weights(E, H)
+        x = np.zeros((B, S, E), np.float32)
+        ones = np.ones(E, np.float32)
+        zeros = np.zeros(E, np.float32)
+        # no TP group: ring_id=0 is a 1-rank group — identity, no raise
+        set_hybrid_communicate_group(None)
+        out = fused_multi_head_attention(
+            P.to_tensor(x), P.to_tensor(qkvw), P.to_tensor(lw),
+            ln_scale=P.to_tensor(ones), ln_bias=P.to_tensor(zeros),
+            dropout_rate=0.0, attn_dropout_rate=0.0, training=False,
+            ring_id=0)
+        assert np.isfinite(np.asarray(out.numpy())).all()
+        # active mp=2 group: skipping the all-reduce would be wrong
+        s = dist.fleet.DistributedStrategy()
+        s.hybrid_configs = {"dp_degree": 1, "mp_degree": 2, "pp_degree": 1,
+                            "sharding_degree": 1, "sep_degree": 1}
+        dist.fleet.init(is_collective=True, strategy=s)
+        try:
+            with pytest.raises(NotImplementedError, match="ring_id"):
+                fused_multi_head_attention(P.to_tensor(x), P.to_tensor(qkvw),
+                                           P.to_tensor(lw), ring_id=0)
+        finally:
+            set_hybrid_communicate_group(None)
+
     def test_cache_decode_incremental(self):
         """Layer-level cache decode equals the full-sequence forward at the
         appended position (post-LN self-attn block)."""

@@ -62,6 +62,10 @@ class TestMultiProcess:
         np.testing.assert_allclose(res[0]["losses_resume"],
                                    res[0]["losses_b"], rtol=2e-4)
 
+    @pytest.mark.skipif(
+        not hasattr(__import__("jax"), "shard_map"),
+        reason="compiled pipeline with size>1 auto axes (mp=4 here) needs "
+               "jax.shard_map (>=0.8); old jax aborts the SPMD partitioner")
     def test_two_process_compiled_pipeline_across_hosts(self, tmp_path):
         _launch(tmp_path, "pp")
         res = [json.load(open(tmp_path / f"pp_result_{r}.json"))

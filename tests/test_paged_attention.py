@@ -364,3 +364,32 @@ class TestBlhaGetMaxLen:
         me, md = blha_get_max_len(enc, dec, P.to_tensor(np.array([3])))
         assert int(np.asarray(me.numpy())[0]) == 9
         assert int(np.asarray(md.numpy())[0]) == 5
+
+
+class TestTracedSeqLens:
+    def test_traced_seq_lens_raises_clear_error(self):
+        """ADVICE r5 low #3: the padded-query bucket is a HOST-side read of
+        max(seq_lens_this_time); under jit tracing there is no concrete
+        value, so the op must raise a clear error instead of crashing deep
+        in numpy."""
+        import jax
+        import jax.numpy as jnp
+
+        B, H, KV, D, bs = 2, 4, 4, 8, 8
+        qkv = np.zeros((B, (H + 2 * KV) * D), np.float32)
+        kc = np.zeros((4, KV, bs, D), np.float32)
+        vc = np.zeros_like(kc)
+        bt = np.zeros((B, 2), np.int32)
+        cu = np.zeros((B + 1,), np.int32)
+        zeros = np.zeros(B, np.int32)
+
+        def f(lens):
+            out = block_multihead_attention(
+                P.to_tensor(qkv), P.to_tensor(kc), P.to_tensor(vc),
+                P.to_tensor(zeros), P.to_tensor(zeros), lens,
+                None, None, P.to_tensor(cu), P.to_tensor(cu),
+                P.to_tensor(bt), block_size=bs)
+            return out[0]._value
+
+        with pytest.raises(ValueError, match="eagerly|ServingEngine"):
+            jax.jit(f)(jnp.ones((B,), jnp.int32))

@@ -373,7 +373,17 @@ class TestMoERagged:
 class TestMoEExpertParallel:
     """VERDICT r3 item 7: dedicated ep mesh axis, ragged dispatch through a
     REAL lax.all_to_all across devices, capacity-drop parity vs the
-    single-device path."""
+    single-device path.
+
+    Old jax (no top-level jax.shard_map) aborts XLA on partial-manual
+    shard_map next to a size>1 auto axis (dp here), so on that image the
+    tests use an ep-ONLY mesh (ep=8, dp=1) — same all_to_all path, no auto
+    axes; the one test that requires ep=2 (dp=4) is skipped there."""
+
+    def _ep_degree(self, want):
+        import jax
+
+        return want if hasattr(jax, "shard_map") else 8
 
     def _init_ep(self, ep):
         from paddle_tpu.distributed.topology import set_hybrid_communicate_group
@@ -402,10 +412,11 @@ class TestMoEExpertParallel:
 
         from paddle_tpu.incubate.distributed.models.moe import MoELayer
 
-        self._init_ep(4)
+        ep = self._ep_degree(4)
+        self._init_ep(ep)
         P.seed(0)
         moe = MoELayer(16, 32, num_experts=8, top_k=2, capacity_factor=2.0)
-        assert moe.expert_axis == "ep" and moe._ep_size == 4
+        assert moe.expert_axis == "ep" and moe._ep_size == ep
         x = P.randn([8, 4, 16])
 
         def fn(xv):
@@ -422,7 +433,7 @@ class TestMoEExpertParallel:
         reproduce the single-device ragged output exactly."""
         from paddle_tpu.incubate.distributed.models.moe import MoELayer
 
-        self._init_ep(4)
+        self._init_ep(self._ep_degree(4))
         P.seed(5)
         ep_moe = MoELayer(16, 32, num_experts=8, top_k=2, capacity_factor=8.0)
         x = P.randn([8, 4, 16])
@@ -442,6 +453,10 @@ class TestMoEExpertParallel:
         # shards are balanced only approximately — check close
         assert np.isfinite(aux_ep)
 
+    @pytest.mark.skipif(
+        not hasattr(__import__("jax"), "shard_map"),
+        reason="needs ep=2 over a dp=4 auto axis; old jax aborts XLA on "
+               "partial-manual shard_map with size>1 auto axes")
     def test_ep_capacity_drops_per_source_rank(self):
         """Oversubscribing one expert from every rank forces drops at the
         per-(expert, source-rank) capacity, like the reference's per-worker
@@ -466,7 +481,7 @@ class TestMoEExpertParallel:
     def test_ep_trains(self):
         from paddle_tpu.incubate.distributed.models.moe import MoELayer
 
-        self._init_ep(4)
+        self._init_ep(self._ep_degree(4))
         P.seed(9)
         moe = MoELayer(16, 32, num_experts=8, top_k=2, capacity_factor=2.0)
         x = P.randn([8, 4, 16])

@@ -1,0 +1,137 @@
+"""tools/perf_gate.py gates EVERY ladder rung, not just the headline
+(ISSUE r6 acceptance: an injected rung regression must fail the gate)."""
+import importlib.util
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+spec = importlib.util.spec_from_file_location(
+    "perf_gate", os.path.join(REPO, "tools", "perf_gate.py"))
+perf_gate = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(perf_gate)
+
+
+def _write(tmp_path, name, data):
+    (tmp_path / name).write_text(json.dumps(data))
+
+
+def _seed_rounds(tmp_path, cur_rungs, prev_rungs=None):
+    os.makedirs(tmp_path / "tools", exist_ok=True)
+    _write(tmp_path, "tools/ladder_tolerances.json",
+           {"default": 0.10, "rungs": {"latency_step_ms": 0.05}})
+    # headline series (stable)
+    _write(tmp_path, "BENCH_r01.json",
+           {"vs_baseline": 1.20, "extra": {"workload": "w"}})
+    _write(tmp_path, "BENCH_r02.json",
+           {"vs_baseline": 1.21, "extra": {"workload": "w"}})
+    # r1 ladder uses the bare-list schema, r2 the {"rungs": ...} schema —
+    # both recorded formats must load
+    _write(tmp_path, "BENCH_LADDER_r01.json", prev_rungs if prev_rungs
+           is not None else [
+               {"metric": "train_tokens_per_sec", "value": 1000.0,
+                "unit": "tokens/s"},
+               {"metric": "latency_step_ms", "value": 50.0,
+                "unit": "ms/step"},
+           ])
+    _write(tmp_path, "BENCH_LADDER_r02.json", {"round": 2,
+                                               "rungs": cur_rungs})
+
+
+class TestLadderGate:
+    def test_passes_within_tolerance(self, tmp_path):
+        _seed_rounds(tmp_path, [
+            {"metric": "train_tokens_per_sec", "value": 950.0,
+             "unit": "tokens/s"},              # -5% within 10%
+            {"metric": "latency_step_ms", "value": 51.0,
+             "unit": "ms/step"},               # +2% within 5%
+        ])
+        assert perf_gate.main(["--root", str(tmp_path)]) == 0
+
+    def test_fails_on_injected_throughput_regression(self, tmp_path):
+        _seed_rounds(tmp_path, [
+            {"metric": "train_tokens_per_sec", "value": 800.0,
+             "unit": "tokens/s"},              # -20% > 10% tolerance
+            {"metric": "latency_step_ms", "value": 50.0, "unit": "ms/step"},
+        ])
+        assert perf_gate.main(["--root", str(tmp_path)]) == 1
+
+    def test_fails_on_injected_latency_regression(self, tmp_path):
+        """ms-unit rungs gate in the LOWER-is-better direction with their
+        recorded per-rung tolerance (5% here, not the 10% default)."""
+        _seed_rounds(tmp_path, [
+            {"metric": "train_tokens_per_sec", "value": 1000.0,
+             "unit": "tokens/s"},
+            {"metric": "latency_step_ms", "value": 54.0,
+             "unit": "ms/step"},               # +8% > 5% rung tolerance
+        ])
+        assert perf_gate.main(["--root", str(tmp_path)]) == 1
+
+    def test_improvement_never_fails(self, tmp_path):
+        _seed_rounds(tmp_path, [
+            {"metric": "train_tokens_per_sec", "value": 2000.0,
+             "unit": "tokens/s"},
+            {"metric": "latency_step_ms", "value": 25.0, "unit": "ms/step"},
+        ])
+        assert perf_gate.main(["--root", str(tmp_path)]) == 0
+
+    def test_vanished_rung_fails(self, tmp_path):
+        _seed_rounds(tmp_path, [
+            {"metric": "train_tokens_per_sec", "value": 1000.0,
+             "unit": "tokens/s"},
+        ])
+        assert perf_gate.main(["--root", str(tmp_path)]) == 1
+
+    def test_new_rung_passes_as_baseline(self, tmp_path):
+        _seed_rounds(tmp_path, [
+            {"metric": "train_tokens_per_sec", "value": 1000.0,
+             "unit": "tokens/s"},
+            {"metric": "latency_step_ms", "value": 50.0, "unit": "ms/step"},
+            {"metric": "brand_new_rung", "value": 1.0, "unit": "x"},
+        ])
+        assert perf_gate.main(["--root", str(tmp_path)]) == 0
+
+    def test_config_drift_rebaselines_instead_of_comparing(self, tmp_path):
+        """A rung whose measurement config changed (e.g. the pipeline
+        rung's mesh degrading on an old-jax image) must not be compared
+        numerically — it re-baselines loudly instead of spuriously
+        failing (or masking a real regression)."""
+        _seed_rounds(tmp_path, [
+            {"metric": "train_tokens_per_sec", "value": 200.0,
+             "unit": "tokens/s", "extra": {"mesh": "dp1.mp1.pp2"}},
+            {"metric": "latency_step_ms", "value": 50.0, "unit": "ms/step"},
+        ], prev_rungs=[
+            {"metric": "train_tokens_per_sec", "value": 1000.0,
+             "unit": "tokens/s", "extra": {"mesh": "dp2.mp2.pp2"}},
+            {"metric": "latency_step_ms", "value": 50.0, "unit": "ms/step"},
+        ])
+        assert perf_gate.main(["--root", str(tmp_path)]) == 0
+
+    def test_recorded_direction_overrides_unit_heuristic(self, tmp_path):
+        """A rung tolerance entry may record lower_is_better explicitly
+        (e.g. a peak-memory rung in 'MB'), beating the ms-unit guess."""
+        _seed_rounds(tmp_path, [
+            {"metric": "train_tokens_per_sec", "value": 1000.0,
+             "unit": "tokens/s"},
+            {"metric": "latency_step_ms", "value": 50.0, "unit": "ms/step"},
+            {"metric": "peak_hbm_mb", "value": 1400.0, "unit": "MB"},
+        ], prev_rungs=[
+            {"metric": "train_tokens_per_sec", "value": 1000.0,
+             "unit": "tokens/s"},
+            {"metric": "latency_step_ms", "value": 50.0, "unit": "ms/step"},
+            {"metric": "peak_hbm_mb", "value": 1000.0, "unit": "MB"},
+        ])
+        (tmp_path / "tools" / "ladder_tolerances.json").write_text(json.dumps({
+            "default": 0.10,
+            "rungs": {"peak_hbm_mb": {"tolerance": 0.10,
+                                      "lower_is_better": True}},
+        }))
+        # +40% memory would PASS under the higher-is-better guess; the
+        # recorded direction makes it fail
+        assert perf_gate.main(["--root", str(tmp_path)]) == 1
+
+    def test_real_recorded_rounds_pass(self):
+        """The gate must hold on the repo's own recorded history."""
+        assert perf_gate.main(["--root", REPO]) == 0

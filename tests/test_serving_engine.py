@@ -57,6 +57,18 @@ class TestServingEngine:
             # step (mq=T) and the tight pure-decode step (mq=1)
             assert eng._step_fn._cache_size() <= 2
 
+    def test_run_raises_on_max_steps_exhaustion(self, model):
+        """ADVICE r5 low #1: a truncated run (max_steps hit with work still
+        queued/active) must raise, not return a dict missing tokens."""
+        eng = ServingEngine(model, max_batch_size=2, max_seq_len=64,
+                            block_size=8, token_budget=16)
+        eng.add_request([3, 17, 101], max_new_tokens=8)
+        with pytest.raises(RuntimeError, match="max_steps"):
+            eng.run(max_steps=2)
+        # draining the remaining steps finishes normally
+        out = eng.run()
+        assert len(next(iter(out.values()))) == 8
+
     def test_eviction_recycles_blocks_for_queued_requests(self, model):
         """More requests than slots/blocks: later requests wait, get admitted
         as earlier ones retire, and still decode correctly."""

@@ -19,6 +19,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SAVER = textwrap.dedent("""
     import json, os, sys
     sys.path.insert(0, os.environ["PADDLE_TPU_REPO"])
+    # pin CPU like every other spawned worker: a wedged TPU tunnel must not
+    # hang the suite (the env var alone loses to sitecustomize's config)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
     import numpy as np
     import paddle_tpu as P
     from paddle_tpu import nn
@@ -51,6 +56,9 @@ SAVER = textwrap.dedent("""
 SERVER = textwrap.dedent("""
     import os, sys
     sys.path.insert(0, os.environ["PADDLE_TPU_REPO"])
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
     import numpy as np
     from paddle_tpu.inference import Config, PredictorPool, create_predictor
 

@@ -125,6 +125,29 @@ class TestStepCostModel:
         big.n_params = 1e10
         assert big.estimate(dp8) > m.estimate(dp8)
 
+    def test_interleaved_vpp_bubble_term(self):
+        """r6: the bubble term knows the interleaved-VPP schedule — with C
+        chunks and M % P == 0 (when the compiled engine auto-selects
+        interleaving) the bubble is (P-1)/C, not (P-1)."""
+        m = self._model()
+        m.gb = 8  # M = 8 microbatches at mbs=1, dp=sh=1
+        pp8 = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 8,
+               "sharding_degree": 1, "micro_batch_size": 1}
+        base = m.estimate(pp8)
+        il = m.estimate(dict(pp8, vpp_degree=2))
+        # exact bubble ratio on the pure-compute config:
+        # (M + (P-1)/C) / (M + P-1)
+        assert il < base
+        assert il / base == pytest.approx((8 + 7 / 2) / (8 + 7), rel=1e-9)
+        # M % P != 0 -> interleaved feed cannot tile; no discount
+        m2 = self._model()
+        m2.gb = 12  # 12 % 8 != 0
+        assert (m2.estimate(dict(pp8, vpp_degree=2))
+                == m2.estimate(pp8))
+        # deeper chunking shrinks the bubble further
+        assert (m.estimate(dict(pp8, vpp_degree=4))
+                < m.estimate(dict(pp8, vpp_degree=2)))
+
     def test_cost_model_search_order_and_prune(self):
         from paddle_tpu.distributed.auto_tuner import AutoTuner
 

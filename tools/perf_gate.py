@@ -3,8 +3,21 @@
 check_op_benchmark_result.py — CI fails when a benchmark regresses vs the
 recorded baseline).
 
-Compares the newest BENCH_r*.json against the previous round's; fails when
-the headline `vs_baseline` ratio drops more than --tolerance (default 10%).
+Two checks, both against the PREVIOUS round's recordings:
+
+1. Headline: the newest BENCH_r*.json's ``vs_baseline`` ratio must not drop
+   more than --tolerance (default 10%), and the pinned workload must not
+   drift (VERDICT r4 item 3).
+2. Ladder (r6, ISSUE #1): EVERY rung of the newest BENCH_LADDER_r*.json is
+   compared against the same rung in the previous round within the
+   per-rung tolerance recorded in tools/ladder_tolerances.json. Direction
+   comes from the unit (``ms``-like units: lower is better; throughput
+   units: higher is better). A rung that VANISHES from the latest round
+   fails (a deleted rung could hide a regression); a new rung passes with
+   a note. This is what keeps schedule wins (e.g. the r6 branch-free
+   interleaved pipeline) and slow drifts (the ~4-7% BERT creep flagged in
+   r5) from silently decaying.
+
 Run with no arguments from the repo root.
 """
 from __future__ import annotations
@@ -15,6 +28,16 @@ import json
 import os
 import re
 import sys
+from typing import Dict, List, Optional, Tuple
+
+
+def _load_json(path: str):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"perf-gate: skipping unreadable {path}: {e}")
+        return None
 
 
 def load_rounds(root: str):
@@ -23,11 +46,8 @@ def load_rounds(root: str):
         m = re.search(r"BENCH_r(\d+)\.json$", path)
         if not m:
             continue
-        try:
-            with open(path) as f:
-                data = json.load(f)
-        except (OSError, json.JSONDecodeError) as e:
-            print(f"perf-gate: skipping unreadable {path}: {e}")
+        data = _load_json(path)
+        if data is None:
             continue
         # driver schema: the bench line lives under "parsed"
         if isinstance(data, dict) and "parsed" in data:
@@ -37,17 +57,63 @@ def load_rounds(root: str):
     return sorted(out)
 
 
-def main() -> int:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--tolerance", type=float, default=0.10,
-                    help="allowed fractional drop in vs_baseline")
-    ap.add_argument("--root", default=os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-    args = ap.parse_args()
+def load_ladders(root: str) -> List[Tuple[int, str, List[Dict]]]:
+    """-> sorted [(round, path, rungs)]. Handles both recorded schemas:
+    r3/r4 store a bare list of rungs, r5+ an object with a 'rungs' key."""
+    out = []
+    for path in glob.glob(os.path.join(root, "BENCH_LADDER_r*.json")):
+        m = re.search(r"BENCH_LADDER_r(\d+)\.json$", path)
+        if not m:
+            continue
+        data = _load_json(path)
+        if data is None:
+            continue
+        rungs = data.get("rungs") if isinstance(data, dict) else data
+        if not isinstance(rungs, list):
+            continue
+        rungs = [r for r in rungs
+                 if isinstance(r, dict) and "metric" in r and "value" in r]
+        if rungs:
+            out.append((int(m.group(1)), path, rungs))
+    return sorted(out)
 
-    rounds = load_rounds(args.root)
+
+def load_tolerances(root: str) -> Dict:
+    path = os.path.join(root, "tools", "ladder_tolerances.json")
+    data = _load_json(path) if os.path.exists(path) else None
+    if not isinstance(data, dict):
+        data = {}
+    return {"default": float(data.get("default", 0.10)),
+            "rungs": dict(data.get("rungs", {}))}
+
+
+def lower_is_better(rung: Dict) -> bool:
+    unit = str(rung.get("unit", ""))
+    return unit.startswith("ms") or unit.endswith("ms") or \
+        str(rung.get("metric", "")).endswith("_ms")
+
+
+# extra.* keys that define a rung's measurement CONFIG (not its outcome) —
+# when one of these changes between rounds the values are not comparable
+# and the rung re-baselines (loudly) instead of being gated numerically
+IDENTITY_KEYS = ("workload", "mesh", "backend", "batch", "seq", "img",
+                 "prompt", "new_tokens", "ring", "block_size", "ctx_lengths",
+                 "num_micro")
+
+
+def config_drift(prev: Dict, cur: Dict) -> List[str]:
+    pe, ce = prev.get("extra") or {}, cur.get("extra") or {}
+    # a key present in only ONE round is also drift: silently dropping
+    # (or adding) e.g. 'mesh' must not let values measured on different
+    # configs be compared as if identical
+    return [k for k in IDENTITY_KEYS
+            if (k in pe or k in ce) and pe.get(k) != ce.get(k)]
+
+
+def check_headline(rounds, tolerance: float) -> int:
     if len(rounds) < 2:
-        print(f"perf-gate: {len(rounds)} recorded round(s); nothing to compare — pass")
+        print(f"perf-gate: {len(rounds)} recorded headline round(s); "
+              "nothing to compare — pass")
         return 0
     (pn, ppath, prev), (cn, cpath, cur) = rounds[-2], rounds[-1]
     pw = (prev.get("extra") or {}).get("workload")
@@ -62,14 +128,99 @@ def main() -> int:
         return 1
     pv, cv = prev["vs_baseline"], cur["vs_baseline"]
     drop = (pv - cv) / pv if pv > 0 else 0.0
-    print(f"perf-gate: r{pn} {pv:.4f} -> r{cn} {cv:.4f} "
+    print(f"perf-gate: headline r{pn} {pv:.4f} -> r{cn} {cv:.4f} "
           f"({'-' if drop > 0 else '+'}{abs(drop) * 100:.1f}%)")
-    if drop > args.tolerance:
+    if drop > tolerance:
         print(f"perf-gate: FAIL — vs_baseline regressed more than "
-              f"{args.tolerance * 100:.0f}% ({ppath} -> {cpath})")
+              f"{tolerance * 100:.0f}% ({ppath} -> {cpath})")
         return 1
-    print("perf-gate: pass")
     return 0
+
+
+def check_ladder(ladders, tolerances: Dict) -> int:
+    if len(ladders) < 2:
+        print(f"perf-gate: {len(ladders)} recorded ladder round(s); "
+              "nothing to compare — pass")
+        return 0
+    (pn, ppath, prev), (cn, cpath, cur) = ladders[-2], ladders[-1]
+    prev_by = {r["metric"]: r for r in prev}
+    cur_by = {r["metric"]: r for r in cur}
+    rc = 0
+    for metric, pr in prev_by.items():
+        entry = tolerances["rungs"].get(metric)
+        if isinstance(entry, dict):
+            # recorded form: {"tolerance": x, "lower_is_better": bool} —
+            # an explicit direction beats the unit heuristic (which only
+            # knows ms-like units)
+            tol = float(entry.get("tolerance", tolerances["default"]))
+            lower = entry.get("lower_is_better")
+        else:
+            tol = float(entry if entry is not None
+                        else tolerances["default"])
+            lower = None
+        cr = cur_by.get(metric)
+        if cr is None:
+            print(f"perf-gate: FAIL — ladder rung '{metric}' present in "
+                  f"r{pn} ({ppath}) but missing from r{cn} ({cpath}); a "
+                  "vanished rung can hide a regression — re-measure it or "
+                  "consciously retire it from BOTH rounds")
+            rc = 1
+            continue
+        drifted = config_drift(pr, cr)
+        if drifted:
+            # forced config changes (e.g. the pp rung's mesh degrading on
+            # an old-jax image) make the numbers incomparable: re-baseline
+            # LOUDLY rather than fail forever or compare garbage — a
+            # vanished rung still fails, so this cannot silently hide one
+            pe, ce = pr.get("extra") or {}, cr.get("extra") or {}
+            changes = ", ".join(f"{k}: {pe[k]!r} -> {ce[k]!r}"
+                                for k in drifted)
+            print(f"perf-gate: WARNING — rung '{metric}' measurement "
+                  f"config changed between r{pn} and r{cn} ({changes}); "
+                  "values not comparable, rung re-baselined this round")
+            continue
+        pv, cv = float(pr["value"]), float(cr["value"])
+        if pv <= 0:
+            print(f"perf-gate: rung '{metric}' r{pn} value {pv} not "
+                  "comparable — skipped")
+            continue
+        if lower is None:
+            lower = lower_is_better(pr)
+        if lower:
+            regression = (cv - pv) / pv
+        else:
+            regression = (pv - cv) / pv
+        sign = "-" if regression > 0 else "+"
+        print(f"perf-gate: rung {metric}: r{pn} {pv:g} -> r{cn} {cv:g} "
+              f"({sign}{abs(regression) * 100:.1f}%, tol "
+              f"{tol * 100:.0f}%)")
+        if regression > tol:
+            print(f"perf-gate: FAIL — '{metric}' regressed "
+                  f"{regression * 100:.1f}% > {tol * 100:.0f}% tolerance "
+                  f"({ppath} -> {cpath})")
+            rc = 1
+    for metric in cur_by:
+        if metric not in prev_by:
+            print(f"perf-gate: new ladder rung '{metric}' in r{cn} — no "
+                  "prior round to gate against (recorded as baseline)")
+    return rc
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tolerance", type=float, default=0.10,
+                    help="allowed fractional drop in the headline "
+                         "vs_baseline (per-rung ladder tolerances come "
+                         "from tools/ladder_tolerances.json)")
+    ap.add_argument("--root", default=os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    args = ap.parse_args(argv)
+
+    rc = check_headline(load_rounds(args.root), args.tolerance)
+    rc = check_ladder(load_ladders(args.root),
+                      load_tolerances(args.root)) or rc
+    print("perf-gate: pass" if rc == 0 else "perf-gate: FAIL")
+    return rc
 
 
 if __name__ == "__main__":

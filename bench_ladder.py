@@ -306,6 +306,23 @@ def bench_serving_mixed():
     }))
 
 
+def bench_serving_frontend():
+    """Serving control-plane rung (ISSUE 2): open-loop Poisson arrivals
+    through ServingFrontend (admission, priority routing, preemption under
+    a deliberately tight block pool) — steady-state tokens/s plus p50/p95
+    TTFT. The heavy lifting lives in tools/bench_serving.py; this rung
+    re-emits its JSON line so the perf gate sees it in the ladder."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_serving",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "tools", "bench_serving.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    print(json.dumps(mod.run_bench()))
+
+
 def bench_pipeline_compiled_vs_eager():
     """Compiled-vs-eager pipeline rung: the same dp2×mp2×pp2 llama microbatch
     schedule through the eager per-op 1F1B engine vs CompiledPipelineTrainStep
@@ -401,5 +418,7 @@ if __name__ == "__main__":
         bench_llama_decode()
     if which in ("all", "serving"):
         bench_serving_mixed()
+    if which in ("all", "frontend"):
+        bench_serving_frontend()
     if which in ("all", "pipeline"):
         bench_pipeline_compiled_vs_eager()

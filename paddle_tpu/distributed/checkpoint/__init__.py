@@ -34,7 +34,8 @@ import ml_dtypes
 
 from ...tensor.tensor import Tensor
 
-__all__ = ["save_state_dict", "load_state_dict"]
+__all__ = ["save_state_dict", "load_state_dict", "wait_all",
+           "wait_pending_saves"]
 
 _BF16_STORED = "uint16"  # npz storage encoding for bfloat16
 
@@ -83,19 +84,41 @@ def _decode(arr: np.ndarray, dtype_str: str) -> np.ndarray:
     return arr
 
 
-def wait_pending_saves():
+def _prune_finished_saves():
+    """Drop threads that already finished — without this, every
+    ``async_save=True`` call leaks one Thread object for the life of the
+    process (the satellite failure mode: a serving job checkpointing every
+    N minutes grows ``_pending_saves`` without bound)."""
+    _pending_saves[:] = [t for t in _pending_saves if t.is_alive()]
+
+
+def _surface_pending_errors():
+    """Re-raise the first error a background write hit. Called on every
+    save/load entry so an async failure surfaces on the NEXT checkpoint
+    operation at the latest, never silently.  Drains ONE error per call —
+    an error appended concurrently (or a second failed save) stays queued
+    for the next call instead of being clear()ed away unseen."""
+    if _pending_errors:
+        err = _pending_errors.pop(0)
+        raise RuntimeError("async checkpoint save failed") from err
+
+
+def wait_all():
     """Block until all async checkpoint writes issued by this process finish.
     Re-raises the first error any background write hit."""
     while _pending_saves:
         _pending_saves.pop().join()
-    if _pending_errors:
-        err = _pending_errors[0]
-        _pending_errors.clear()
-        raise RuntimeError("async checkpoint save failed") from err
+    _surface_pending_errors()
+
+
+# historical name, kept as an alias of the public wait_all
+wait_pending_saves = wait_all
 
 
 def save_state_dict(state_dict: Dict[str, Tensor], path: str, process_group=None,
                     coordinator_rank: int = 0, async_save: bool = False):
+    _prune_finished_saves()
+    _surface_pending_errors()
     os.makedirs(path, exist_ok=True)
     rank = jax.process_index()
     local_arrays = {}
@@ -131,22 +154,24 @@ def save_state_dict(state_dict: Dict[str, Tensor], path: str, process_group=None
     multi_host = jax.process_count() > 1
 
     def _write():
+        np.savez(_shard_file(path, rank), **local_arrays)
+        if multi_host:
+            # every rank records ITS OWN shard extents; the loader (or the
+            # coordinator below) merges the fragments into the global view
+            with open(_rank_meta_path(path, rank), "w") as f:
+                json.dump(meta, f)
+        else:
+            with open(_meta_path(path), "w") as f:
+                json.dump(meta, f)
+
+    def _write_async():
         try:
-            np.savez(_shard_file(path, rank), **local_arrays)
-            if multi_host:
-                # every rank records ITS OWN shard extents; the loader (or the
-                # coordinator below) merges the fragments into the global view
-                with open(_rank_meta_path(path, rank), "w") as f:
-                    json.dump(meta, f)
-            else:
-                with open(_meta_path(path), "w") as f:
-                    json.dump(meta, f)
-        except BaseException as e:  # propagated by wait_pending_saves
+            _write()
+        except BaseException as e:  # surfaced by the NEXT save/load/wait_all
             _pending_errors.append(e)
-            raise
 
     if async_save:
-        th = threading.Thread(target=_write, daemon=False)
+        th = threading.Thread(target=_write_async, daemon=False)
         th.start()
         _pending_saves.append(th)
         return

@@ -20,8 +20,17 @@ import jax.numpy as jnp
 from ..tensor.tensor import Tensor
 
 __all__ = ["Config", "create_predictor", "Predictor", "PredictorPool",
-           "BlockManager", "ServingEngine", "ServingRequest"]
+           "BlockManager", "ServingEngine", "ServingRequest",
+           "ServingFrontend", "ServingMetrics", "Priority",
+           "RequestStatus", "RequestResult"]
 
+from .control_plane import (  # noqa: E402
+    Priority,
+    RequestResult,
+    RequestStatus,
+    ServingFrontend,
+)
+from .metrics import ServingMetrics  # noqa: E402
 from .serving import BlockManager, ServingEngine, ServingRequest  # noqa: E402
 
 

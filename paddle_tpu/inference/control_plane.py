@@ -1,0 +1,530 @@
+"""SLO-aware serving control plane: the layer between callers and one or
+more ``ServingEngine`` replicas (reference analogs: fleet's elastic
+manager for replica health, Orca-style iteration-level scheduling for the
+dispatch loop, vLLM-style recompute preemption for block-pool pressure —
+adapted to the XLA static-shape regime the engine already uses).
+
+``ServingFrontend`` owns the request lifecycle end to end; the engines
+stay pure execution loops driven via ``ServingEngine.step()``:
+
+* **Admission** — a priority queue (``Priority.HIGH/NORMAL/LOW``) with
+  per-request deadlines and token-budget-aware caps.  A request that can
+  never fit, or that arrives past the configured queue caps, resolves
+  immediately with a typed ``OVERLOADED`` result — submit never blocks.
+* **Deadlines & cancellation** — queued requests past deadline are shed
+  (``DEADLINE_EXCEEDED``); running ones are evicted mid-generation and
+  return their partial tokens.  ``cancel(rid)`` works in both states.
+* **Recompute preemption** — when a request cannot be placed because the
+  block pools are exhausted, the lowest-priority (then youngest) running
+  sequence strictly below the waiting request's class is evicted via
+  ``ServingEngine.evict``: its blocks are freed and it is re-queued with
+  ``prompt + generated`` as the new prefill.  Greedy decode is
+  deterministic, so a preempted-then-resumed request produces exactly
+  the tokens of an unpreempted run.
+* **Routing & failover** — least-loaded placement with round-robin
+  tie-break across replicas.  A replica whose ``step()`` raises is
+  marked dead; its in-flight requests are re-queued from host-side state
+  (prompt + tokens harvested so far) and drained to survivors.  With no
+  survivors, every pending request resolves with a typed ``FAILED``
+  result — nothing is silently dropped.
+* **Metrics** — a ``ServingMetrics`` registry sampled inside the step
+  loop (TTFT, per-token latency, tokens/s, queue depth, shed/preempt
+  counters, block-pool utilization) with ``snapshot()`` and a
+  Prometheus-text export.
+
+Scope: replicas are in-process single-host engines; cross-host replica
+RPC is the next layer up (ROADMAP open item).
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from enum import Enum, IntEnum
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .metrics import ServingMetrics
+from .serving import ServingEngine
+
+__all__ = ["Priority", "RequestStatus", "RequestResult", "ServingFrontend"]
+
+
+class Priority(IntEnum):
+    """Lower value = more important. Preemption only ever evicts a
+    strictly lower class than the request waiting for blocks."""
+
+    HIGH = 0
+    NORMAL = 1
+    LOW = 2
+
+
+class RequestStatus(Enum):
+    COMPLETED = "completed"
+    OVERLOADED = "overloaded"              # rejected at/after admission
+    DEADLINE_EXCEEDED = "deadline_exceeded"  # shed from queue or mid-flight
+    CANCELLED = "cancelled"
+    FAILED = "failed"                      # replica death with no survivor
+
+
+_STATUS_COUNTER = {
+    RequestStatus.COMPLETED: "completed_total",
+    RequestStatus.OVERLOADED: "rejected_overloaded_total",
+    RequestStatus.DEADLINE_EXCEEDED: "shed_deadline_total",
+    RequestStatus.CANCELLED: "cancelled_total",
+    RequestStatus.FAILED: "failed_total",
+}
+
+
+@dataclass
+class RequestResult:
+    """Typed terminal outcome for one submitted request. ``tokens`` holds
+    whatever was generated before the terminal state (partial for
+    sheds/cancels, complete for COMPLETED)."""
+
+    rid: int
+    status: RequestStatus
+    tokens: List[int] = field(default_factory=list)
+    detail: str = ""
+    preemptions: int = 0
+    ttft_s: Optional[float] = None
+    e2e_s: Optional[float] = None
+
+    @property
+    def ok(self) -> bool:
+        return self.status is RequestStatus.COMPLETED
+
+
+@dataclass(eq=False)
+class _FrontendRequest:
+    rid: int
+    prompt: List[int]
+    max_new_tokens: int
+    priority: Priority
+    deadline_t: Optional[float]    # absolute clock() time, None = no SLO
+    eos_token_id: Optional[int]
+    submit_t: float
+    seq: int                       # FIFO tie-break within a priority class
+    generated: List[int] = field(default_factory=list)
+    preemptions: int = 0
+    assignments: int = 0
+    replica: Optional["_Replica"] = None
+    engine_rid: Optional[int] = None
+    first_token_t: Optional[float] = None
+    last_token_t: Optional[float] = None
+
+    @property
+    def remaining_new_tokens(self) -> int:
+        return self.max_new_tokens - len(self.generated)
+
+    @property
+    def total_tokens(self) -> int:
+        # invariant across preemptions: resumed prefill (prompt+generated)
+        # plus remaining budget always sums to prompt + max_new
+        return len(self.prompt) + self.max_new_tokens
+
+    def sort_key(self):
+        return (int(self.priority), self.seq)
+
+
+class _Replica:
+    """One engine plus the frontend's view of what runs on it."""
+
+    def __init__(self, idx: int, engine: ServingEngine):
+        self.idx = idx
+        self.engine = engine
+        self.alive = True
+        self.last_error: Optional[str] = None
+        self.requests: Dict[int, _FrontendRequest] = {}  # engine_rid -> req
+
+
+def _blocks_needed(engine: ServingEngine, total_tokens: int) -> int:
+    return (total_tokens + engine.bs - 1) // engine.bs
+
+
+class ServingFrontend:
+    """SLO-aware router/admission layer over ServingEngine replicas.
+
+    >>> fe = ServingFrontend([eng_a, eng_b], max_queue_requests=64)
+    >>> rid = fe.submit([1, 5, 7], max_new_tokens=16,
+    ...                 priority=Priority.HIGH, deadline_s=2.0)
+    >>> results = fe.run()          # {rid: RequestResult}
+    >>> fe.metrics.snapshot()["tokens_per_sec"]
+    """
+
+    def __init__(self, engines: Union[ServingEngine, Sequence[ServingEngine]],
+                 *, max_queue_requests: Optional[int] = None,
+                 max_queue_tokens: Optional[int] = None,
+                 preemption: bool = True,
+                 clock: Callable[[], float] = time.monotonic,
+                 metrics: Optional[ServingMetrics] = None):
+        if isinstance(engines, ServingEngine):
+            engines = [engines]
+        if not engines:
+            raise ValueError("ServingFrontend needs at least one engine")
+        self._replicas = [_Replica(i, e) for i, e in enumerate(engines)]
+        self._clock = clock
+        self.max_queue_requests = max_queue_requests
+        self.max_queue_tokens = max_queue_tokens
+        self.preemption = bool(preemption)
+        self.metrics = metrics if metrics is not None else ServingMetrics(clock)
+        self._queue: List[_FrontendRequest] = []
+        self._requests: Dict[int, _FrontendRequest] = {}
+        self._results: Dict[int, RequestResult] = {}
+        self._next_rid = 0
+        self._next_seq = 0
+        self._rr = 0  # round-robin cursor for routing tie-breaks
+
+    @classmethod
+    def from_model(cls, model, num_replicas: int = 1, frontend_kwargs=None,
+                   **engine_kwargs) -> "ServingFrontend":
+        engines = [ServingEngine(model, **engine_kwargs)
+                   for _ in range(num_replicas)]
+        return cls(engines, **(frontend_kwargs or {}))
+
+    # ----------------------------------------------------------- public API
+    @property
+    def replicas(self) -> List[_Replica]:
+        return list(self._replicas)
+
+    @property
+    def num_live_replicas(self) -> int:
+        return sum(r.alive for r in self._replicas)
+
+    @property
+    def pending(self) -> int:
+        """Requests submitted but not yet resolved to a RequestResult."""
+        return len(self._requests) - len(self._results)
+
+    def result(self, rid: int) -> Optional[RequestResult]:
+        return self._results.get(rid)
+
+    def results(self) -> Dict[int, RequestResult]:
+        return dict(self._results)
+
+    def submit(self, prompt_ids, max_new_tokens: int = 32, *,
+               priority: Priority = Priority.NORMAL,
+               deadline_s: Optional[float] = None,
+               eos_token_id: Optional[int] = None) -> int:
+        """Enqueue a request; never blocks. Returns a rid whose outcome is
+        readable via ``result(rid)`` — immediately for typed rejections
+        (OVERLOADED / FAILED), after ``step()``/``run()`` otherwise.
+        ``deadline_s`` is relative to submission."""
+        prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new_tokens <= 0:
+            raise ValueError("max_new_tokens must be positive")
+        now = self._clock()
+        rid = self._next_rid
+        self._next_rid += 1
+        req = _FrontendRequest(
+            rid=rid, prompt=prompt, max_new_tokens=int(max_new_tokens),
+            priority=Priority(priority),
+            deadline_t=(now + deadline_s) if deadline_s is not None else None,
+            eos_token_id=eos_token_id, submit_t=now, seq=self._next_seq)
+        self._next_seq += 1
+        self._requests[rid] = req
+
+        live = [r for r in self._replicas if r.alive]
+        if not live:
+            self._finish(req, RequestStatus.FAILED, "no live replicas")
+            return rid
+        if not any(self._fits_at_all(r, req) for r in live):
+            self._finish(req, RequestStatus.OVERLOADED,
+                         f"prompt+max_new_tokens={req.total_tokens} exceeds "
+                         "every live replica's capacity")
+            return rid
+        if (self.max_queue_requests is not None
+                and len(self._queue) >= self.max_queue_requests):
+            self._finish(req, RequestStatus.OVERLOADED,
+                         f"queue full ({self.max_queue_requests} requests)")
+            return rid
+        if self.max_queue_tokens is not None:
+            committed = sum(q.total_tokens for q in self._queue)
+            if committed + req.total_tokens > self.max_queue_tokens:
+                self._finish(req, RequestStatus.OVERLOADED,
+                             f"queued token budget exhausted ({committed}"
+                             f"+{req.total_tokens} > {self.max_queue_tokens})")
+                return rid
+        self._queue.append(req)
+        self.metrics.inc("admitted_total")
+        return rid
+
+    def cancel(self, rid: int) -> bool:
+        """Cancel a queued or running request; returns False if already
+        resolved (or unknown)."""
+        req = self._requests.get(rid)
+        if req is None or rid in self._results:
+            return False
+        if req in self._queue:
+            self._queue.remove(req)
+        elif req.replica is not None:
+            req.replica.engine.evict(req.engine_rid)
+            req.replica.requests.pop(req.engine_rid, None)
+            req.replica = None
+            req.engine_rid = None
+        self._finish(req, RequestStatus.CANCELLED, "cancelled by caller")
+        return True
+
+    def step(self):
+        """One control-plane iteration: shed expired deadlines, dispatch
+        (with preemption), step every live replica, harvest tokens and
+        completions, sample metrics."""
+        live = [r for r in self._replicas if r.alive]
+        if not live:
+            for req in list(self._queue):
+                self._queue.remove(req)
+                self._finish(req, RequestStatus.FAILED, "no live replicas")
+            self._sample_gauges()
+            return
+        self._shed_expired()
+        self._dispatch()
+        for rep in self._replicas:
+            if rep.alive and (rep.engine.num_active or rep.engine._queue):
+                self._step_replica(rep)
+        self._sample_gauges()
+
+    def run(self, max_steps: int = 10_000) -> Dict[int, RequestResult]:
+        """Drive ``step()`` until every submitted request has a result.
+        Raises RuntimeError if ``max_steps`` is exhausted with requests
+        still unresolved (a truncated run must not look complete)."""
+        for _ in range(max_steps):
+            if not self.pending:
+                break
+            self.step()
+        if self.pending:
+            stuck = [r.rid for r in self._requests.values()
+                     if r.rid not in self._results]
+            raise RuntimeError(
+                f"ServingFrontend.run: max_steps={max_steps} exhausted with "
+                f"{len(stuck)} unresolved request(s) {stuck[:8]} — raise "
+                "max_steps or inspect metrics.snapshot()")
+        return dict(self._results)
+
+    # ------------------------------------------------------------ internals
+    def _fits_at_all(self, rep: _Replica, req: _FrontendRequest) -> bool:
+        """Could this request run on ``rep`` if the replica were idle?"""
+        eng = rep.engine
+        if req.total_tokens > eng.max_seq_len:
+            return False
+        if _blocks_needed(eng, req.total_tokens) > eng.blocks.num_blocks:
+            return False
+        if (eng.cache_quant == "int8"
+                and len(req.prompt) + len(req.generated) > eng.T):
+            return False  # int8 prefill must land in one step
+        return True
+
+    def _headroom(self, rep: _Replica):
+        """(free slots, free blocks) net of requests the engine has queued
+        but not yet admitted (same-step adds)."""
+        eng = rep.engine
+        q_blocks = sum(_blocks_needed(eng, len(q.prompt) + q.max_new_tokens)
+                       for q in eng._queue)
+        return (len(eng._free_slots) - len(eng._queue),
+                eng.blocks.num_free - q_blocks)
+
+    def _shed_expired(self):
+        now = self._clock()
+        for req in [q for q in self._queue
+                    if q.deadline_t is not None and now >= q.deadline_t]:
+            self._queue.remove(req)
+            self._finish(req, RequestStatus.DEADLINE_EXCEEDED,
+                         "deadline expired while queued")
+        for rep in self._replicas:
+            if not rep.alive:
+                continue
+            for erid, req in list(rep.requests.items()):
+                if req.deadline_t is not None and now >= req.deadline_t:
+                    rep.engine.evict(erid)
+                    rep.requests.pop(erid, None)
+                    req.replica = None
+                    req.engine_rid = None
+                    self._finish(req, RequestStatus.DEADLINE_EXCEEDED,
+                                 "deadline expired mid-generation")
+
+    def _dispatch(self):
+        # priority order; equal-priority backfill is allowed past a blocked
+        # request, strictly-lower is not (it would eat the blocks the
+        # blocked class is waiting for, then get preempted right back)
+        barrier: Optional[int] = None
+        for req in sorted(list(self._queue), key=_FrontendRequest.sort_key):
+            if req not in self._queue:
+                continue
+            if barrier is not None and int(req.priority) > barrier:
+                continue
+            live = [r for r in self._replicas if r.alive]
+            if not live:
+                break
+            if not any(self._fits_at_all(r, req) for r in live):
+                self._queue.remove(req)
+                self._finish(req, RequestStatus.OVERLOADED,
+                             f"prompt+max_new_tokens={req.total_tokens} "
+                             "exceeds every live replica's capacity")
+                continue
+            rep = self._pick_replica(req, live)
+            if rep is None and self.preemption:
+                rep = self._preempt_for(req, live)
+            if rep is None:
+                barrier = int(req.priority)
+                continue
+            self._queue.remove(req)
+            self._assign(req, rep)
+
+    def _pick_replica(self, req: _FrontendRequest,
+                      live: List[_Replica]) -> Optional[_Replica]:
+        fits = []
+        for rep in live:
+            if not self._fits_at_all(rep, req):
+                continue
+            slots, blocks = self._headroom(rep)
+            if slots >= 1 and blocks >= _blocks_needed(rep.engine,
+                                                       req.total_tokens):
+                fits.append(rep)
+        if not fits:
+            return None
+        n = len(self._replicas)
+        best = min(fits, key=lambda r: (
+            len(r.requests) + len(r.engine._queue),      # least loaded
+            -self._headroom(r)[1],                        # then most free
+            (r.idx - self._rr) % n))                      # then round-robin
+        self._rr = (best.idx + 1) % n
+        return best
+
+    def _preempt_for(self, req: _FrontendRequest,
+                     live: List[_Replica]) -> Optional[_Replica]:
+        """Find a replica where evicting strictly-lower-priority running
+        sequences frees enough blocks for ``req``; evict the minimal set
+        (lowest class first, youngest first) and return the replica."""
+        best = None  # (evictions, -free_after, rep, victims)
+        for rep in live:
+            if not self._fits_at_all(rep, req):
+                continue
+            need = _blocks_needed(rep.engine, req.total_tokens)
+            victims = sorted(
+                [fr for fr in rep.requests.values()
+                 if int(fr.priority) > int(req.priority)
+                 and fr.engine_rid in rep.engine._active],
+                key=lambda f: (-int(f.priority), -f.seq))
+            slots, blocks = self._headroom(rep)
+            take: List[_FrontendRequest] = []
+            for v in victims:
+                if slots >= 1 and blocks >= need:
+                    break
+                take.append(v)
+                slots += 1
+                blocks += len(rep.engine._active[v.engine_rid].blocks)
+            if slots >= 1 and blocks >= need and take:
+                cand = (len(take), -blocks, rep.idx, rep, take)
+                if best is None or cand[:3] < best[:3]:
+                    best = cand
+        if best is None:
+            return None
+        _, _, _, rep, take = best
+        for v in take:
+            self._preempt(v)
+        return rep
+
+    def _preempt(self, victim: _FrontendRequest):
+        rep = victim.replica
+        rep.engine.evict(victim.engine_rid)
+        rep.requests.pop(victim.engine_rid, None)
+        victim.replica = None
+        victim.engine_rid = None
+        victim.preemptions += 1
+        self.metrics.inc("preempted_total")
+        # re-queued with prompt+generated as the new prefill; keeps its
+        # original seq so it resumes ahead of younger peers in its class
+        self._queue.append(victim)
+
+    def _assign(self, req: _FrontendRequest, rep: _Replica):
+        if req.remaining_new_tokens <= 0:
+            self._finish(req, RequestStatus.COMPLETED)
+            return
+        prefill = req.prompt + req.generated
+        try:
+            erid = rep.engine.add_request(
+                prefill, max_new_tokens=req.remaining_new_tokens,
+                eos_token_id=req.eos_token_id)
+        except ValueError as e:
+            # e.g. an int8 engine whose one-shot-prefill contract a resumed
+            # (grown) prefill no longer satisfies
+            self._finish(req, RequestStatus.OVERLOADED,
+                         f"engine rejected request: {e}")
+            return
+        rep.requests[erid] = req
+        req.replica = rep
+        req.engine_rid = erid
+        if req.assignments > 0:
+            self.metrics.inc("resumed_total")
+        req.assignments += 1
+
+    def _step_replica(self, rep: _Replica):
+        try:
+            emitted = rep.engine.step()
+        except Exception as e:  # noqa: BLE001 — any replica fault fails over
+            self._kill_replica(rep, e)
+            return
+        self.metrics.inc("engine_steps_total")
+        t = self._clock()
+        for erid, toks in emitted.items():
+            req = rep.requests.get(erid)
+            if req is None:
+                continue
+            if req.first_token_t is None:
+                req.first_token_t = t
+                self.metrics.observe("ttft_seconds", t - req.submit_t)
+            elif req.last_token_t is not None:
+                self.metrics.observe(
+                    "token_latency_seconds", (t - req.last_token_t) / len(toks))
+            req.last_token_t = t
+            req.generated.extend(toks)
+            self.metrics.note_tokens(len(toks), t)
+        for erid in rep.engine.pop_finished():
+            req = rep.requests.pop(erid, None)
+            if req is None:
+                continue
+            req.replica = None
+            req.engine_rid = None
+            self._finish(req, RequestStatus.COMPLETED)
+
+    def _kill_replica(self, rep: _Replica, exc: BaseException):
+        rep.alive = False
+        rep.last_error = repr(exc)
+        self.metrics.inc("replica_deaths_total")
+        # the engine's device state is untrusted after a fault; resume every
+        # in-flight request from host-side state on a surviving replica
+        for erid, req in list(rep.requests.items()):
+            req.replica = None
+            req.engine_rid = None
+            self._queue.append(req)
+            self.metrics.inc("requeued_on_failover_total")
+        rep.requests.clear()
+
+    def _finish(self, req: _FrontendRequest, status: RequestStatus,
+                detail: str = "") -> RequestResult:
+        now = self._clock()
+        res = RequestResult(
+            rid=req.rid, status=status, tokens=list(req.generated),
+            detail=detail, preemptions=req.preemptions,
+            ttft_s=(req.first_token_t - req.submit_t)
+            if req.first_token_t is not None else None,
+            e2e_s=now - req.submit_t)
+        self._results[req.rid] = res
+        self.metrics.inc(_STATUS_COUNTER[status])
+        if status is RequestStatus.COMPLETED:
+            self.metrics.observe("e2e_latency_seconds", res.e2e_s)
+        return res
+
+    def _sample_gauges(self):
+        m = self.metrics
+        live = [r for r in self._replicas if r.alive]
+        m.set_gauge_peak("queue_depth", len(self._queue))
+        m.set_gauge("running_requests", sum(len(r.requests) for r in live))
+        m.set_gauge("replicas_alive", len(live))
+        total = sum(r.engine.blocks.num_blocks for r in live)
+        free = sum(r.engine.blocks.num_free for r in live)
+        m.set_gauge("blocks_total", total)
+        m.set_gauge("blocks_free", free)
+        m.set_gauge_peak("block_pool_utilization",
+                         (1.0 - free / total) if total else 0.0)

@@ -1,0 +1,173 @@
+"""Live serving metrics for the control plane (reference analog: the
+fleet elastic manager's health/metrics reporting — here the observable
+surface of `inference/control_plane.py`).
+
+`ServingMetrics` is a small host-side registry sampled inside the
+frontend's step loop: monotonically increasing counters (admissions,
+sheds, preemptions, deaths, tokens), point-in-time gauges (queue depth,
+block-pool utilization), and latency sample sets (TTFT, per-token
+latency, end-to-end) with percentile summaries.  Two exports:
+
+* ``snapshot()``      — a plain dict for programmatic health checks;
+* ``prometheus_text()`` — Prometheus text exposition (counter/gauge
+  lines + ``summary`` quantiles) for scraping.
+
+The clock is injectable so deadline/latency behavior is deterministic
+under test; nothing here touches the device.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["ServingMetrics"]
+
+_PREFIX = "paddle_tpu_serving_"
+
+COUNTERS = (
+    "admitted_total", "rejected_overloaded_total", "shed_deadline_total",
+    "preempted_total", "resumed_total", "cancelled_total", "completed_total",
+    "failed_total", "replica_deaths_total", "requeued_on_failover_total",
+    "tokens_emitted_total", "engine_steps_total",
+)
+GAUGES = (
+    "queue_depth", "queue_depth_peak", "running_requests", "replicas_alive",
+    "blocks_total", "blocks_free", "block_pool_utilization",
+    "block_pool_utilization_peak",
+)
+SAMPLES = ("ttft_seconds", "token_latency_seconds", "e2e_latency_seconds")
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[idx]
+
+
+class ServingMetrics:
+    """Counter/gauge/latency-sample registry for one ServingFrontend."""
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic,
+                 max_samples: int = 65536):
+        self._clock = clock
+        self._max_samples = int(max_samples)
+        self.reset()
+
+    def reset(self):
+        """Zero everything (e.g. after a warmup/compile phase)."""
+        self._t0 = self._clock()
+        self._counters: Dict[str, int] = {k: 0 for k in COUNTERS}
+        self._gauges: Dict[str, float] = {k: 0.0 for k in GAUGES}
+        self._samples: Dict[str, List[float]] = {k: [] for k in SAMPLES}
+        self._sample_counts: Dict[str, int] = {k: 0 for k in SAMPLES}
+        self._sample_sums: Dict[str, float] = {k: 0.0 for k in SAMPLES}
+        self._first_emit_t: Optional[float] = None
+        self._last_emit_t: Optional[float] = None
+        self._tokens_at_first_emit = 0
+
+    # ------------------------------------------------------------- record
+    def now(self) -> float:
+        return self._clock()
+
+    def inc(self, name: str, n: int = 1):
+        self._counters[name] = self._counters.get(name, 0) + n
+
+    def set_gauge(self, name: str, value: float):
+        self._gauges[name] = float(value)
+
+    def set_gauge_peak(self, name: str, value: float):
+        """Set ``name`` and keep a high-water mark in ``name + '_peak'``
+        (a final snapshot of a drained system would otherwise read 0 for
+        every pressure gauge)."""
+        self._gauges[name] = float(value)
+        peak = name + "_peak"
+        self._gauges[peak] = max(self._gauges.get(peak, 0.0), float(value))
+
+    def observe(self, name: str, value: float):
+        buf = self._samples.setdefault(name, [])
+        cnt = self._sample_counts.get(name, 0)
+        if len(buf) < self._max_samples:
+            buf.append(float(value))
+        else:
+            buf[cnt % self._max_samples] = float(value)
+        self._sample_counts[name] = cnt + 1
+        self._sample_sums[name] = self._sample_sums.get(name, 0.0) + float(value)
+
+    def note_tokens(self, n: int, t: Optional[float] = None):
+        """Record ``n`` tokens emitted at time ``t`` (defaults to now)."""
+        if n <= 0:
+            return
+        t = self._clock() if t is None else t
+        self.inc("tokens_emitted_total", n)
+        if self._first_emit_t is None:
+            self._first_emit_t = t
+            self._tokens_at_first_emit = n
+        self._last_emit_t = t
+
+    # -------------------------------------------------------------- views
+    def counter(self, name: str) -> int:
+        return self._counters.get(name, 0)
+
+    def gauge(self, name: str) -> float:
+        return self._gauges.get(name, 0.0)
+
+    def tokens_per_sec(self) -> float:
+        """Steady-state emission rate: tokens after the first emission
+        event over the first→last emission window (excludes compile/queue
+        lead-in); falls back to total/uptime for single-emission runs."""
+        tokens = self.counter("tokens_emitted_total")
+        if tokens <= 0:
+            return 0.0
+        if (self._first_emit_t is not None and self._last_emit_t is not None
+                and self._last_emit_t > self._first_emit_t
+                and tokens > self._tokens_at_first_emit):
+            return ((tokens - self._tokens_at_first_emit)
+                    / (self._last_emit_t - self._first_emit_t))
+        return tokens / max(self._clock() - self._t0, 1e-9)
+
+    def _summary(self, name: str) -> Dict[str, float]:
+        vals = sorted(self._samples.get(name, []))
+        cnt = self._sample_counts.get(name, 0)
+        return {
+            "count": cnt,
+            "sum": self._sample_sums.get(name, 0.0),
+            "mean": (self._sample_sums.get(name, 0.0) / cnt) if cnt else 0.0,
+            "p50": _percentile(vals, 0.50),
+            "p95": _percentile(vals, 0.95),
+            "max": vals[-1] if vals else 0.0,
+        }
+
+    def snapshot(self) -> Dict:
+        """Programmatic point-in-time view of the whole registry."""
+        return {
+            "uptime_s": self._clock() - self._t0,
+            "counters": dict(self._counters),
+            "gauges": dict(self._gauges),
+            "latency": {k: self._summary(k) for k in self._samples},
+            "tokens_per_sec": self.tokens_per_sec(),
+        }
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition format (one scrape page)."""
+        lines: List[str] = []
+        for name in sorted(self._counters):
+            full = _PREFIX + name
+            lines.append(f"# TYPE {full} counter")
+            lines.append(f"{full} {self._counters[name]}")
+        for name in sorted(self._gauges):
+            full = _PREFIX + name
+            lines.append(f"# TYPE {full} gauge")
+            lines.append(f"{full} {self._gauges[name]:.6g}")
+        full = _PREFIX + "tokens_per_sec"
+        lines.append(f"# TYPE {full} gauge")
+        lines.append(f"{full} {self.tokens_per_sec():.6g}")
+        for name in sorted(self._samples):
+            full = _PREFIX + name
+            s = self._summary(name)
+            lines.append(f"# TYPE {full} summary")
+            lines.append(f'{full}{{quantile="0.5"}} {s["p50"]:.6g}')
+            lines.append(f'{full}{{quantile="0.95"}} {s["p95"]:.6g}')
+            lines.append(f"{full}_count {s['count']}")
+            lines.append(f"{full}_sum {s['sum']:.6g}")
+        return "\n".join(lines) + "\n"

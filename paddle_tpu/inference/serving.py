@@ -17,9 +17,22 @@ Design:
   transfer, the same shape every step).
 - This is the vLLM-style schedule expressed the XLA way: static shapes +
   dynamic lengths as data, not as shapes.
+
+Frontend/engine split (control_plane.py): the engine is a pure execution
+loop — it admits whatever is in its queue, steps, and retires.  Policy
+(priority classes, deadlines, admission control, routing across replicas,
+failover) lives in ``ServingFrontend``, which drives ``step()`` and
+harvests via ``pop_finished()``.  The preemption contract: ``evict(rid)``
+removes a queued or running request mid-flight, frees its blocks and slot
+immediately (BlockManager tolerates this and guards double-frees), and
+returns the request object; the caller re-queues it with ``prompt +
+generated`` as the new prefill.  Greedy decode is deterministic, so a
+preempted-then-resumed request reproduces the unpreempted token stream
+exactly.
 """
 from __future__ import annotations
 
+from collections import Counter
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Dict, List, Optional
@@ -32,14 +45,24 @@ import jax.numpy as jnp
 from ..ops.paged_attention import blha_attention
 
 __all__ = ["BlockManager", "ServingRequest", "ServingEngine"]
+# the policy layer above this engine lives in control_plane.py
+# (ServingFrontend) and metrics.py (ServingMetrics)
 
 
 class BlockManager:
-    """Host-side free-list over the global block pool."""
+    """Host-side free-list over the global block pool.
+
+    ``free`` rejects double-frees loudly: re-inserting a block already in
+    the free-list would hand the same block to two sequences on the next
+    ``allocate`` and silently corrupt both KV streams (the failure mode is
+    token garbage long after the actual bug).  Mid-flight release of a
+    live request's blocks (eviction/preemption) is fine — that is the
+    normal path for ``ServingEngine.evict``."""
 
     def __init__(self, num_blocks: int):
         self.num_blocks = num_blocks
         self._free = list(range(num_blocks - 1, -1, -1))
+        self._free_set = set(self._free)
 
     def can_allocate(self, n: int) -> bool:
         return len(self._free) >= n
@@ -48,10 +71,27 @@ class BlockManager:
         if not self.can_allocate(n):
             raise RuntimeError(f"block pool exhausted (need {n}, "
                                f"free {len(self._free)})")
-        return [self._free.pop() for _ in range(n)]
+        out = [self._free.pop() for _ in range(n)]
+        self._free_set.difference_update(out)
+        assert len(set(out)) == len(out), \
+            f"free-list corruption: allocate returned duplicate ids {out}"
+        return out
 
     def free(self, blocks: List[int]):
+        counts = Counter(blocks)
+        dup = sorted(b for b in counts if b in self._free_set)
+        internal = sorted(b for b, c in counts.items() if c > 1)
+        bad = sorted(b for b in counts if not 0 <= b < self.num_blocks)
+        if dup or internal or bad:
+            raise RuntimeError(
+                "BlockManager.free: "
+                + "; ".join(filter(None, [
+                    f"double-free of block ids {dup}" if dup else "",
+                    f"ids repeated in the freed list {internal}"
+                    if internal else "",
+                    f"ids outside the pool {bad}" if bad else ""])))
         self._free.extend(blocks)
+        self._free_set.update(blocks)
 
     @property
     def num_free(self) -> int:
@@ -280,15 +320,50 @@ class ServingEngine:
             self.block_tables[req.slot] = row
             self._active[req.rid] = req
 
-    def _retire(self, req: ServingRequest):
-        req.done = True
+    def _release(self, req: ServingRequest):
+        """Return a running request's blocks and batch slot to the pools
+        (shared by retirement and mid-flight eviction)."""
         self.blocks.free(req.blocks)
         req.blocks = []
         self.block_tables[req.slot] = -1
         self._free_slots.append(req.slot)
         req.slot = -1
+
+    def _retire(self, req: ServingRequest):
+        req.done = True
+        self._release(req)
         del self._active[req.rid]
         self._finished[req.rid] = list(req.generated)
+
+    def evict(self, rid: int) -> ServingRequest:
+        """Remove a queued or running request mid-flight (recompute
+        preemption / cancellation hook for the control plane).
+
+        Frees the request's blocks and batch slot immediately and returns
+        the request object — ``prompt`` and ``generated`` are intact, so
+        the caller can re-queue it with ``prompt + generated`` as the new
+        prefill and get the identical greedy continuation.  ``prefill_pos``
+        is reset: the KV blocks are gone, a resume re-prefills from
+        scratch."""
+        req = self._active.get(rid)
+        if req is not None:
+            del self._active[rid]
+            self._release(req)
+            req.prefill_pos = 0
+            return req
+        for i, q in enumerate(self._queue):
+            if q.rid == rid:
+                return self._queue.pop(i)
+        raise KeyError(f"no queued or active request with rid={rid}")
+
+    def pop_finished(self) -> Dict[int, List[int]]:
+        """Drain and return requests retired since the last call,
+        {rid: generated tokens}.  The control plane harvests completions
+        with this between ``step()`` calls; note it drains the same record
+        ``run()`` returns, so mix the two styles per-engine, not both."""
+        out = self._finished
+        self._finished = {}
+        return out
 
     def step(self) -> Dict[int, List[int]]:
         """One engine iteration: schedule -> compiled step -> sample/retire.

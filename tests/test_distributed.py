@@ -287,3 +287,69 @@ class TestCrossTopologyCheckpoint:
         # the resumed loss continues from the dp=8 trajectory, not from the
         # fresh seed-7 init
         assert abs(l0 - l_dp8) < 1.0
+
+
+class TestAsyncCheckpointHygiene:
+    """ISSUE 2 satellites: _pending_saves must not grow without bound
+    across async_save=True calls, and background-write errors must surface
+    on the NEXT save/load (or via the public wait_all), never silently."""
+
+    def test_pending_saves_pruned_on_each_save(self, tmp_path):
+        import paddle_tpu.distributed.checkpoint as ckpt
+
+        sd = {"w": P.to_tensor(np.arange(8, dtype=np.float32))}
+        for i in range(5):
+            ckpt.save_state_dict(sd, str(tmp_path / f"c{i}"), async_save=True)
+        ckpt.wait_all()
+        assert ckpt._pending_saves == []
+        # finished threads are pruned at the next save even WITHOUT an
+        # explicit wait (the unbounded-growth failure mode)
+        for i in range(5):
+            ckpt.save_state_dict(sd, str(tmp_path / f"d{i}"), async_save=True)
+            for t in list(ckpt._pending_saves):
+                t.join()  # let the writes land, but don't pop them
+        ckpt.save_state_dict(sd, str(tmp_path / "last"))
+        assert len(ckpt._pending_saves) == 0
+
+    def test_async_error_surfaces_on_next_save(self, tmp_path, monkeypatch):
+        import paddle_tpu.distributed.checkpoint as ckpt
+
+        sd = {"w": P.to_tensor(np.arange(4, dtype=np.float32))}
+
+        def boom(*a, **k):
+            raise OSError("disk full (injected)")
+
+        monkeypatch.setattr(ckpt.np, "savez", boom)
+        ckpt.save_state_dict(sd, str(tmp_path / "bad"), async_save=True)
+        for t in list(ckpt._pending_saves):
+            t.join()
+        monkeypatch.undo()
+        with pytest.raises(RuntimeError, match="async checkpoint save failed"):
+            ckpt.save_state_dict(sd, str(tmp_path / "next"))
+        # the error is consumed: the save after that succeeds
+        ckpt.save_state_dict(sd, str(tmp_path / "next2"))
+        ckpt.wait_all()
+
+    def test_async_error_surfaces_on_load_and_wait_all(self, tmp_path,
+                                                       monkeypatch):
+        import paddle_tpu.distributed.checkpoint as ckpt
+
+        sd = {"w": P.to_tensor(np.arange(4, dtype=np.float32))}
+        ckpt.save_state_dict(sd, str(tmp_path / "good"))
+
+        def boom(*a, **k):
+            raise OSError("injected")
+
+        monkeypatch.setattr(ckpt.np, "savez", boom)
+        ckpt.save_state_dict(sd, str(tmp_path / "bad"), async_save=True)
+        for t in list(ckpt._pending_saves):
+            t.join()  # the injected failure must fire before savez restores
+        monkeypatch.undo()
+        tgt = {"w": P.to_tensor(np.zeros(4, dtype=np.float32))}
+        with pytest.raises(RuntimeError, match="async checkpoint save failed"):
+            ckpt.load_state_dict(tgt, str(tmp_path / "good"))
+        # consumed: load now proceeds and fills the tensor
+        ckpt.load_state_dict(tgt, str(tmp_path / "good"))
+        np.testing.assert_array_equal(np.asarray(tgt["w"]._value),
+                                      np.arange(4, dtype=np.float32))
+        ckpt.wait_all()

@@ -245,6 +245,14 @@ class TestSaveLoad:
         st = P.load(path)
         assert any("moment1" in k for k in st)
 
+    def test_save_creates_missing_parent_dirs(self, tmp_path):
+        """ISSUE 2 satellite: a nested path must not fail with a raw
+        FileNotFoundError — save() creates the parent directories."""
+        path = str(tmp_path / "runs" / "exp3" / "step_100" / "ckpt")
+        P.save({"w": P.ones([2, 2])}, path)
+        back = P.load(path)
+        np.testing.assert_array_equal(back["w"].numpy(), np.ones((2, 2)))
+
     def test_save_nested_objects(self, tmp_path):
         obj = {"epoch": 5, "tensors": [P.ones([2]), P.zeros([3])], "meta": {"lr": 0.1}}
         path = str(tmp_path / "ckpt")

@@ -160,32 +160,39 @@ class Test4DHybridLlama:
         )
 
         set_hybrid_communicate_group(None)
-        s = dist.fleet.DistributedStrategy()
-        s.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 2,
-                            "sharding_degree": 1, "sep_degree": 1}
-        s.pipeline_configs = {"accumulate_steps": 2, "schedule_mode": "1F1B"}
-        dist.fleet.init(is_collective=True, strategy=s)
-        P.seed(0)
-        cfg = llama_tiny()
-        crit = LlamaPretrainingCriterion()
-        pipe = PipelineLayer(layers=llama_pipeline_descs(cfg), num_stages=2,
-                             loss_fn=lambda lo, la: crit(lo, la))
-        model = dist.fleet.distributed_model(pipe)
-        opt = P.optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
-        ids = P.to_tensor(np.random.RandomState(0).randint(
-            0, cfg.vocab_size, (4, 32)).astype(np.int32))
-        l0 = float(model.train_batch([ids, ids], opt).numpy())
-        for _ in range(4):
-            l1 = float(model.train_batch([ids, ids], opt).numpy())
-        assert np.isfinite(l0) and l1 < l0
-        # a TP weight inside a pipeline stage is mp-sharded on its SUBMESH
-        import jax
-
-        qw = None
-        for lay in pipe._stage_layers[1]:
-            for p in lay.parameters():
-                if p.ndim == 2 and "mp" in str(p._value.sharding.spec):
-                    qw = p
-                    break
-        assert qw is not None
-        assert len(qw._value.sharding.mesh.devices.flatten()) == 4  # stage submesh
+        # unconditional reset: leaving the mp=2 group active (including on
+        # an assertion failure below) would silently turn every LATER
+        # test's llama into a TP model (the serving suites build plain
+        # single-process models and compare against generate)
+        try:
+            s = dist.fleet.DistributedStrategy()
+            s.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 2,
+                                "sharding_degree": 1, "sep_degree": 1}
+            s.pipeline_configs = {"accumulate_steps": 2, "schedule_mode": "1F1B"}
+            dist.fleet.init(is_collective=True, strategy=s)
+            P.seed(0)
+            cfg = llama_tiny()
+            crit = LlamaPretrainingCriterion()
+            pipe = PipelineLayer(layers=llama_pipeline_descs(cfg), num_stages=2,
+                                 loss_fn=lambda lo, la: crit(lo, la))
+            model = dist.fleet.distributed_model(pipe)
+            opt = P.optimizer.AdamW(learning_rate=1e-3,
+                                    parameters=model.parameters())
+            ids = P.to_tensor(np.random.RandomState(0).randint(
+                0, cfg.vocab_size, (4, 32)).astype(np.int32))
+            l0 = float(model.train_batch([ids, ids], opt).numpy())
+            for _ in range(4):
+                l1 = float(model.train_batch([ids, ids], opt).numpy())
+            assert np.isfinite(l0) and l1 < l0
+            # a TP weight inside a pipeline stage is mp-sharded on its SUBMESH
+            qw = None
+            for lay in pipe._stage_layers[1]:
+                for p in lay.parameters():
+                    if p.ndim == 2 and "mp" in str(p._value.sharding.spec):
+                        qw = p
+                        break
+            assert qw is not None
+            # stage submesh
+            assert len(qw._value.sharding.mesh.devices.flatten()) == 4
+        finally:
+            set_hybrid_communicate_group(None)

@@ -13,6 +13,12 @@ pytestmark = pytest.mark.quick
 
 @pytest.fixture(scope="module")
 def model():
+    # a leaked fleet hybrid group (e.g. an earlier test file's mp>1 init)
+    # would silently make this llama build TP-parallel layers and break
+    # engine-vs-generate parity — build single-process explicitly
+    from paddle_tpu.distributed.topology import set_hybrid_communicate_group
+
+    set_hybrid_communicate_group(None)
     P.seed(11)
     return LlamaForCausalLM(llama_tiny())
 
@@ -23,6 +29,54 @@ def ref_greedy(model, prompt, n):
     ids = P.to_tensor(np.asarray(prompt, np.int32)[None, :])
     out = generate(model, ids, max_new_tokens=n, do_sample=False)
     return list(np.asarray(out.numpy()).reshape(-1))
+
+
+class TestBlockManagerGuards:
+    """ISSUE 2 satellite: double-free silently corrupts allocation (two
+    sequences handed the same block) — it must raise, naming the ids."""
+
+    def test_double_free_raises_with_ids(self):
+        from paddle_tpu.inference import BlockManager
+
+        bm = BlockManager(8)
+        blocks = bm.allocate(3)
+        bm.free(blocks)
+        with pytest.raises(RuntimeError, match="double-free"):
+            bm.free([blocks[0]])
+        # the error names the offending ids
+        with pytest.raises(RuntimeError, match=str(blocks[1])):
+            bm.free([blocks[1]])
+
+    def test_repeated_ids_in_one_free_raise(self):
+        from paddle_tpu.inference import BlockManager
+
+        bm = BlockManager(8)
+        a, b = bm.allocate(2)
+        with pytest.raises(RuntimeError, match="repeated"):
+            bm.free([a, a, b])
+        # the failed free must not have mutated the free list
+        assert bm.num_free == 6
+        bm.free([a, b])
+        assert bm.num_free == 8
+
+    def test_out_of_range_ids_raise(self):
+        from paddle_tpu.inference import BlockManager
+
+        bm = BlockManager(4)
+        with pytest.raises(RuntimeError, match="outside the pool"):
+            bm.free([99])
+
+    def test_allocate_returns_unique_ids(self):
+        from paddle_tpu.inference import BlockManager
+
+        bm = BlockManager(16)
+        out = bm.allocate(16)
+        assert len(set(out)) == 16
+        bm.free(out)
+        # interleaved alloc/free keeps ids unique
+        x = bm.allocate(5)
+        y = bm.allocate(5)
+        assert not set(x) & set(y)
 
 
 class TestServingEngine:

@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Serving control-plane benchmark: open-loop arrivals through the
+ServingFrontend (ISSUE 2 satellite; reference analog: the serving-stack
+QPS/latency harnesses around block_multihead_attention decode).
+
+Open-loop means arrival times are drawn up front from a seeded Poisson
+process and submitted when the wall clock passes them, INDEPENDENT of
+service progress — so the bench measures how the frontend behaves under
+offered load (queueing, shedding, TTFT growth), not a closed feedback
+loop that politely waits for capacity.
+
+Reports steady-state decode tokens/s (from the metrics registry's
+first->last emission window, which excludes compile/prefill lead-in) and
+p50/p95 TTFT across completed requests.  One JSON line on stdout — the
+same schema bench_ladder.py rungs use, so the ladder imports and re-emits
+``run_bench()`` directly.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_bench(num_requests=None, rate_rps=None, replicas=1, seed=0):
+    import jax
+    import numpy as np
+
+    import paddle_tpu as P
+    from paddle_tpu.inference import Priority, ServingEngine, ServingFrontend
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    backend = jax.default_backend()
+    on_accel = backend in ("tpu", "axon")
+    P.seed(0)
+    if on_accel:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2560,
+                          intermediate_size=8192, num_hidden_layers=9,
+                          num_attention_heads=10,
+                          max_position_embeddings=2048, dtype="bfloat16")
+        B, block, budget, max_seq = 8, 64, 64, 448
+        prompt_lens, max_new = (96, 160, 224), 32
+        num_blocks = 24  # pool binds before slots: preemption pressure
+        num_requests = num_requests or 32
+        rate_rps = rate_rps or 16.0
+    else:
+        cfg = LlamaConfig(vocab_size=512, hidden_size=128,
+                          intermediate_size=352, num_hidden_layers=2,
+                          num_attention_heads=4, max_position_embeddings=256)
+        B, block, budget, max_seq = 4, 8, 16, 64
+        prompt_lens, max_new = (4, 8, 12), 8
+        num_blocks = 8   # pool binds before slots: preemption pressure
+        num_requests = num_requests or 24
+        rate_rps = rate_rps or 200.0  # ~4x service rate: queue must form
+    model = LlamaForCausalLM(cfg)
+    if on_accel:
+        model.bfloat16()
+    model.eval()
+    engines = [ServingEngine(model, max_batch_size=B, max_seq_len=max_seq,
+                             block_size=block, token_budget=budget,
+                             num_blocks=num_blocks)
+               for _ in range(replicas)]
+    fe = ServingFrontend(engines)
+
+    rng = np.random.RandomState(seed)
+    prompts = [rng.randint(0, cfg.vocab_size,
+                           (int(rng.choice(prompt_lens)),)).tolist()
+               for _ in range(num_requests)]
+    # open-loop Poisson arrivals, drawn up front
+    arrivals = np.cumsum(rng.exponential(1.0 / rate_rps, num_requests))
+
+    # warm the two compiled step programs (prefill + pure-decode) outside
+    # the measured window, then zero the registry
+    w = fe.submit(prompts[0], max_new_tokens=max_new)
+    fe.run()
+    assert fe.result(w).ok
+    fe.metrics.reset()
+
+    priorities = [Priority.HIGH if i % 4 == 0 else Priority.NORMAL
+                  for i in range(num_requests)]
+    t0 = time.monotonic()
+    submitted = 0
+    rids = []
+    while fe.pending or submitted < num_requests:
+        now = time.monotonic() - t0
+        while submitted < num_requests and arrivals[submitted] <= now:
+            rids.append(fe.submit(prompts[submitted], max_new_tokens=max_new,
+                                  priority=priorities[submitted]))
+            submitted += 1
+        fe.step()
+    wall_s = time.monotonic() - t0
+
+    res = fe.results()
+    snap = fe.metrics.snapshot()
+    completed = [res[r] for r in rids if res[r].ok]
+    # TTFT percentiles come from the metrics registry itself (every
+    # first-token event this run — all requests completed, so identical
+    # population to a completed-only view)
+    ttft = snap["latency"]["ttft_seconds"]
+
+    return {
+        "metric": "serving_frontend_openloop_tokens_per_sec",
+        "value": round(snap["tokens_per_sec"], 1),
+        "unit": "tokens/s",
+        "extra": {
+            "backend": backend, "batch": B, "block_size": block,
+            "replicas": replicas, "num_requests": num_requests,
+            "rate_rps": rate_rps, "max_new_tokens": max_new,
+            "p50_ttft_ms": round(ttft["p50"] * 1e3, 1),
+            "p95_ttft_ms": round(ttft["p95"] * 1e3, 1),
+            "completed": len(completed),
+            "shed_deadline": snap["counters"]["shed_deadline_total"],
+            "rejected_overloaded":
+                snap["counters"]["rejected_overloaded_total"],
+            "preempted": snap["counters"]["preempted_total"],
+            "peak_queue_depth": snap["gauges"]["queue_depth_peak"],
+            "peak_block_pool_utilization":
+                round(snap["gauges"]["block_pool_utilization_peak"], 3),
+            "engine_steps": snap["counters"]["engine_steps_total"],
+            "wall_s": round(wall_s, 2),
+            "method": "open-loop Poisson arrivals; tokens/s from the "
+                      "metrics registry's first->last emission window",
+        },
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--num-requests", type=int, default=None)
+    ap.add_argument("--rate-rps", type=float, default=None)
+    ap.add_argument("--replicas", type=int, default=1)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+    print(json.dumps(run_bench(num_requests=args.num_requests,
+                               rate_rps=args.rate_rps,
+                               replicas=args.replicas, seed=args.seed)))
+
+
+if __name__ == "__main__":
+    main()

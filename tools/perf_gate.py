@@ -98,7 +98,8 @@ def lower_is_better(rung: Dict) -> bool:
 # and the rung re-baselines (loudly) instead of being gated numerically
 IDENTITY_KEYS = ("workload", "mesh", "backend", "batch", "seq", "img",
                  "prompt", "new_tokens", "ring", "block_size", "ctx_lengths",
-                 "num_micro")
+                 "num_micro", "replicas", "num_requests", "rate_rps",
+                 "max_new_tokens")
 
 
 def config_drift(prev: Dict, cur: Dict) -> List[str]:

@@ -15,6 +15,27 @@ import jax
 import numpy as np
 
 
+def host_fingerprint():
+    """Identity of the machine a wall-clock rung was measured on.  The
+    perf gate treats 'host' as a measurement-config key: rungs recorded
+    on different hosts re-baseline loudly instead of being compared —
+    r7 measured the SAME seed code 1.6-2.2x apart across two 'cpu'
+    dev containers, so cross-host CPU numbers are garbage to gate on."""
+    import platform
+
+    model = "unknown"
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("model name"):
+                    model = line.split(":", 1)[1].strip()
+                    break
+    except OSError:
+        pass
+    slug = "".join(c if c.isalnum() else "-" for c in model)[:40].strip("-")
+    return f"{platform.machine()}-{os.cpu_count()}c-{slug}"
+
+
 def _timeit(step, args, steps):
     """Multi-step timing: the whole window runs as ONE compiled scan
     (TrainStep.run_steps), so per-dispatch host overhead — large for models
@@ -65,7 +86,8 @@ def bench_resnet50():
         "metric": "resnet50_train_imgs_per_sec_per_chip",
         "value": round(batch / dt, 1),
         "unit": "imgs/s",
-        "extra": {"backend": backend, "batch": batch, "img": size,
+        "extra": {"backend": backend, "host": host_fingerprint(),
+                  "batch": batch, "img": size,
                   "step_ms": round(dt * 1e3, 2), "loss": loss},
     }))
 
@@ -114,7 +136,8 @@ def bench_bert_base():
         "metric": "bert_base_finetune_step_ms",
         "value": round(dt * 1e3, 2),
         "unit": "ms/step",
-        "extra": {"backend": backend, "batch": batch, "seq": seq,
+        "extra": {"backend": backend, "host": host_fingerprint(),
+                  "batch": batch, "seq": seq,
                   "examples_per_sec": round(batch / dt, 1), "loss": loss},
     }))
 
@@ -174,7 +197,8 @@ def bench_llama_decode():
         "metric": "llama_1b_decode_tokens_per_sec_per_chip",
         "value": round(tps, 1),
         "unit": "tokens/s",
-        "extra": {"backend": backend, "batch": batch, "prompt": prompt,
+        "extra": {"backend": backend, "host": host_fingerprint(),
+                  "batch": batch, "prompt": prompt,
                   "new_tokens": new, "ring": ring,
                   "ms_per_token_per_seq": round(per_step * 1e3, 2),
                   "method": "slope over decode lengths (removes fixed "
@@ -296,7 +320,8 @@ def bench_serving_mixed():
         "metric": "serving_mixed_decode_tokens_per_sec_per_chip",
         "value": round(tps, 1),
         "unit": "tokens/s",
-        "extra": {"backend": backend, "batch": B, "ctx_lengths": ctx0,
+        "extra": {"backend": backend, "host": host_fingerprint(),
+                  "batch": B, "ctx_lengths": ctx0,
                   "block_size": block, "paged_cache": True,
                   "ms_per_step": round(per_step * 1e3, 3),
                   "method": "slope over in-graph scan lengths "
@@ -306,12 +331,8 @@ def bench_serving_mixed():
     }))
 
 
-def bench_serving_frontend():
-    """Serving control-plane rung (ISSUE 2): open-loop Poisson arrivals
-    through ServingFrontend (admission, priority routing, preemption under
-    a deliberately tight block pool) — steady-state tokens/s plus p50/p95
-    TTFT. The heavy lifting lives in tools/bench_serving.py; this rung
-    re-emits its JSON line so the perf gate sees it in the ladder."""
+def _load_bench_serving():
+    """tools/bench_serving.py by path (it is a script dir, not a package)."""
     import importlib.util
 
     spec = importlib.util.spec_from_file_location(
@@ -320,7 +341,25 @@ def bench_serving_frontend():
                      "tools", "bench_serving.py"))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    print(json.dumps(mod.run_bench()))
+    return mod
+
+
+def bench_serving_frontend():
+    """Serving control-plane rung (ISSUE 2): open-loop Poisson arrivals
+    through ServingFrontend (admission, priority routing, preemption under
+    a deliberately tight block pool) — steady-state tokens/s plus p50/p95
+    TTFT. The heavy lifting lives in tools/bench_serving.py; this rung
+    re-emits its JSON line so the perf gate sees it in the ladder."""
+    print(json.dumps(_load_bench_serving().run_bench()))
+
+
+def bench_serving_fleet():
+    """Cross-host fleet rung (ISSUE 3): the frontend rung's open-loop
+    Poisson workload, but served by 2 remote serving_worker.py processes
+    over the RPC stack instead of in-process replicas — measures what the
+    per-step HTTP round trips and state-mirror sync cost against the
+    in-process number directly above it in the ladder."""
+    print(json.dumps(_load_bench_serving().run_bench_fleet(workers=2)))
 
 
 def bench_pipeline_compiled_vs_eager():
@@ -401,7 +440,8 @@ def bench_pipeline_compiled_vs_eager():
         "metric": "pp_llama_step_ms_compiled_vs_eager",
         "value": round(comp_ms, 2),
         "unit": "ms/step",
-        "extra": {"backend": "cpu-mesh-8dev", "mesh": f"dp{dmp}.mp{dmp}.pp2",
+        "extra": {"backend": "cpu-mesh-8dev", "host": host_fingerprint(),
+                  "mesh": f"dp{dmp}.mp{dmp}.pp2",
                   "eager_step_ms": round(eager_ms, 2),
                   "speedup_vs_eager": round(eager_ms / comp_ms, 2),
                   "num_micro": 4},
@@ -420,5 +460,7 @@ if __name__ == "__main__":
         bench_serving_mixed()
     if which in ("all", "frontend"):
         bench_serving_frontend()
+    if which in ("all", "fleet"):
+        bench_serving_fleet()
     if which in ("all", "pipeline"):
         bench_pipeline_compiled_vs_eager()

@@ -17,11 +17,13 @@ import pickle
 import socket
 import threading
 import time
+import urllib.error
 import urllib.request
 from typing import Any, Dict, List, NamedTuple, Optional
 
 __all__ = ["init_rpc", "shutdown", "rpc_sync", "rpc_async", "get_worker_info",
-           "get_all_worker_infos", "WorkerInfo"]
+           "get_all_worker_infos", "refresh_workers", "WorkerInfo",
+           "RpcTimeout"]
 
 
 class WorkerInfo(NamedTuple):
@@ -31,8 +33,18 @@ class WorkerInfo(NamedTuple):
     port: int
 
 
+class RpcTimeout(TimeoutError):
+    """A per-call RPC deadline expired before the peer answered.
+
+    Typed so callers that drive remote workers (the serving fleet's step
+    loop, heartbeats) can treat a hung peer exactly like a dead one and
+    fail over, instead of blocking the control loop behind a silent
+    worker."""
+
+
 _state: Dict[str, Any] = {
     "server": None, "name": None, "workers": {}, "pool": None, "kv": None,
+    "thread": None,
 }
 
 
@@ -97,7 +109,9 @@ def init_rpc(name: str, rank: Optional[int] = None, world_size: Optional[int] = 
     bind_host = "0.0.0.0" if master_endpoint else "127.0.0.1"
     _state["token"] = os.environ.get("PADDLE_RPC_TOKEN")
     srv = _Server((bind_host, port), _RpcHandler)
-    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    _state["thread"] = thread
     ip = os.environ.get("PADDLE_LOCAL_IP")
     if not ip:
         if master_endpoint:
@@ -149,28 +163,68 @@ def get_all_worker_infos() -> List[WorkerInfo]:
     return sorted(_state["workers"].values(), key=lambda w: w.rank)
 
 
+def refresh_workers() -> Dict[str, WorkerInfo]:
+    """Re-read worker membership from the KV master (dynamic fleets).
+
+    The init-time rendezvous snapshot is static; a serving fleet adds and
+    drains workers after init.  Rebuilds the routing table from the
+    current ``/rpc/workers/`` prefix (always keeping this process's own
+    entry) and returns it.  No-op without a KV master (the in-process
+    registry is always current)."""
+    kv = _state.get("kv")
+    if kv is None:
+        return dict(_state["workers"])
+    entries = kv.get_prefix("/rpc/workers/")
+    workers: Dict[str, WorkerInfo] = {}
+    for key, val in entries.items():
+        wname = key.rsplit("/", 1)[-1]
+        r, ip, p = val.split(":")
+        workers[wname] = WorkerInfo(wname, int(r), ip, int(p))
+    own = _state.get("name")
+    if own and own in _state["workers"]:
+        workers.setdefault(own, _state["workers"][own])
+    _state["workers"] = workers
+    return dict(workers)
+
+
 def _post(info: WorkerInfo, payload: bytes, timeout: float):
     headers = {}
     if _state.get("token"):
         headers["X-Paddle-Rpc-Token"] = _state["token"]
     req = urllib.request.Request(f"http://{info.ip}:{info.port}/", data=payload,
                                  headers=headers, method="POST")
-    with urllib.request.urlopen(req, timeout=timeout) as r:
-        status, value = pickle.loads(r.read())
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            status, value = pickle.loads(r.read())
+    except (socket.timeout, TimeoutError) as e:
+        raise RpcTimeout(
+            f"rpc to '{info.name}' ({info.ip}:{info.port}) timed out after "
+            f"{timeout}s") from e
+    except urllib.error.URLError as e:
+        if isinstance(getattr(e, "reason", None), (socket.timeout, TimeoutError)):
+            raise RpcTimeout(
+                f"rpc to '{info.name}' ({info.ip}:{info.port}) timed out "
+                f"after {timeout}s") from e
+        raise
     if status == "err":
         raise value
     return value
 
 
 def rpc_sync(to: str, fn, args=(), kwargs=None, timeout: float = 300.0):
-    """Run ``fn(*args, **kwargs)`` on worker ``to``; block for the result."""
+    """Run ``fn(*args, **kwargs)`` on worker ``to``; block for the result.
+
+    ``timeout`` is a per-call deadline (connect + the remote execution):
+    past it the call raises a typed ``RpcTimeout`` instead of blocking
+    the caller behind a hung peer."""
     info = get_worker_info(to)
     payload = pickle.dumps((fn, tuple(args), dict(kwargs or {})))
     return _post(info, payload, timeout)
 
 
 def rpc_async(to: str, fn, args=(), kwargs=None, timeout: float = 300.0):
-    """Like rpc_sync but returns a Future (``.wait()``/``.result()``)."""
+    """Like rpc_sync but returns a Future (``.wait()``/``.result()``);
+    the future resolves to ``RpcTimeout`` past the per-call deadline."""
     info = get_worker_info(to)
     payload = pickle.dumps((fn, tuple(args), dict(kwargs or {})))
     fut = _state["pool"].submit(_post, info, payload, timeout)
@@ -182,9 +236,22 @@ def shutdown():
     srv = _state.get("server")
     if srv is not None:
         srv.shutdown()
+        srv.server_close()  # release the listening socket now, not at GC
     pool = _state.get("pool")
     if pool is not None:
-        pool.shutdown(wait=False)
+        # join the executor with a BOUNDED wait: queued-but-unstarted
+        # calls are cancelled and idle/finishing workers are reaped (no
+        # leaked threads on the normal path), but a call hung on a dead
+        # peer must not hold shutdown() hostage for its full per-call
+        # timeout — such stragglers are abandoned to finish (bounded by
+        # that timeout) on their own
+        pool.shutdown(wait=False, cancel_futures=True)
+        deadline = time.time() + 10
+        for t in list(getattr(pool, "_threads", ())):
+            t.join(timeout=max(0.0, deadline - time.time()))
+    thread = _state.get("thread")
+    if thread is not None:
+        thread.join(timeout=10)
     name = _state.get("name")
     kv = _state.get("kv")
     if kv is not None and name:
@@ -194,4 +261,4 @@ def shutdown():
             pass
     _GLOBAL_REGISTRY.pop(name, None)
     _state.update(server=None, name=None, workers={}, pool=None, kv=None,
-                  token=None)
+                  token=None, thread=None)

@@ -22,13 +22,20 @@ from ..tensor.tensor import Tensor
 __all__ = ["Config", "create_predictor", "Predictor", "PredictorPool",
            "BlockManager", "ServingEngine", "ServingRequest",
            "ServingFrontend", "ServingMetrics", "Priority",
-           "RequestStatus", "RequestResult"]
+           "RequestStatus", "RequestResult", "ServingFleet",
+           "RemoteReplica", "FleetAutoscaler", "AutoscalePolicy"]
 
 from .control_plane import (  # noqa: E402
     Priority,
     RequestResult,
     RequestStatus,
     ServingFrontend,
+)
+from .fleet import (  # noqa: E402
+    AutoscalePolicy,
+    FleetAutoscaler,
+    RemoteReplica,
+    ServingFleet,
 )
 from .metrics import ServingMetrics  # noqa: E402
 from .serving import BlockManager, ServingEngine, ServingRequest  # noqa: E402

@@ -32,8 +32,18 @@ stay pure execution loops driven via ``ServingEngine.step()``:
   counters, block-pool utilization) with ``snapshot()`` and a
   Prometheus-text export.
 
-Scope: replicas are in-process single-host engines; cross-host replica
-RPC is the next layer up (ROADMAP open item).
+Frontend → fleet → engine split: a replica is anything exposing the
+ServingEngine driving surface — an in-process engine or a
+``fleet.RemoteReplica`` proxy whose engine lives in a
+``tools/serving_worker.py`` process (spawnable on another host) behind
+the ``distributed/rpc`` stack.  Because the frontend owns all admission
+state, caps like ``class_token_budgets`` hold fleet-wide no matter how
+many replicas exist; ``fleet.ServingFleet`` adds worker spawn/drain,
+heartbeat health-checking (via ``fail_replica``), autoscaling, and
+fleet-wide metrics aggregation on top of this class, and replicas can be
+attached/detached at runtime with ``add_replica``/``remove_replica``
+(``draining`` replicas finish in-flight work but take no new
+placements).
 """
 from __future__ import annotations
 
@@ -112,6 +122,7 @@ class _FrontendRequest:
     engine_rid: Optional[int] = None
     first_token_t: Optional[float] = None
     last_token_t: Optional[float] = None
+    counted_tokens: int = 0        # held against the class token budget
 
     @property
     def remaining_new_tokens(self) -> int:
@@ -128,12 +139,19 @@ class _FrontendRequest:
 
 
 class _Replica:
-    """One engine plus the frontend's view of what runs on it."""
+    """One engine plus the frontend's view of what runs on it.
+
+    ``engine`` is anything with the ServingEngine driving surface
+    (``add_request``/``step``/``evict``/``pop_finished`` + the capacity
+    attrs) — an in-process engine or a ``fleet.RemoteReplica`` proxy.
+    ``draining`` replicas take no new placements but keep stepping until
+    their in-flight requests finish (fleet scale-down)."""
 
     def __init__(self, idx: int, engine: ServingEngine):
         self.idx = idx
         self.engine = engine
         self.alive = True
+        self.draining = False
         self.last_error: Optional[str] = None
         self.requests: Dict[int, _FrontendRequest] = {}  # engine_rid -> req
 
@@ -155,6 +173,7 @@ class ServingFrontend:
     def __init__(self, engines: Union[ServingEngine, Sequence[ServingEngine]],
                  *, max_queue_requests: Optional[int] = None,
                  max_queue_tokens: Optional[int] = None,
+                 class_token_budgets: Optional[Dict[Priority, int]] = None,
                  preemption: bool = True,
                  clock: Callable[[], float] = time.monotonic,
                  metrics: Optional[ServingMetrics] = None):
@@ -166,6 +185,13 @@ class ServingFrontend:
         self._clock = clock
         self.max_queue_requests = max_queue_requests
         self.max_queue_tokens = max_queue_tokens
+        # fleet-wide per-class caps on committed (queued + running) tokens:
+        # the frontend owns admission, so the budget holds across however
+        # many local or remote replicas currently exist
+        self.class_token_budgets = (
+            {Priority(k): int(v) for k, v in class_token_budgets.items()}
+            if class_token_budgets else None)
+        self._class_tokens: Dict[Priority, int] = {p: 0 for p in Priority}
         self.preemption = bool(preemption)
         self.metrics = metrics if metrics is not None else ServingMetrics(clock)
         self._queue: List[_FrontendRequest] = []
@@ -174,6 +200,7 @@ class ServingFrontend:
         self._next_rid = 0
         self._next_seq = 0
         self._rr = 0  # round-robin cursor for routing tie-breaks
+        self._next_replica_idx = len(self._replicas)
 
     @classmethod
     def from_model(cls, model, num_replicas: int = 1, frontend_kwargs=None,
@@ -190,6 +217,36 @@ class ServingFrontend:
     @property
     def num_live_replicas(self) -> int:
         return sum(r.alive for r in self._replicas)
+
+    def add_replica(self, engine) -> _Replica:
+        """Attach a new replica (in-process engine or RemoteReplica proxy)
+        at runtime — the fleet autoscaler's scale-up hook.  The next
+        ``step()`` starts routing to it."""
+        rep = _Replica(self._next_replica_idx, engine)
+        self._next_replica_idx += 1
+        self._replicas.append(rep)
+        return rep
+
+    def remove_replica(self, replica: _Replica):
+        """Detach a replica.  It must be idle (drained) or dead — removing
+        one with in-flight requests would orphan them silently, which the
+        failover path exists to prevent."""
+        if replica.alive and replica.requests:
+            raise RuntimeError(
+                f"remove_replica: replica {replica.idx} still has "
+                f"{len(replica.requests)} in-flight request(s) — drain it "
+                "first (draining=True, wait for them to finish) or let "
+                "failover reap it")
+        self._replicas.remove(replica)
+
+    def fail_replica(self, replica: _Replica, exc: BaseException):
+        """Mark a replica dead and re-queue its in-flight requests from
+        host-side state (public face of the failover path, used by the
+        fleet heartbeat when a SILENT worker — one that never gets stepped
+        because it looks idle, or whose health probe times out — must
+        trigger the same recovery as a step() fault)."""
+        if replica.alive:
+            self._kill_replica(replica, exc)
 
     @property
     def pending(self) -> int:
@@ -230,7 +287,13 @@ class ServingFrontend:
         if not live:
             self._finish(req, RequestStatus.FAILED, "no live replicas")
             return rid
-        if not any(self._fits_at_all(r, req) for r in live):
+        accepting = [r for r in live if not r.draining]
+        if not accepting:
+            self._finish(req, RequestStatus.OVERLOADED,
+                         "every live replica is draining (fleet scale-down "
+                         "in progress) — not admitting")
+            return rid
+        if not any(self._fits_at_all(r, req) for r in accepting):
             self._finish(req, RequestStatus.OVERLOADED,
                          f"prompt+max_new_tokens={req.total_tokens} exceeds "
                          "every live replica's capacity")
@@ -247,6 +310,17 @@ class ServingFrontend:
                              f"queued token budget exhausted ({committed}"
                              f"+{req.total_tokens} > {self.max_queue_tokens})")
                 return rid
+        if self.class_token_budgets is not None:
+            cap = self.class_token_budgets.get(req.priority)
+            held = self._class_tokens[req.priority]
+            if cap is not None and held + req.total_tokens > cap:
+                self._finish(req, RequestStatus.OVERLOADED,
+                             f"class {req.priority.name} token budget "
+                             f"exhausted ({held}+{req.total_tokens} > {cap} "
+                             "fleet-wide)")
+                return rid
+        req.counted_tokens = req.total_tokens
+        self._class_tokens[req.priority] += req.counted_tokens
         self._queue.append(req)
         self.metrics.inc("admitted_total")
         return rid
@@ -260,8 +334,19 @@ class ServingFrontend:
         if req in self._queue:
             self._queue.remove(req)
         elif req.replica is not None:
-            req.replica.engine.evict(req.engine_rid)
-            req.replica.requests.pop(req.engine_rid, None)
+            rep = req.replica
+            try:
+                rep.engine.evict(req.engine_rid)
+            except KeyError:
+                pass  # engine already retired it; harvest races are benign
+            except Exception as e:  # noqa: BLE001 — remote replica fault
+                # a dead/hung remote replica fails over like a step() fault;
+                # _kill_replica re-queues its requests (incl. this one) —
+                # pull it back out before finishing it as cancelled
+                self._kill_replica(rep, e)
+                if req in self._queue:
+                    self._queue.remove(req)
+            rep.requests.pop(req.engine_rid, None)
             req.replica = None
             req.engine_rid = None
         self._finish(req, RequestStatus.CANCELLED, "cancelled by caller")
@@ -280,9 +365,22 @@ class ServingFrontend:
             return
         self._shed_expired()
         self._dispatch()
-        for rep in self._replicas:
-            if rep.alive and (rep.engine.num_active or rep.engine._queue):
-                self._step_replica(rep)
+        stepping = [rep for rep in self._replicas
+                    if rep.alive and (rep.engine.num_active
+                                      or rep.engine._queue)]
+        # remote replicas overlap their engine steps: begin_step issues the
+        # RPC asynchronously, step() below collects it — fleet step latency
+        # is the max of the workers' round trips, not the sum.  In-process
+        # engines have no begin_step and run synchronously as before.
+        for rep in stepping:
+            begin = getattr(rep.engine, "begin_step", None)
+            if begin is not None:
+                try:
+                    begin()
+                except Exception:  # noqa: BLE001 — surfaced by step() below
+                    pass
+        for rep in stepping:
+            self._step_replica(rep)
         self._sample_gauges()
 
     def run(self, max_steps: int = 10_000) -> Dict[int, RequestResult]:
@@ -336,12 +434,23 @@ class ServingFrontend:
                 continue
             for erid, req in list(rep.requests.items()):
                 if req.deadline_t is not None and now >= req.deadline_t:
-                    rep.engine.evict(erid)
+                    try:
+                        rep.engine.evict(erid)
+                    except KeyError:
+                        pass
+                    except Exception as e:  # noqa: BLE001 — replica fault
+                        # failover re-queues the replica's requests; the
+                        # expired one is finished below either way
+                        self._kill_replica(rep, e)
+                    if req in self._queue:   # re-queued by failover
+                        self._queue.remove(req)
                     rep.requests.pop(erid, None)
                     req.replica = None
                     req.engine_rid = None
                     self._finish(req, RequestStatus.DEADLINE_EXCEEDED,
                                  "deadline expired mid-generation")
+                    if not rep.alive:
+                        break
 
     def _dispatch(self):
         # priority order; equal-priority backfill is allowed past a blocked
@@ -356,15 +465,20 @@ class ServingFrontend:
             live = [r for r in self._replicas if r.alive]
             if not live:
                 break
-            if not any(self._fits_at_all(r, req) for r in live):
+            # draining replicas take no NEW placements (they finish what
+            # they have); queued work waits for accepting capacity
+            accepting = [r for r in live if not r.draining]
+            if not accepting:
+                break
+            if not any(self._fits_at_all(r, req) for r in accepting):
                 self._queue.remove(req)
                 self._finish(req, RequestStatus.OVERLOADED,
                              f"prompt+max_new_tokens={req.total_tokens} "
                              "exceeds every live replica's capacity")
                 continue
-            rep = self._pick_replica(req, live)
+            rep = self._pick_replica(req, accepting)
             if rep is None and self.preemption:
-                rep = self._preempt_for(req, live)
+                rep = self._preempt_for(req, accepting)
             if rep is None:
                 barrier = int(req.priority)
                 continue
@@ -422,12 +536,21 @@ class ServingFrontend:
             return None
         _, _, _, rep, take = best
         for v in take:
-            self._preempt(v)
+            if not self._preempt(v):
+                return None    # replica died mid-eviction; failover ran
         return rep
 
-    def _preempt(self, victim: _FrontendRequest):
+    def _preempt(self, victim: _FrontendRequest) -> bool:
+        """Evict ``victim`` and re-queue it; False if its replica faulted
+        (failover then already re-queued everything on it)."""
         rep = victim.replica
-        rep.engine.evict(victim.engine_rid)
+        try:
+            rep.engine.evict(victim.engine_rid)
+        except KeyError:
+            pass  # retired between planning and eviction; slot is free
+        except Exception as e:  # noqa: BLE001 — remote replica fault
+            self._kill_replica(rep, e)
+            return False
         rep.requests.pop(victim.engine_rid, None)
         victim.replica = None
         victim.engine_rid = None
@@ -436,6 +559,7 @@ class ServingFrontend:
         # re-queued with prompt+generated as the new prefill; keeps its
         # original seq so it resumes ahead of younger peers in its class
         self._queue.append(victim)
+        return True
 
     def _assign(self, req: _FrontendRequest, rep: _Replica):
         if req.remaining_new_tokens <= 0:
@@ -451,6 +575,13 @@ class ServingFrontend:
             # (grown) prefill no longer satisfies
             self._finish(req, RequestStatus.OVERLOADED,
                          f"engine rejected request: {e}")
+            return
+        except Exception as e:  # noqa: BLE001 — remote replica fault
+            # a worker that died between heartbeats surfaces here when
+            # dispatch tries to place on it: fail over (re-queues its
+            # in-flight requests) and re-queue this one for a survivor
+            self._kill_replica(rep, e)
+            self._queue.append(req)
             return
         rep.requests[erid] = req
         req.replica = rep
@@ -511,6 +642,9 @@ class ServingFrontend:
             if req.first_token_t is not None else None,
             e2e_s=now - req.submit_t)
         self._results[req.rid] = res
+        if req.counted_tokens:
+            self._class_tokens[req.priority] -= req.counted_tokens
+            req.counted_tokens = 0
         self.metrics.inc(_STATUS_COUNTER[status])
         if status is RequestStatus.COMPLETED:
             self.metrics.observe("e2e_latency_seconds", res.e2e_s)

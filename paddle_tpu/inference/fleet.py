@@ -1,0 +1,734 @@
+"""Cross-host serving fleet: remote ServingEngine replicas behind the
+SLO-aware frontend (the layer ROADMAP's "single-host-per-replica" open
+item asks for; reference analogs: fleet elastic's worker registry +
+health loop for membership, Orca/vLLM's scheduler-over-engine-workers
+split for the data plane).
+
+Three pieces, layered on four existing subsystems:
+
+* **Worker side** — ``tools/serving_worker.py`` builds a ``ServingEngine``
+  in its own process (spawnable on another host), registers with the
+  launch KV master, and serves the module-level ``_w_*`` handlers below
+  over the ``distributed/rpc`` HTTP stack.  One ``_w_health`` probe
+  returns engine scheduling state + a metrics snapshot — heartbeat,
+  state mirror, and autoscaler all share it instead of growing three
+  code paths.
+* **``RemoteReplica``** — duck-types the exact ServingEngine surface
+  ``ServingFrontend`` drives (``add_request``/``step``/``evict``/
+  ``pop_finished`` + the capacity/scheduling attrs), proxying each call
+  over RPC with a per-call timeout.  Every RPC piggybacks the worker's
+  post-call ``state_summary`` so the frontend's local mirror of queue/
+  slots/blocks is exactly what an in-process engine would show — which
+  is why routing, priority admission, deadlines, and recompute
+  preemption work unchanged, and why a local and a remote fleet produce
+  token-identical schedules.
+* **``ServingFleet``** — spawns/attaches workers (parallel process
+  launch + KV-registration wait), builds the ``ServingFrontend`` over
+  the ``RemoteReplica`` set, and adds what only the fleet layer can see:
+  heartbeat health-checking (a silent worker — hung step, SIGKILL, or
+  idle-but-dead — fails over via ``ServingFrontend.fail_replica``, which
+  re-queues its in-flight requests from host-side state), drain-based
+  scale-down (stop admitting, finish in-flight, deregister), and
+  fleet-wide metrics aggregation (``ServingMetrics.merge`` +
+  ``prometheus_text_fleet`` with a ``replica`` label).  The shared
+  admission state (per-class token budgets, queue caps) already lives in
+  the frontend, so it holds fleet-wide by construction.
+* **``FleetAutoscaler``** — queue-depth / SLO-pressure policy object:
+  scales up when queued work per accepting replica (or p95 TTFT) stays
+  above target, drains the most idle worker after enough consecutive
+  idle observations, never leaves fewer than ``min_workers`` accepting.
+
+Failure contract: any RPC fault (connection refused after SIGKILL, typed
+``RpcTimeout`` from a hung worker) surfaces either in ``step()`` —
+caught by the frontend's existing failover — or in the heartbeat, which
+routes through the same path.  Requests are re-queued from frontend-side
+state (prompt + tokens harvested so far) and finish on survivors with
+greedy-identical tokens; nothing is dropped.
+
+Scope note: each worker is still one host / one engine; true multi-host
+TPU meshes *per replica* (a sharded engine spanning hosts) remain open.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+from .control_plane import ServingFrontend
+from .metrics import ServingMetrics
+
+__all__ = ["RemoteReplica", "ServingFleet", "FleetAutoscaler",
+           "AutoscalePolicy", "init_worker"]
+
+
+# --------------------------------------------------------------------------
+# worker side: process-global engine + module-level RPC handlers.  The rpc
+# stack pickles functions BY REFERENCE (module + qualname), so these must be
+# importable under the same path in the worker process.
+# --------------------------------------------------------------------------
+_WORKER: Dict[str, Any] = {
+    "engine": None, "metrics": None, "stop": None, "name": None,
+}
+
+
+def init_worker(engine, name: str,
+                stop: Optional[threading.Event] = None,
+                metrics: Optional[ServingMetrics] = None) -> threading.Event:
+    """Install ``engine`` as this process's served replica (called by
+    tools/serving_worker.py before ``rpc.init_rpc``).  Returns the stop
+    event ``_w_shutdown`` sets."""
+    _WORKER["engine"] = engine
+    _WORKER["metrics"] = metrics if metrics is not None else ServingMetrics()
+    _WORKER["stop"] = stop if stop is not None else threading.Event()
+    _WORKER["name"] = name
+    return _WORKER["stop"]
+
+
+def _engine():
+    eng = _WORKER["engine"]
+    if eng is None:
+        raise RuntimeError("serving worker not initialised (init_worker)")
+    return eng
+
+
+def _w_config() -> Dict:
+    eng = _engine()
+    return {
+        "max_batch_size": eng.B, "token_budget": eng.T, "block_size": eng.bs,
+        "max_seq_len": eng.max_seq_len, "num_blocks": eng.blocks.num_blocks,
+        "cache_quant": eng.cache_quant, "pid": os.getpid(),
+    }
+
+
+def _w_add_request(prompt, max_new_tokens, eos_token_id=None):
+    eng = _engine()
+    rid = eng.add_request(prompt, max_new_tokens=max_new_tokens,
+                          eos_token_id=eos_token_id)
+    return rid, eng.state_summary()
+
+
+def _w_step():
+    eng = _engine()
+    emitted = eng.step()
+    finished = eng.pop_finished()
+    m = _WORKER["metrics"]
+    m.inc("engine_steps_total")
+    n_tok = sum(len(t) for t in emitted.values())
+    if n_tok:
+        m.note_tokens(n_tok)
+    st = eng.state_summary()
+    m.set_gauge_peak("queue_depth", st["queue_depth"])
+    m.set_gauge("running_requests", st["num_active"])
+    m.set_gauge("blocks_total", st["blocks_total"])
+    m.set_gauge("blocks_free", st["blocks_free"])
+    m.set_gauge_peak("block_pool_utilization", st["pool_utilization"])
+    m.inc("completed_total", len(finished))
+    return emitted, finished, st
+
+
+def _w_evict(rid):
+    eng = _engine()
+    eng.evict(rid)
+    return eng.state_summary()
+
+
+def _w_health(include_samples: bool = False):
+    """The one shared probe: heartbeat liveness, autoscaler load signals,
+    and metrics aggregation all read this."""
+    eng = _engine()
+    return {
+        "state": eng.state_summary(),
+        "metrics": _WORKER["metrics"].snapshot(include_samples=include_samples),
+        "config": _w_config(),
+        "draining": False,  # drain state is frontend-side; kept for probes
+        "name": _WORKER["name"],
+    }
+
+
+def _w_reset_metrics():
+    """Zero the worker's registry (benches call this after the warmup/
+    compile phase so engine-level counters cover the same measured window
+    as the frontend's)."""
+    _WORKER["metrics"].reset()
+    return True
+
+
+def _w_shutdown():
+    _WORKER["stop"].set()
+    return True
+
+
+# --------------------------------------------------------------------------
+# frontend side
+# --------------------------------------------------------------------------
+class _QView:
+    """Mirror of one queued-but-unadmitted remote request; exposes the two
+    things frontend headroom math reads (``len(prompt)``,
+    ``max_new_tokens``)."""
+
+    __slots__ = ("rid", "prompt", "max_new_tokens")
+
+    def __init__(self, rid: int, prompt_len: int, max_new_tokens: int):
+        self.rid = rid
+        self.prompt = range(prompt_len)
+        self.max_new_tokens = max_new_tokens
+
+
+class _ActiveView:
+    """Mirror of one running remote request; ``len(blocks)`` feeds the
+    preemption victim-sizing math."""
+
+    __slots__ = ("blocks",)
+
+    def __init__(self, num_blocks: int):
+        self.blocks = range(num_blocks)
+
+
+class _RemoteBlockView:
+    """BlockManager facade over the worker's last-synced pool state."""
+
+    def __init__(self, num_blocks: int, num_free: int):
+        self.num_blocks = num_blocks
+        self.num_free = num_free
+
+
+class RemoteReplica:
+    """ServingEngine-shaped proxy for an engine living in a worker process.
+
+    The frontend schedules against a local mirror of the worker's host-side
+    state (queue, free slots, free blocks, per-request block counts); every
+    RPC returns the worker's post-call ``state_summary`` and the mirror is
+    replaced wholesale, so it is exactly as fresh as an in-process engine's
+    own attributes between frontend operations.  All calls carry
+    ``rpc_timeout`` — a hung worker raises ``RpcTimeout`` into the
+    frontend's failover path instead of freezing the step loop."""
+
+    def __init__(self, worker_name: str, rpc_timeout: float = 60.0):
+        from ..distributed import rpc
+
+        self._rpc = rpc
+        self.worker = worker_name
+        self.rpc_timeout = float(rpc_timeout)
+        h = self._call(_w_health)
+        cfg = h["config"]
+        self.B = int(cfg["max_batch_size"])
+        self.T = int(cfg["token_budget"])
+        self.bs = int(cfg["block_size"])
+        self.max_seq_len = int(cfg["max_seq_len"])
+        self.cache_quant = cfg["cache_quant"]
+        self.pid = cfg["pid"]
+        self.blocks = _RemoteBlockView(int(cfg["num_blocks"]),
+                                       int(cfg["num_blocks"]))
+        self._queue: List[_QView] = []
+        self._active: Dict[int, _ActiveView] = {}
+        self._free_slots: List[int] = list(range(self.B))
+        self._finished: Dict[int, List[int]] = {}
+        self._pending_step = None
+        self._apply_state(h["state"])
+
+    # ------------------------------------------------------------ plumbing
+    def _call(self, fn, *args):
+        return self._rpc.rpc_sync(self.worker, fn, args=args,
+                                  timeout=self.rpc_timeout)
+
+    def _apply_state(self, st: Dict):
+        self._queue = [_QView(rid, pl, mn) for rid, pl, mn in st["queued"]]
+        self._active = {rid: _ActiveView(nb)
+                        for rid, nb in st["active"].items()}
+        self._free_slots = list(range(st["free_slots"]))
+        self.blocks.num_free = int(st["blocks_free"])
+
+    # ----------------------------------------------- ServingEngine surface
+    @property
+    def num_active(self) -> int:
+        return len(self._active)
+
+    def add_request(self, prompt_ids, max_new_tokens: int = 32,
+                    eos_token_id: Optional[int] = None) -> int:
+        prompt = [int(t) for t in prompt_ids]
+        rid, st = self._call(_w_add_request, prompt, int(max_new_tokens),
+                             eos_token_id)
+        self._apply_state(st)
+        return rid
+
+    def begin_step(self):
+        """Issue the step RPC without waiting (the frontend calls this on
+        every replica first, then collects via ``step()`` — concurrent
+        replicas overlap their engine steps instead of serializing the
+        HTTP round trips)."""
+        if self._pending_step is None:
+            self._pending_step = self._rpc.rpc_async(
+                self.worker, _w_step, timeout=self.rpc_timeout)
+
+    def step(self) -> Dict[int, List[int]]:
+        fut = self._pending_step
+        self._pending_step = None
+        if fut is not None:
+            emitted, finished, st = fut.result()
+        else:
+            emitted, finished, st = self._call(_w_step)
+        self._apply_state(st)
+        self._finished.update(finished)
+        return emitted
+
+    def pop_finished(self) -> Dict[int, List[int]]:
+        out = self._finished
+        self._finished = {}
+        return out
+
+    def evict(self, rid: int):
+        st = self._call(_w_evict, rid)
+        self._apply_state(st)
+
+    # --------------------------------------------------- fleet-layer extras
+    def health(self, include_samples: bool = False,
+               timeout: Optional[float] = None) -> Dict:
+        """Probe the worker; ``timeout`` overrides the data-plane timeout
+        (heartbeats use a short one so a hung worker is detected within
+        ~a heartbeat interval, not after a full data-plane deadline)."""
+        h = self._rpc.rpc_sync(self.worker, _w_health,
+                               args=(include_samples,),
+                               timeout=self.rpc_timeout
+                               if timeout is None else timeout)
+        self._apply_state(h["state"])
+        return h
+
+    def request_shutdown(self, timeout: Optional[float] = None):
+        self._rpc.rpc_sync(self.worker, _w_shutdown,
+                           timeout=self.rpc_timeout
+                           if timeout is None else timeout)
+
+
+@dataclass
+class AutoscalePolicy:
+    """Knobs for ``FleetAutoscaler`` (all observation-count based so tests
+    can drive it deterministically with an injected clockless loop)."""
+
+    min_workers: int = 1
+    max_workers: int = 4
+    # scale up when queued requests per accepting replica exceed this...
+    scale_up_queue_per_replica: float = 2.0
+    # ...or when p95 TTFT (from the frontend registry) exceeds this SLO
+    scale_up_ttft_p95_s: Optional[float] = None
+    # consecutive pressured/idle observations required to act
+    up_after: int = 2
+    down_after: int = 3
+    # observations to wait after any scale action before the next one
+    cooldown: int = 2
+
+
+class FleetAutoscaler:
+    """Queue-depth / SLO-pressure replica autoscaler.
+
+    Call ``observe()`` once per control-plane iteration (ServingFleet does
+    this from ``step()``).  Decisions: spawn a worker when sustained
+    pressure, drain the most idle worker when sustained idleness, hold
+    otherwise.  Drain = stop admitting (frontend ``draining`` flag),
+    finish in-flight, deregister + reap (ServingFleet completes it once
+    the replica is empty)."""
+
+    def __init__(self, fleet: "ServingFleet",
+                 policy: Optional[AutoscalePolicy] = None):
+        self.fleet = fleet
+        self.policy = policy or AutoscalePolicy()
+        self._pressure = 0
+        self._idle = 0
+        self._cooldown = 0
+        self.actions: List[str] = []  # audit trail ("up:worker2", ...)
+
+    def observe(self) -> str:
+        """One autoscaling observation; returns 'up', 'down', or 'hold'."""
+        pol = self.policy
+        fe = self.fleet.frontend
+        if fe is None:  # fleet created with num_workers=0, none spawned yet
+            return "hold"
+        accepting = [r for r in fe.replicas if r.alive and not r.draining]
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            return "hold"
+        queue_depth = len(fe._queue)
+        per_rep = queue_depth / max(len(accepting), 1)
+        pressured = per_rep > pol.scale_up_queue_per_replica
+        if not pressured and pol.scale_up_ttft_p95_s is not None:
+            # summary(), not snapshot(): this runs every fleet step and a
+            # full snapshot sorts every latency buffer just to read one p95
+            p95 = fe.metrics.summary("ttft_seconds")["p95"]
+            pressured = p95 > pol.scale_up_ttft_p95_s
+        busy = queue_depth > 0 or any(len(r.requests) for r in accepting)
+        self._pressure = self._pressure + 1 if pressured else 0
+        self._idle = self._idle + 1 if not busy else 0
+
+        if (self._pressure >= pol.up_after
+                and len(accepting) < pol.max_workers):
+            name = self.fleet.spawn_worker()
+            self.actions.append(f"up:{name}")
+            self._pressure = 0
+            self._cooldown = pol.cooldown
+            return "up"
+        if (self._idle >= pol.down_after
+                and len(accepting) > pol.min_workers):
+            victim = min(accepting, key=lambda r: len(r.requests))
+            self.fleet.drain_replica(victim)
+            self.actions.append(f"down:{victim.engine.worker}")
+            self._idle = 0
+            self._cooldown = pol.cooldown
+            return "down"
+        return "hold"
+
+
+class ServingFleet:
+    """Remote-replica data plane: worker processes + frontend + heartbeat.
+
+    >>> fleet = ServingFleet(worker_spec={"seed": 11, "model": {...},
+    ...                                   "engine": {...}}, num_workers=2)
+    >>> rid = fleet.frontend.submit([1, 5, 7], max_new_tokens=16)
+    >>> results = fleet.run()
+    >>> fleet.shutdown()
+
+    ``worker_spec`` is the JSON-able model/engine recipe every spawned
+    worker builds (seeded identically, so greedy decode is replica-
+    independent).  Pass ``master_endpoint`` to join an existing KV master
+    (e.g. workers pre-started on other hosts via ``attach_worker``);
+    otherwise the fleet starts its own in-process ``KVServer``.
+    ``cpu_workers=True`` (default) pins spawned workers to
+    ``JAX_PLATFORMS=cpu`` exactly like the standalone-serving test
+    subprocesses — pass False to let workers use the host's accelerator
+    config."""
+
+    def __init__(self, worker_spec: Dict, num_workers: int = 0, *,
+                 master_endpoint: Optional[str] = None,
+                 frontend_kwargs: Optional[Dict] = None,
+                 rpc_timeout: float = 60.0,
+                 spawn_timeout: float = 120.0,
+                 heartbeat_interval_s: float = 1.0,
+                 heartbeat_timeout_s: float = 5.0,
+                 cpu_workers: bool = True,
+                 autoscaler_policy: Optional[AutoscalePolicy] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        from ..distributed import rpc
+        from ..distributed.launch.master import KVClient, KVServer
+
+        self.worker_spec = dict(worker_spec)
+        self.rpc_timeout = float(rpc_timeout)
+        self.spawn_timeout = float(spawn_timeout)
+        self.heartbeat_interval_s = float(heartbeat_interval_s)
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.cpu_workers = bool(cpu_workers)
+        self._clock = clock
+        self._rpc = rpc
+        self._kv_server = None
+        if master_endpoint is None:
+            self._kv_server = KVServer(0).start()
+            master_endpoint = f"127.0.0.1:{self._kv_server.port}"
+        self.master_endpoint = master_endpoint
+        self._kv = KVClient(master_endpoint)
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._logs: Dict[str, str] = {}
+        self._next_worker = 0
+        self._last_heartbeat = -float("inf")
+        self._frontend_kwargs = dict(frontend_kwargs or {})
+        self.frontend: Optional[ServingFrontend] = None
+        self.autoscaler: Optional[FleetAutoscaler] = None
+        self._rpc_inited = False
+        # from here on every failure funnels through shutdown() so the
+        # just-started KVServer (thread + port) cannot leak — init_rpc
+        # itself raises when this process already has an rpc session
+        try:
+            rpc.init_rpc("fleet-frontend", rank=0, world_size=1,
+                         master_endpoint=master_endpoint)
+            self._rpc_inited = True
+            names = [self._launch() for _ in range(num_workers)]
+            for name in names:
+                self._await_worker(name)
+        except Exception:
+            self.shutdown()
+            raise
+        if autoscaler_policy is not None:
+            self.autoscaler = FleetAutoscaler(self, autoscaler_policy)
+
+    # ------------------------------------------------------- worker launch
+    def _worker_script(self) -> str:
+        here = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        return os.path.join(here, "tools", "serving_worker.py")
+
+    def _launch(self, name: Optional[str] = None) -> str:
+        """Start a worker process (non-blocking); pair with _await_worker."""
+        if name is None:
+            name = f"worker{self._next_worker}"
+            self._next_worker += 1
+        cmd = [sys.executable, self._worker_script(),
+               "--master", self.master_endpoint, "--name", name,
+               "--spec-json", json.dumps(self.worker_spec)]
+        if self.cpu_workers:
+            cmd += ["--platform", "cpu"]
+        # stderr to a file, not a pipe: nobody drains worker pipes and a
+        # chatty worker (jax warnings) would block on a full pipe buffer
+        log = tempfile.NamedTemporaryFile(
+            mode="w+", prefix=f"paddle_tpu_{name}_", suffix=".log",
+            delete=False)
+        self._logs[name] = log.name
+        self._procs[name] = subprocess.Popen(
+            cmd, stdout=log, stderr=subprocess.STDOUT)
+        log.close()
+        return name
+
+    def worker_log(self, name: str, tail: int = 2000) -> str:
+        path = self._logs.get(name)
+        if not path or not os.path.exists(path):
+            return ""
+        with open(path) as f:
+            return f.read()[-tail:]
+
+    def _await_worker(self, name: str):
+        """Block until ``name`` registers with the KV master, then attach
+        its RemoteReplica to the frontend."""
+        proc = self._procs[name]
+        # real wall clock, NOT the injectable self._clock: this loop
+        # actually sleeps, and a frozen/jumping test clock would make the
+        # spawn deadline never (or spuriously) fire
+        deadline = time.monotonic() + self.spawn_timeout
+        while self._kv.get(f"/rpc/workers/{name}") is None:
+            if proc.poll() is not None:
+                err = self.worker_log(name)
+                self._procs.pop(name, None)
+                self._drop_log(name)
+                raise RuntimeError(
+                    f"serving worker '{name}' exited rc={proc.returncode} "
+                    f"before registering:\n{err}")
+            if time.monotonic() > deadline:
+                proc.kill()
+                proc.wait(timeout=10)  # reap — no zombie behind the raise
+                self._procs.pop(name, None)
+                self._drop_log(name)
+                raise TimeoutError(
+                    f"serving worker '{name}' did not register within "
+                    f"{self.spawn_timeout}s")
+            time.sleep(0.05)
+        self._rpc.refresh_workers()
+        self.attach_worker(name)
+
+    def attach_worker(self, name: str):
+        """Wrap an already-registered worker (spawned here or started by an
+        operator on another host) in a RemoteReplica and route to it."""
+        self._rpc.refresh_workers()
+        replica = RemoteReplica(name, rpc_timeout=self.rpc_timeout)
+        if self.frontend is None:
+            self.frontend = ServingFrontend([replica],
+                                            **self._frontend_kwargs)
+        else:
+            self.frontend.add_replica(replica)
+        return replica
+
+    def spawn_worker(self, name: Optional[str] = None) -> str:
+        """Launch + register + attach one new worker (autoscale-up hook).
+        Blocking: the worker is routable when this returns."""
+        name = self._launch(name)
+        self._await_worker(name)
+        return name
+
+    # ------------------------------------------------------------- driving
+    @property
+    def workers(self) -> List[str]:
+        if self.frontend is None:
+            return []
+        return [r.engine.worker for r in self.frontend.replicas
+                if isinstance(r.engine, RemoteReplica)]
+
+    def _require_frontend(self) -> ServingFrontend:
+        if self.frontend is None:
+            raise RuntimeError(
+                "ServingFleet has no workers yet (num_workers=0 and nothing "
+                "attached) — spawn_worker()/attach_worker() first")
+        return self.frontend
+
+    def step(self):
+        """One fleet iteration: heartbeat (rate-limited), autoscale (if
+        attached), frontend step, reap drained/dead workers."""
+        fe = self._require_frontend()
+        now = self._clock()
+        if now - self._last_heartbeat >= self.heartbeat_interval_s:
+            self._last_heartbeat = now
+            self.heartbeat()
+        if self.autoscaler is not None:
+            self.autoscaler.observe()
+        fe.step()
+        self._reap()
+
+    def run(self, max_steps: int = 10_000):
+        """Drive ``step()`` until every submitted request has a result
+        (same contract/failure mode as ``ServingFrontend.run``)."""
+        fe = self._require_frontend()
+        for _ in range(max_steps):
+            if not fe.pending:
+                break
+            self.step()
+        if fe.pending:
+            raise RuntimeError(
+                f"ServingFleet.run: max_steps={max_steps} exhausted with "
+                f"{fe.pending} unresolved request(s)")
+        return fe.results()
+
+    def heartbeat(self):
+        """Probe every live replica's health RPC; a silent worker (probe
+        raises — SIGKILLed process, or a hung handler past the SHORT
+        ``heartbeat_timeout_s``, so detection is bounded by roughly one
+        interval rather than the 60 s data-plane deadline) is failed over
+        exactly like a step() fault: marked dead, in-flight requests
+        re-queued from frontend-side state."""
+        if self.frontend is None:
+            return
+        for rep in self.frontend.replicas:
+            if not rep.alive or not isinstance(rep.engine, RemoteReplica):
+                continue
+            try:
+                rep.engine.health(timeout=self.heartbeat_timeout_s)
+            except Exception as e:  # noqa: BLE001 — any probe fault = dead
+                self.frontend.fail_replica(rep, e)
+
+    # ------------------------------------------------------------ draining
+    def drain_replica(self, rep):
+        """Begin scale-down of one replica: stop admitting to it; once its
+        in-flight work finishes, ``step()`` deregisters the worker and
+        reaps the process."""
+        rep.draining = True
+
+    def _reap(self):
+        for rep in list(self.frontend.replicas):
+            if not isinstance(rep.engine, RemoteReplica):
+                continue
+            name = rep.engine.worker
+            if rep.alive and rep.draining and not rep.requests \
+                    and not rep.engine._queue and not rep.engine._active:
+                try:
+                    # a drained worker is idle; the short probe timeout is
+                    # the right bound (a wedged one just gets SIGKILLed)
+                    rep.engine.request_shutdown(self.heartbeat_timeout_s)
+                except Exception:
+                    pass
+                self.frontend.remove_replica(rep)
+                self._reap_proc(name)
+            elif not rep.alive:
+                # failover already re-queued its requests; deregister
+                self.frontend.remove_replica(rep)
+                self._reap_proc(name, kill=True)
+
+    def _reap_proc(self, name: str, kill: bool = False, timeout: float = 30):
+        # the KV deregistration must happen even for externally-attached
+        # workers (no local Popen): a stale /rpc/workers entry would keep
+        # a dead worker in everyone's routing table on the next refresh
+        self._kv.delete(f"/rpc/workers/{name}")
+        proc = self._procs.pop(name, None)
+        if proc is None:
+            return
+        try:
+            if kill and proc.poll() is None:
+                proc.kill()
+            proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
+        self._drop_log(name)
+
+    def _drop_log(self, name: str):
+        path = self._logs.pop(name, None)
+        if path:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    # ------------------------------------------------------------- metrics
+    def worker_snapshots(self, include_samples: bool = True) -> Dict[str, Dict]:
+        """{worker_name: metrics snapshot} from every reachable replica."""
+        out: Dict[str, Dict] = {}
+        for rep in self.frontend.replicas:
+            if not rep.alive or not isinstance(rep.engine, RemoteReplica):
+                continue
+            try:
+                out[rep.engine.worker] = \
+                    rep.engine.health(include_samples)["metrics"]
+            except Exception:
+                pass
+        return out
+
+    def reset_worker_metrics(self):
+        """Zero every reachable worker's registry (pair with
+        ``frontend.metrics.reset()`` when excluding a warmup window)."""
+        for rep in self.frontend.replicas:
+            if not rep.alive or not isinstance(rep.engine, RemoteReplica):
+                continue
+            try:
+                self._rpc.rpc_sync(rep.engine.worker, _w_reset_metrics,
+                                   timeout=rep.engine.rpc_timeout)
+            except Exception:
+                pass
+
+    def merged_snapshot(self) -> Dict:
+        """One fleet-wide engine-level snapshot (ServingMetrics.merge of
+        the per-worker registries).  Request-level metrics (TTFT, e2e,
+        admission counters) live in ``self.frontend.metrics`` — the two
+        views count different things, so they are not summed together."""
+        return ServingMetrics.merge(self.worker_snapshots())
+
+    def prometheus_text(self) -> str:
+        """One scrape page: every worker's engine-level series plus the
+        frontend's request-level series, each with a ``replica`` label.
+        Rendering only reads the precomputed quantile summaries, so the
+        raw sample buffers (up to ~1.5 MB pickled per worker) stay out of
+        the per-scrape RPCs — ``merged_snapshot`` is the path that needs
+        them for exact fleet-wide percentiles."""
+        snaps = dict(self.worker_snapshots(include_samples=False))
+        snaps["frontend"] = self.frontend.metrics.snapshot()
+        return ServingMetrics.prometheus_text_fleet(snaps)
+
+    # ------------------------------------------------------------ shutdown
+    def shutdown(self):
+        """Stop every worker (polite RPC first, then kill), the RPC state,
+        and the KV master.  Idempotent."""
+        if self.frontend is not None:
+            for rep in self.frontend.replicas:
+                if rep.alive and isinstance(rep.engine, RemoteReplica):
+                    try:
+                        # heartbeat timeout, not the 60 s data-plane one: a
+                        # hung worker must not stall shutdown per replica
+                        rep.engine.request_shutdown(self.heartbeat_timeout_s)
+                    except Exception:
+                        pass
+        for name, proc in list(self._procs.items()):
+            # SIGTERM (the worker installs a handler that sets its stop
+            # event) covers workers that never got the polite RPC — e.g.
+            # a spawn that timed out mid-__init__ — without the 15 s stall
+            if proc.poll() is None:
+                proc.terminate()
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pass
+            self._procs.pop(name, None)
+            self._drop_log(name)
+        if self._rpc_inited:
+            # only tear down the rpc session THIS fleet created — when
+            # init_rpc refused because the process already had one (e.g. a
+            # concurrent fleet), that session belongs to someone else
+            self._rpc.shutdown()
+            self._rpc_inited = False
+        if self._kv_server is not None:
+            self._kv_server.stop()
+            self._kv_server = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()

@@ -12,13 +12,22 @@ latency, end-to-end) with percentile summaries.  Two exports:
 * ``prometheus_text()`` — Prometheus text exposition (counter/gauge
   lines + ``summary`` quantiles) for scraping.
 
+Fleet aggregation (the cross-host serving layer in
+``inference/fleet.py``): each remote worker keeps its own registry and
+ships ``snapshot(include_samples=True)`` dicts over RPC;
+``ServingMetrics.merge(snapshots)`` folds them into one snapshot
+(counters summed, peaks maxed, pool utilization recomputed from merged
+totals, percentiles recomputed from raw samples when present), and
+``prometheus_text_fleet({name: snapshot})`` renders one scrape page
+with a ``replica`` label per series.
+
 The clock is injectable so deadline/latency behavior is deterministic
 under test; nothing here touches the device.
 """
 from __future__ import annotations
 
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, Iterable, List, Mapping, Optional, Union
 
 __all__ = ["ServingMetrics"]
 
@@ -126,6 +135,13 @@ class ServingMetrics:
                     / (self._last_emit_t - self._first_emit_t))
         return tokens / max(self._clock() - self._t0, 1e-9)
 
+    def summary(self, name: str) -> Dict[str, float]:
+        """Quantile summary of ONE sample series (count/sum/mean/p50/p95/
+        max) — what hot-loop consumers like the autoscaler's TTFT check
+        should call instead of a full ``snapshot()`` (which sorts every
+        series)."""
+        return self._summary(name)
+
     def _summary(self, name: str) -> Dict[str, float]:
         vals = sorted(self._samples.get(name, []))
         cnt = self._sample_counts.get(name, 0)
@@ -138,15 +154,170 @@ class ServingMetrics:
             "max": vals[-1] if vals else 0.0,
         }
 
-    def snapshot(self) -> Dict:
-        """Programmatic point-in-time view of the whole registry."""
-        return {
+    def snapshot(self, include_samples: bool = False) -> Dict:
+        """Programmatic point-in-time view of the whole registry.
+
+        ``include_samples=True`` additionally carries the raw latency
+        sample buffers (bounded by ``max_samples``) so a downstream
+        ``merge`` can recompute exact percentiles across registries —
+        this is what fleet workers ship over RPC."""
+        snap = {
             "uptime_s": self._clock() - self._t0,
             "counters": dict(self._counters),
             "gauges": dict(self._gauges),
             "latency": {k: self._summary(k) for k in self._samples},
             "tokens_per_sec": self.tokens_per_sec(),
         }
+        if include_samples:
+            snap["samples"] = {k: list(v) for k, v in self._samples.items()}
+        return snap
+
+    # ------------------------------------------------------- fleet merging
+    @staticmethod
+    def merge(snapshots: Union[Mapping[str, Dict], Iterable[Dict]]) -> Dict:
+        """Fold per-replica ``snapshot()`` dicts into one fleet snapshot.
+
+        Counters and token rates are summed (parallel replicas add),
+        additive gauges (queue depth, running requests, block totals) are
+        summed, ``*_peak`` gauges are maxed, and the block-pool
+        utilization pair is recomputed from the merged free/total so it
+        stays a true fleet-wide ratio.  Latency percentiles are exact
+        when the snapshots carry raw samples (``include_samples=True``);
+        otherwise they fall back to a count-weighted average of the
+        per-replica quantiles (labelled via ``percentiles_exact``)."""
+        if isinstance(snapshots, Mapping):
+            snaps = list(snapshots.values())
+        else:
+            snaps = list(snapshots)
+        if not snaps:
+            return {"uptime_s": 0.0, "counters": {}, "gauges": {},
+                    "latency": {}, "tokens_per_sec": 0.0,
+                    "percentiles_exact": True, "num_replicas": 0}
+        counters: Dict[str, int] = {}
+        for s in snaps:
+            for k, v in (s.get("counters") or {}).items():
+                counters[k] = counters.get(k, 0) + v
+        gauges: Dict[str, float] = {}
+        for s in snaps:
+            for k, v in (s.get("gauges") or {}).items():
+                if k.endswith("_peak"):
+                    gauges[k] = max(gauges.get(k, 0.0), float(v))
+                else:
+                    gauges[k] = gauges.get(k, 0.0) + float(v)
+        total = gauges.get("blocks_total", 0.0)
+        free = gauges.get("blocks_free", 0.0)
+        if "block_pool_utilization" in gauges:
+            gauges["block_pool_utilization"] = \
+                (1.0 - free / total) if total else 0.0
+        have_samples = all("samples" in s for s in snaps)
+        names: List[str] = []
+        for s in snaps:
+            for k in (s.get("latency") or {}):
+                if k not in names:
+                    names.append(k)
+        latency: Dict[str, Dict[str, float]] = {}
+        for name in names:
+            subs = [s["latency"][name] for s in snaps
+                    if name in (s.get("latency") or {})]
+            cnt = sum(int(x.get("count", 0)) for x in subs)
+            tot = sum(float(x.get("sum", 0.0)) for x in subs)
+            out = {"count": cnt, "sum": tot,
+                   "mean": (tot / cnt) if cnt else 0.0,
+                   "max": max((float(x.get("max", 0.0)) for x in subs),
+                              default=0.0)}
+            if have_samples:
+                vals = sorted(v for s in snaps
+                              for v in (s["samples"].get(name) or []))
+                out["p50"] = _percentile(vals, 0.50)
+                out["p95"] = _percentile(vals, 0.95)
+            else:
+                for q in ("p50", "p95"):
+                    out[q] = (sum(float(x.get(q, 0.0)) * int(x.get("count", 0))
+                                  for x in subs) / cnt) if cnt else 0.0
+            latency[name] = out
+        return {
+            "uptime_s": max(float(s.get("uptime_s", 0.0)) for s in snaps),
+            "counters": counters,
+            "gauges": gauges,
+            "latency": latency,
+            "tokens_per_sec": sum(float(s.get("tokens_per_sec", 0.0))
+                                  for s in snaps),
+            "percentiles_exact": have_samples,
+            "num_replicas": len(snaps),
+        }
+
+    # ----------------------------------------------------------- rendering
+    @staticmethod
+    def _render_families(snapshot: Dict,
+                         labels: Optional[Dict[str, str]] = None):
+        """-> [(family_name, prom_type, [sample lines])] for one snapshot.
+        The grouping unit matters: the exposition format requires ALL
+        samples of a metric family to sit together under one # TYPE
+        header, so multi-snapshot renderers merge at family granularity.
+        """
+        base = [f'{k}="{v}"' for k, v in (labels or {}).items()]
+
+        def series(name: str, *extra: str) -> str:
+            lab = ",".join(base + list(extra))
+            return f"{name}{{{lab}}}" if lab else name
+
+        fams = []
+        for name in sorted(snapshot.get("counters") or {}):
+            full = _PREFIX + name
+            fams.append((full, "counter",
+                         [f"{series(full)} {snapshot['counters'][name]}"]))
+        gauges = dict(snapshot.get("gauges") or {})
+        gauges["tokens_per_sec"] = snapshot.get("tokens_per_sec", 0.0)
+        for name in sorted(gauges):
+            full = _PREFIX + name
+            fams.append((full, "gauge",
+                         [f"{series(full)} {gauges[name]:.6g}"]))
+        for name in sorted(snapshot.get("latency") or {}):
+            full = _PREFIX + name
+            s = snapshot["latency"][name]
+            q50, q95 = 'quantile="0.5"', 'quantile="0.95"'
+            fams.append((full, "summary", [
+                f"{series(full, q50)} {s['p50']:.6g}",
+                f"{series(full, q95)} {s['p95']:.6g}",
+                f"{series(full + '_count')} {s['count']}",
+                f"{series(full + '_sum')} {s['sum']:.6g}"]))
+        return fams
+
+    @staticmethod
+    def render_prometheus(snapshot: Dict,
+                          labels: Optional[Dict[str, str]] = None) -> List[str]:
+        """Render one ``snapshot()`` dict as Prometheus text-exposition
+        lines; ``labels`` (e.g. ``{"replica": "worker0"}``) are attached
+        to every series.  Returns the lines (callers join pages)."""
+        lines: List[str] = []
+        for fam, ptype, samples in ServingMetrics._render_families(snapshot,
+                                                                   labels):
+            lines.append(f"# TYPE {fam} {ptype}")
+            lines.extend(samples)
+        return lines
+
+    @staticmethod
+    def prometheus_text_fleet(snapshots: Mapping[str, Dict]) -> str:
+        """One scrape page for a whole fleet: every replica's snapshot with
+        a ``replica="<name>"`` label, grouped BY METRIC FAMILY (all of a
+        family's labelled series under its single # TYPE header — the
+        text-exposition format rejects interleaved families)."""
+        order: List[str] = []              # family order of first appearance
+        types: Dict[str, str] = {}
+        by_family: Dict[str, List[str]] = {}
+        for rname in sorted(snapshots):
+            for fam, ptype, samples in ServingMetrics._render_families(
+                    snapshots[rname], labels={"replica": rname}):
+                if fam not in by_family:
+                    order.append(fam)
+                    types[fam] = ptype
+                    by_family[fam] = []
+                by_family[fam].extend(samples)
+        lines: List[str] = []
+        for fam in order:
+            lines.append(f"# TYPE {fam} {types[fam]}")
+            lines.extend(by_family[fam])
+        return "\n".join(lines) + "\n"
 
     def prometheus_text(self) -> str:
         """Prometheus text exposition format (one scrape page)."""

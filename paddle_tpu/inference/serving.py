@@ -18,11 +18,16 @@ Design:
 - This is the vLLM-style schedule expressed the XLA way: static shapes +
   dynamic lengths as data, not as shapes.
 
-Frontend/engine split (control_plane.py): the engine is a pure execution
-loop — it admits whatever is in its queue, steps, and retires.  Policy
+Frontend → fleet → engine split: the engine is a pure execution loop —
+it admits whatever is in its queue, steps, and retires.  Policy
 (priority classes, deadlines, admission control, routing across replicas,
-failover) lives in ``ServingFrontend``, which drives ``step()`` and
-harvests via ``pop_finished()``.  The preemption contract: ``evict(rid)``
+failover) lives in ``ServingFrontend`` (control_plane.py), which drives
+``step()`` and harvests via ``pop_finished()``.  The frontend does not
+care where an engine runs: in-process ``ServingEngine`` objects and
+``fleet.RemoteReplica`` adapters (the same surface proxied over RPC to a
+``tools/serving_worker.py`` process on this or another host) are
+interchangeable replicas; ``fleet.ServingFleet`` spawns/drains those
+workers and layers heartbeats + autoscaling on top.  The preemption contract: ``evict(rid)``
 removes a queued or running request mid-flight, frees its blocks and slot
 immediately (BlockManager tolerates this and guards double-frees), and
 returns the request object; the caller re-queues it with ``prompt +
@@ -355,6 +360,24 @@ class ServingEngine:
             if q.rid == rid:
                 return self._queue.pop(i)
         raise KeyError(f"no queued or active request with rid={rid}")
+
+    def state_summary(self) -> Dict:
+        """Host-side scheduling state, cheap and device-sync-free — the ONE
+        probe shared by the fleet layer's heartbeat, the remote-replica
+        state mirror, and the autoscaler (inference/fleet.py), so health
+        checking and scaling decisions read the same numbers."""
+        nb = self.blocks.num_blocks
+        return {
+            "queued": [(q.rid, len(q.prompt), q.max_new_tokens)
+                       for q in self._queue],
+            "active": {rid: len(r.blocks) for rid, r in self._active.items()},
+            "free_slots": len(self._free_slots),
+            "blocks_free": self.blocks.num_free,
+            "blocks_total": nb,
+            "queue_depth": len(self._queue),
+            "num_active": len(self._active),
+            "pool_utilization": (1.0 - self.blocks.num_free / nb) if nb else 0.0,
+        }
 
     def pop_finished(self) -> Dict[int, List[int]]:
         """Drain and return requests retired since the last call,

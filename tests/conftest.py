@@ -29,6 +29,12 @@ def pytest_configure(config):
         "quick: fast cross-subsystem verification tier (~3 min total; "
         "run with -m quick to re-check a round's claims without the full "
         "suite)")
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 'not slow' run (which already "
+        "overruns its wall-clock budget at the seed): subprocess-spawning "
+        "fleet tests etc.; CI shards run their files without the filter, "
+        "so these still gate merges")
 
 
 @pytest.fixture(autouse=True)

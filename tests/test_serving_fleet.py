@@ -1,0 +1,503 @@
+"""Cross-host serving fleet (ISSUE 3 tentpole): remote ServingEngine
+workers behind the SLO-aware frontend — RPC replica adapters, heartbeat
+failover, shared admission, autoscaling, fleet metrics.
+
+Acceptance-critical properties checked here:
+* a 2-worker remote fleet produces greedy completions token-identical to
+  the in-process frontend for the same seeded request stream (the
+  RemoteReplica state mirror is faithful enough that routing, admission,
+  and preemption decisions match);
+* SIGKILLing a worker mid-generation drops NO requests — the survivors
+  finish every in-flight request with tokens identical to an unkilled
+  run (failover re-queues from frontend-side state);
+* the autoscaler spawns a worker under queue pressure and drains back to
+  ``min_workers`` when idle (drain = stop admitting, finish in-flight,
+  deregister, process reaped);
+* per-class token budgets are enforced fleet-wide by the frontend;
+* ``ServingMetrics.merge`` + the ``replica``-labelled Prometheus export
+  aggregate per-worker snapshots.
+
+Worker processes cost ~10 s each to boot on the CI container (jax
+import + compile), so fleets are spawned in parallel and shared across
+test methods where the scenario allows.
+"""
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu.inference import (
+    AutoscalePolicy,
+    Priority,
+    RequestStatus,
+    ServingEngine,
+    ServingFleet,
+    ServingFrontend,
+    ServingMetrics,
+)
+
+pytestmark = pytest.mark.quick
+
+# Worker-spawning tests carry this: each fleet boots 1-2 subprocesses at
+# ~10 s apiece (jax import + compile), and the tier-1 'not slow' run
+# already exceeds its wall-clock budget at the seed — adding ~3 min
+# before the timeout cliff would push passing tests past it.  The CI
+# 'parallel' shard runs this file with no marker filter, so these still
+# gate; in-process tests (rpc timeout, metrics merge, drain semantics,
+# state probe) stay in tier-1.
+spawns_workers = pytest.mark.slow
+
+MODEL = dict(vocab_size=256, hidden_size=64, intermediate_size=160,
+             num_hidden_layers=1, num_attention_heads=2,
+             max_position_embeddings=256)
+ENGINE = dict(max_batch_size=2, max_seq_len=64, block_size=8,
+              token_budget=16)
+SPEC = {"seed": 11, "model": MODEL, "engine": ENGINE}
+
+PROMPTS = [[3, 17, 101, 7, 250], [42, 5], [250, 4, 9], [88, 13, 77]]
+
+
+def _local_model():
+    # the exact model every worker builds from SPEC (same seed, same config)
+    from paddle_tpu.distributed.topology import set_hybrid_communicate_group
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    set_hybrid_communicate_group(None)
+    P.seed(SPEC["seed"])
+    return LlamaForCausalLM(LlamaConfig(**MODEL))
+
+
+def ref_greedy(model, prompt, n):
+    from paddle_tpu.models.generation import generate
+
+    ids = P.to_tensor(np.asarray(prompt, np.int32)[None, :])
+    out = generate(model, ids, max_new_tokens=n, do_sample=False)
+    return list(np.asarray(out.numpy()).reshape(-1))
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _local_model()
+
+
+def make_fleet(num_workers, **kw):
+    kw.setdefault("heartbeat_interval_s", 0.5)
+    kw.setdefault("spawn_timeout", 180.0)
+    return ServingFleet(SPEC, num_workers=num_workers, **kw)
+
+
+@spawns_workers
+class TestRemoteParity:
+    def test_remote_matches_local_and_generate(self, model):
+        """Same seeded workload through a 2-worker remote fleet and a
+        2-replica in-process frontend: identical statuses and tokens,
+        and both match reference greedy decode."""
+        with make_fleet(2) as fleet:
+            rids = [fleet.frontend.submit(p, max_new_tokens=6,
+                                          priority=Priority.HIGH
+                                          if i % 2 else Priority.NORMAL)
+                    for i, p in enumerate(PROMPTS)]
+            res = fleet.run()
+
+            # spread across both workers (least-loaded routing saw through
+            # the RemoteReplica mirror)
+            per_worker = fleet.frontend.metrics.gauge("replicas_alive")
+            assert per_worker == 2
+
+            local = ServingFrontend([ServingEngine(model, **ENGINE),
+                                     ServingEngine(model, **ENGINE)])
+            lrids = [local.submit(p, max_new_tokens=6,
+                                  priority=Priority.HIGH
+                                  if i % 2 else Priority.NORMAL)
+                     for i, p in enumerate(PROMPTS)]
+            lres = local.run()
+            for rid, lrid, p in zip(rids, lrids, PROMPTS):
+                assert res[rid].status == lres[lrid].status
+                assert res[rid].tokens == lres[lrid].tokens
+                assert res[rid].tokens == ref_greedy(model, p, 6)
+
+    def test_engine_rejection_travels_back_typed(self):
+        """A ValueError raised inside the remote engine (request larger
+        than max_seq_len) surfaces as the same typed OVERLOADED result
+        the in-process path produces."""
+        with make_fleet(1) as fleet:
+            r = fleet.frontend.submit(list(range(1, 60)), max_new_tokens=30)
+            assert fleet.frontend.result(r).status is RequestStatus.OVERLOADED
+
+    def test_shared_class_token_budget_holds_fleet_wide(self):
+        """The frontend owns admission state, so a per-class cap binds
+        across workers even when each worker alone has capacity."""
+        with make_fleet(1, frontend_kwargs={
+                "class_token_budgets": {Priority.NORMAL: 24}}) as fleet:
+            fe = fleet.frontend
+            r1 = fe.submit([3, 17, 101], max_new_tokens=8)    # 11 tokens
+            r2 = fe.submit([42, 5], max_new_tokens=8)         # +10 = 21
+            r3 = fe.submit([250, 4], max_new_tokens=8)        # +10 > 24
+            over = fe.result(r3)
+            assert over is not None
+            assert over.status is RequestStatus.OVERLOADED
+            assert "class NORMAL token budget" in over.detail
+            # HIGH is uncapped: admission is per class, not global
+            r4 = fe.submit([9, 9], max_new_tokens=4, priority=Priority.HIGH)
+            res = fleet.run()
+            assert res[r1].ok and res[r2].ok and res[r4].ok
+            # budget released on completion: a new NORMAL fits again
+            r5 = fe.submit([7, 8], max_new_tokens=4)
+            res = fleet.run()
+            assert res[r5].ok
+
+    def test_fleet_metrics_merge_and_replica_labels(self):
+        with make_fleet(2) as fleet:
+            rids = [fleet.frontend.submit(p, max_new_tokens=4)
+                    for p in PROMPTS]
+            res = fleet.run()
+            assert all(res[r].ok for r in rids)
+            snaps = fleet.worker_snapshots()
+            assert set(snaps) == {"worker0", "worker1"}
+            merged = fleet.merged_snapshot()
+            # every emitted token shows up exactly once fleet-wide
+            assert merged["counters"]["tokens_emitted_total"] == 4 * 4
+            assert merged["num_replicas"] == 2
+            assert merged["gauges"]["blocks_total"] == sum(
+                s["gauges"]["blocks_total"] for s in snaps.values())
+            text = fleet.prometheus_text()
+            for name in ("worker0", "worker1", "frontend"):
+                assert f'replica="{name}"' in text
+            # one TYPE header per metric even with three labelled series
+            assert text.count(
+                "# TYPE paddle_tpu_serving_engine_steps_total counter") == 1
+            # request-level series come from the frontend only
+            assert 'paddle_tpu_serving_admitted_total{replica="frontend"} 4' \
+                in text
+
+
+@spawns_workers
+class TestFaultInjection:
+    def test_sigkill_worker_mid_generation_no_request_dropped(self, model):
+        """Acceptance criterion: SIGKILL a remote worker mid-generation.
+        Every request must resolve COMPLETED (survivor re-queue from
+        frontend-side state) with tokens identical to an unkilled greedy
+        run; the dead worker is deregistered and reaped."""
+        with make_fleet(2, heartbeat_interval_s=10.0) as fleet:
+            rids = [fleet.frontend.submit(p, max_new_tokens=6)
+                    for p in PROMPTS]
+            fleet.step()
+            fleet.step()
+            doomed = next(r for r in fleet.frontend.replicas if r.requests)
+            name = doomed.engine.worker
+            on_doomed = [fr.rid for fr in doomed.requests.values()]
+            assert on_doomed, "routing should have spread load"
+            os.kill(doomed.engine.pid, signal.SIGKILL)
+
+            res = fleet.run()
+            # NONE dropped: every rid resolved, all completed (a survivor
+            # existed), tokens identical to an unkilled run
+            assert set(res) == set(rids)
+            for rid, p in zip(rids, PROMPTS):
+                assert res[rid].status is RequestStatus.COMPLETED
+                assert res[rid].tokens == ref_greedy(model, p, 6)
+            m = fleet.frontend.metrics
+            assert m.counter("replica_deaths_total") == 1
+            assert m.counter("requeued_on_failover_total") == len(on_doomed)
+            # dead worker deregistered + its process reaped
+            assert name not in fleet.workers
+            assert name not in fleet._procs
+            assert len(fleet.workers) == 1
+
+            # the surviving fleet still serves
+            r_new = fleet.frontend.submit([5, 6, 7], max_new_tokens=4)
+            res2 = fleet.run()
+            assert res2[r_new].ok
+            assert res2[r_new].tokens == ref_greedy(model, [5, 6, 7], 4)
+
+    def test_heartbeat_detects_silent_idle_worker(self):
+        """A worker that dies while IDLE is never stepped (the frontend
+        skips empty replicas), so only the heartbeat can notice: the next
+        fleet.step() must mark it dead and deregister it."""
+        with make_fleet(1, heartbeat_interval_s=0.0) as fleet:
+            rep = fleet.frontend.replicas[0]
+            os.kill(rep.engine.pid, signal.SIGKILL)
+            fleet._procs[rep.engine.worker].wait(timeout=30)
+            fleet.step()   # heartbeat probe fails -> fail_replica -> reap
+            assert not rep.alive
+            assert fleet.workers == []
+            # with no live replica, submits resolve typed FAILED
+            r = fleet.frontend.submit([1, 2], max_new_tokens=2)
+            assert fleet.frontend.result(r).status is RequestStatus.FAILED
+
+
+@spawns_workers
+class TestAutoscaler:
+    def test_scale_up_under_pressure_then_drain_idle(self):
+        pol = AutoscalePolicy(min_workers=1, max_workers=2,
+                              scale_up_queue_per_replica=1.5,
+                              up_after=2, down_after=4, cooldown=1)
+        with make_fleet(1, autoscaler_policy=pol,
+                        heartbeat_interval_s=10.0) as fleet:
+            rids = [fleet.frontend.submit([3 + i, 17, 101], max_new_tokens=6)
+                    for i in range(6)]
+            res = fleet.run()
+            assert all(res[r].ok for r in rids)
+            assert any(a.startswith("up:") for a in fleet.autoscaler.actions)
+            assert len(fleet.workers) == 2
+
+            drained = None
+            for _ in range(12):     # idle observations -> drain to min
+                fleet.step()
+                down = [a for a in fleet.autoscaler.actions
+                        if a.startswith("down:")]
+                if down and drained is None:
+                    drained = down[0].split(":", 1)[1]
+            assert drained is not None
+            assert len(fleet.workers) == 1
+            assert drained not in fleet.workers
+            assert drained not in fleet._procs  # process reaped
+            # still at or above min_workers and still serving
+            r = fleet.frontend.submit([9, 8, 7], max_new_tokens=4)
+            assert fleet.run()[r].ok
+
+
+class TestRpcTimeoutSurface:
+    def test_hung_worker_rpc_times_out_typed(self):
+        """A handler that blocks past the per-call deadline raises the
+        typed RpcTimeout instead of freezing the caller (the frontend
+        step loop treats it like any replica fault)."""
+        from paddle_tpu.distributed import rpc
+
+        rpc.shutdown()
+        rpc.init_rpc("hung_solo", rank=0, world_size=1)
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(rpc.RpcTimeout):
+                rpc.rpc_sync("hung_solo", time.sleep, args=(30,), timeout=0.3)
+            assert time.monotonic() - t0 < 5.0
+            fut = rpc.rpc_async("hung_solo", time.sleep, args=(30,),
+                                timeout=0.3)
+            with pytest.raises(rpc.RpcTimeout):
+                fut.wait()
+        finally:
+            rpc.shutdown()
+
+    def test_shutdown_joins_executor_threads(self):
+        from paddle_tpu.distributed import rpc
+
+        rpc.shutdown()
+        rpc.init_rpc("join_solo", rank=0, world_size=1)
+        fut = rpc.rpc_async("join_solo", pow, args=(2, 8))
+        assert fut.wait() == 256
+        pool = rpc._state["pool"]
+        rpc.shutdown()
+        assert all(not t.is_alive() for t in getattr(pool, "_threads", ())), \
+            "rpc shutdown leaked executor threads"
+        # idempotent + re-init works after a clean join
+        rpc.shutdown()
+        rpc.init_rpc("join_solo2", rank=0, world_size=1)
+        assert rpc.rpc_sync("join_solo2", pow, args=(2, 5)) == 32
+        rpc.shutdown()
+
+
+class TestStateSummaryProbe:
+    def test_state_summary_tracks_engine_state(self, model):
+        """The shared probe reflects queue/active/pool transitions (this
+        is what the RemoteReplica mirror and autoscaler consume)."""
+        eng = ServingEngine(model, **ENGINE)
+        st = eng.state_summary()
+        assert st["num_active"] == 0 and st["queue_depth"] == 0
+        assert st["blocks_free"] == st["blocks_total"]
+        r1 = eng.add_request([3, 17, 101], max_new_tokens=6)
+        r2 = eng.add_request([42, 5], max_new_tokens=4)
+        r3 = eng.add_request([9, 9], max_new_tokens=4)   # B=2: queued
+        st = eng.state_summary()
+        assert st["queue_depth"] == 3 and st["num_active"] == 0
+        assert st["queued"][0] == (r1, 3, 6)
+        eng.step()
+        st = eng.state_summary()
+        assert st["num_active"] == 2 and st["queue_depth"] == 1
+        assert set(st["active"]) == {r1, r2}
+        assert st["active"][r1] == 2            # ceil((3+6)/8) blocks
+        assert 0 < st["pool_utilization"] <= 1
+        eng.evict(r1)
+        eng.evict(r2)
+        assert eng.state_summary()["blocks_free"] == st["blocks_total"]
+        assert r3 is not None
+
+
+class TestMetricsMerge:
+    def test_merge_counters_gauges_percentiles(self):
+        class Clock:
+            t = 0.0
+
+            def __call__(self):
+                return self.t
+
+        a, b = ServingMetrics(Clock()), ServingMetrics(Clock())
+        a.inc("tokens_emitted_total", 10)
+        b.inc("tokens_emitted_total", 5)
+        a.set_gauge_peak("queue_depth", 3)
+        b.set_gauge_peak("queue_depth", 7)
+        a.set_gauge("blocks_total", 8)
+        a.set_gauge("blocks_free", 2)
+        b.set_gauge("blocks_total", 8)
+        b.set_gauge("blocks_free", 6)
+        a.set_gauge_peak("block_pool_utilization", 0.75)
+        b.set_gauge_peak("block_pool_utilization", 0.25)
+        for v in (0.1, 0.2):
+            a.observe("ttft_seconds", v)
+        for v in (0.3, 0.4, 0.5):
+            b.observe("ttft_seconds", v)
+        sa = a.snapshot(include_samples=True)
+        sb = b.snapshot(include_samples=True)
+        m = ServingMetrics.merge({"w0": sa, "w1": sb})
+        assert m["counters"]["tokens_emitted_total"] == 15
+        assert m["gauges"]["queue_depth"] == 10          # additive
+        assert m["gauges"]["queue_depth_peak"] == 7      # maxed
+        assert m["gauges"]["block_pool_utilization"] == pytest.approx(0.5)
+        assert m["gauges"]["block_pool_utilization_peak"] == 0.75
+        lat = m["latency"]["ttft_seconds"]
+        assert lat["count"] == 5 and lat["max"] == 0.5
+        assert m["percentiles_exact"] and lat["p50"] == 0.3  # exact, pooled
+        # without samples: count-weighted fallback, flagged
+        m2 = ServingMetrics.merge([a.snapshot(), b.snapshot()])
+        assert not m2["percentiles_exact"]
+        assert m2["latency"]["ttft_seconds"]["count"] == 5
+        # empty merge is well-formed
+        empty = ServingMetrics.merge({})
+        assert empty["num_replicas"] == 0 and empty["tokens_per_sec"] == 0.0
+
+    def test_prometheus_fleet_labels(self):
+        a, b = ServingMetrics(), ServingMetrics()
+        a.inc("admitted_total", 2)
+        b.inc("admitted_total", 3)
+        a.observe("ttft_seconds", 0.25)
+        text = ServingMetrics.prometheus_text_fleet(
+            {"w0": a.snapshot(include_samples=True),
+             "w1": b.snapshot(include_samples=True)})
+        assert 'paddle_tpu_serving_admitted_total{replica="w0"} 2' in text
+        assert 'paddle_tpu_serving_admitted_total{replica="w1"} 3' in text
+        assert text.count("# TYPE paddle_tpu_serving_admitted_total counter") == 1
+        assert ('paddle_tpu_serving_ttft_seconds{replica="w0",'
+                'quantile="0.95"} 0.25') in text
+        assert 'paddle_tpu_serving_ttft_seconds_count{replica="w0"} 1' in text
+        # single-registry export unchanged (no labels)
+        assert "paddle_tpu_serving_admitted_total 2" in a.prometheus_text()
+
+
+class TestReplicaFaultPaths:
+    """RPC faults outside step() — add_request during dispatch, evict
+    during cancel/shed — must fail over (kill replica, re-queue from
+    host-side state), not crash the control loop.  Driven with in-process
+    engines whose methods are made to raise like a dead remote worker."""
+
+    def test_add_request_fault_fails_over(self, model):
+        fe = ServingFrontend([ServingEngine(model, **ENGINE),
+                              ServingEngine(model, **ENGINE)])
+        bad = fe.replicas[0].engine
+
+        def boom(*a, **k):
+            raise ConnectionRefusedError("worker died between heartbeats")
+
+        bad.add_request = boom
+        rid = fe.submit([3, 17, 101], max_new_tokens=6)
+        res = fe.run()
+        assert res[rid].ok
+        assert res[rid].tokens == ref_greedy(model, [3, 17, 101], 6)
+        dead = [r for r in fe.replicas if not r.alive]
+        assert len(dead) == 1 and "worker died" in dead[0].last_error
+        assert fe.metrics.counter("replica_deaths_total") == 1
+
+    def test_cancel_fault_fails_over_and_rescues_peers(self, model):
+        # single replica with both requests on it: the evict fault must
+        # kill it AND the peer must resolve typed (no survivor -> FAILED,
+        # never silently dropped or crashed)
+        fe = ServingFrontend([ServingEngine(model, **ENGINE),
+                              ServingEngine(model, **ENGINE)])
+        r1 = fe.submit([3, 17, 101], max_new_tokens=8)
+        r2 = fe.submit([42, 5], max_new_tokens=6)
+        fe.step()
+        rep = fe._requests[r1].replica
+        assert rep is not None
+
+        def boom(*a, **k):
+            raise ConnectionResetError("evict rpc failed")
+
+        rep.engine.evict = boom
+        assert fe.cancel(r1)
+        assert fe.result(r1).status is RequestStatus.CANCELLED
+        assert not rep.alive
+        res = fe.run()
+        # r2 (on the surviving replica) unaffected and correct
+        assert res[r2].ok
+        assert res[r2].tokens == ref_greedy(model, [42, 5], 6)
+
+    def test_deadline_evict_fault_fails_over(self, model):
+        class Clock:
+            t = 0.0
+
+            def __call__(self):
+                return self.t
+
+        clock = Clock()
+        # batch 2: both requests land on one replica; its evict fault on
+        # the expired request must fail over the non-expired peer
+        fe = ServingFrontend([ServingEngine(model, **ENGINE),
+                              ServingEngine(model, **ENGINE)], clock=clock)
+        r1 = fe.submit([3, 17, 101], max_new_tokens=8, deadline_s=5.0)
+        r2 = fe.submit([42, 5], max_new_tokens=6)
+        fe.step()
+        rep1, rep2 = fe._requests[r1].replica, fe._requests[r2].replica
+
+        def boom(*a, **k):
+            raise ConnectionResetError("evict rpc failed")
+
+        rep1.engine.evict = boom
+        clock.t = 10.0
+        res = fe.run()
+        assert res[r1].status is RequestStatus.DEADLINE_EXCEEDED
+        assert not rep1.alive
+        assert res[r2].ok and res[r2].tokens == ref_greedy(model, [42, 5], 6)
+        if rep2 is rep1:   # peer was co-located: it survived via re-queue
+            assert fe.metrics.counter("requeued_on_failover_total") >= 1
+
+    def test_fleet_without_workers_raises_cleanly(self):
+        from paddle_tpu.inference.fleet import ServingFleet as SF
+
+        fleet = SF.__new__(SF)     # no subprocess spin-up needed
+        fleet.frontend = None
+        fleet.autoscaler = None
+        with pytest.raises(RuntimeError, match="no workers"):
+            SF.step(fleet)
+        with pytest.raises(RuntimeError, match="no workers"):
+            SF.run(fleet)
+        SF.heartbeat(fleet)        # probe of an empty fleet is a no-op
+
+
+class TestDrainAdmission:
+    def test_draining_replica_takes_no_new_placements(self, model):
+        """Drain semantics at the frontend level (no subprocesses): a
+        draining replica finishes in-flight work, gets nothing new, and
+        with every replica draining submits are typed-rejected."""
+        fe = ServingFrontend([ServingEngine(model, **ENGINE),
+                              ServingEngine(model, **ENGINE)])
+        r1 = fe.submit([3, 17, 101], max_new_tokens=6)
+        fe.step()
+        draining = next(r for r in fe.replicas if r.requests)
+        other = next(r for r in fe.replicas if r is not draining)
+        draining.draining = True
+        r2 = fe.submit([42, 5], max_new_tokens=4)
+        res = fe.run()
+        assert res[r1].ok and res[r2].ok
+        assert draining.requests == {}      # finished, took nothing new
+        # r2 ran on the accepting replica
+        assert fe.metrics.counter("completed_total") == 2
+        other.draining = True
+        r3 = fe.submit([9, 9], max_new_tokens=2)
+        out = fe.result(r3)
+        assert out.status is RequestStatus.OVERLOADED
+        assert "draining" in out.detail
+        # add_replica restores service
+        fe.add_replica(ServingEngine(model, **ENGINE))
+        r4 = fe.submit([9, 9], max_new_tokens=2)
+        assert fe.run()[r4].ok

@@ -14,6 +14,11 @@ first->last emission window, which excludes compile/prefill lead-in) and
 p50/p95 TTFT across completed requests.  One JSON line on stdout — the
 same schema bench_ladder.py rungs use, so the ladder imports and re-emits
 ``run_bench()`` directly.
+
+``--workers N`` switches to REMOTE mode (ISSUE 3): the same open-loop
+workload through a ServingFleet of N serving_worker.py processes behind
+the RPC stack instead of in-process replicas — what the fleet ladder
+rung measures (per-step HTTP round trips are the cost being watched).
 """
 import argparse
 import json
@@ -24,73 +29,78 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def run_bench(num_requests=None, rate_rps=None, replicas=1, seed=0):
+def _workload(seed, num_requests, rate_rps):
+    """Shared config for local and remote mode: model/engine spec, seeded
+    prompts, Poisson arrival times."""
     import jax
     import numpy as np
 
-    import paddle_tpu as P
-    from paddle_tpu.inference import Priority, ServingEngine, ServingFrontend
-    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
-
     backend = jax.default_backend()
     on_accel = backend in ("tpu", "axon")
-    P.seed(0)
     if on_accel:
-        cfg = LlamaConfig(vocab_size=32000, hidden_size=2560,
-                          intermediate_size=8192, num_hidden_layers=9,
-                          num_attention_heads=10,
-                          max_position_embeddings=2048, dtype="bfloat16")
-        B, block, budget, max_seq = 8, 64, 64, 448
+        model = dict(vocab_size=32000, hidden_size=2560,
+                     intermediate_size=8192, num_hidden_layers=9,
+                     num_attention_heads=10,
+                     max_position_embeddings=2048, dtype="bfloat16")
+        engine = dict(max_batch_size=8, max_seq_len=448, block_size=64,
+                      token_budget=64, num_blocks=24)
         prompt_lens, max_new = (96, 160, 224), 32
-        num_blocks = 24  # pool binds before slots: preemption pressure
         num_requests = num_requests or 32
         rate_rps = rate_rps or 16.0
     else:
-        cfg = LlamaConfig(vocab_size=512, hidden_size=128,
-                          intermediate_size=352, num_hidden_layers=2,
-                          num_attention_heads=4, max_position_embeddings=256)
-        B, block, budget, max_seq = 4, 8, 16, 64
+        model = dict(vocab_size=512, hidden_size=128,
+                     intermediate_size=352, num_hidden_layers=2,
+                     num_attention_heads=4, max_position_embeddings=256)
+        engine = dict(max_batch_size=4, max_seq_len=64, block_size=8,
+                      token_budget=16, num_blocks=8)
+        # pool binds before slots: preemption pressure
         prompt_lens, max_new = (4, 8, 12), 8
-        num_blocks = 8   # pool binds before slots: preemption pressure
         num_requests = num_requests or 24
         rate_rps = rate_rps or 200.0  # ~4x service rate: queue must form
-    model = LlamaForCausalLM(cfg)
-    if on_accel:
-        model.bfloat16()
-    model.eval()
-    engines = [ServingEngine(model, max_batch_size=B, max_seq_len=max_seq,
-                             block_size=block, token_budget=budget,
-                             num_blocks=num_blocks)
-               for _ in range(replicas)]
-    fe = ServingFrontend(engines)
-
     rng = np.random.RandomState(seed)
-    prompts = [rng.randint(0, cfg.vocab_size,
+    prompts = [rng.randint(0, model["vocab_size"],
                            (int(rng.choice(prompt_lens)),)).tolist()
                for _ in range(num_requests)]
-    # open-loop Poisson arrivals, drawn up front
     arrivals = np.cumsum(rng.exponential(1.0 / rate_rps, num_requests))
+    return (backend, on_accel, model, engine, prompts, arrivals, max_new,
+            num_requests, rate_rps)
 
-    # warm the two compiled step programs (prefill + pure-decode) outside
-    # the measured window, then zero the registry
-    w = fe.submit(prompts[0], max_new_tokens=max_new)
-    fe.run()
-    assert fe.result(w).ok
+
+def _drive(fe, step, prompts, arrivals, max_new, warm_n, after_warm=None):
+    """Warm the compiled step programs, then replay the open-loop arrival
+    schedule through ``fe`` (stepping via ``step()``).  ``after_warm``
+    runs right after the frontend registry reset — the fleet mode uses it
+    to reset the per-worker registries too, so every reported counter
+    covers the same measured window."""
+    from paddle_tpu.inference import Priority
+
+    warm = [fe.submit(prompts[0], max_new_tokens=max_new)
+            for _ in range(warm_n)]
+    while fe.pending:
+        step()
+    assert all(fe.result(w).ok for w in warm)
     fe.metrics.reset()
+    if after_warm is not None:
+        after_warm()
 
+    n = len(prompts)
     priorities = [Priority.HIGH if i % 4 == 0 else Priority.NORMAL
-                  for i in range(num_requests)]
+                  for i in range(n)]
     t0 = time.monotonic()
     submitted = 0
     rids = []
-    while fe.pending or submitted < num_requests:
+    while fe.pending or submitted < n:
         now = time.monotonic() - t0
-        while submitted < num_requests and arrivals[submitted] <= now:
+        while submitted < n and arrivals[submitted] <= now:
             rids.append(fe.submit(prompts[submitted], max_new_tokens=max_new,
                                   priority=priorities[submitted]))
             submitted += 1
-        fe.step()
-    wall_s = time.monotonic() - t0
+        step()
+    return rids, time.monotonic() - t0
+
+
+def _report(metric, fe, rids, wall_s, extra):
+    import bench_ladder  # repo root is on sys.path (top of this file)
 
     res = fe.results()
     snap = fe.metrics.snapshot()
@@ -99,31 +109,83 @@ def run_bench(num_requests=None, rate_rps=None, replicas=1, seed=0):
     # first-token event this run — all requests completed, so identical
     # population to a completed-only view)
     ttft = snap["latency"]["ttft_seconds"]
-
+    out = {
+        "host": bench_ladder.host_fingerprint(),
+        "p50_ttft_ms": round(ttft["p50"] * 1e3, 1),
+        "p95_ttft_ms": round(ttft["p95"] * 1e3, 1),
+        "completed": len(completed),
+        "shed_deadline": snap["counters"]["shed_deadline_total"],
+        "rejected_overloaded":
+            snap["counters"]["rejected_overloaded_total"],
+        "preempted": snap["counters"]["preempted_total"],
+        "peak_queue_depth": snap["gauges"]["queue_depth_peak"],
+        "peak_block_pool_utilization":
+            round(snap["gauges"]["block_pool_utilization_peak"], 3),
+        "engine_steps": snap["counters"]["engine_steps_total"],
+        "wall_s": round(wall_s, 2),
+        "method": "open-loop Poisson arrivals; tokens/s from the "
+                  "metrics registry's first->last emission window",
+    }
+    out.update(extra)
     return {
-        "metric": "serving_frontend_openloop_tokens_per_sec",
+        "metric": metric,
         "value": round(snap["tokens_per_sec"], 1),
         "unit": "tokens/s",
-        "extra": {
-            "backend": backend, "batch": B, "block_size": block,
-            "replicas": replicas, "num_requests": num_requests,
-            "rate_rps": rate_rps, "max_new_tokens": max_new,
-            "p50_ttft_ms": round(ttft["p50"] * 1e3, 1),
-            "p95_ttft_ms": round(ttft["p95"] * 1e3, 1),
-            "completed": len(completed),
-            "shed_deadline": snap["counters"]["shed_deadline_total"],
-            "rejected_overloaded":
-                snap["counters"]["rejected_overloaded_total"],
-            "preempted": snap["counters"]["preempted_total"],
-            "peak_queue_depth": snap["gauges"]["queue_depth_peak"],
-            "peak_block_pool_utilization":
-                round(snap["gauges"]["block_pool_utilization_peak"], 3),
-            "engine_steps": snap["counters"]["engine_steps_total"],
-            "wall_s": round(wall_s, 2),
-            "method": "open-loop Poisson arrivals; tokens/s from the "
-                      "metrics registry's first->last emission window",
-        },
+        "extra": out,
     }
+
+
+def run_bench(num_requests=None, rate_rps=None, replicas=1, seed=0):
+    import paddle_tpu as P
+    from paddle_tpu.inference import ServingEngine, ServingFrontend
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    (backend, on_accel, model_cfg, engine_cfg, prompts, arrivals, max_new,
+     num_requests, rate_rps) = _workload(seed, num_requests, rate_rps)
+    P.seed(0)
+    model = LlamaForCausalLM(LlamaConfig(**model_cfg))
+    if on_accel:
+        model.bfloat16()
+    model.eval()
+    engines = [ServingEngine(model, **engine_cfg) for _ in range(replicas)]
+    fe = ServingFrontend(engines)
+    rids, wall_s = _drive(fe, fe.step, prompts, arrivals, max_new,
+                          warm_n=replicas)
+    return _report(
+        "serving_frontend_openloop_tokens_per_sec", fe, rids, wall_s,
+        {"backend": backend, "batch": engine_cfg["max_batch_size"],
+         "block_size": engine_cfg["block_size"], "replicas": replicas,
+         "num_requests": num_requests, "rate_rps": rate_rps,
+         "max_new_tokens": max_new})
+
+
+def run_bench_fleet(num_requests=None, rate_rps=None, workers=2, seed=0):
+    """Remote mode: the identical open-loop workload through a
+    ServingFleet of ``workers`` spawned serving_worker.py processes.
+    Workers are pinned to CPU on a CPU host (CI contract) and inherit the
+    host's jax config on an accelerator host."""
+    from paddle_tpu.inference import ServingFleet
+
+    (backend, on_accel, model_cfg, engine_cfg, prompts, arrivals, max_new,
+     num_requests, rate_rps) = _workload(seed, num_requests, rate_rps)
+    spec = {"seed": 0, "model": model_cfg, "engine": engine_cfg,
+            "bfloat16": bool(on_accel)}
+    with ServingFleet(spec, num_workers=workers,
+                      cpu_workers=not on_accel) as fleet:
+        fe = fleet.frontend
+        rids, wall_s = _drive(fe, fleet.step, prompts, arrivals, max_new,
+                              warm_n=workers,
+                              after_warm=fleet.reset_worker_metrics)
+        merged = fleet.merged_snapshot()
+        return _report(
+            "serving_fleet_openloop_tokens_per_sec", fe, rids, wall_s,
+            {"backend": backend, "batch": engine_cfg["max_batch_size"],
+             "block_size": engine_cfg["block_size"], "workers": workers,
+             "num_requests": num_requests, "rate_rps": rate_rps,
+             "max_new_tokens": max_new,
+             "worker_engine_steps":
+                 merged["counters"].get("engine_steps_total", 0),
+             "transport": "distributed/rpc HTTP, per-step round trips"})
 
 
 def main(argv=None):
@@ -131,11 +193,21 @@ def main(argv=None):
     ap.add_argument("--num-requests", type=int, default=None)
     ap.add_argument("--rate-rps", type=float, default=None)
     ap.add_argument("--replicas", type=int, default=1)
+    ap.add_argument("--workers", type=int, default=0,
+                    help="N>0: remote mode — N serving_worker.py processes "
+                         "behind the RPC stack instead of in-process "
+                         "replicas")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
-    print(json.dumps(run_bench(num_requests=args.num_requests,
+    if args.workers > 0:
+        line = run_bench_fleet(num_requests=args.num_requests,
                                rate_rps=args.rate_rps,
-                               replicas=args.replicas, seed=args.seed)))
+                               workers=args.workers, seed=args.seed)
+    else:
+        line = run_bench(num_requests=args.num_requests,
+                         rate_rps=args.rate_rps,
+                         replicas=args.replicas, seed=args.seed)
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

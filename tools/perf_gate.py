@@ -96,10 +96,10 @@ def lower_is_better(rung: Dict) -> bool:
 # extra.* keys that define a rung's measurement CONFIG (not its outcome) —
 # when one of these changes between rounds the values are not comparable
 # and the rung re-baselines (loudly) instead of being gated numerically
-IDENTITY_KEYS = ("workload", "mesh", "backend", "batch", "seq", "img",
-                 "prompt", "new_tokens", "ring", "block_size", "ctx_lengths",
-                 "num_micro", "replicas", "num_requests", "rate_rps",
-                 "max_new_tokens")
+IDENTITY_KEYS = ("workload", "mesh", "backend", "host", "batch", "seq",
+                 "img", "prompt", "new_tokens", "ring", "block_size",
+                 "ctx_lengths", "num_micro", "replicas", "workers",
+                 "num_requests", "rate_rps", "max_new_tokens")
 
 
 def config_drift(prev: Dict, cur: Dict) -> List[str]:
@@ -174,8 +174,11 @@ def check_ladder(ladders, tolerances: Dict) -> int:
             # LOUDLY rather than fail forever or compare garbage — a
             # vanished rung still fails, so this cannot silently hide one
             pe, ce = pr.get("extra") or {}, cr.get("extra") or {}
-            changes = ", ".join(f"{k}: {pe[k]!r} -> {ce[k]!r}"
-                                for k in drifted)
+            # .get: a drifted key may exist in only one round (that is
+            # itself drift) — show '<absent>' instead of KeyError-ing
+            changes = ", ".join(
+                f"{k}: {pe.get(k, '<absent>')!r} -> {ce.get(k, '<absent>')!r}"
+                for k in drifted)
             print(f"perf-gate: WARNING — rung '{metric}' measurement "
                   f"config changed between r{pn} and r{cn} ({changes}); "
                   "values not comparable, rung re-baselined this round")

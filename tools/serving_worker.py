@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Remote serving replica worker: one ServingEngine in its own process,
+driven over RPC by a ServingFleet frontend (possibly on another host).
+
+Boot sequence: pin the platform (CI/fleet default: ``--platform cpu``,
+same contract as the standalone-serving test subprocesses — a wedged TPU
+tunnel must not hang the fleet), build the seeded model + engine from
+``--spec-json``, install them as this process's served replica
+(``fleet.init_worker``), register with the launch KV master via
+``rpc.init_rpc``, then park until the frontend's ``_w_shutdown`` RPC (or
+SIGTERM).  All serving traffic — add_request / step / evict / health —
+arrives as RPC calls into ``paddle_tpu.inference.fleet``'s ``_w_*``
+handlers; this file is only the bootstrap.
+
+Spec JSON (everything the worker needs to be a bit-identical replica):
+
+    {"seed": 11,
+     "model": {"vocab_size": 256, "hidden_size": 64, ...},   # LlamaConfig
+     "engine": {"max_batch_size": 2, "max_seq_len": 64, ...},
+     "bfloat16": false}
+
+Run standalone (an operator adding capacity from another host):
+
+    python tools/serving_worker.py --master 10.0.0.1:8765 \
+        --name worker7 --spec-json "$(cat spec.json)" --platform cpu
+"""
+import argparse
+import json
+import os
+import signal
+import sys
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--master", required=True,
+                    help="KV master endpoint ip:port (launch KVServer)")
+    ap.add_argument("--name", required=True, help="unique worker name")
+    ap.add_argument("--spec-json", required=True,
+                    help="model/engine spec as inline JSON, or @/path/to.json")
+    ap.add_argument("--rank", type=int, default=0)
+    ap.add_argument("--platform", default=None, choices=(None, "cpu"),
+                    help="'cpu' pins JAX_PLATFORMS=cpu (CI / fleet default "
+                         "via ServingFleet(cpu_workers=True)); omit to "
+                         "inherit the host's jax config")
+    args = ap.parse_args(argv)
+
+    if args.platform == "cpu":
+        # env var alone loses to a sitecustomize that pins the config —
+        # set both, before anything imports jax (same fix as the
+        # standalone-serving SAVER/SERVER subprocesses)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+
+    spec = args.spec_json
+    if spec.startswith("@"):
+        with open(spec[1:]) as f:
+            spec = f.read()
+    spec = json.loads(spec)
+
+    import paddle_tpu as P
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.inference import ServingEngine, fleet
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    P.seed(int(spec.get("seed", 0)))
+    model = LlamaForCausalLM(LlamaConfig(**spec.get("model", {})))
+    if spec.get("bfloat16"):
+        model.bfloat16()
+    model.eval()
+    engine = ServingEngine(model, **spec.get("engine", {}))
+
+    stop = fleet.init_worker(engine, name=args.name)
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    rpc.init_rpc(args.name, rank=args.rank, world_size=1,
+                 master_endpoint=args.master)
+    print(f"WORKER_READY {args.name} pid={os.getpid()}", flush=True)
+    stop.wait()
+    rpc.shutdown()
+    print(f"WORKER_EXIT {args.name}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -269,35 +269,56 @@ def bench_serving_mixed():
     dec0 = jnp.asarray([r.context_len - 1 for r in by_slot], jnp.int32)
     toks0 = jnp.asarray([r.generated[-1] for r in by_slot], jnp.int32)
 
+    def body(weights, carry, _):
+        toks, kcs, vcs, dec = carry
+        nxt, kcs, vcs, _ = eng._step_raw(
+            weights, kcs, vcs, eng._rope, toks, enc, dec, now, cu,
+            bt, 1)
+        return (nxt, kcs, vcs, dec + 1), nxt[0]
+
+    progs = {}  # one compile per scan length, shared across slope repeats
+
     def run_n(n):
-        def body(weights, carry, _):
-            toks, kcs, vcs, dec = carry
-            nxt, kcs, vcs, _ = eng._step_raw(
-                weights, kcs, vcs, eng._rope, toks, enc, dec, now, cu,
-                bt, 1)
-            return (nxt, kcs, vcs, dec + 1), nxt[0]
-
-        @jax.jit
-        def prog(weights, kcs, vcs):
-            # weights MUST be arguments: closing over the ~2 GB pytree
-            # embeds it as program constants, which the tunneled remote
-            # compile service cannot swallow (broken pipe)
-            (_, kcs, vcs, _), out = lax.scan(
-                lambda c, x: body(weights, c, x),
-                (toks0, list(kcs), list(vcs), dec0), None, length=n)
-            return out[-1]
-
-        o = prog(eng._weights, eng.key_caches, eng.value_caches)  # compile
+        prog = progs.get(n)
+        if prog is None:
+            @jax.jit
+            def prog(weights, kcs, vcs):
+                # weights MUST be arguments: closing over the ~2 GB pytree
+                # embeds it as program constants, which the tunneled remote
+                # compile service cannot swallow (broken pipe)
+                (_, kcs, vcs, _), out = lax.scan(
+                    lambda c, x: body(weights, c, x),
+                    (toks0, list(kcs), list(vcs), dec0), None, length=n)
+                return out[-1]
+            progs[n] = prog
+        o = prog(eng._weights, eng.key_caches, eng.value_caches)  # compile/warm
         float(o)
         best = 1e9
-        for _ in range(2):
+        for _ in range(4):
             t0 = time.perf_counter()
             float(prog(eng._weights, eng.key_caches, eng.value_caches))
             best = min(best, time.perf_counter() - t0)
         return best
 
-    t_lo, t_hi = run_n(n_lo), run_n(n_hi)
-    per_step = max((t_hi - t_lo) / (n_hi - n_lo), 1e-9)
+    # the slope SUBTRACTS two noisy minima, so scheduler jitter on a
+    # shared-vCPU host amplifies: r8 measured 13.5k vs 24.2k tok/s on
+    # identical code back-to-back with the old best-of-2 single slope.
+    # Harden: best-of-4 per point, 3 full slope repeats, keep the min
+    # POSITIVE per-step (the least-interference estimate) — a repeat whose
+    # subtraction goes non-positive is pure interference and is discarded,
+    # not clamped (a clamped 1e-9 inside the min would win and record an
+    # absurd ~1e10 tok/s baseline)
+    pairs = [(run_n(n_lo), run_n(n_hi)) for _ in range(3)]
+    positive = [(hi - lo) / (n_hi - n_lo) for lo, hi in pairs if hi > lo]
+    if positive:
+        per_step, slope_fallback = min(positive), False
+    else:
+        # every repeat's subtraction went non-positive (pathological host
+        # interference): fall back to whole-scan time over steps — it
+        # folds the fixed dispatch overhead in (underestimates tok/s,
+        # never records an absurd 1e10 baseline the gate would then hold
+        # every honest round against)
+        per_step, slope_fallback = min(hi for _, hi in pairs) / n_hi, True
     tps = B / per_step
 
     # end-to-end cross-check: staggered mixed-length service completes
@@ -324,8 +345,10 @@ def bench_serving_mixed():
                   "batch": B, "ctx_lengths": ctx0,
                   "block_size": block, "paged_cache": True,
                   "ms_per_step": round(per_step * 1e3, 3),
-                  "method": "slope over in-graph scan lengths "
-                            f"({n_lo} vs {n_hi} steps)",
+                  "slope_fallback": slope_fallback,
+                  "method": "min over 3 slope repeats, in-graph scan "
+                            f"lengths {n_lo} vs {n_hi} steps, best-of-4 "
+                            "per point",
                   "e2e_staggered_admission_ok": ok,
                   "e2e_wallclock_s_incl_tunnel_dispatch": round(e2e_s, 2)},
     }))
@@ -360,6 +383,15 @@ def bench_serving_fleet():
     per-step HTTP round trips and state-mirror sync cost against the
     in-process number directly above it in the ladder."""
     print(json.dumps(_load_bench_serving().run_bench_fleet(workers=2)))
+
+
+def bench_serving_prefix():
+    """Prefix-cache rung (ISSUE 5): a shared-system-prompt request stream
+    served cache-off then cache-on; value = the ratio of prefill tokens
+    actually computed (deterministic engine counters, lower is better).
+    Greedy parity across modes is asserted inside the bench — a rung that
+    'wins' by emitting different tokens fails instead of recording."""
+    print(json.dumps(_load_bench_serving().run_bench_prefix()))
 
 
 def bench_pipeline_compiled_vs_eager():
@@ -462,5 +494,7 @@ if __name__ == "__main__":
         bench_serving_frontend()
     if which in ("all", "fleet"):
         bench_serving_fleet()
+    if which in ("all", "prefix"):
+        bench_serving_prefix()
     if which in ("all", "pipeline"):
         bench_pipeline_compiled_vs_eager()

@@ -21,8 +21,13 @@ stay pure execution loops driven via ``ServingEngine.step()``:
   ``prompt + generated`` as the new prefill.  Greedy decode is
   deterministic, so a preempted-then-resumed request produces exactly
   the tokens of an unpreempted run.
-* **Routing & failover** — least-loaded placement with round-robin
-  tie-break across replicas.  A replica whose ``step()`` raises is
+* **Routing & failover** — prefix-affinity placement first: the prompt's
+  full-block chain hashes are scored against each replica's cached-block
+  summary (mirrored from ``state_summary`` for remote replicas) and the
+  live, non-draining replica with the longest cached prefix wins, so
+  shared-system-prompt traffic lands where its KV already is; ties fall
+  back to the least-loaded rule with round-robin tie-break.  A replica
+  whose ``step()`` raises is
   marked dead; its in-flight requests are re-queued from host-side state
   (prompt + tokens harvested so far) and drained to survivors.  With no
   survivors, every pending request resolves with a typed ``FAILED``
@@ -54,8 +59,8 @@ from typing import Callable, Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
-from .metrics import ServingMetrics
-from .serving import ServingEngine
+from .metrics import ServingMetrics, fold_prefix_counters
+from .serving import ServingEngine, prompt_block_hashes
 
 __all__ = ["Priority", "RequestStatus", "RequestResult", "ServingFrontend"]
 
@@ -154,6 +159,10 @@ class _Replica:
         self.draining = False
         self.last_error: Optional[str] = None
         self.requests: Dict[int, _FrontendRequest] = {}  # engine_rid -> req
+        # engine-level prefix counters last folded into the registry (the
+        # engine counts monotonically; the frontend incs the deltas so the
+        # registry counter survives replica death/removal)
+        self.prefix_seen = (0, 0, 0)  # (hit_blocks, miss_blocks, evictions)
 
 
 def _blocks_needed(engine: ServingEngine, total_tokens: int) -> int:
@@ -485,6 +494,30 @@ class ServingFrontend:
             self._queue.remove(req)
             self._assign(req, rep)
 
+    def _prefix_affinity(self, rep: _Replica, req: _FrontendRequest,
+                         hash_cache: Dict[int, List[str]]) -> int:
+        """Consecutive full blocks of the request's (resumed) prefill that
+        are already cached on ``rep`` — the routing score that sends
+        shared-prefix traffic where its KV lives.  ``hash_cache`` memoizes
+        the prompt's chain hashes per block size across replicas."""
+        cached_fn = getattr(rep.engine, "cached_block_hashes", None)
+        if cached_fn is None:
+            return 0
+        cached = cached_fn()
+        if not cached:
+            return 0
+        bs = int(rep.engine.bs)
+        chain = hash_cache.get(bs)
+        if chain is None:
+            chain = hash_cache[bs] = prompt_block_hashes(
+                req.prompt + req.generated, bs)
+        score = 0
+        for h in chain:
+            if h not in cached:
+                break
+            score += 1
+        return score
+
     def _pick_replica(self, req: _FrontendRequest,
                       live: List[_Replica]) -> Optional[_Replica]:
         fits = []
@@ -498,8 +531,10 @@ class ServingFrontend:
         if not fits:
             return None
         n = len(self._replicas)
+        hcache: Dict[int, List[str]] = {}
         best = min(fits, key=lambda r: (
-            len(r.requests) + len(r.engine._queue),      # least loaded
+            -self._prefix_affinity(r, req, hcache),       # most cached prefix
+            len(r.requests) + len(r.engine._queue),      # then least loaded
             -self._headroom(r)[1],                        # then most free
             (r.idx - self._rr) % n))                      # then round-robin
         self._rr = (best.idx + 1) % n
@@ -662,3 +697,14 @@ class ServingFrontend:
         m.set_gauge("blocks_free", free)
         m.set_gauge_peak("block_pool_utilization",
                          (1.0 - free / total) if total else 0.0)
+        for rep in live:
+            eng = rep.engine
+            if getattr(eng, "prefix_counters_self_reported", False):
+                # RemoteReplica mirrors counters the worker's own registry
+                # already exports on the fleet scrape page — folding the
+                # mirror here would double-count them fleet-wide
+                continue
+            cur = (int(getattr(eng, "prefix_hit_blocks", 0)),
+                   int(getattr(eng, "prefix_miss_blocks", 0)),
+                   int(getattr(eng, "prefix_evictions", 0)))
+            rep.prefix_seen = fold_prefix_counters(m, cur, rep.prefix_seen)

@@ -37,6 +37,10 @@ Three pieces, layered on four existing subsystems:
   scales up when queued work per accepting replica (or p95 TTFT) stays
   above target, drains the most idle worker after enough consecutive
   idle observations, never leaves fewer than ``min_workers`` accepting.
+  Scale-up is NON-BLOCKING: ``spawn_worker_async`` launches the process
+  and a background thread absorbs the ~10 s jax-import + compile boot;
+  the step loop keeps serving and attaches the replica once its health
+  probe answers (workers still booting count toward ``max_workers``).
 
 Failure contract: any RPC fault (connection refused after SIGKILL, typed
 ``RpcTimeout`` from a hung worker) surfaces either in ``step()`` —
@@ -61,7 +65,7 @@ from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional
 
 from .control_plane import ServingFrontend
-from .metrics import ServingMetrics
+from .metrics import ServingMetrics, fold_prefix_counters
 
 __all__ = ["RemoteReplica", "ServingFleet", "FleetAutoscaler",
            "AutoscalePolicy", "init_worker"]
@@ -74,6 +78,7 @@ __all__ = ["RemoteReplica", "ServingFleet", "FleetAutoscaler",
 # --------------------------------------------------------------------------
 _WORKER: Dict[str, Any] = {
     "engine": None, "metrics": None, "stop": None, "name": None,
+    "prefix_seen": (0, 0, 0),
 }
 
 
@@ -87,6 +92,7 @@ def init_worker(engine, name: str,
     _WORKER["metrics"] = metrics if metrics is not None else ServingMetrics()
     _WORKER["stop"] = stop if stop is not None else threading.Event()
     _WORKER["name"] = name
+    _WORKER["prefix_seen"] = (0, 0, 0)
     return _WORKER["stop"]
 
 
@@ -128,6 +134,13 @@ def _w_step():
     m.set_gauge("blocks_total", st["blocks_total"])
     m.set_gauge("blocks_free", st["blocks_free"])
     m.set_gauge_peak("block_pool_utilization", st["pool_utilization"])
+    # prefix-cache counters: the engine counts monotonically; fold the
+    # per-step deltas so _w_reset_metrics windows stay correct
+    pc = st.get("prefix_cache") or {}
+    cur = (int(pc.get("hit_blocks", 0)), int(pc.get("miss_blocks", 0)),
+           int(pc.get("evictions", 0)))
+    _WORKER["prefix_seen"] = fold_prefix_counters(m, cur,
+                                                  _WORKER["prefix_seen"])
     m.inc("completed_total", len(finished))
     return emitted, finished, st
 
@@ -209,6 +222,11 @@ class RemoteReplica:
     ``rpc_timeout`` — a hung worker raises ``RpcTimeout`` into the
     frontend's failover path instead of freezing the step loop."""
 
+    # the worker folds its engine's prefix counters into its own registry
+    # (_w_step), which the fleet scrape/merge paths already collect — the
+    # frontend's gauge sampler must not fold the mirror a second time
+    prefix_counters_self_reported = True
+
     def __init__(self, worker_name: str, rpc_timeout: float = 60.0):
         from ..distributed import rpc
 
@@ -243,6 +261,20 @@ class RemoteReplica:
                         for rid, nb in st["active"].items()}
         self._free_slots = list(range(st["free_slots"]))
         self.blocks.num_free = int(st["blocks_free"])
+        # prefix-cache mirror: the hash summary feeds frontend-side
+        # prefix-affinity routing, the counters feed _sample_gauges —
+        # exactly the attributes an in-process engine exposes
+        pc = st.get("prefix_cache") or {}
+        self.prefix_cache_enabled = bool(pc.get("enabled"))
+        self._prefix_hashes = frozenset(pc.get("hashes") or ())
+        self.prefix_hit_blocks = int(pc.get("hit_blocks", 0))
+        self.prefix_miss_blocks = int(pc.get("miss_blocks", 0))
+        self.prefix_evictions = int(pc.get("evictions", 0))
+
+    def cached_block_hashes(self):
+        """Last-synced mirror of the worker engine's content-addressable
+        block hashes (piggybacked on every RPC reply)."""
+        return self._prefix_hashes
 
     # ----------------------------------------------- ServingEngine surface
     @property
@@ -328,10 +360,12 @@ class FleetAutoscaler:
 
     Call ``observe()`` once per control-plane iteration (ServingFleet does
     this from ``step()``).  Decisions: spawn a worker when sustained
-    pressure, drain the most idle worker when sustained idleness, hold
-    otherwise.  Drain = stop admitting (frontend ``draining`` flag),
-    finish in-flight, deregister + reap (ServingFleet completes it once
-    the replica is empty)."""
+    pressure (non-blocking — the boot happens off the step loop and the
+    replica attaches when ready; booting workers count as capacity so
+    pressure during the boot can't over-spawn), drain the most idle
+    worker when sustained idleness, hold otherwise.  Drain = stop
+    admitting (frontend ``draining`` flag), finish in-flight, deregister
+    + reap (ServingFleet completes it once the replica is empty)."""
 
     def __init__(self, fleet: "ServingFleet",
                  policy: Optional[AutoscalePolicy] = None):
@@ -364,9 +398,14 @@ class FleetAutoscaler:
         self._pressure = self._pressure + 1 if pressured else 0
         self._idle = self._idle + 1 if not busy else 0
 
+        # workers already booting count as capacity on the way — without
+        # this, every observation during the ~10 s boot would spawn one
+        # more (the non-blocking spawn returns before the worker exists)
+        pending = getattr(self.fleet, "num_pending_spawns", 0)
         if (self._pressure >= pol.up_after
-                and len(accepting) < pol.max_workers):
-            name = self.fleet.spawn_worker()
+                and len(accepting) + pending < pol.max_workers):
+            spawn = getattr(self.fleet, "spawn_worker_async", None)
+            name = spawn() if spawn is not None else self.fleet.spawn_worker()
             self.actions.append(f"up:{name}")
             self._pressure = 0
             self._cooldown = pol.cooldown
@@ -432,6 +471,14 @@ class ServingFleet:
         self._logs: Dict[str, str] = {}
         self._next_worker = 0
         self._last_heartbeat = -float("inf")
+        # non-blocking scale-up state: background threads wait out worker
+        # boot (jax import + first-step compile, ~10 s) and park the ready
+        # RemoteReplica here; step() attaches it on the control thread so
+        # frontend structures are never mutated concurrently
+        self._spawn_lock = threading.Lock()
+        self._pending_spawns: Dict[str, threading.Thread] = {}
+        self._ready_replicas: List = []
+        self.spawn_errors: Dict[str, str] = {}
         self._frontend_kwargs = dict(frontend_kwargs or {})
         self.frontend: Optional[ServingFrontend] = None
         self.autoscaler: Optional[FleetAutoscaler] = None
@@ -486,9 +533,9 @@ class ServingFleet:
         with open(path) as f:
             return f.read()[-tail:]
 
-    def _await_worker(self, name: str):
-        """Block until ``name`` registers with the KV master, then attach
-        its RemoteReplica to the frontend."""
+    def _await_registration(self, name: str):
+        """Block until ``name`` registers with the KV master (raising, and
+        reaping the process, on early exit or timeout)."""
         proc = self._procs[name]
         # real wall clock, NOT the injectable self._clock: this loop
         # actually sleeps, and a frozen/jumping test clock would make the
@@ -511,14 +558,21 @@ class ServingFleet:
                     f"serving worker '{name}' did not register within "
                     f"{self.spawn_timeout}s")
             time.sleep(0.05)
+
+    def _await_worker(self, name: str):
+        """Block until ``name`` registers with the KV master, then attach
+        its RemoteReplica to the frontend."""
+        self._await_registration(name)
         self._rpc.refresh_workers()
         self.attach_worker(name)
 
-    def attach_worker(self, name: str):
-        """Wrap an already-registered worker (spawned here or started by an
-        operator on another host) in a RemoteReplica and route to it."""
-        self._rpc.refresh_workers()
-        replica = RemoteReplica(name, rpc_timeout=self.rpc_timeout)
+    def _make_replica(self, name: str):
+        """RemoteReplica factory (constructing one IS the readiness probe:
+        its ``__init__`` round-trips the worker's health RPC).  Split out
+        so tests can stand in a fake replica without subprocess boots."""
+        return RemoteReplica(name, rpc_timeout=self.rpc_timeout)
+
+    def _attach_replica(self, replica):
         if self.frontend is None:
             self.frontend = ServingFrontend([replica],
                                             **self._frontend_kwargs)
@@ -526,12 +580,79 @@ class ServingFleet:
             self.frontend.add_replica(replica)
         return replica
 
+    def attach_worker(self, name: str):
+        """Wrap an already-registered worker (spawned here or started by an
+        operator on another host) in a RemoteReplica and route to it."""
+        self._rpc.refresh_workers()
+        return self._attach_replica(self._make_replica(name))
+
     def spawn_worker(self, name: Optional[str] = None) -> str:
-        """Launch + register + attach one new worker (autoscale-up hook).
-        Blocking: the worker is routable when this returns."""
+        """Launch + register + attach one new worker.  Blocking: the
+        worker is routable when this returns (initial fleet bring-up; the
+        autoscaler's in-loop scale-up uses ``spawn_worker_async``)."""
         name = self._launch(name)
         self._await_worker(name)
         return name
+
+    def spawn_worker_async(self, name: Optional[str] = None) -> str:
+        """Non-blocking scale-up: launch the worker process and return its
+        name immediately.  A daemon thread waits out KV registration and
+        the first health probe (the ~10 s jax-import + compile boot that
+        used to stall the step loop), then parks the ready RemoteReplica;
+        the next ``step()`` attaches it on the control thread.  Spawn
+        failures are recorded in ``spawn_errors`` (the autoscaler's
+        pending count drops either way, so it can try again)."""
+        name = self._launch(name)
+        t = threading.Thread(target=self._spawn_wait, args=(name,),
+                             name=f"fleet-spawn-{name}", daemon=True)
+        with self._spawn_lock:
+            self._pending_spawns[name] = t
+        t.start()
+        return name
+
+    def _spawn_wait(self, name: str):
+        try:
+            self._await_registration(name)
+            self._rpc.refresh_workers()
+            replica = self._make_replica(name)
+        except Exception as e:  # noqa: BLE001 — boot fault, record + reap
+            with self._spawn_lock:
+                self._pending_spawns.pop(name, None)
+                self.spawn_errors[name] = repr(e)
+            proc = self._procs.pop(name, None)
+            if proc is not None:
+                try:
+                    if proc.poll() is None:
+                        proc.kill()
+                    proc.wait(timeout=10)
+                except Exception:  # noqa: BLE001
+                    pass
+                self._drop_log(name)
+            return
+        with self._spawn_lock:
+            # the _pending_spawns seat is NOT released here: it must hold
+            # until the replica is actually attached, or the autoscaler
+            # could observe in the ready-but-unattached window and spawn
+            # past max_workers
+            self._ready_replicas.append((name, replica))
+
+    @property
+    def num_pending_spawns(self) -> int:
+        """Workers launched asynchronously but not yet attached — the
+        autoscaler counts these as capacity already on the way."""
+        with self._spawn_lock:
+            return len(self._pending_spawns)
+
+    def _attach_ready(self):
+        """Attach replicas whose async spawn completed (control thread
+        only — frontend structures are single-threaded); the pending
+        seat is released only now, with the replica live."""
+        with self._spawn_lock:
+            ready, self._ready_replicas = self._ready_replicas, []
+            for name, _ in ready:
+                self._pending_spawns.pop(name, None)
+        for _, replica in ready:
+            self._attach_replica(replica)
 
     # ------------------------------------------------------------- driving
     @property
@@ -549,8 +670,10 @@ class ServingFleet:
         return self.frontend
 
     def step(self):
-        """One fleet iteration: heartbeat (rate-limited), autoscale (if
-        attached), frontend step, reap drained/dead workers."""
+        """One fleet iteration: attach async-spawned replicas, heartbeat
+        (rate-limited), autoscale (if attached), frontend step, reap
+        drained/dead workers."""
+        self._attach_ready()
         fe = self._require_frontend()
         now = self._clock()
         if now - self._last_heartbeat >= self.heartbeat_interval_s:

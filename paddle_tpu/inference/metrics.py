@@ -29,7 +29,7 @@ from __future__ import annotations
 import time
 from typing import Callable, Dict, Iterable, List, Mapping, Optional, Union
 
-__all__ = ["ServingMetrics"]
+__all__ = ["ServingMetrics", "fold_prefix_counters"]
 
 _PREFIX = "paddle_tpu_serving_"
 
@@ -38,13 +38,36 @@ COUNTERS = (
     "preempted_total", "resumed_total", "cancelled_total", "completed_total",
     "failed_total", "replica_deaths_total", "requeued_on_failover_total",
     "tokens_emitted_total", "engine_steps_total",
+    "prefix_hit_blocks_total", "prefix_miss_blocks_total",
+    "prefix_evictions_total",
 )
 GAUGES = (
     "queue_depth", "queue_depth_peak", "running_requests", "replicas_alive",
     "blocks_total", "blocks_free", "block_pool_utilization",
-    "block_pool_utilization_peak",
+    "block_pool_utilization_peak", "prefix_cache_hit_rate",
 )
 SAMPLES = ("ttft_seconds", "token_latency_seconds", "e2e_latency_seconds")
+
+# engine-level prefix-cache counters, in the order fold_prefix_counters
+# expects its (hit_blocks, miss_blocks, evictions) tuples
+PREFIX_COUNTERS = ("prefix_hit_blocks_total", "prefix_miss_blocks_total",
+                   "prefix_evictions_total")
+
+
+def fold_prefix_counters(metrics: "ServingMetrics", cur, seen):
+    """Fold one engine's monotone prefix counters into a registry as
+    deltas and refresh the hit-rate gauge; returns ``cur`` (the caller's
+    next ``seen``).  Shared by the frontend's gauge sampler (per replica)
+    and the fleet worker's step handler — delta-folding keeps registry
+    counters monotone across replica death and ``reset()`` windows."""
+    for name, c, s in zip(PREFIX_COUNTERS, cur, seen):
+        if c > s:
+            metrics.inc(name, c - s)
+    hit = metrics.counter("prefix_hit_blocks_total")
+    miss = metrics.counter("prefix_miss_blocks_total")
+    metrics.set_gauge("prefix_cache_hit_rate",
+                      hit / (hit + miss) if (hit + miss) else 0.0)
+    return cur
 
 
 def _percentile(sorted_vals: List[float], q: float) -> float:
@@ -209,6 +232,13 @@ class ServingMetrics:
         if "block_pool_utilization" in gauges:
             gauges["block_pool_utilization"] = \
                 (1.0 - free / total) if total else 0.0
+        # ratio gauges don't add: recompute the fleet-wide prefix hit rate
+        # from the merged counters, same as pool utilization above
+        if "prefix_cache_hit_rate" in gauges:
+            hit = counters.get("prefix_hit_blocks_total", 0)
+            miss = counters.get("prefix_miss_blocks_total", 0)
+            gauges["prefix_cache_hit_rate"] = \
+                hit / (hit + miss) if (hit + miss) else 0.0
         have_samples = all("samples" in s for s in snaps)
         names: List[str] = []
         for s in snaps:

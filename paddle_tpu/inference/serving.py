@@ -17,6 +17,22 @@ Design:
   transfer, the same shape every step).
 - This is the vLLM-style schedule expressed the XLA way: static shapes +
   dynamic lengths as data, not as shapes.
+- Automatic prefix caching (on by default, ``prefix_cache="auto"``):
+  ``BlockManager`` refcounts blocks and keeps a content-hash index chained
+  over ``(parent_hash, block_size token ids)`` — a retiring or evicted
+  request publishes its FULL blocks, and admission maps the longest cached
+  full-block prefix of a new prompt straight into its block table with
+  ``prefill_pos`` advanced past it, so the compiled step only ever feeds
+  the uncached tail (``prefill_pos`` is data, not shape: no recompile, no
+  in-graph change).  Granularity is whole blocks: a partial tail block is
+  never shared, and a fully-cached block-aligned prompt re-feeds exactly
+  one token into a copy-on-write fork of its last block (compute must see
+  ≥ 1 token to produce logits; the shared original stays read-only).
+  Refcount-0 published blocks park in an LRU that ``allocate`` evicts
+  only when the true free list is empty.  ``cache_quant='int8'`` is
+  excluded by a hard error: its per-(slot, kv-head) dynamic scales make
+  block payloads writer-specific, so shared blocks would dequantize
+  garbage.
 
 Frontend → fleet → engine split: the engine is a pure execution loop —
 it admits whatever is in its queue, steps, and retires.  Policy
@@ -37,10 +53,11 @@ exactly.
 """
 from __future__ import annotations
 
-from collections import Counter
+import hashlib
+from collections import Counter, OrderedDict
 from dataclasses import dataclass, field
 from functools import partial
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence, Set
 
 import numpy as np
 
@@ -49,44 +66,102 @@ import jax.numpy as jnp
 
 from ..ops.paged_attention import blha_attention
 
-__all__ = ["BlockManager", "ServingRequest", "ServingEngine"]
+__all__ = ["BlockManager", "ServingRequest", "ServingEngine",
+           "prefix_block_hash", "prompt_block_hashes"]
 # the policy layer above this engine lives in control_plane.py
 # (ServingFrontend) and metrics.py (ServingMetrics)
 
 
-class BlockManager:
-    """Host-side free-list over the global block pool.
+def prefix_block_hash(parent: Optional[str], tokens: Sequence[int]) -> str:
+    """Chain hash of ONE full block of token ids:
+    ``blake2b(parent_hash, token bytes)``.  The chaining means a block's
+    hash commits to the entire token prefix before it, so equal hashes ⇒
+    equal KV content.  blake2b (not builtin ``hash``, which is randomized
+    per process) keeps hashes comparable across worker processes — the
+    frontend's prefix-affinity routing matches its own prompt hashes
+    against hash sets shipped from remote replicas."""
+    h = hashlib.blake2b(digest_size=16)
+    h.update(parent.encode() if parent else b"\x00root")
+    h.update(np.asarray(tokens, np.int64).tobytes())
+    return h.hexdigest()
 
-    ``free`` rejects double-frees loudly: re-inserting a block already in
-    the free-list would hand the same block to two sequences on the next
-    ``allocate`` and silently corrupt both KV streams (the failure mode is
-    token garbage long after the actual bug).  Mid-flight release of a
-    live request's blocks (eviction/preemption) is fine — that is the
-    normal path for ``ServingEngine.evict``."""
+
+def prompt_block_hashes(tokens: Sequence[int], block_size: int) -> List[str]:
+    """Chain hashes for every FULL block of ``tokens`` (a partial tail
+    block is never cached or matched — it would alias every continuation
+    sharing its first few tokens)."""
+    out: List[str] = []
+    parent = None
+    for i in range(len(tokens) // block_size):
+        parent = prefix_block_hash(
+            parent, tokens[i * block_size:(i + 1) * block_size])
+        out.append(parent)
+    return out
+
+
+class BlockManager:
+    """Host-side refcounted allocator over the global block pool, with a
+    content-hash index for automatic prefix caching.
+
+    A block is in exactly one of three states:
+
+    * **free**   — on the free list; the next ``allocate`` may return it.
+    * **live**   — refcount ≥ 1: owned by one or more sequences.  ``fork``
+      shares a live (or cached) block with another sequence read-only;
+      ``free`` decrements and only releases at refcount 0.
+    * **cached** — refcount 0 but content-addressable: ``publish`` gave it
+      a chain hash, so when its last owner freed it, it was parked in an
+      LRU instead of hard-freed.  ``lookup`` + ``fork`` revive it for a
+      new sequence; ``allocate`` evicts from the LRU (oldest first,
+      dropping the hash mapping) only when the true free list is empty.
+
+    ``free`` rejects double-frees loudly: releasing a block more times
+    than it has owners would hand the same block to two sequences on the
+    next ``allocate`` and silently corrupt both KV streams (the failure
+    mode is token garbage long after the actual bug).  Mid-flight release
+    of a live request's blocks (eviction/preemption) is fine — that is
+    the normal path for ``ServingEngine.evict``."""
 
     def __init__(self, num_blocks: int):
         self.num_blocks = num_blocks
         self._free = list(range(num_blocks - 1, -1, -1))
-        self._free_set = set(self._free)
+        self._ref: Dict[int, int] = {}          # live blocks only
+        self._hash_of: Dict[int, str] = {}      # published block -> hash
+        self._block_of: Dict[str, int] = {}     # hash -> published block
+        self._lru: "OrderedDict[int, None]" = OrderedDict()  # cached, ref 0
+        self.evictions = 0   # cached blocks dropped to satisfy allocate
 
     def can_allocate(self, n: int) -> bool:
-        return len(self._free) >= n
+        return len(self._free) + len(self._lru) >= n
 
     def allocate(self, n: int) -> List[int]:
         if not self.can_allocate(n):
             raise RuntimeError(f"block pool exhausted (need {n}, "
-                               f"free {len(self._free)})")
-        out = [self._free.pop() for _ in range(n)]
-        self._free_set.difference_update(out)
+                               f"free {self.num_free})")
+        out: List[int] = []
+        for _ in range(n):
+            if self._free:
+                b = self._free.pop()
+            else:
+                # true free list empty: evict the least-recently-cached
+                # block (its KV becomes unreachable — drop the hash)
+                b, _ = self._lru.popitem(last=False)
+                h = self._hash_of.pop(b)
+                del self._block_of[h]
+                self.evictions += 1
+            self._ref[b] = 1
+            out.append(b)
         assert len(set(out)) == len(out), \
             f"free-list corruption: allocate returned duplicate ids {out}"
         return out
 
     def free(self, blocks: List[int]):
         counts = Counter(blocks)
-        dup = sorted(b for b in counts if b in self._free_set)
         internal = sorted(b for b, c in counts.items() if c > 1)
         bad = sorted(b for b in counts if not 0 <= b < self.num_blocks)
+        dup = sorted(b for b in counts
+                     if 0 <= b < self.num_blocks and b not in internal
+                     and self._ref.get(b, 0) < counts[b])
         if dup or internal or bad:
             raise RuntimeError(
                 "BlockManager.free: "
@@ -95,12 +170,76 @@ class BlockManager:
                     f"ids repeated in the freed list {internal}"
                     if internal else "",
                     f"ids outside the pool {bad}" if bad else ""])))
-        self._free.extend(blocks)
-        self._free_set.update(blocks)
+        for b in blocks:
+            self._ref[b] -= 1
+            if self._ref[b] > 0:
+                continue          # still shared with another sequence
+            del self._ref[b]
+            if b in self._hash_of:
+                self._lru[b] = None   # published: park evictable, reusable
+            else:
+                self._free.append(b)
+
+    def fork(self, block: int):
+        """Hand ``block`` to one more sequence read-only (refcount++).  A
+        cached (refcount-0, LRU-parked) block is revived: pulled out of
+        the LRU with refcount 1.  Forking a free block is a bug."""
+        if not 0 <= block < self.num_blocks:
+            raise RuntimeError(f"BlockManager.fork: id {block} outside the "
+                               f"pool of {self.num_blocks}")
+        if block in self._lru:
+            del self._lru[block]
+            self._ref[block] = 1
+        elif self._ref.get(block, 0) > 0:
+            self._ref[block] += 1
+        else:
+            raise RuntimeError(
+                f"BlockManager.fork: block {block} is on the free list — "
+                "only live or cached blocks can be shared")
+
+    def lookup(self, h: str) -> Optional[int]:
+        """Block currently holding the content with chain hash ``h``
+        (live or cached), or None."""
+        return self._block_of.get(h)
+
+    def publish(self, block: int, h: str) -> bool:
+        """Register ``block``'s content under chain hash ``h`` so a later
+        ``free`` parks it in the LRU (reusable) instead of hard-freeing.
+        No-op (False) when the hash is already mapped — first publisher
+        wins; chained hashing guarantees the content is identical — or
+        when the block already carries a hash."""
+        if h in self._block_of or block in self._hash_of:
+            return False
+        if self._ref.get(block, 0) <= 0:
+            raise RuntimeError(
+                f"BlockManager.publish: block {block} is not live — publish "
+                "before freeing (free() is what parks published blocks)")
+        self._block_of[h] = block
+        self._hash_of[block] = h
+        return True
+
+    def ref_count(self, block: int) -> int:
+        return self._ref.get(block, 0)
+
+    def cached_hashes(self) -> Set[str]:
+        """Chain hashes currently content-addressable (live or cached) —
+        the engine's prefix-affinity summary shipped to the frontend."""
+        return set(self._block_of)
 
     @property
     def num_free(self) -> int:
-        return len(self._free)
+        """Blocks allocatable right now: truly free plus cached-evictable.
+        (Admission headroom math must see cached blocks as capacity, or a
+        warm cache would look like an exhausted pool.)"""
+        return len(self._free) + len(self._lru)
+
+    @property
+    def num_cached(self) -> int:
+        return len(self._block_of)
+
+    @property
+    def num_evictable(self) -> int:
+        return len(self._lru)
 
 
 @dataclass
@@ -113,6 +252,7 @@ class ServingRequest:
     generated: List[int] = field(default_factory=list)
     blocks: List[int] = field(default_factory=list)
     prefill_pos: int = 0          # prompt tokens already cached
+    cached_prefix_tokens: int = 0  # of those, tokens REUSED from the cache
     slot: int = -1                # batch row while active
     done: bool = False
 
@@ -136,7 +276,7 @@ class ServingEngine:
     def __init__(self, model, max_batch_size: int = 4, max_seq_len: int = 256,
                  block_size: int = 16, token_budget: int = 32,
                  num_blocks: Optional[int] = None, cache_dtype=None,
-                 cache_quant: str = "none"):
+                 cache_quant: str = "none", prefix_cache="auto"):
         cfg = model.config
         self.cfg = cfg
         self.B = int(max_batch_size)
@@ -154,6 +294,23 @@ class ServingEngine:
         if cache_quant not in ("none", "int8"):
             raise ValueError("cache_quant must be 'none' or 'int8'")
         self.cache_quant = cache_quant
+        if prefix_cache not in ("auto", True, False):
+            raise ValueError("prefix_cache must be 'auto', True, or False")
+        if cache_quant == "int8" and prefix_cache is True:
+            raise ValueError(
+                "prefix_cache cannot be combined with cache_quant='int8': "
+                "the int8 cache dequantizes through per-(slot, kv-head) "
+                "DYNAMIC scales frozen at each sequence's own prefill, so a "
+                "block's uint8 payload is only meaningful under its writer's "
+                "scales — a second sequence sharing the block would "
+                "dequantize garbage. Use the unquantized cache with the "
+                "prefix cache, or pass prefix_cache=False")
+        # 'auto' = on wherever it is sound (everything but int8)
+        self.prefix_cache_enabled = (cache_quant != "int8"
+                                     and prefix_cache in ("auto", True))
+        self.prefix_hit_blocks = 0      # full blocks reused from the cache
+        self.prefix_miss_blocks = 0     # full prompt blocks that missed
+        self.prefill_tokens_computed = 0  # prompt tokens actually fed
         if cache_quant == "int8" and cache_dtype is not None:
             raise ValueError(
                 "cache_quant='int8' fixes the cache dtype to uint8 — don't "
@@ -188,6 +345,7 @@ class ServingEngine:
         self._next_rid = 0
         self._free_slots = list(range(self.B - 1, -1, -1))
         self._step_fn = self._build_step()
+        self._cow_fn = None   # lazy: compiled block-copy for COW forks
         self.compile_count = 0
 
     # ------------------------------------------------------------ weights
@@ -311,23 +469,104 @@ class ServingEngine:
                                           eos_token_id))
         return rid
 
+    def _match_cached_prefix(self, prompt: List[int]):
+        """Longest run of consecutive full prompt blocks whose chain
+        hashes are content-addressable in the pool ->
+        ``[(block_id, hash), ...]``."""
+        matched = []
+        parent = None
+        for i in range(len(prompt) // self.bs):
+            parent = prefix_block_hash(
+                parent, prompt[i * self.bs:(i + 1) * self.bs])
+            b = self.blocks.lookup(parent)
+            if b is None:
+                break
+            matched.append((b, parent))
+        return matched
+
+    def _copy_block(self, src: int, dst: int):
+        """Device-side copy of one pool block across every layer's K and V
+        cache (the copy-on-write fork: the writer gets a private copy, the
+        shared original stays read-only for its other owners)."""
+        if self._cow_fn is None:
+            def cow(kcs, vcs, s, d):
+                kcs = [kc.at[d].set(kc[s]) for kc in kcs]
+                vcs = [vc.at[d].set(vc[s]) for vc in vcs]
+                return kcs, vcs
+            # s/d are data, not static: one compiled copy program total
+            self._cow_fn = jax.jit(cow, donate_argnums=(0, 1))
+        self.key_caches, self.value_caches = self._cow_fn(
+            self.key_caches, self.value_caches,
+            jnp.asarray(src, jnp.int32), jnp.asarray(dst, jnp.int32))
+
     def _try_admit(self):
         while self._queue and self._free_slots:
             req = self._queue[0]
-            need = (len(req.prompt) + req.max_new_tokens + self.bs - 1) // self.bs
-            if not self.blocks.can_allocate(need):
-                break  # head-of-line waits for evictions
+            prompt = req.prompt
+            need = (len(prompt) + req.max_new_tokens + self.bs - 1) // self.bs
+            matched = (self._match_cached_prefix(prompt)
+                       if self.prefix_cache_enabled else [])
+            m = len(matched)
+            # a fully-cached block-aligned prompt still needs ≥ 1 token of
+            # real prefill (no compute = no logits for the first sampled
+            # token): keep the whole match, but the final token re-feeds
+            # into the LAST matched block — which is shared/read-only, so
+            # that one block is copy-on-write-forked below
+            full_match = m > 0 and m * self.bs == len(prompt)
+            n_shared = m - 1 if full_match else m
+            need_fresh = need - n_shared
+            # pin the match first: the matched blocks may be sitting in the
+            # reuse LRU, and allocating the tail could otherwise evict them
+            for b, _ in matched:
+                self.blocks.fork(b)
+            if not self.blocks.can_allocate(need_fresh):
+                self.blocks.free([b for b, _ in matched])  # unpin
+                break  # head-of-line waits for retirements
             self._queue.pop(0)
-            req.blocks = self.blocks.allocate(need)
+            fresh = self.blocks.allocate(need_fresh)
+            if full_match:
+                # COW fork of the last matched block: the re-fed final
+                # prompt token rewrites its own KV slot (same values) in a
+                # private copy, never in the shared original
+                cow_src = matched[-1][0]
+                self._copy_block(cow_src, fresh[0])
+                self.blocks.free([cow_src])   # drop the pin on the original
+                req.blocks = [b for b, _ in matched[:-1]] + fresh
+            else:
+                req.blocks = [b for b, _ in matched] + fresh
+            req.prefill_pos = min(m * self.bs, len(prompt) - 1)
+            req.cached_prefix_tokens = req.prefill_pos
+            if self.prefix_cache_enabled:
+                self.prefix_hit_blocks += m
+                self.prefix_miss_blocks += len(prompt) // self.bs - m
             req.slot = self._free_slots.pop()
             row = np.full((self.P,), -1, np.int32)
             row[:need] = req.blocks
             self.block_tables[req.slot] = row
             self._active[req.rid] = req
 
+    def _publish_prefix(self, req: ServingRequest):
+        """Make the request's full KV blocks content-addressable before
+        they are freed, so the next request sharing the token prefix skips
+        their prefill.  Only positions whose KV is actually WRITTEN count:
+        the newest sampled token is fed (and cached) one step later, so it
+        is excluded."""
+        toks = req.prompt[:req.prefill_pos] + req.generated
+        if req.generated:
+            toks = toks[:-1]
+        parent = None
+        for i in range(len(toks) // self.bs):
+            parent = prefix_block_hash(
+                parent, toks[i * self.bs:(i + 1) * self.bs])
+            self.blocks.publish(req.blocks[i], parent)
+
     def _release(self, req: ServingRequest):
         """Return a running request's blocks and batch slot to the pools
-        (shared by retirement and mid-flight eviction)."""
+        (shared by retirement and mid-flight eviction).  With the prefix
+        cache on, full blocks are published first: ``free`` then parks
+        them reusable in the LRU instead of hard-freeing."""
+        if self.prefix_cache_enabled and req.blocks:
+            self._publish_prefix(req)
         self.blocks.free(req.blocks)
         req.blocks = []
         self.block_tables[req.slot] = -1
@@ -348,8 +587,10 @@ class ServingEngine:
         the request object — ``prompt`` and ``generated`` are intact, so
         the caller can re-queue it with ``prompt + generated`` as the new
         prefill and get the identical greedy continuation.  ``prefill_pos``
-        is reset: the KV blocks are gone, a resume re-prefills from
-        scratch."""
+        is reset; with the prefix cache on, the evicted request's full KV
+        blocks are published before release, so a resume finds its own
+        prefix cached and the recompute is nearly free (only the partial
+        tail block and anything evicted under pool pressure re-prefills)."""
         req = self._active.get(rid)
         if req is not None:
             del self._active[rid]
@@ -377,6 +618,19 @@ class ServingEngine:
             "queue_depth": len(self._queue),
             "num_active": len(self._active),
             "pool_utilization": (1.0 - self.blocks.num_free / nb) if nb else 0.0,
+            # prefix-cache summary: the hash list is bounded by the pool
+            # size (tens of entries), cheap enough to piggyback on every
+            # RPC reply — the frontend's prefix-affinity routing matches
+            # prompt hashes against it without an extra round trip
+            "prefix_cache": {
+                "enabled": self.prefix_cache_enabled,
+                "hashes": sorted(self.blocks.cached_hashes())
+                if self.prefix_cache_enabled else [],
+                "cached_blocks": self.blocks.num_cached,
+                "hit_blocks": self.prefix_hit_blocks,
+                "miss_blocks": self.prefix_miss_blocks,
+                "evictions": self.blocks.evictions,
+            },
         }
 
     def pop_finished(self) -> Dict[int, List[int]]:
@@ -397,7 +651,6 @@ class ServingEngine:
         enc = np.zeros((self.B,), np.int32)
         dec = np.zeros((self.B,), np.int32)
         now = np.zeros((self.B,), np.int32)
-        tokens = np.zeros((self.T,), np.int32)
         budget = self.T
         sched: List[tuple] = []  # (req, n_tokens, finishes_prefill)
         # decode first (latency), then fill with prefill chunks
@@ -419,10 +672,10 @@ class ServingEngine:
         if not sched:
             return {}
         # pure-decode steps run the tight [B]-token program (mq=1); steps
-        # carrying prefill chunks run the [T]-token program (mq=T)
+        # carrying prefill chunks run the [T]-token program (mq=T) — decide
+        # first, allocate the one token buffer the program actually takes
         decode_only = all(not r.in_prefill for r, _, _ in sched)
-        if decode_only:
-            tokens = np.zeros((self.B,), np.int32)
+        tokens = np.zeros((self.B if decode_only else self.T,), np.int32)
         # stable slot order so cu_seqlens is monotone over batch rows
         sched.sort(key=lambda s: s[0].slot)
         cu = np.zeros((self.B + 1,), np.int32)
@@ -437,6 +690,7 @@ class ServingEngine:
                 chunk = req.prompt[req.prefill_pos:req.prefill_pos + n]
                 enc[slot] = n
                 dec[slot] = req.prefill_pos
+                self.prefill_tokens_computed += n
             else:
                 chunk = [req.generated[-1] if req.generated
                          else req.prompt[-1]]
@@ -511,3 +765,17 @@ class ServingEngine:
     @property
     def num_active(self) -> int:
         return len(self._active)
+
+    @property
+    def prefix_evictions(self) -> int:
+        """Cached blocks dropped from the reuse LRU under allocation
+        pressure (monotone; the control plane folds it into metrics)."""
+        return self.blocks.evictions
+
+    def cached_block_hashes(self) -> Set[str]:
+        """Chain hashes content-addressable in this engine's pool right
+        now — what prefix-affinity routing scores a prompt against
+        (``fleet.RemoteReplica`` mirrors this from ``state_summary``)."""
+        if not self.prefix_cache_enabled:
+            return set()
+        return self.blocks.cached_hashes()

@@ -166,6 +166,15 @@ class TestRemoteParity:
             text = fleet.prometheus_text()
             for name in ("worker0", "worker1", "frontend"):
                 assert f'replica="{name}"' in text
+            # prefix-cache counters ride the same per-replica export
+            assert "paddle_tpu_serving_prefix_hit_blocks_total" in text
+            assert "paddle_tpu_serving_prefix_cache_hit_rate" in text
+            # ...and are worker-reported ONLY: the frontend must not fold
+            # the RemoteReplica mirrors too, or a fleet-wide sum reads 2x
+            assert ('paddle_tpu_serving_prefix_hit_blocks_total'
+                    '{replica="frontend"} 0') in text
+            assert ('paddle_tpu_serving_prefix_miss_blocks_total'
+                    '{replica="frontend"} 0') in text
             # one TYPE header per metric even with three labelled series
             assert text.count(
                 "# TYPE paddle_tpu_serving_engine_steps_total counter") == 1
@@ -242,6 +251,16 @@ class TestAutoscaler:
             res = fleet.run()
             assert all(res[r].ok for r in rids)
             assert any(a.startswith("up:") for a in fleet.autoscaler.actions)
+            # scale-up is non-blocking: the worker boots off the step loop
+            # and attaches on a later step — poll for it (requests may all
+            # have finished on worker0 before the boot completes)
+            deadline = time.monotonic() + 120
+            while len(fleet.workers) < 2 and time.monotonic() < deadline:
+                if len(fleet.workers) + fleet.num_pending_spawns < 2 \
+                        and fleet.spawn_errors:
+                    pytest.fail(f"async spawn failed: {fleet.spawn_errors}")
+                fleet._attach_ready()
+                time.sleep(0.1)
             assert len(fleet.workers) == 2
 
             drained = None
@@ -258,6 +277,142 @@ class TestAutoscaler:
             # still at or above min_workers and still serving
             r = fleet.frontend.submit([9, 8, 7], max_new_tokens=4)
             assert fleet.run()[r].ok
+
+
+class TestNonBlockingScaleUp:
+    """ISSUE 5 satellite (ROADMAP item b): autoscale-up must not stall
+    the step loop on the ~10 s worker boot.  Driven with a FAKE worker —
+    launch and registration-wait are stubbed so the async machinery is
+    exercised without subprocess spawns (keeps this in tier-1)."""
+
+    def test_spawn_async_returns_immediately_and_attaches_on_step(
+            self, model, monkeypatch):
+        import threading
+
+        from paddle_tpu.distributed import rpc
+
+        release = threading.Event()     # held = worker still "booting"
+        registering = threading.Event()
+
+        def fake_launch(self, name=None):
+            if name is None:
+                name = f"worker{self._next_worker}"
+                self._next_worker += 1
+            return name                  # no subprocess
+
+        def fake_await_registration(self, name):
+            registering.set()
+            assert release.wait(timeout=30), "test never released the boot"
+
+        def fake_make_replica(self, name):
+            return ServingEngine(model, **ENGINE)
+
+        monkeypatch.setattr(ServingFleet, "_launch", fake_launch)
+        monkeypatch.setattr(ServingFleet, "_await_registration",
+                            fake_await_registration)
+        monkeypatch.setattr(ServingFleet, "_make_replica", fake_make_replica)
+        rpc.shutdown()                   # a leaked session would refuse init
+        fleet = ServingFleet(SPEC, num_workers=0)
+        try:
+            t0 = time.monotonic()
+            fleet.spawn_worker_async()
+            assert time.monotonic() - t0 < 1.0, \
+                "spawn_worker_async blocked on the worker boot"
+            assert fleet.num_pending_spawns == 1
+            assert registering.wait(timeout=10)
+            assert fleet.frontend is None      # not attached mid-boot
+            release.set()
+
+            def parked():
+                with fleet._spawn_lock:
+                    return bool(fleet._ready_replicas)
+
+            deadline = time.monotonic() + 30
+            while not parked() and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert parked(), "boot thread never parked the ready replica"
+            # the pending seat holds until the replica is ATTACHED — if it
+            # were released here, the autoscaler could observe in the
+            # ready-but-unattached window and spawn past max_workers
+            assert fleet.num_pending_spawns == 1
+            assert not fleet.spawn_errors
+            fleet.step()                       # control thread attaches
+            assert fleet.num_pending_spawns == 0
+            assert fleet.frontend is not None
+            assert len(fleet.frontend.replicas) == 1
+            rid = fleet.frontend.submit([3, 17, 101], max_new_tokens=4)
+            res = fleet.run()
+            assert res[rid].ok
+            assert res[rid].tokens == ref_greedy(model, [3, 17, 101], 4)
+        finally:
+            fleet.shutdown()
+
+    def test_spawn_async_failure_recorded_not_raised(self, model,
+                                                     monkeypatch):
+        from paddle_tpu.distributed import rpc
+
+        def fake_launch(self, name=None):
+            return "workerX"
+
+        def fake_await_registration(self, name):
+            raise RuntimeError("worker exited rc=1 before registering")
+
+        monkeypatch.setattr(ServingFleet, "_launch", fake_launch)
+        monkeypatch.setattr(ServingFleet, "_await_registration",
+                            fake_await_registration)
+        rpc.shutdown()
+        fleet = ServingFleet(SPEC, num_workers=0)
+        try:
+            fleet.spawn_worker_async()
+            deadline = time.monotonic() + 10
+            while fleet.num_pending_spawns and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert fleet.num_pending_spawns == 0   # pending count released
+            assert "workerX" in fleet.spawn_errors
+            assert "before registering" in fleet.spawn_errors["workerX"]
+        finally:
+            fleet.shutdown()
+
+    def test_autoscaler_counts_booting_workers_as_capacity(self, model):
+        """Sustained pressure during a slow boot must not over-spawn: the
+        pending spawn holds a max_workers seat until it attaches."""
+        from paddle_tpu.inference.fleet import FleetAutoscaler
+
+        fe = ServingFrontend([ServingEngine(model, **ENGINE)])
+
+        class StubFleet:
+            def __init__(self):
+                self.frontend = fe
+                self.spawned = []
+                self.num_pending_spawns = 0
+
+            def spawn_worker_async(self):
+                self.num_pending_spawns += 1
+                name = f"worker{len(self.spawned) + 1}"
+                self.spawned.append(name)
+                return name
+
+            def drain_replica(self, rep):
+                rep.draining = True
+
+        stub = StubFleet()
+        auto = FleetAutoscaler(stub, AutoscalePolicy(
+            min_workers=1, max_workers=2, scale_up_queue_per_replica=1.5,
+            up_after=1, down_after=1000, cooldown=0))
+        for _ in range(4):                 # queue pressure, nothing stepped
+            fe.submit([3, 17, 101], max_new_tokens=4)
+        assert auto.observe() == "up"
+        assert stub.spawned == ["worker1"]
+        # still pressured, but the booting worker fills max_workers
+        assert auto.observe() == "hold"
+        assert stub.spawned == ["worker1"]
+        # boot finishes: replica attaches, pending seat released
+        stub.num_pending_spawns = 0
+        fe.add_replica(ServingEngine(model, **ENGINE))
+        assert auto.observe() == "hold"    # at max_workers for real now
+        assert stub.spawned == ["worker1"]
+        res = fe.run()
+        assert all(r.ok for r in res.values())
 
 
 class TestRpcTimeoutSurface:
@@ -462,11 +617,16 @@ class TestReplicaFaultPaths:
             assert fe.metrics.counter("requeued_on_failover_total") >= 1
 
     def test_fleet_without_workers_raises_cleanly(self):
+        import threading
+
         from paddle_tpu.inference.fleet import ServingFleet as SF
 
         fleet = SF.__new__(SF)     # no subprocess spin-up needed
         fleet.frontend = None
         fleet.autoscaler = None
+        fleet._spawn_lock = threading.Lock()
+        fleet._ready_replicas = []
+        fleet._pending_spawns = {}
         with pytest.raises(RuntimeError, match="no workers"):
             SF.step(fleet)
         with pytest.raises(RuntimeError, match="no workers"):

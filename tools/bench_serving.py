@@ -19,6 +19,14 @@ same schema bench_ladder.py rungs use, so the ladder imports and re-emits
 workload through a ServingFleet of N serving_worker.py processes behind
 the RPC stack instead of in-process replicas — what the fleet ladder
 rung measures (per-step HTTP round trips are the cost being watched).
+
+``--shared-prefix-len S`` switches to the PREFIX-CACHE workload
+(ISSUE 5): every request's prompt opens with the same S-token system
+prompt (S ≥ 2 blocks).  The same request stream runs cache-off then
+cache-on; the report carries the prefix hit rate, prefill tokens
+actually computed in both modes (the gated ``value`` is their ratio —
+deterministic counters, not wall clock), per-mode TTFT, and asserts the
+greedy outputs are token-identical.
 """
 import argparse
 import json
@@ -188,6 +196,123 @@ def run_bench_fleet(num_requests=None, rate_rps=None, workers=2, seed=0):
              "transport": "distributed/rpc HTTP, per-step round trips"})
 
 
+def run_bench_prefix(num_requests=None, shared_prefix_len=None, seed=0):
+    """Prefix-cache workload (ISSUE 5): requests sharing an S-token
+    system prompt, served cache-off then cache-on through the frontend.
+    The reported ``value`` is prefill_tokens_computed(on) / (off) — a
+    deterministic counter ratio (lower is better), immune to the CPU
+    container's wall-clock noise; hit rate and per-mode TTFT ride in
+    ``extra``.  Asserts greedy outputs are token-identical across modes."""
+    import jax
+    import numpy as np
+
+    import bench_ladder  # repo root is on sys.path (top of this file)
+    import paddle_tpu as P
+    from paddle_tpu.inference import ServingEngine, ServingFrontend
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    backend = jax.default_backend()
+    on_accel = backend in ("tpu", "axon")
+    if on_accel:
+        model_cfg = dict(vocab_size=32000, hidden_size=2560,
+                         intermediate_size=8192, num_hidden_layers=9,
+                         num_attention_heads=10,
+                         max_position_embeddings=2048, dtype="bfloat16")
+        engine_cfg = dict(max_batch_size=8, max_seq_len=448, block_size=64,
+                          token_budget=128, num_blocks=56)
+        shared_prefix_len = shared_prefix_len or 192   # 3 full blocks
+        tail_lens, max_new = (17, 33, 49), 16
+        num_requests = num_requests or 16
+    else:
+        model_cfg = dict(vocab_size=512, hidden_size=128,
+                         intermediate_size=352, num_hidden_layers=2,
+                         num_attention_heads=4, max_position_embeddings=256)
+        engine_cfg = dict(max_batch_size=4, max_seq_len=64, block_size=8,
+                          token_budget=16, num_blocks=24)
+        shared_prefix_len = shared_prefix_len or 16    # 2 full blocks
+        tail_lens, max_new = (3, 5, 7), 8
+        num_requests = num_requests or 8
+    bs = engine_cfg["block_size"]
+    if shared_prefix_len < 2 * bs:
+        raise ValueError(f"--shared-prefix-len must cover >= 2 full blocks "
+                         f"({2 * bs} tokens at block_size={bs})")
+    rng = np.random.RandomState(seed)
+    prefix = rng.randint(0, model_cfg["vocab_size"],
+                         (shared_prefix_len,)).tolist()
+    prompts = [prefix + rng.randint(0, model_cfg["vocab_size"],
+                                    (int(rng.choice(tail_lens)),)).tolist()
+               for _ in range(num_requests)]
+
+    P.seed(0)
+    model = LlamaForCausalLM(LlamaConfig(**model_cfg))
+    if on_accel:
+        model.bfloat16()
+    model.eval()
+
+    def serve(prefix_cache):
+        eng = ServingEngine(model, prefix_cache=prefix_cache, **engine_cfg)
+        fe = ServingFrontend(eng)
+        # the first request alone: it pays the full prefill and publishes
+        # the shared blocks on retirement, so every later request can hit
+        r0 = fe.submit(prompts[0], max_new_tokens=max_new)
+        fe.run()
+        t0 = time.monotonic()
+        rids = [r0] + [fe.submit(p, max_new_tokens=max_new)
+                       for p in prompts[1:]]
+        fe.run()
+        wall = time.monotonic() - t0
+        res = fe.results()
+        snap = fe.metrics.snapshot()
+        return {
+            "tokens": [res[r].tokens for r in rids],
+            "prefill_tokens_computed": eng.prefill_tokens_computed,
+            "hit_rate": snap["gauges"]["prefix_cache_hit_rate"],
+            "hit_blocks": snap["counters"]["prefix_hit_blocks_total"],
+            "evictions": snap["counters"]["prefix_evictions_total"],
+            "p50_ttft_ms": round(
+                snap["latency"]["ttft_seconds"]["p50"] * 1e3, 2),
+            "wall_s": round(wall, 3),
+        }
+
+    off = serve(False)
+    on = serve("auto")
+    assert on["tokens"] == off["tokens"], \
+        "prefix cache changed greedy outputs — parity violation"
+    frac = on["prefill_tokens_computed"] / max(off["prefill_tokens_computed"],
+                                               1)
+    # the shared-full-block fraction of the cacheable workload (requests
+    # 2..N can skip the shared blocks; request 1 must compute everything)
+    sharable = (num_requests - 1) * (shared_prefix_len // bs) * bs
+    total_prefill = sum(len(p) for p in prompts)
+    return {
+        "metric": "serving_prefix_cache_prefill_fraction",
+        "value": round(frac, 4),
+        "unit": "computed/uncached (lower=better)",
+        "extra": {
+            "host": bench_ladder.host_fingerprint(),
+            "backend": backend,
+            "shared_prefix_len": shared_prefix_len,
+            "block_size": bs,
+            "num_requests": num_requests,
+            "max_new_tokens": max_new,
+            "prefill_tokens_computed_off": off["prefill_tokens_computed"],
+            "prefill_tokens_computed_on": on["prefill_tokens_computed"],
+            "shared_fraction_bound": round(1.0 - sharable / total_prefill, 4),
+            "hit_rate": round(on["hit_rate"], 4),
+            "hit_blocks": on["hit_blocks"],
+            "evictions": on["evictions"],
+            "p50_ttft_ms_off": off["p50_ttft_ms"],
+            "p50_ttft_ms_on": on["p50_ttft_ms"],
+            "wall_s_off": off["wall_s"],
+            "wall_s_on": on["wall_s"],
+            "outputs_token_identical": True,
+            "method": "same request stream served cache-off then cache-on; "
+                      "value = ratio of engine prefill_tokens_computed "
+                      "counters (deterministic, wall-clock-free)",
+        },
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--num-requests", type=int, default=None)
@@ -198,8 +323,17 @@ def main(argv=None):
                          "behind the RPC stack instead of in-process "
                          "replicas")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--shared-prefix-len", type=int, default=0,
+                    help="S>0: prefix-cache workload — every prompt opens "
+                         "with the same S-token system prompt (>= 2 full "
+                         "blocks); reports hit rate + prefill tokens "
+                         "computed cache-on vs cache-off")
     args = ap.parse_args(argv)
-    if args.workers > 0:
+    if args.shared_prefix_len > 0:
+        line = run_bench_prefix(num_requests=args.num_requests,
+                                shared_prefix_len=args.shared_prefix_len,
+                                seed=args.seed)
+    elif args.workers > 0:
         line = run_bench_fleet(num_requests=args.num_requests,
                                rate_rps=args.rate_rps,
                                workers=args.workers, seed=args.seed)

@@ -95,11 +95,18 @@ def lower_is_better(rung: Dict) -> bool:
 
 # extra.* keys that define a rung's measurement CONFIG (not its outcome) —
 # when one of these changes between rounds the values are not comparable
-# and the rung re-baselines (loudly) instead of being gated numerically
+# and the rung re-baselines (loudly) instead of being gated numerically.
+# 'method' is config too: rungs describe HOW the number was produced there
+# (slope lengths, repeat counts, timing windows), and a changed estimator
+# produces numbers on a different distribution — r8 measured the
+# serving_mixed slope rung at 13.5k vs 24.2k tok/s on IDENTICAL code
+# back-to-back, which forced its estimator to be hardened (and honestly
+# re-baselined) rather than silently compared across methods
 IDENTITY_KEYS = ("workload", "mesh", "backend", "host", "batch", "seq",
                  "img", "prompt", "new_tokens", "ring", "block_size",
                  "ctx_lengths", "num_micro", "replicas", "workers",
-                 "num_requests", "rate_rps", "max_new_tokens")
+                 "num_requests", "rate_rps", "max_new_tokens", "method",
+                 "shared_prefix_len")
 
 
 def config_drift(prev: Dict, cur: Dict) -> List[str]:

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import concurrent.futures
 import http.server
+import os
 import pickle
 import socket
 import threading
@@ -23,7 +24,7 @@ from typing import Any, Dict, List, NamedTuple, Optional
 
 __all__ = ["init_rpc", "shutdown", "rpc_sync", "rpc_async", "get_worker_info",
            "get_all_worker_infos", "refresh_workers", "WorkerInfo",
-           "RpcTimeout"]
+           "RpcTimeout", "set_fault_injector"]
 
 
 class WorkerInfo(NamedTuple):
@@ -46,6 +47,40 @@ _state: Dict[str, Any] = {
     "server": None, "name": None, "workers": {}, "pool": None, "kv": None,
     "thread": None,
 }
+
+# --------------------------------------------------------------------------
+# fault injection (inference/faults.py failpoint registry): the 'rpc.send'
+# site fires caller-side before each POST, so a chaos run can delay, drop,
+# or time out specific calls deterministically.  Survives shutdown() —
+# injector lifetime is the chaos run, not the rpc session.
+# --------------------------------------------------------------------------
+_fault_injector: Optional[Any] = None
+_fault_env_checked = False
+
+
+def set_fault_injector(inj) -> None:
+    """Arm (or with None, disarm) the 'rpc.send' failpoint for this
+    process; overrides any PADDLE_TPU_FAULTS env spec."""
+    global _fault_injector, _fault_env_checked
+    _fault_injector = inj
+    _fault_env_checked = True
+
+
+def _get_fault_injector():
+    global _fault_injector, _fault_env_checked
+    if not _fault_env_checked:
+        _fault_env_checked = True
+        # gate on the env var BEFORE importing: faults.py is stdlib-only
+        # but lives under paddle_tpu.inference, whose __init__ pulls in
+        # jax — an rpc-only process (parameter server, launch tooling)
+        # must not pay that import just to learn no faults are armed
+        if os.environ.get("PADDLE_TPU_FAULTS"):
+            try:
+                from ...inference.faults import FaultInjector
+                _fault_injector = FaultInjector.from_env()
+            except Exception:  # noqa: BLE001 — spec errors must not kill rpc
+                _fault_injector = None
+    return _fault_injector
 
 
 class _RpcHandler(http.server.BaseHTTPRequestHandler):
@@ -187,7 +222,16 @@ def refresh_workers() -> Dict[str, WorkerInfo]:
     return dict(workers)
 
 
-def _post(info: WorkerInfo, payload: bytes, timeout: float):
+def _post(info: WorkerInfo, payload: bytes, timeout: float, ctx: str = ""):
+    inj = _get_fault_injector()
+    if inj is not None:
+        # kind='timeout' raises the exact type a hung peer produces;
+        # 'drop' raises ConnectionResetError like a SIGKILLed one; 'delay'
+        # sleeps and proceeds.  Runs in the caller thread for rpc_sync and
+        # in the pool thread for rpc_async, so async faults surface
+        # through the future exactly like real transport faults.
+        inj.fire("rpc.send", detail=f"{info.name}:{ctx}",
+                 timeout_exc=RpcTimeout)
     headers = {}
     if _state.get("token"):
         headers["X-Paddle-Rpc-Token"] = _state["token"]
@@ -219,7 +263,7 @@ def rpc_sync(to: str, fn, args=(), kwargs=None, timeout: float = 300.0):
     the caller behind a hung peer."""
     info = get_worker_info(to)
     payload = pickle.dumps((fn, tuple(args), dict(kwargs or {})))
-    return _post(info, payload, timeout)
+    return _post(info, payload, timeout, ctx=getattr(fn, "__name__", ""))
 
 
 def rpc_async(to: str, fn, args=(), kwargs=None, timeout: float = 300.0):
@@ -227,7 +271,8 @@ def rpc_async(to: str, fn, args=(), kwargs=None, timeout: float = 300.0):
     the future resolves to ``RpcTimeout`` past the per-call deadline."""
     info = get_worker_info(to)
     payload = pickle.dumps((fn, tuple(args), dict(kwargs or {})))
-    fut = _state["pool"].submit(_post, info, payload, timeout)
+    fut = _state["pool"].submit(_post, info, payload, timeout,
+                                getattr(fn, "__name__", ""))
     fut.wait = fut.result  # paddle Future parity
     return fut
 

@@ -23,13 +23,21 @@ __all__ = ["Config", "create_predictor", "Predictor", "PredictorPool",
            "BlockManager", "ServingEngine", "ServingRequest",
            "ServingFrontend", "ServingMetrics", "Priority",
            "RequestStatus", "RequestResult", "ServingFleet",
-           "RemoteReplica", "FleetAutoscaler", "AutoscalePolicy"]
+           "RemoteReplica", "FleetAutoscaler", "AutoscalePolicy",
+           "BrownoutPolicy", "FaultInjector", "FaultSpec",
+           "RespawnCircuitBreaker"]
 
 from .control_plane import (  # noqa: E402
+    BrownoutPolicy,
     Priority,
     RequestResult,
     RequestStatus,
     ServingFrontend,
+)
+from .faults import (  # noqa: E402
+    FaultInjector,
+    FaultSpec,
+    RespawnCircuitBreaker,
 )
 from .fleet import (  # noqa: E402
     AutoscalePolicy,

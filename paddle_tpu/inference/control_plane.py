@@ -32,6 +32,20 @@ stay pure execution loops driven via ``ServingEngine.step()``:
   (prompt + tokens harvested so far) and drained to survivors.  With no
   survivors, every pending request resolves with a typed ``FAILED``
   result — nothing is silently dropped.
+* **Retry budgets & poison quarantine** — every replica death charges
+  the in-flight requests' ``attempts``; one that outlives
+  ``max_request_retries`` deaths (whether the replica died mid-step or
+  at dispatch) resolves typed ``FAILED_POISON`` instead of being handed
+  to — and likely killing — the next replica.  The failure mode this
+  contains: one deterministically-crashing request cascading through
+  every replica in the fleet.
+* **Brownout degradation** — with a ``BrownoutPolicy``, sustained
+  queue/pool pressure first sheds LOW admission (typed
+  ``REJECTED_BROWNOUT``), then caps NORMAL ``max_new_tokens``; HIGH is
+  never degraded.  Enter/exit thresholds are split (a hysteresis band)
+  and each transition needs consecutive pressured/clear control steps,
+  so the level — exported as the ``degraded_mode`` gauge — moves only on
+  sustained signals and restores automatically.
 * **Metrics** — a ``ServingMetrics`` registry sampled inside the step
   loop (TTFT, per-token latency, tokens/s, queue depth, shed/preempt
   counters, block-pool utilization) with ``snapshot()`` and a
@@ -62,7 +76,8 @@ import numpy as np
 from .metrics import ServingMetrics, fold_prefix_counters
 from .serving import ServingEngine, prompt_block_hashes
 
-__all__ = ["Priority", "RequestStatus", "RequestResult", "ServingFrontend"]
+__all__ = ["Priority", "RequestStatus", "RequestResult", "ServingFrontend",
+           "BrownoutPolicy"]
 
 
 class Priority(IntEnum):
@@ -80,6 +95,11 @@ class RequestStatus(Enum):
     DEADLINE_EXCEEDED = "deadline_exceeded"  # shed from queue or mid-flight
     CANCELLED = "cancelled"
     FAILED = "failed"                      # replica death with no survivor
+    # the replica serving this request died more than max_request_retries
+    # times: quarantined as poison instead of cascading through the fleet
+    FAILED_POISON = "failed_poison"
+    # brownout degradation shed this request's class at admission
+    REJECTED_BROWNOUT = "rejected_brownout"
 
 
 _STATUS_COUNTER = {
@@ -88,7 +108,44 @@ _STATUS_COUNTER = {
     RequestStatus.DEADLINE_EXCEEDED: "shed_deadline_total",
     RequestStatus.CANCELLED: "cancelled_total",
     RequestStatus.FAILED: "failed_total",
+    RequestStatus.FAILED_POISON: "requests_quarantined_total",
+    RequestStatus.REJECTED_BROWNOUT: "shed_brownout_total",
 }
+
+
+@dataclass
+class BrownoutPolicy:
+    """Hysteresis knobs for graceful degradation under sustained
+    pressure (ISSUE 7; the analog of load-shedding tiers in front of a
+    saturated service: shed the cheapest traffic first, then shrink the
+    work accepted, instead of the binary admit-or-reject cliff).
+
+    Pressure = queued requests per accepting replica above ``queue_high``
+    OR live block-pool utilization above ``pool_high``, sustained for
+    ``enter_after`` consecutive control steps; each sustained episode
+    escalates ONE level (0 normal -> 1 shed LOW admission -> 2 also cap
+    NORMAL ``max_new_tokens`` at ``normal_max_new_tokens``).  Recovery is
+    the mirror image with the LOW thresholds and ``exit_after`` — the gap
+    between the high and low thresholds is the hysteresis band that
+    keeps the fleet from flapping at the boundary.  HIGH traffic is
+    never degraded."""
+
+    queue_high: float = 8.0   # queued per accepting replica: enter above
+    queue_low: float = 2.0    # ...and only recover below this
+    pool_high: float = 0.95   # block-pool utilization: enter above
+    pool_low: float = 0.75
+    enter_after: int = 2      # consecutive pressured steps per escalation
+    exit_after: int = 4       # consecutive clear steps per de-escalation
+    normal_max_new_tokens: int = 16   # level-2 cap for NORMAL requests
+
+    def __post_init__(self):
+        if self.queue_low > self.queue_high or self.pool_low > self.pool_high:
+            raise ValueError(
+                "BrownoutPolicy hysteresis needs low <= high thresholds "
+                f"(queue {self.queue_low}/{self.queue_high}, "
+                f"pool {self.pool_low}/{self.pool_high})")
+        if self.normal_max_new_tokens < 1:
+            raise ValueError("normal_max_new_tokens must be >= 1")
 
 
 @dataclass
@@ -102,6 +159,7 @@ class RequestResult:
     tokens: List[int] = field(default_factory=list)
     detail: str = ""
     preemptions: int = 0
+    attempts: int = 0              # replica deaths survived via re-queue
     ttft_s: Optional[float] = None
     e2e_s: Optional[float] = None
 
@@ -123,6 +181,8 @@ class _FrontendRequest:
     generated: List[int] = field(default_factory=list)
     preemptions: int = 0
     assignments: int = 0
+    attempts: int = 0              # failover re-queues (replica deaths)
+    capped_from: Optional[int] = None  # brownout clipped max_new_tokens
     replica: Optional["_Replica"] = None
     engine_rid: Optional[int] = None
     first_token_t: Optional[float] = None
@@ -184,6 +244,8 @@ class ServingFrontend:
                  max_queue_tokens: Optional[int] = None,
                  class_token_budgets: Optional[Dict[Priority, int]] = None,
                  preemption: bool = True,
+                 max_request_retries: int = 3,
+                 brownout: Optional[BrownoutPolicy] = None,
                  clock: Callable[[], float] = time.monotonic,
                  metrics: Optional[ServingMetrics] = None):
         if isinstance(engines, ServingEngine):
@@ -194,6 +256,17 @@ class ServingFrontend:
         self._clock = clock
         self.max_queue_requests = max_queue_requests
         self.max_queue_tokens = max_queue_tokens
+        # retry budget: a request may survive at most this many replica
+        # deaths via failover re-queue; past it, it is quarantined as
+        # FAILED_POISON instead of being handed to (and possibly killing)
+        # yet another replica
+        if max_request_retries < 0:
+            raise ValueError("max_request_retries must be >= 0")
+        self.max_request_retries = int(max_request_retries)
+        self.brownout = brownout
+        self._brownout_level = 0
+        self._brownout_pressure_steps = 0
+        self._brownout_clear_steps = 0
         # fleet-wide per-class caps on committed (queued + running) tokens:
         # the frontend owns admission, so the budget holds across however
         # many local or remote replicas currently exist
@@ -302,6 +375,21 @@ class ServingFrontend:
                          "every live replica is draining (fleet scale-down "
                          "in progress) — not admitting")
             return rid
+        # brownout degradation (level maintained by step() with
+        # hysteresis): shed the cheapest class first, then shrink NORMAL
+        # work; HIGH is never degraded
+        if self._brownout_level >= 1 and req.priority is Priority.LOW:
+            self._finish(req, RequestStatus.REJECTED_BROWNOUT,
+                         f"brownout level {self._brownout_level}: LOW "
+                         "admission shed under sustained queue/pool "
+                         "pressure — retry later or raise priority")
+            return rid
+        if self._brownout_level >= 2 and req.priority is Priority.NORMAL:
+            cap = self.brownout.normal_max_new_tokens
+            if req.max_new_tokens > cap:
+                req.capped_from = req.max_new_tokens
+                req.max_new_tokens = cap
+                self.metrics.inc("brownout_capped_total")
         if not any(self._fits_at_all(r, req) for r in accepting):
             self._finish(req, RequestStatus.OVERLOADED,
                          f"prompt+max_new_tokens={req.total_tokens} exceeds "
@@ -373,6 +461,7 @@ class ServingFrontend:
             self._sample_gauges()
             return
         self._shed_expired()
+        self._update_brownout()
         self._dispatch()
         stepping = [rep for rep in self._replicas
                     if rep.alive and (rep.engine.num_active
@@ -410,6 +499,50 @@ class ServingFrontend:
         return dict(self._results)
 
     # ------------------------------------------------------------ internals
+    @property
+    def brownout_level(self) -> int:
+        """0 = normal, 1 = LOW admission shed, 2 = + NORMAL max_new_tokens
+        capped (mirrored in the ``degraded_mode`` gauge)."""
+        return self._brownout_level
+
+    def _update_brownout(self):
+        """Advance the degradation state machine one control step.
+
+        Escalates one level after ``enter_after`` consecutive pressured
+        steps, de-escalates after ``exit_after`` consecutive clear steps;
+        readings inside the hysteresis band reset both runs, so the level
+        only moves on genuinely sustained signals."""
+        pol = self.brownout
+        if pol is None:
+            return
+        accepting = [r for r in self._replicas
+                     if r.alive and not r.draining]
+        per_rep = len(self._queue) / max(len(accepting), 1)
+        total = sum(r.engine.blocks.num_blocks for r in accepting)
+        free = sum(r.engine.blocks.num_free for r in accepting)
+        util = (1.0 - free / total) if total else 0.0
+        pressured = per_rep > pol.queue_high or util > pol.pool_high
+        clear = per_rep <= pol.queue_low and util <= pol.pool_low
+        if pressured:
+            self._brownout_pressure_steps += 1
+            self._brownout_clear_steps = 0
+        elif clear:
+            self._brownout_clear_steps += 1
+            self._brownout_pressure_steps = 0
+        else:
+            self._brownout_pressure_steps = 0
+            self._brownout_clear_steps = 0
+        if (self._brownout_pressure_steps >= pol.enter_after
+                and self._brownout_level < 2):
+            self._brownout_level += 1
+            self._brownout_pressure_steps = 0
+            self.metrics.inc("brownout_transitions_total")
+        elif (self._brownout_clear_steps >= pol.exit_after
+                and self._brownout_level > 0):
+            self._brownout_level -= 1
+            self._brownout_clear_steps = 0
+        self.metrics.set_gauge("degraded_mode", self._brownout_level)
+
     def _fits_at_all(self, rep: _Replica, req: _FrontendRequest) -> bool:
         """Could this request run on ``rep`` if the replica were idle?"""
         eng = rep.engine
@@ -614,9 +747,11 @@ class ServingFrontend:
         except Exception as e:  # noqa: BLE001 — remote replica fault
             # a worker that died between heartbeats surfaces here when
             # dispatch tries to place on it: fail over (re-queues its
-            # in-flight requests) and re-queue this one for a survivor
+            # in-flight requests) and retry this one on a survivor —
+            # through the same retry budget as a mid-step death, so a
+            # request that kills replicas at admission quarantines too
             self._kill_replica(rep, e)
-            self._queue.append(req)
+            self._requeue_or_quarantine(req, rep)
             return
         rep.requests[erid] = req
         req.replica = rep
@@ -659,20 +794,49 @@ class ServingFrontend:
         rep.last_error = repr(exc)
         self.metrics.inc("replica_deaths_total")
         # the engine's device state is untrusted after a fault; resume every
-        # in-flight request from host-side state on a surviving replica
+        # in-flight request from host-side state on a surviving replica —
+        # UNLESS its retry budget is spent: a request whose replica died
+        # max_request_retries+1 times is overwhelmingly likely to be the
+        # poison that killed them, and re-queueing it would cascade the
+        # crash through every survivor in turn.  Quarantine it typed.
         for erid, req in list(rep.requests.items()):
             req.replica = None
             req.engine_rid = None
-            self._queue.append(req)
-            self.metrics.inc("requeued_on_failover_total")
+            self._requeue_or_quarantine(req, rep)
         rep.requests.clear()
+
+    def _requeue_or_quarantine(self, req: _FrontendRequest, rep: _Replica):
+        """Charge one replica death against ``req``'s retry budget: back
+        to the queue within budget, typed FAILED_POISON past it."""
+        req.attempts += 1
+        if req.attempts > self.max_request_retries:
+            self._finish(
+                req, RequestStatus.FAILED_POISON,
+                f"quarantined: replica died {req.attempts} times with "
+                f"this request in flight (max_request_retries="
+                f"{self.max_request_retries}); last error: "
+                f"{rep.last_error}")
+            return
+        self._queue.append(req)
+        self.metrics.inc("requeued_on_failover_total")
+        self.metrics.inc("requests_retried_total")
 
     def _finish(self, req: _FrontendRequest, status: RequestStatus,
                 detail: str = "") -> RequestResult:
+        # first terminal state wins: a request quarantined inside
+        # _kill_replica during a cancel/shed evict fault must not be
+        # re-finished (and double-counted) by the outer path
+        prev = self._results.get(req.rid)
+        if prev is not None:
+            return prev
+        if status is RequestStatus.COMPLETED and req.capped_from is not None:
+            detail = (f"brownout: max_new_tokens capped "
+                      f"{req.capped_from} -> {req.max_new_tokens}")
         now = self._clock()
         res = RequestResult(
             rid=req.rid, status=status, tokens=list(req.generated),
             detail=detail, preemptions=req.preemptions,
+            attempts=req.attempts,
             ttft_s=(req.first_token_t - req.submit_t)
             if req.first_token_t is not None else None,
             e2e_s=now - req.submit_t)

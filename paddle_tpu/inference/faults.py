@@ -1,0 +1,332 @@
+"""Deterministic fault injection + containment primitives for the
+serving fleet (reference analogs: freebsd/etcd-style failpoints for the
+injection side, the classic Netflix/Hystrix breaker state machine for
+containment — rebuilt host-side and seeded so chaos runs are exactly
+reproducible).
+
+Three pieces:
+
+* **``FaultInjector``** — a seeded failpoint registry.  Production code
+  carries *named sites* (``engine.step``, ``rpc.send``, ``health.probe``,
+  ``fleet.spawn``, ``fleet.heartbeat``) as one-line hooks that are
+  zero-cost when no injector is armed (the default is ``None`` unless the
+  ``PADDLE_TPU_FAULTS`` env var carries a JSON spec).  Each armed site
+  has a ``FaultSpec`` — kind (``error``/``timeout``/``drop``/``delay``),
+  probability, skip-count, fire-budget, and an optional ``match``
+  substring against the site's detail string (how a *poison request* is
+  expressed: match on its prompt signature and the fault follows the
+  request across replicas and resumes).  Randomness is a per-site
+  ``random.Random`` seeded from ``(seed, site)``, so fire schedules are
+  independent of cross-site interleaving and reproducible across
+  processes — the chaos soak's whole contract.
+* **``RespawnCircuitBreaker``** — the containment for a crash-looping
+  spawner: K failures (spawn faults or early deaths) inside a sliding
+  window open the breaker; while open, ``allow()`` refuses respawns
+  until an exponentially-growing, jittered backoff elapses, then admits
+  exactly ONE half-open probe — probe success re-closes, probe failure
+  re-opens with doubled backoff.  Clock and jitter RNG are injectable
+  so tests drive the state machine deterministically.
+* **``FaultyReplica``** — an engine-surface proxy that fires injector
+  sites around ``step``/``add_request``/``evict``: how the chaos harness
+  (``tools/chaos_serving.py``) and the fast fault-containment tests make
+  in-process replicas fail exactly like remote workers (crash, hang past
+  the RPC deadline, drop the connection) without subprocess boots.
+
+Nothing here imports jax or the engine — pure host-side stdlib, safe to
+import from ``distributed/rpc`` without cycles.
+"""
+from __future__ import annotations
+
+import json
+import os
+import random
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple, Union
+
+__all__ = [
+    "FaultSpec", "FaultInjector", "InjectedFault", "InjectedTimeout",
+    "InjectedDrop", "RespawnCircuitBreaker", "FaultyReplica",
+    "FAULTS_ENV_VAR",
+]
+
+FAULTS_ENV_VAR = "PADDLE_TPU_FAULTS"
+
+
+class InjectedFault(RuntimeError):
+    """kind='error': the failure a crashing component would raise."""
+
+
+class InjectedTimeout(TimeoutError):
+    """kind='timeout' when the site supplies no typed exception (RPC
+    sites pass ``timeout_exc=RpcTimeout`` so callers see the exact type
+    a genuinely hung peer produces)."""
+
+
+class InjectedDrop(ConnectionResetError):
+    """kind='drop': peer vanished mid-call (SIGKILL, network partition)."""
+
+
+@dataclass
+class FaultSpec:
+    """One armed failpoint.
+
+    ``kind``: ``error`` raises :class:`InjectedFault`; ``timeout`` raises
+    the site's typed timeout (or :class:`InjectedTimeout`); ``drop``
+    raises :class:`InjectedDrop`; ``delay`` sleeps ``delay_s`` and lets
+    the call proceed.  ``p`` is the per-traversal fire probability
+    (seeded), ``after`` skips the first N matching traversals, ``times``
+    bounds total fires (None = unbounded), ``match`` restricts the site
+    to traversals whose detail string contains it (poison routing)."""
+
+    kind: str
+    p: float = 1.0
+    after: int = 0
+    times: Optional[int] = None
+    delay_s: float = 0.0
+    match: Optional[str] = None
+
+    KINDS = ("error", "timeout", "drop", "delay")
+
+    def __post_init__(self):
+        if self.kind not in self.KINDS:
+            raise ValueError(f"FaultSpec.kind must be one of {self.KINDS}, "
+                             f"got {self.kind!r}")
+        if not 0.0 <= self.p <= 1.0:
+            raise ValueError(f"FaultSpec.p must be in [0, 1], got {self.p}")
+
+
+class FaultInjector:
+    """Seeded registry of named failpoints.
+
+    >>> inj = FaultInjector({"engine.step": {"kind": "error", "p": 0.1}},
+    ...                     seed=7)
+    >>> inj.fire("engine.step")        # raises InjectedFault ~10% of hits
+    >>> inj.fire("unarmed.site")       # no spec: returns False, free
+
+    Sites with no spec cost one dict lookup; production components only
+    reach that lookup when an injector was explicitly armed (constructor
+    arg or the ``PADDLE_TPU_FAULTS`` env JSON), so the default serving
+    path carries zero overhead."""
+
+    def __init__(self, sites: Dict[str, Union[FaultSpec, Dict]],
+                 seed: int = 0, sleep: Callable[[float], None] = time.sleep):
+        self.seed = int(seed)
+        self._sleep = sleep
+        self._specs: Dict[str, FaultSpec] = {
+            site: spec if isinstance(spec, FaultSpec) else FaultSpec(**spec)
+            for site, spec in (sites or {}).items()}
+        # one RNG per site, seeded by (seed, site): a site's fire schedule
+        # depends only on its own traversal count, never on how other
+        # sites interleave — the reproducibility contract chaos runs need
+        self._rng: Dict[str, random.Random] = {
+            site: random.Random(f"{self.seed}:{site}") for site in self._specs}
+        self._traversals: Dict[str, int] = {}
+        self._fires: Dict[str, int] = {}
+        self.log: List[Tuple[str, str, str]] = []  # (site, kind, detail)
+
+    @classmethod
+    def from_env(cls, var: str = FAULTS_ENV_VAR) -> Optional["FaultInjector"]:
+        """Injector from a JSON env spec, or None when unset — the
+        production default every instrumented constructor falls back to.
+
+        ``PADDLE_TPU_FAULTS='{"seed": 7, "sites": {"engine.step":
+        {"kind": "error", "p": 0.05}}}'``"""
+        raw = os.environ.get(var)
+        if not raw:
+            return None
+        cfg = json.loads(raw)
+        return cls(cfg.get("sites", {}), seed=cfg.get("seed", 0))
+
+    def spec(self, site: str) -> Optional[FaultSpec]:
+        return self._specs.get(site)
+
+    def fires(self, site: str) -> int:
+        """How many times ``site`` actually fired."""
+        return self._fires.get(site, 0)
+
+    @property
+    def total_fires(self) -> int:
+        return sum(self._fires.values())
+
+    def kinds_fired(self) -> List[str]:
+        """Distinct fault kinds that actually fired (the chaos soak
+        asserts >= 3 so a 'chaos' run can't silently degrade to calm)."""
+        return sorted({k for _, k, _ in self.log})
+
+    def fire(self, site: str, detail: str = "",
+             timeout_exc: Optional[type] = None) -> bool:
+        """Traverse failpoint ``site``.  Returns False when the site is
+        unarmed or the spec declines this traversal; otherwise performs
+        the spec's action — sleeps for ``delay`` (returns True), raises
+        for ``error``/``timeout``/``drop``."""
+        spec = self._specs.get(site)
+        if spec is None:
+            return False
+        if spec.match is not None and spec.match not in detail:
+            return False
+        n = self._traversals.get(site, 0) + 1
+        self._traversals[site] = n
+        if n <= spec.after:
+            return False
+        if spec.times is not None and self._fires.get(site, 0) >= spec.times:
+            return False
+        if spec.p < 1.0 and self._rng[site].random() >= spec.p:
+            return False
+        self._fires[site] = self._fires.get(site, 0) + 1
+        self.log.append((site, spec.kind, detail))
+        msg = (f"injected {spec.kind} at failpoint '{site}'"
+               + (f" ({detail})" if detail else ""))
+        if spec.kind == "delay":
+            self._sleep(spec.delay_s)
+            return True
+        if spec.kind == "timeout":
+            raise (timeout_exc or InjectedTimeout)(msg)
+        if spec.kind == "drop":
+            raise InjectedDrop(msg)
+        raise InjectedFault(msg)
+
+
+class RespawnCircuitBreaker:
+    """Spawn-path circuit breaker with exponential jittered backoff.
+
+    Containment for the crash-looping-worker failure mode: without it a
+    fleet whose worker *config* is broken respawns (and pays the ~10 s
+    boot for) a doomed process on every autoscaler observation, forever.
+
+    States: ``closed`` (spawns flow; ``threshold`` failures inside
+    ``window_s`` open it) -> ``open`` (``allow()`` is False until the
+    backoff deadline) -> ``half_open`` (exactly one probe spawn admitted;
+    ``record_success`` re-closes and resets the backoff ladder,
+    ``record_failure`` re-opens with the backoff doubled, up to
+    ``max_backoff_s``).  Backoff is jittered ±``jitter`` relative via a
+    seeded RNG so N breakers opened by one outage don't retry in
+    lockstep, while staying reproducible under test."""
+
+    def __init__(self, threshold: int = 3, window_s: float = 60.0,
+                 base_backoff_s: float = 2.0, max_backoff_s: float = 120.0,
+                 jitter: float = 0.25,
+                 clock: Callable[[], float] = time.monotonic, seed: int = 0):
+        if threshold < 1:
+            raise ValueError("threshold must be >= 1")
+        self.threshold = int(threshold)
+        self.window_s = float(window_s)
+        self.base_backoff_s = float(base_backoff_s)
+        self.max_backoff_s = float(max_backoff_s)
+        self.jitter = float(jitter)
+        self._clock = clock
+        self._rng = random.Random(f"breaker:{seed}")
+        self.state = "closed"
+        self.open_count = 0          # times the breaker opened (monotone)
+        self._failures: List[float] = []   # timestamps inside the window
+        self._consecutive_opens = 0
+        self._retry_at = -float("inf")
+
+    def _backoff(self) -> float:
+        raw = min(self.base_backoff_s * (2.0 ** (self._consecutive_opens - 1)),
+                  self.max_backoff_s)
+        return raw * (1.0 + self.jitter * (2.0 * self._rng.random() - 1.0))
+
+    def _open(self):
+        self.state = "open"
+        self.open_count += 1
+        self._consecutive_opens += 1
+        self._retry_at = self._clock() + self._backoff()
+        self._failures.clear()
+
+    def allow(self) -> bool:
+        """May a spawn proceed right now?  An open breaker past its
+        backoff deadline transitions to half-open and admits exactly one
+        probe (callers MUST report that probe via record_success /
+        record_failure, or the breaker stays half-open)."""
+        if self.state == "closed":
+            return True
+        if self.state == "open" and self._clock() >= self._retry_at:
+            self.state = "half_open"
+            return True
+        return False   # open before the deadline, or half-open probe out
+
+    def record_failure(self):
+        """A spawn failed, or a just-spawned worker died early."""
+        if self.state == "half_open":
+            self._open()               # probe failed: back off, doubled
+            return
+        now = self._clock()
+        self._failures.append(now)
+        cutoff = now - self.window_s
+        self._failures = [t for t in self._failures if t >= cutoff]
+        if self.state == "closed" and len(self._failures) >= self.threshold:
+            self._open()
+
+    def record_success(self):
+        """A spawned worker attached and looks healthy."""
+        self.state = "closed"
+        self._failures.clear()
+        self._consecutive_opens = 0
+        self._retry_at = -float("inf")
+
+    @property
+    def open_gauge(self) -> float:
+        """0 closed / 0.5 half-open / 1 open — the ``respawn_breaker_open``
+        metrics gauge."""
+        return {"closed": 0.0, "half_open": 0.5, "open": 1.0}[self.state]
+
+
+def prompt_signature(prompt, limit: int = 6) -> str:
+    """Stable detail-string marker for one request's prompt — what a
+    poison ``FaultSpec.match`` latches onto.  Uses the prompt HEAD, so a
+    preempted/failed-over request resumed with ``prompt + generated`` as
+    its new prefill keeps the same signature and the poison follows it
+    across replicas (exactly how a deterministically-crashing input
+    behaves in production).  EVERY token is terminated with ``-`` so a
+    match anchors on token boundaries: ``match="p66-6-6-"`` fires on
+    prompts headed ``[66, 6, 6]`` but never on ``[66, 6, 61]`` (whose
+    signature is ``p66-6-61-``)."""
+    return "p" + "".join(f"{int(t)}-" for t in list(prompt)[:limit])
+
+
+class FaultyReplica:
+    """Engine-surface proxy with failpoints at the frontend's driving
+    calls — in-process stand-in for a remote worker that can crash, hang
+    past its RPC deadline, or drop the connection.
+
+    Fires two sites per call: the replica-specific ``{name}.{op}`` (a
+    chaos schedule targets one replica) and the shared ``engine.{op}``
+    (a poison spec matches any replica via the active prompts' signature
+    in the detail string).  Everything else delegates to the wrapped
+    engine, so admission/routing/preemption math sees real state."""
+
+    def __init__(self, engine, injector: FaultInjector,
+                 name: str = "replica", timeout_exc: Optional[type] = None):
+        self._eng = engine
+        self._inj = injector
+        self.name = name
+        self._timeout_exc = timeout_exc
+
+    def __getattr__(self, attr):
+        return getattr(self._eng, attr)
+
+    def _detail(self) -> str:
+        return " ".join(prompt_signature(r.prompt)
+                        for r in self._eng._active.values())
+
+    def _fire(self, op: str, detail: str):
+        self._inj.fire(f"{self.name}.{op}", detail=detail,
+                       timeout_exc=self._timeout_exc)
+        self._inj.fire(f"engine.{op}", detail=detail,
+                       timeout_exc=self._timeout_exc)
+
+    def add_request(self, prompt_ids, max_new_tokens: int = 32,
+                    eos_token_id=None):
+        self._fire("add_request", prompt_signature(prompt_ids))
+        return self._eng.add_request(prompt_ids,
+                                     max_new_tokens=max_new_tokens,
+                                     eos_token_id=eos_token_id)
+
+    def step(self):
+        self._fire("step", self._detail())
+        return self._eng.step()
+
+    def evict(self, rid):
+        self._fire("evict", self._detail())
+        return self._eng.evict(rid)

@@ -47,7 +47,16 @@ Failure contract: any RPC fault (connection refused after SIGKILL, typed
 caught by the frontend's existing failover — or in the heartbeat, which
 routes through the same path.  Requests are re-queued from frontend-side
 state (prompt + tokens harvested so far) and finish on survivors with
-greedy-identical tokens; nothing is dropped.
+greedy-identical tokens; nothing is dropped.  Fault containment on top
+(ISSUE 7): heartbeat probes are idempotent and retry transient transport
+faults with backoff before declaring a worker dead (data-plane ``step``
+stays fail-fast into failover); spawn failures and early worker deaths
+feed a ``RespawnCircuitBreaker`` the autoscaler consults before every
+scale-up, so a crash-looping worker config backs off exponentially
+(jittered) instead of paying a doomed ~10 s boot per observation;
+``spawn_errors`` is a bounded ring; and the ``fleet.spawn`` /
+``fleet.heartbeat`` failpoints (``inference/faults.py``) let the chaos
+soak drive all of it deterministically.
 
 Scope note: each worker is still one host / one engine; true multi-host
 TPU meshes *per replica* (a sharded engine spanning hosts) remain open.
@@ -61,14 +70,34 @@ import sys
 import tempfile
 import threading
 import time
+from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional
 
 from .control_plane import ServingFrontend
+from .faults import FaultInjector, RespawnCircuitBreaker
 from .metrics import ServingMetrics, fold_prefix_counters
 
 __all__ = ["RemoteReplica", "ServingFleet", "FleetAutoscaler",
            "AutoscalePolicy", "init_worker"]
+
+
+class _BoundedErrors(OrderedDict):
+    """Dict-shaped ring of the most recent errors: a crash-looping
+    spawner must not grow ``ServingFleet.spawn_errors`` without bound.
+    Oldest entries fall off past ``maxlen``; lookup/containment/iteration
+    behave like the plain dict this replaces."""
+
+    def __init__(self, maxlen: int = 32):
+        super().__init__()
+        self.maxlen = int(maxlen)
+
+    def __setitem__(self, key, value):
+        if key in self:
+            del self[key]              # refresh recency
+        super().__setitem__(key, value)
+        while len(self) > self.maxlen:
+            self.popitem(last=False)
 
 
 # --------------------------------------------------------------------------
@@ -78,21 +107,27 @@ __all__ = ["RemoteReplica", "ServingFleet", "FleetAutoscaler",
 # --------------------------------------------------------------------------
 _WORKER: Dict[str, Any] = {
     "engine": None, "metrics": None, "stop": None, "name": None,
-    "prefix_seen": (0, 0, 0),
+    "prefix_seen": (0, 0, 0), "faults": None,
 }
 
 
 def init_worker(engine, name: str,
                 stop: Optional[threading.Event] = None,
-                metrics: Optional[ServingMetrics] = None) -> threading.Event:
+                metrics: Optional[ServingMetrics] = None,
+                fault_injector: Optional[FaultInjector] = None
+                ) -> threading.Event:
     """Install ``engine`` as this process's served replica (called by
     tools/serving_worker.py before ``rpc.init_rpc``).  Returns the stop
-    event ``_w_shutdown`` sets."""
+    event ``_w_shutdown`` sets.  ``fault_injector`` arms the worker-side
+    failpoints (``health.probe`` here; the engine carries its own
+    ``engine.step`` site) for chaos runs."""
     _WORKER["engine"] = engine
     _WORKER["metrics"] = metrics if metrics is not None else ServingMetrics()
     _WORKER["stop"] = stop if stop is not None else threading.Event()
     _WORKER["name"] = name
     _WORKER["prefix_seen"] = (0, 0, 0)
+    _WORKER["faults"] = (fault_injector if fault_injector is not None
+                         else FaultInjector.from_env())
     return _WORKER["stop"]
 
 
@@ -154,6 +189,11 @@ def _w_evict(rid):
 def _w_health(include_samples: bool = False):
     """The one shared probe: heartbeat liveness, autoscaler load signals,
     and metrics aggregation all read this."""
+    inj = _WORKER.get("faults")
+    if inj is not None:
+        # a probe that raises here travels back as an RPC error — exactly
+        # the shape a wedged health handler produces
+        inj.fire("health.probe", detail=str(_WORKER.get("name")))
     eng = _engine()
     return {
         "state": eng.state_summary(),
@@ -320,14 +360,34 @@ class RemoteReplica:
 
     # --------------------------------------------------- fleet-layer extras
     def health(self, include_samples: bool = False,
-               timeout: Optional[float] = None) -> Dict:
+               timeout: Optional[float] = None, retries: int = 0,
+               retry_backoff_s: float = 0.05) -> Dict:
         """Probe the worker; ``timeout`` overrides the data-plane timeout
         (heartbeats use a short one so a hung worker is detected within
-        ~a heartbeat interval, not after a full data-plane deadline)."""
-        h = self._rpc.rpc_sync(self.worker, _w_health,
-                               args=(include_samples,),
-                               timeout=self.rpc_timeout
-                               if timeout is None else timeout)
+        ~a heartbeat interval, not after a full data-plane deadline).
+
+        ``retries`` re-issues the probe after transient transport faults
+        (RpcTimeout / connection errors) with exponential backoff — the
+        probe is idempotent and read-only, so retrying is always safe,
+        and one dropped packet must not fail over a healthy worker.  The
+        data-plane ``step`` path deliberately has NO retry: it is not
+        idempotent from the frontend's view (tokens could be emitted
+        twice) and the existing failover re-queue already recovers it
+        exactly."""
+        last: Optional[BaseException] = None
+        for attempt in range(int(retries) + 1):
+            if attempt:
+                time.sleep(retry_backoff_s * (2.0 ** (attempt - 1)))
+            try:
+                h = self._rpc.rpc_sync(self.worker, _w_health,
+                                       args=(include_samples,),
+                                       timeout=self.rpc_timeout
+                                       if timeout is None else timeout)
+                break
+            except (TimeoutError, ConnectionError, OSError) as e:
+                last = e       # transient transport shapes: retry
+        else:
+            raise last
         self._apply_state(h["state"])
         return h
 
@@ -404,6 +464,16 @@ class FleetAutoscaler:
         pending = getattr(self.fleet, "num_pending_spawns", 0)
         if (self._pressure >= pol.up_after
                 and len(accepting) + pending < pol.max_workers):
+            # respawn circuit breaker: after K spawn-or-early-death
+            # failures the fleet stops paying a doomed ~10 s boot per
+            # observation; pressure is NOT reset, so the next allow()
+            # (half-open probe after the jittered backoff) retries
+            # immediately instead of re-accumulating up_after signals
+            breaker = getattr(self.fleet, "spawn_breaker", None)
+            if breaker is not None and not breaker.allow():
+                if not self.actions or self.actions[-1] != "breaker:hold":
+                    self.actions.append("breaker:hold")
+                return "hold"
             spawn = getattr(self.fleet, "spawn_worker_async", None)
             name = spawn() if spawn is not None else self.fleet.spawn_worker()
             self.actions.append(f"up:{name}")
@@ -447,8 +517,13 @@ class ServingFleet:
                  spawn_timeout: float = 120.0,
                  heartbeat_interval_s: float = 1.0,
                  heartbeat_timeout_s: float = 5.0,
+                 heartbeat_retries: int = 1,
                  cpu_workers: bool = True,
                  autoscaler_policy: Optional[AutoscalePolicy] = None,
+                 spawn_breaker: Optional[RespawnCircuitBreaker] = None,
+                 early_death_s: float = 20.0,
+                 max_spawn_errors: int = 32,
+                 fault_injector: Optional[FaultInjector] = None,
                  clock: Callable[[], float] = time.monotonic):
         from ..distributed import rpc
         from ..distributed.launch.master import KVClient, KVServer
@@ -458,9 +533,23 @@ class ServingFleet:
         self.spawn_timeout = float(spawn_timeout)
         self.heartbeat_interval_s = float(heartbeat_interval_s)
         self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        # idempotent health probes survive one transient transport fault
+        # by default; data-plane step RPCs stay fail-fast into failover
+        self.heartbeat_retries = int(heartbeat_retries)
         self.cpu_workers = bool(cpu_workers)
         self._clock = clock
         self._rpc = rpc
+        # respawn containment: spawn failures and early worker deaths feed
+        # this breaker; the autoscaler consults it before every spawn, so
+        # a crash-looping worker config backs off exponentially instead of
+        # burning a ~10 s boot per observation forever
+        self.spawn_breaker = (spawn_breaker if spawn_breaker is not None
+                              else RespawnCircuitBreaker(clock=clock))
+        self.early_death_s = float(early_death_s)
+        self._attached_at: Dict[str, float] = {}
+        self._faults = (fault_injector if fault_injector is not None
+                        else FaultInjector.from_env())
+        self._max_spawn_errors = int(max_spawn_errors)
         self._kv_server = None
         if master_endpoint is None:
             self._kv_server = KVServer(0).start()
@@ -478,7 +567,8 @@ class ServingFleet:
         self._spawn_lock = threading.Lock()
         self._pending_spawns: Dict[str, threading.Thread] = {}
         self._ready_replicas: List = []
-        self.spawn_errors: Dict[str, str] = {}
+        self.spawn_errors: Dict[str, str] = _BoundedErrors(
+            self._max_spawn_errors)
         self._frontend_kwargs = dict(frontend_kwargs or {})
         self.frontend: Optional[ServingFrontend] = None
         self.autoscaler: Optional[FleetAutoscaler] = None
@@ -537,6 +627,17 @@ class ServingFleet:
         """Block until ``name`` registers with the KV master (raising, and
         reaping the process, on early exit or timeout)."""
         proc = self._procs[name]
+        if self._faults is not None:
+            try:
+                self._faults.fire("fleet.spawn", detail=name)
+            except Exception:
+                # the injected spawn fault must leave no zombie behind —
+                # same reap discipline as the real early-exit path below
+                proc.kill()
+                proc.wait(timeout=10)
+                self._procs.pop(name, None)
+                self._drop_log(name)
+                raise
         # real wall clock, NOT the injectable self._clock: this loop
         # actually sleeps, and a frozen/jumping test clock would make the
         # spawn deadline never (or spuriously) fire
@@ -572,7 +673,34 @@ class ServingFleet:
         so tests can stand in a fake replica without subprocess boots."""
         return RemoteReplica(name, rpc_timeout=self.rpc_timeout)
 
+    def _inc_metric(self, name: str, n: int = 1):
+        """Fleet-layer counter increments land in the frontend registry
+        (the one the Prometheus fleet page exports under the 'frontend'
+        replica label); dropped silently before the first worker attaches
+        — there is no registry to count into yet."""
+        if self.frontend is not None:
+            self.frontend.metrics.inc(name, n)
+
+    def _note_spawn_failure(self, name: str, err: str):
+        """Shared bookkeeping for every spawn-path fault (blocking spawn,
+        async boot thread, early worker death): bounded error ring,
+        breaker failure, counter."""
+        self.spawn_errors[name] = err
+        was_open = self.spawn_breaker.state == "open"
+        self.spawn_breaker.record_failure()
+        if self.spawn_breaker.state == "open" and not was_open:
+            self._inc_metric("breaker_open_total")
+        self._inc_metric("spawn_failures_total")
+
     def _attach_replica(self, replica):
+        # NOT a breaker success yet: a crash-looping config usually boots
+        # and attaches fine, then dies on first real work — success is
+        # recorded only when the replica SURVIVES early_death_s (the
+        # maturation sweep in step()), so attach/die cycles accumulate
+        # failures instead of resetting the window every boot
+        name = getattr(replica, "worker", None)
+        if name is not None:
+            self._attached_at[name] = self._clock()
         if self.frontend is None:
             self.frontend = ServingFrontend([replica],
                                             **self._frontend_kwargs)
@@ -591,7 +719,11 @@ class ServingFleet:
         worker is routable when this returns (initial fleet bring-up; the
         autoscaler's in-loop scale-up uses ``spawn_worker_async``)."""
         name = self._launch(name)
-        self._await_worker(name)
+        try:
+            self._await_worker(name)
+        except Exception as e:  # noqa: BLE001 — feed the respawn breaker
+            self._note_spawn_failure(name, repr(e))
+            raise
         return name
 
     def spawn_worker_async(self, name: Optional[str] = None) -> str:
@@ -618,7 +750,7 @@ class ServingFleet:
         except Exception as e:  # noqa: BLE001 — boot fault, record + reap
             with self._spawn_lock:
                 self._pending_spawns.pop(name, None)
-                self.spawn_errors[name] = repr(e)
+                self._note_spawn_failure(name, repr(e))
             proc = self._procs.pop(name, None)
             if proc is not None:
                 try:
@@ -654,6 +786,25 @@ class ServingFleet:
         for _, replica in ready:
             self._attach_replica(replica)
 
+    def _note_matured_replicas(self):
+        """Replicas alive past ``early_death_s`` since attach count as
+        spawn SUCCESSES: this is what re-closes a half-open breaker (the
+        probe worker proved itself) and clears the failure window after
+        genuine recovery.  Recording at attach instead would let a
+        boots-fine-dies-early crash loop reset the window every cycle
+        and the breaker would never open."""
+        if self.frontend is None:
+            return
+        now = self._clock()
+        for rep in self.frontend.replicas:
+            if not rep.alive:
+                continue
+            name = getattr(rep.engine, "worker", None)
+            att = self._attached_at.get(name) if name is not None else None
+            if att is not None and now - att >= self.early_death_s:
+                self._attached_at.pop(name, None)
+                self.spawn_breaker.record_success()
+
     # ------------------------------------------------------------- driving
     @property
     def workers(self) -> List[str]:
@@ -675,12 +826,15 @@ class ServingFleet:
         drained/dead workers."""
         self._attach_ready()
         fe = self._require_frontend()
+        self._note_matured_replicas()
         now = self._clock()
         if now - self._last_heartbeat >= self.heartbeat_interval_s:
             self._last_heartbeat = now
             self.heartbeat()
         if self.autoscaler is not None:
             self.autoscaler.observe()
+        fe.metrics.set_gauge("respawn_breaker_open",
+                             self.spawn_breaker.open_gauge)
         fe.step()
         self._reap()
 
@@ -711,7 +865,15 @@ class ServingFleet:
             if not rep.alive or not isinstance(rep.engine, RemoteReplica):
                 continue
             try:
-                rep.engine.health(timeout=self.heartbeat_timeout_s)
+                if self._faults is not None:
+                    self._faults.fire("fleet.heartbeat",
+                                      detail=rep.engine.worker)
+                # transient-fault retry: the probe is idempotent, so one
+                # dropped/slow packet re-probes instead of failing over a
+                # healthy worker (a genuinely dead one fails every retry
+                # and still dies within this heartbeat)
+                rep.engine.health(timeout=self.heartbeat_timeout_s,
+                                  retries=self.heartbeat_retries)
             except Exception as e:  # noqa: BLE001 — any probe fault = dead
                 self.frontend.fail_replica(rep, e)
 
@@ -735,10 +897,22 @@ class ServingFleet:
                     rep.engine.request_shutdown(self.heartbeat_timeout_s)
                 except Exception:
                     pass
+                self._attached_at.pop(name, None)   # drained, not dead
                 self.frontend.remove_replica(rep)
                 self._reap_proc(name)
             elif not rep.alive:
                 # failover already re-queued its requests; deregister
+                att = self._attached_at.pop(name, None)
+                if (att is not None
+                        and self._clock() - att < self.early_death_s):
+                    # spawn-or-early-death: a worker that dies this soon
+                    # after attaching counts against the respawn breaker
+                    # exactly like a failed spawn — a crash-looping config
+                    # usually boots fine and dies on first real work
+                    self._note_spawn_failure(
+                        name, f"early death: replica died within "
+                        f"{self.early_death_s}s of attach "
+                        f"({rep.last_error})")
                 self.frontend.remove_replica(rep)
                 self._reap_proc(name, kill=True)
 

@@ -40,11 +40,20 @@ COUNTERS = (
     "tokens_emitted_total", "engine_steps_total",
     "prefix_hit_blocks_total", "prefix_miss_blocks_total",
     "prefix_evictions_total",
+    # fault containment (ISSUE 7): retry budgets / poison quarantine,
+    # brownout degradation, spawn breaker — counters are plain sums, so
+    # merge() folds them fleet-wide with no special cases
+    "requests_retried_total", "requests_quarantined_total",
+    "shed_brownout_total", "brownout_capped_total",
+    "brownout_transitions_total",
+    "spawn_failures_total", "breaker_open_total",
 )
 GAUGES = (
     "queue_depth", "queue_depth_peak", "running_requests", "replicas_alive",
     "blocks_total", "blocks_free", "block_pool_utilization",
     "block_pool_utilization_peak", "prefix_cache_hit_rate",
+    # 0/1/2 brownout level and 0 / 0.5 / 1 breaker state (closed/half/open)
+    "degraded_mode", "respawn_breaker_open",
 )
 SAMPLES = ("ttft_seconds", "token_latency_seconds", "e2e_latency_seconds")
 
@@ -221,9 +230,12 @@ class ServingMetrics:
             for k, v in (s.get("counters") or {}).items():
                 counters[k] = counters.get(k, 0) + v
         gauges: Dict[str, float] = {}
+        # level/state gauges are ordinal, not additive: two replicas at
+        # brownout level 1 are NOT a fleet at level 2
+        _maxed = ("degraded_mode", "respawn_breaker_open")
         for s in snaps:
             for k, v in (s.get("gauges") or {}).items():
-                if k.endswith("_peak"):
+                if k.endswith("_peak") or k in _maxed:
                     gauges[k] = max(gauges.get(k, 0.0), float(v))
                 else:
                     gauges[k] = gauges.get(k, 0.0) + float(v)

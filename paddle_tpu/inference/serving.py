@@ -276,7 +276,17 @@ class ServingEngine:
     def __init__(self, model, max_batch_size: int = 4, max_seq_len: int = 256,
                  block_size: int = 16, token_budget: int = 32,
                  num_blocks: Optional[int] = None, cache_dtype=None,
-                 cache_quant: str = "none", prefix_cache="auto"):
+                 cache_quant: str = "none", prefix_cache="auto",
+                 fault_injector=None):
+        from .faults import FaultInjector
+
+        # seeded failpoint registry (faults.py): the 'engine.step' site
+        # lets a chaos run crash this engine deterministically — incl.
+        # poison requests via a match on the active prompts' signatures.
+        # None (the default, unless PADDLE_TPU_FAULTS is set) keeps the
+        # production step loop at a single attribute test of cost.
+        self._faults = (fault_injector if fault_injector is not None
+                        else FaultInjector.from_env())
         cfg = model.config
         self.cfg = cfg
         self.B = int(max_batch_size)
@@ -648,6 +658,18 @@ class ServingEngine:
         self._try_admit()
         if not self._active:
             return {}
+        if self._faults is not None:
+            from .faults import prompt_signature
+
+            # detail carries each active request's prompt signature so a
+            # poison spec (match="p<t0>-<t1>-...") fires exactly when its
+            # request is scheduled — and keeps firing on whichever replica
+            # the request is retried on (the resumed prefill keeps the
+            # original prompt as its head)
+            self._faults.fire(
+                "engine.step",
+                detail=" ".join(prompt_signature(r.prompt)
+                                for r in self._active.values()))
         enc = np.zeros((self.B,), np.int32)
         dec = np.zeros((self.B,), np.int32)
         now = np.zeros((self.B,), np.int32)

@@ -1,0 +1,94 @@
+"""Chaos soak (ISSUE 7 acceptance): seeded randomized fault schedules
+over a multi-replica serving stack via tools/chaos_serving.py.
+
+Everything here is marked ``slow`` — the soaks build several engines and
+step them hundreds of times, and the fleet variant boots real worker
+processes — so tier-1 (already past its wall-clock budget at the seed)
+is not displaced; the CI 'parallel' shard runs this file with no marker
+filter, exactly like the fleet subprocess tests (satellite: chaos soak
+rides the existing parallel shard).
+
+The contract each soak asserts (inside ``run_chaos``/``run_chaos_fleet``
+— an AssertionError here IS the product failing):
+* every submitted request reaches a terminal typed status (no hangs, no
+  silent drops);
+* every COMPLETED request is token-identical to a fault-free run;
+* >= 3 distinct fault kinds actually fired;
+* the poison request is quarantined, not cascaded.
+"""
+import os
+import sys
+
+import pytest
+
+pytestmark = [pytest.mark.quick, pytest.mark.slow]
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+
+@pytest.fixture(autouse=True)
+def _reset_group():
+    from paddle_tpu.distributed.topology import set_hybrid_communicate_group
+
+    set_hybrid_communicate_group(None)
+    yield
+
+
+class TestChaosSoak:
+    def test_soak_with_poison_seed7(self):
+        import chaos_serving
+
+        report = chaos_serving.run_chaos(seed=7, replicas=3,
+                                         num_requests=18,
+                                         max_request_retries=2)
+        # the harness already asserted termination, token parity, >= 3
+        # kinds, and quarantine; pin the headline numbers here so a
+        # silent weakening of the schedule shows up as a diff
+        assert report["statuses"]["failed_poison"] == 1
+        assert report["statuses"]["completed"] == 18
+        assert len(report["fault_kinds_fired"]) >= 3
+        assert report["replica_deaths"] >= 3
+        assert report["respawns"] >= 1
+        assert report["survivors_token_identical"]
+
+    def test_soak_brownout_interleaves_seed3(self):
+        import chaos_serving
+
+        report = chaos_serving.run_chaos(seed=3, replicas=3,
+                                         num_requests=24,
+                                         max_request_retries=2,
+                                         brownout=True)
+        assert report["statuses"].get("failed_poison") == 1
+        assert len(report["fault_kinds_fired"]) >= 3
+        # seed 3's schedule drives enough early deaths to open the
+        # breaker and enough queue pressure to move the brownout level
+        assert report["breaker_opens"] >= 1
+        assert report["brownout_transitions"] >= 1
+
+    def test_soak_deterministic_replay(self):
+        """Same seed => byte-identical failure history (the property that
+        makes a chaos-found bug reproducible).  Compares every
+        wall-clock-free report field."""
+        import chaos_serving
+
+        a = chaos_serving.run_chaos(seed=11, replicas=3, num_requests=12)
+        b = chaos_serving.run_chaos(seed=11, replicas=3, num_requests=12)
+        assert a == b
+
+
+class TestChaosFleet:
+    def test_fleet_chaos_with_real_workers(self):
+        """Fleet-level variant: real worker processes, failpoints armed
+        through the spec JSON (engine-step delay everywhere, worker0's
+        health probe fault) plus one frontend-side rpc.send timeout —
+        heartbeat failover + step failover across real process
+        boundaries, survivors token-identical."""
+        import chaos_serving
+
+        report = chaos_serving.run_chaos_fleet(seed=0, workers=3,
+                                               num_requests=8)
+        assert report["statuses"].get("completed", 0) >= 1
+        assert report["replica_deaths"] >= 1
+        assert report["workers_alive_at_end"] >= 1
+        assert report["survivors_token_identical"]

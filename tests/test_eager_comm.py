@@ -11,6 +11,8 @@ import subprocess
 import sys
 import textwrap
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 WORKER = textwrap.dedent("""
@@ -60,6 +62,18 @@ WORKER = textwrap.dedent("""
 """)
 
 
+# ISSUE 7 satellite triage: fails in THIS container on every run (solo
+# included) — workerlogs show jaxlib raising "INVALID_ARGUMENT:
+# Multiprocess computations aren't implemented on the CPU backend" from
+# the ppermute under dist.send, i.e. the pinned jax 0.4.37 CPU backend
+# dropped multiprocess collectives (same environment wall as the
+# skipif-gated dp/mp mesh tests, see ROADMAP item 5).  Non-strict xfail:
+# the jax upgrade that un-gates those meshes flips this to XPASS.
+@pytest.mark.xfail(
+    strict=False,
+    reason="container jaxlib CPU backend: 'Multiprocess computations "
+           "aren't implemented on the CPU backend' (jax 0.4.37); lifted "
+           "by the ROADMAP item-5 jax upgrade")
 def test_two_process_eager_comm(tmp_path):
     script = tmp_path / "worker.py"
     script.write_text(WORKER)

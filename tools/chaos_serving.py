@@ -1,0 +1,391 @@
+#!/usr/bin/env python
+"""Chaos soak for the serving fleet (ISSUE 7 tentpole): a SEEDED
+randomized fault schedule over a multi-replica serving stack, asserting
+the fault-containment contract end to end:
+
+* every submitted request reaches a terminal typed status — no hangs,
+  no silent drops (the run itself fails loudly if the step loop stalls);
+* every COMPLETED request's tokens are identical to a fault-free run of
+  the same request stream (the engine's greedy-deterministic contract,
+  extended across failover, retry, respawn, and brownout);
+* at least three distinct fault kinds actually fired (a 'chaos' run that
+  quietly degraded to calm must not count as coverage);
+* a poison request (one that deterministically crashes any engine that
+  schedules it) is quarantined after ``max_request_retries`` replica
+  deaths instead of cascading through the whole fleet.
+
+In-process mode (default) wraps N ``ServingEngine`` replicas in
+``faults.FaultyReplica`` proxies behind one ``ServingFrontend``: the
+seeded ``FaultInjector`` crashes/hangs/drops specific replicas at
+scheduled step counts, dead replicas are respawned through a
+``RespawnCircuitBreaker`` (recycling the engine object, as a restarted
+worker process would rebuild it — early deaths feed the breaker), and an
+optional ``BrownoutPolicy`` lets degradation interleave with the faults.
+Everything that steers control flow is seeded or derived from step
+counts, so a (seed, config) pair replays the exact same failure history.
+
+``--workers N`` runs the fleet-level variant instead: N real
+serving_worker.py processes with worker-side failpoints armed through
+the spec JSON (``engine.step`` delays, a ``health.probe`` fault on one
+worker) plus a frontend-side ``rpc.send`` timeout — the same terminal
+status + token-parity assertions across real process boundaries.
+
+One JSON report on stdout:
+
+    python tools/chaos_serving.py --seed 7 --replicas 3 --requests 18
+    python tools/chaos_serving.py --workers 3 --requests 8
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# sub-tiny config (same scale the serving control-plane tests use): the
+# soak builds replicas+spares engines and steps them hundreds of times on
+# a 2-vCPU CI container
+MODEL = dict(vocab_size=256, hidden_size=64, intermediate_size=160,
+             num_hidden_layers=1, num_attention_heads=2,
+             max_position_embeddings=256)
+ENGINE = dict(max_batch_size=2, max_seq_len=64, block_size=8,
+              token_budget=16)
+POISON_PROMPT = [66, 6, 6]   # signature "p66-6-6-" for the poison match
+
+
+def _build_model():
+    import paddle_tpu as P
+    from paddle_tpu.distributed.topology import set_hybrid_communicate_group
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    set_hybrid_communicate_group(None)
+    P.seed(11)
+    model = LlamaForCausalLM(LlamaConfig(**MODEL))
+    model.eval()
+    return model
+
+
+def _request_stream(seed, num_requests, poison):
+    """Seeded (prompt, max_new_tokens, priority) stream shared by the
+    fault-free reference and the chaos run."""
+    import random
+
+    from paddle_tpu.inference import Priority
+
+    rng = random.Random(f"chaos-reqs:{seed}")
+    reqs = []
+    for i in range(num_requests):
+        prompt = [rng.randrange(1, MODEL["vocab_size"])
+                  for _ in range(rng.randrange(2, 6))]
+        prio = (Priority.HIGH if i % 5 == 0
+                else Priority.LOW if i % 5 == 4 else Priority.NORMAL)
+        reqs.append((prompt, rng.randrange(3, 7), prio))
+    if poison:
+        # poison rides mid-stream at NORMAL priority so it reaches several
+        # replicas before quarantine while other traffic is in flight
+        reqs.insert(num_requests // 3,
+                    (list(POISON_PROMPT), 4, Priority.NORMAL))
+    return reqs
+
+
+def _fault_schedule(seed, total_names, poison):
+    """Seeded failpoint schedule: each initial replica gets one scheduled
+    step fault (error/timeout/drop round-robin so >= 3 kinds fire), a
+    delay rides the first replica's add_request path, and some respawn
+    names are doomed too (that is what drives the breaker)."""
+    import random
+
+    rng = random.Random(f"chaos-sched:{seed}")
+    kinds = ["error", "timeout", "drop"]
+    sites = {}
+    for i in range(total_names):
+        doomed = i < 3 or rng.random() < 0.35
+        if doomed:
+            sites[f"r{i}.step"] = {
+                "kind": kinds[i % 3] if i < 3 else kinds[rng.randrange(3)],
+                "after": rng.randrange(2, 9),
+                "times": 1,
+            }
+    sites["r0.add_request"] = {"kind": "delay", "delay_s": 0.001, "times": 2}
+    if poison:
+        sites["engine.step"] = {"kind": "error", "match": "p66-6-6-"}
+    return sites
+
+
+def run_chaos(seed=0, replicas=3, num_requests=18, max_request_retries=2,
+              poison=True, brownout=False, max_steps=3000):
+    """In-process chaos soak; returns the report dict (raises AssertionError
+    on any containment-contract violation)."""
+    from paddle_tpu.distributed.rpc import RpcTimeout
+    from paddle_tpu.inference import (
+        BrownoutPolicy,
+        FaultInjector,
+        RespawnCircuitBreaker,
+        RequestStatus,
+        ServingEngine,
+        ServingFrontend,
+    )
+    from paddle_tpu.inference.faults import FaultyReplica
+
+    model = _build_model()
+    reqs = _request_stream(seed, num_requests, poison)
+
+    # ---- fault-free reference: same stream, no injector, no respawns
+    ref_fe = ServingFrontend([ServingEngine(model, **ENGINE)])
+    ref_rids = [ref_fe.submit(p, max_new_tokens=m, priority=pr)
+                for p, m, pr in reqs]
+    ref_tokens = {i: ref_fe.run()[r].tokens
+                  for i, r in enumerate(ref_rids)}
+
+    # ---- chaos run
+    max_respawns = replicas * 3
+    total_names = replicas + max_respawns
+    inj = FaultInjector(_fault_schedule(seed, total_names, poison),
+                        seed=seed)
+    # engine pool: respawns recycle a dead replica's engine (a restarted
+    # worker rebuilds the same engine; recycling skips the recompile)
+    spares = []
+
+    def wrap(engine, name):
+        return FaultyReplica(engine, inj, name=name, timeout_exc=RpcTimeout)
+
+    fe = ServingFrontend(
+        [wrap(ServingEngine(model, **ENGINE), f"r{i}")
+         for i in range(replicas)],
+        max_request_retries=max_request_retries,
+        # sensitive thresholds: the 2-requests-per-step trickle over 3
+        # replicas must be able to cross them while replicas are dying,
+        # or the soak never exercises degradation
+        brownout=BrownoutPolicy(queue_high=2.5, queue_low=0.5,
+                                enter_after=2, exit_after=3,
+                                normal_max_new_tokens=6)
+        if brownout else None)
+    step_i = 0
+    breaker = RespawnCircuitBreaker(threshold=3, window_s=40.0,
+                                    base_backoff_s=4.0, max_backoff_s=64.0,
+                                    jitter=0.25, seed=seed,
+                                    clock=lambda: float(step_i))
+    born_at = {id(rep): 0 for rep in fe.replicas}
+    next_name = replicas
+    respawns = early_deaths = deaths = 0
+
+    rids = []
+    submitted = 0
+    while (fe.pending or submitted < len(reqs)) and step_i < max_steps:
+        # trickle arrivals: two per control step keeps a queue formed so
+        # faults interleave with real routing/admission pressure
+        for _ in range(2):
+            if submitted < len(reqs):
+                p, m, pr = reqs[submitted]
+                rids.append(fe.submit(p, max_new_tokens=m, priority=pr))
+                submitted += 1
+        fe.step()
+        step_i += 1
+        # maturation mirrors the fleet layer: a replica alive past the
+        # early-death window is the spawn SUCCESS that re-closes a
+        # half-open breaker (attaching alone is not — see
+        # ServingFleet._note_matured_replicas)
+        for rep in fe.replicas:
+            if rep.alive and id(rep) in born_at \
+                    and step_i - born_at[id(rep)] >= 5:
+                born_at.pop(id(rep))
+                breaker.record_success()
+        # reap + respawn through the breaker (the fleet layer's job,
+        # mirrored here for in-process replicas)
+        for rep in list(fe.replicas):
+            if rep.alive:
+                continue
+            deaths += 1
+            if step_i - born_at.pop(id(rep), 0) < 5:   # early death
+                early_deaths += 1
+                breaker.record_failure()
+            fe.remove_replica(rep)
+            spares.append(rep.engine._eng)
+        while (fe.num_live_replicas < replicas and spares
+               and next_name < total_names and breaker.allow()):
+            eng = spares.pop()
+            for rid in [r.rid for r in eng._queue] + list(eng._active):
+                eng.evict(rid)   # a restarted worker has empty state
+            rep = fe.add_replica(wrap(eng, f"r{next_name}"))
+            born_at[id(rep)] = step_i
+            next_name += 1
+            respawns += 1
+
+    # ---- containment contract
+    res = fe.results()
+    assert len(res) == len(rids) and not fe.pending, (
+        f"chaos soak stalled: {fe.pending} request(s) never reached a "
+        f"terminal status in {max_steps} steps")
+    statuses = {}
+    mismatched = []
+    for i, rid in enumerate(rids):
+        r = res[rid]
+        statuses[r.status.value] = statuses.get(r.status.value, 0) + 1
+        if r.status is RequestStatus.COMPLETED:
+            want = ref_tokens[i]
+            if r.detail.startswith("brownout:"):
+                ok = r.tokens == want[:len(r.tokens)] and r.tokens
+            else:
+                ok = r.tokens == want
+            if not ok:
+                mismatched.append(rid)
+    assert not mismatched, (
+        f"survivors diverged from the fault-free run: rids {mismatched}")
+    kinds = inj.kinds_fired()
+    assert len(kinds) >= 3, (
+        f"chaos schedule degraded to calm: only kinds {kinds} fired")
+    poison_status = None
+    if poison:
+        pi = next(i for i, (p, _, _) in enumerate(reqs)
+                  if p == POISON_PROMPT)
+        pr = res[rids[pi]]
+        poison_status = pr.status.value
+        # the poison must never slip through; quarantine is the normal
+        # outcome, FAILED the total-outage path (every replica already
+        # dead — e.g. the breaker held respawns — so the queued poison
+        # resolved before it could kill max_request_retries+1 replicas)
+        assert pr.status in (RequestStatus.FAILED_POISON,
+                             RequestStatus.FAILED), (
+            f"poison request ended {pr.status}")
+        if pr.status is RequestStatus.FAILED_POISON:
+            assert pr.attempts == max_request_retries + 1
+
+    m = fe.metrics
+    return {
+        "mode": "in-process",
+        "seed": seed,
+        "replicas": replicas,
+        "requests": len(rids),
+        "steps": step_i,
+        "statuses": statuses,
+        "poison_status": poison_status,
+        "fault_kinds_fired": kinds,
+        "faults_fired": inj.total_fires,
+        "replica_deaths": m.counter("replica_deaths_total"),
+        "requeued_on_failover": m.counter("requeued_on_failover_total"),
+        "retried": m.counter("requests_retried_total"),
+        "quarantined": m.counter("requests_quarantined_total"),
+        "respawns": respawns,
+        "early_deaths": early_deaths,
+        "breaker_opens": breaker.open_count,
+        "brownout_transitions": m.counter("brownout_transitions_total"),
+        "shed_brownout": m.counter("shed_brownout_total"),
+        "survivors_token_identical": True,
+    }
+
+
+def run_chaos_fleet(seed=0, workers=3, num_requests=8, max_steps=3000):
+    """Fleet-level chaos: real worker processes, worker-side failpoints
+    armed through the spec JSON, frontend-side rpc fault, heartbeat
+    failover — the cross-process half of the containment contract."""
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.inference import (
+        FaultInjector,
+        RequestStatus,
+        ServingEngine,
+        ServingFleet,
+        ServingFrontend,
+    )
+
+    model = _build_model()
+    reqs = _request_stream(seed, num_requests, poison=False)
+    ref_fe = ServingFrontend([ServingEngine(model, **ENGINE)])
+    ref_rids = [ref_fe.submit(p, max_new_tokens=m, priority=pr)
+                for p, m, pr in reqs]
+    ref_tokens = {i: ref_fe.run()[r].tokens
+                  for i, r in enumerate(ref_rids)}
+
+    spec = {
+        "seed": 11, "model": MODEL, "engine": ENGINE,
+        # worker-side failpoints travel in the replica recipe: a harmless
+        # engine-step delay on every worker, plus worker0's health probe
+        # blowing up (the heartbeat-failover kind).  Every worker runs the
+        # same spec, so the probe fault is name-matched to worker0 only;
+        # times=2 outlasts the heartbeat's one transient retry (after=1
+        # spares the RemoteReplica.__init__ readiness probe)
+        "faults": {"seed": seed, "sites": {
+            "engine.step": {"kind": "delay", "delay_s": 0.002, "times": 3},
+            "health.probe": {"kind": "error", "match": "worker0",
+                             "after": 1, "times": 2},
+        }},
+    }
+    # frontend-side transport fault: exactly one step RPC times out
+    rpc.set_fault_injector(FaultInjector(
+        {"rpc.send": {"kind": "timeout", "match": "_w_step",
+                      "after": 4, "times": 1}}, seed=seed))
+    try:
+        with ServingFleet(spec, num_workers=workers,
+                          heartbeat_interval_s=0.5,
+                          spawn_timeout=180.0) as fleet:
+            fe = fleet.frontend
+            rids = [fe.submit(p, max_new_tokens=m, priority=pr)
+                    for p, m, pr in reqs]
+            steps = 0
+            while fe.pending and steps < max_steps:
+                fleet.step()
+                steps += 1
+            res = fe.results()
+            assert not fe.pending, (
+                f"fleet chaos stalled with {fe.pending} unresolved")
+            statuses = {}
+            mismatched = []
+            for i, rid in enumerate(rids):
+                r = res[rid]
+                statuses[r.status.value] = statuses.get(r.status.value, 0) + 1
+                if (r.status is RequestStatus.COMPLETED
+                        and r.tokens != ref_tokens[i]):
+                    mismatched.append(rid)
+            assert not mismatched, (
+                f"fleet survivors diverged from fault-free run: {mismatched}")
+            m = fe.metrics
+            deaths = m.counter("replica_deaths_total")
+            # the health.probe fault fires on every worker's FIRST
+            # heartbeat-after-one (after=1, per-process counters), and the
+            # rpc timeout kills whichever worker the 5th step RPC hits —
+            # at least one death must have been observed and survived
+            assert deaths >= 1, "no fault reached the fleet layer"
+            return {
+                "mode": "fleet",
+                "seed": seed,
+                "workers": workers,
+                "requests": len(rids),
+                "steps": steps,
+                "statuses": statuses,
+                "replica_deaths": deaths,
+                "requeued_on_failover":
+                    m.counter("requeued_on_failover_total"),
+                "workers_alive_at_end": fe.metrics.gauge("replicas_alive"),
+                "survivors_token_identical": True,
+            }
+    finally:
+        rpc.set_fault_injector(None)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--requests", type=int, default=18)
+    ap.add_argument("--max-request-retries", type=int, default=2)
+    ap.add_argument("--no-poison", action="store_true")
+    ap.add_argument("--brownout", action="store_true",
+                    help="arm a BrownoutPolicy so degradation interleaves "
+                         "with the fault schedule")
+    ap.add_argument("--workers", type=int, default=0,
+                    help="N>0: fleet mode — real serving_worker.py "
+                         "processes with spec-armed failpoints")
+    args = ap.parse_args(argv)
+    if args.workers > 0:
+        report = run_chaos_fleet(seed=args.seed, workers=args.workers,
+                                 num_requests=args.requests)
+    else:
+        report = run_chaos(seed=args.seed, replicas=args.replicas,
+                           num_requests=args.requests,
+                           max_request_retries=args.max_request_retries,
+                           poison=not args.no_poison,
+                           brownout=args.brownout)
+    print(json.dumps(report))
+
+
+if __name__ == "__main__":
+    main()

@@ -67,6 +67,7 @@ def main(argv=None):
     import paddle_tpu as P
     from paddle_tpu.distributed import rpc
     from paddle_tpu.inference import ServingEngine, fleet
+    from paddle_tpu.inference.faults import FaultInjector
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
 
     P.seed(int(spec.get("seed", 0)))
@@ -74,9 +75,17 @@ def main(argv=None):
     if spec.get("bfloat16"):
         model.bfloat16()
     model.eval()
-    engine = ServingEngine(model, **spec.get("engine", {}))
+    # chaos runs arm worker-side failpoints through the spec (the fleet
+    # ships the same JSON to every worker, so a fault schedule is part of
+    # the replica recipe): {"faults": {"seed": 7, "sites": {...}}}
+    faults = spec.get("faults")
+    injector = (FaultInjector(faults.get("sites", {}),
+                              seed=faults.get("seed", 0))
+                if faults else None)
+    engine = ServingEngine(model, fault_injector=injector,
+                           **spec.get("engine", {}))
 
-    stop = fleet.init_worker(engine, name=args.name)
+    stop = fleet.init_worker(engine, name=args.name, fault_injector=injector)
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_: stop.set())
     rpc.init_rpc(args.name, rank=args.rank, world_size=1,

@@ -394,6 +394,15 @@ def bench_serving_prefix():
     print(json.dumps(_load_bench_serving().run_bench_prefix()))
 
 
+def bench_serving_megastep():
+    """Megastep rung (ISSUE 9): a closed request batch served with K-step
+    in-graph decode vs per-token stepping; value = host round trips per
+    generated token with the megastep on (deterministic scheduling
+    counters, lower is better, bound = prefill steps + 1/K).  Token
+    parity megastep-on vs -off is asserted inside the bench."""
+    print(json.dumps(_load_bench_serving().run_bench_megastep()))
+
+
 def bench_pipeline_compiled_vs_eager():
     """Compiled-vs-eager pipeline rung: the same dp2×mp2×pp2 llama microbatch
     schedule through the eager per-op 1F1B engine vs CompiledPipelineTrainStep
@@ -496,5 +505,7 @@ if __name__ == "__main__":
         bench_serving_fleet()
     if which in ("all", "prefix"):
         bench_serving_prefix()
+    if which in ("all", "megastep"):
+        bench_serving_megastep()
     if which in ("all", "pipeline"):
         bench_pipeline_compiled_vs_eager()

@@ -21,8 +21,8 @@ from ..tensor.tensor import Tensor
 
 __all__ = ["Config", "create_predictor", "Predictor", "PredictorPool",
            "BlockManager", "ServingEngine", "ServingRequest",
-           "ServingFrontend", "ServingMetrics", "Priority",
-           "RequestStatus", "RequestResult", "ServingFleet",
+           "SamplingParams", "ServingFrontend", "ServingMetrics",
+           "Priority", "RequestStatus", "RequestResult", "ServingFleet",
            "RemoteReplica", "FleetAutoscaler", "AutoscalePolicy",
            "BrownoutPolicy", "FaultInjector", "FaultSpec",
            "RespawnCircuitBreaker"]
@@ -46,7 +46,12 @@ from .fleet import (  # noqa: E402
     ServingFleet,
 )
 from .metrics import ServingMetrics  # noqa: E402
-from .serving import BlockManager, ServingEngine, ServingRequest  # noqa: E402
+from .serving import (  # noqa: E402
+    BlockManager,
+    SamplingParams,
+    ServingEngine,
+    ServingRequest,
+)
 
 
 class Config:

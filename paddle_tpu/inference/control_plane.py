@@ -14,6 +14,25 @@ stay pure execution loops driven via ``ServingEngine.step()``:
 * **Deadlines & cancellation** — queued requests past deadline are shed
   (``DEADLINE_EXCEEDED``); running ones are evicted mid-generation and
   return their partial tokens.  ``cancel(rid)`` works in both states.
+  MEGASTEP BOUNDARY SEMANTICS (ISSUE 9): the engines decode up to
+  ``megastep_k`` (K) tokens per compiled step, and the frontend's
+  deadline/cancel checks run between steps — so a running request can
+  generate at most K-1 tokens past its deadline (it was under deadline
+  when the megastep launched and the first in-scan token was due) before
+  the next boundary sheds it.  The shed result still carries every token
+  generated, so the overshoot is extra work, never wrong output; size
+  the engines' ``megastep_k`` (default 8) against the tightest SLO.
+* **Sampling & streaming** — ``submit`` takes per-request
+  ``temperature``/``top_k``/``top_p``/``seed``/``logprobs`` (defaults =
+  exact greedy argmax; see ``serving.SamplingParams``) and forwards them
+  to the engine's in-graph sampler; seeded streams replay identically
+  across preemption, failover, and worker restarts because the PRNG key
+  depends only on (seed, sample index).  Tokens are surfaced
+  incrementally: pass ``on_token=fn`` to ``submit`` (called
+  ``fn(rid, token)`` per token as each engine step is harvested — i.e.
+  in bursts of up to K at megastep boundaries) or drive
+  ``stream(rid)``, an iterator that steps the frontend and yields the
+  request's tokens in order until its terminal result.
 * **Recompute preemption** — when a request cannot be placed because the
   block pools are exhausted, the lowest-priority (then youngest) running
   sequence strictly below the waiting request's class is evicted via
@@ -73,8 +92,9 @@ from typing import Callable, Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
-from .metrics import ServingMetrics, fold_prefix_counters
-from .serving import ServingEngine, prompt_block_hashes
+from .metrics import (MEGASTEP_COUNTERS, ServingMetrics,
+                      fold_counter_deltas, fold_prefix_counters)
+from .serving import SamplingParams, ServingEngine, prompt_block_hashes
 
 __all__ = ["Priority", "RequestStatus", "RequestResult", "ServingFrontend",
            "BrownoutPolicy"]
@@ -152,7 +172,8 @@ class BrownoutPolicy:
 class RequestResult:
     """Typed terminal outcome for one submitted request. ``tokens`` holds
     whatever was generated before the terminal state (partial for
-    sheds/cancels, complete for COMPLETED)."""
+    sheds/cancels, complete for COMPLETED).  ``logprobs`` aligns 1:1 with
+    ``tokens`` when the request asked for them (else None)."""
 
     rid: int
     status: RequestStatus
@@ -162,6 +183,7 @@ class RequestResult:
     attempts: int = 0              # replica deaths survived via re-queue
     ttft_s: Optional[float] = None
     e2e_s: Optional[float] = None
+    logprobs: Optional[List[float]] = None
 
     @property
     def ok(self) -> bool:
@@ -178,7 +200,10 @@ class _FrontendRequest:
     eos_token_id: Optional[int]
     submit_t: float
     seq: int                       # FIFO tie-break within a priority class
+    sampling: SamplingParams = field(default_factory=SamplingParams)
+    on_token: Optional[Callable[[int, int], None]] = None
     generated: List[int] = field(default_factory=list)
+    logprob_values: List[float] = field(default_factory=list)
     preemptions: int = 0
     assignments: int = 0
     attempts: int = 0              # failover re-queues (replica deaths)
@@ -219,10 +244,11 @@ class _Replica:
         self.draining = False
         self.last_error: Optional[str] = None
         self.requests: Dict[int, _FrontendRequest] = {}  # engine_rid -> req
-        # engine-level prefix counters last folded into the registry (the
-        # engine counts monotonically; the frontend incs the deltas so the
+        # engine-level counters last folded into the registry (the engine
+        # counts monotonically; the frontend incs the deltas so the
         # registry counter survives replica death/removal)
         self.prefix_seen = (0, 0, 0)  # (hit_blocks, miss_blocks, evictions)
+        self.mega_seen = (0, 0)       # (megasteps, megastep tokens)
 
 
 def _blocks_needed(engine: ServingEngine, total_tokens: int) -> int:
@@ -344,16 +370,31 @@ class ServingFrontend:
     def submit(self, prompt_ids, max_new_tokens: int = 32, *,
                priority: Priority = Priority.NORMAL,
                deadline_s: Optional[float] = None,
-               eos_token_id: Optional[int] = None) -> int:
+               eos_token_id: Optional[int] = None,
+               temperature: float = 0.0, top_k: int = 0,
+               top_p: float = 1.0, seed: int = 0, logprobs: bool = False,
+               on_token: Optional[Callable[[int, int], None]] = None) -> int:
         """Enqueue a request; never blocks. Returns a rid whose outcome is
         readable via ``result(rid)`` — immediately for typed rejections
         (OVERLOADED / FAILED), after ``step()``/``run()`` otherwise.
-        ``deadline_s`` is relative to submission."""
+        ``deadline_s`` is relative to submission.
+
+        Sampling: ``temperature=0`` (default) is exact greedy;
+        ``temperature>0`` samples in-graph through the top-k/top-p
+        filters under a per-request seed whose stream survives
+        preemption/failover resumes.  ``logprobs=True`` attaches raw-logit
+        logprobs to the result.  ``on_token(rid, tok)`` is invoked for
+        every harvested token in order (in bursts of up to the engine's
+        ``megastep_k`` per step); a callback that raises is disabled for
+        that request and counted in ``stream_callback_errors_total``."""
         prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
         if max_new_tokens <= 0:
             raise ValueError("max_new_tokens must be positive")
+        sampling = SamplingParams(temperature=float(temperature),
+                                  top_k=int(top_k), top_p=float(top_p),
+                                  seed=int(seed), logprobs=bool(logprobs))
         now = self._clock()
         rid = self._next_rid
         self._next_rid += 1
@@ -361,7 +402,8 @@ class ServingFrontend:
             rid=rid, prompt=prompt, max_new_tokens=int(max_new_tokens),
             priority=Priority(priority),
             deadline_t=(now + deadline_s) if deadline_s is not None else None,
-            eos_token_id=eos_token_id, submit_t=now, seq=self._next_seq)
+            eos_token_id=eos_token_id, submit_t=now, seq=self._next_seq,
+            sampling=sampling, on_token=on_token)
         self._next_seq += 1
         self._requests[rid] = req
 
@@ -497,6 +539,33 @@ class ServingFrontend:
                 f"{len(stuck)} unresolved request(s) {stuck[:8]} — raise "
                 "max_steps or inspect metrics.snapshot()")
         return dict(self._results)
+
+    def stream(self, rid: int, max_steps: int = 10_000):
+        """Iterator over one request's tokens, in order, as they are
+        generated: drives ``step()`` (the whole frontend progresses, so
+        concurrent requests keep being served) and yields ``rid``'s new
+        tokens after each boundary — arriving in bursts of up to the
+        engine's ``megastep_k``, each burst yielded token-by-token.
+        Returns when the request reaches a terminal result (check
+        ``result(rid)`` for the status — a shed/cancelled stream simply
+        ends after its partial tokens).  Raises KeyError for an unknown
+        rid and RuntimeError when ``max_steps`` pass without a result."""
+        if rid not in self._requests:
+            raise KeyError(f"unknown rid {rid}")
+        sent = 0
+        for _ in range(max_steps):
+            res = self._results.get(rid)
+            toks = (res.tokens if res is not None
+                    else self._requests[rid].generated)
+            while sent < len(toks):
+                yield toks[sent]
+                sent += 1
+            if res is not None:
+                return
+            self.step()
+        raise RuntimeError(
+            f"ServingFrontend.stream: max_steps={max_steps} exhausted with "
+            f"request {rid} still unresolved")
 
     # ------------------------------------------------------------ internals
     @property
@@ -735,9 +804,14 @@ class ServingFrontend:
             return
         prefill = req.prompt + req.generated
         try:
+            # sampling params travel as the dict wire form (RemoteReplica
+            # ships them over RPC verbatim); sample_offset continues the
+            # seeded key stream where a preempted/failed-over run stopped
             erid = rep.engine.add_request(
                 prefill, max_new_tokens=req.remaining_new_tokens,
-                eos_token_id=req.eos_token_id)
+                eos_token_id=req.eos_token_id,
+                sampling=req.sampling.to_wire(),
+                sample_offset=len(req.generated))
         except ValueError as e:
             # e.g. an int8 engine whose one-shot-prefill contract a resumed
             # (grown) prefill no longer satisfies
@@ -767,19 +841,37 @@ class ServingFrontend:
             self._kill_replica(rep, e)
             return
         self.metrics.inc("engine_steps_total")
+        lp_fn = getattr(rep.engine, "pop_token_logprobs", None)
+        lps = lp_fn() if lp_fn is not None else {}
         t = self._clock()
         for erid, toks in emitted.items():
             req = rep.requests.get(erid)
             if req is None:
                 continue
+            if not toks:
+                continue
             if req.first_token_t is None:
                 req.first_token_t = t
                 self.metrics.observe("ttft_seconds", t - req.submit_t)
             elif req.last_token_t is not None:
+                # inter-token latency: a megastep delivers its K tokens in
+                # one burst, so the per-token value is the boundary-to-
+                # boundary gap amortized over the burst
                 self.metrics.observe(
                     "token_latency_seconds", (t - req.last_token_t) / len(toks))
             req.last_token_t = t
             req.generated.extend(toks)
+            if req.sampling.logprobs:
+                req.logprob_values.extend(lps.get(erid, ()))
+            if req.on_token is not None:
+                try:
+                    for tok in toks:
+                        req.on_token(req.rid, tok)
+                except Exception:  # noqa: BLE001 — caller bug, not ours
+                    # a raising stream callback must not kill the replica
+                    # or wedge the step loop: disable it for this request
+                    req.on_token = None
+                    self.metrics.inc("stream_callback_errors_total")
             self.metrics.note_tokens(len(toks), t)
         for erid in rep.engine.pop_finished():
             req = rep.requests.pop(erid, None)
@@ -839,7 +931,9 @@ class ServingFrontend:
             attempts=req.attempts,
             ttft_s=(req.first_token_t - req.submit_t)
             if req.first_token_t is not None else None,
-            e2e_s=now - req.submit_t)
+            e2e_s=now - req.submit_t,
+            logprobs=(list(req.logprob_values) if req.sampling.logprobs
+                      else None))
         self._results[req.rid] = res
         if req.counted_tokens:
             self._class_tokens[req.priority] -= req.counted_tokens
@@ -872,3 +966,7 @@ class ServingFrontend:
                    int(getattr(eng, "prefix_miss_blocks", 0)),
                    int(getattr(eng, "prefix_evictions", 0)))
             rep.prefix_seen = fold_prefix_counters(m, cur, rep.prefix_seen)
+            mcur = (int(getattr(eng, "megasteps", 0)),
+                    int(getattr(eng, "megastep_tokens", 0)))
+            rep.mega_seen = fold_counter_deltas(m, MEGASTEP_COUNTERS, mcur,
+                                                rep.mega_seen)

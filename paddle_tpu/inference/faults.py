@@ -7,7 +7,9 @@ reproducible).
 Three pieces:
 
 * **``FaultInjector``** — a seeded failpoint registry.  Production code
-  carries *named sites* (``engine.step``, ``rpc.send``, ``health.probe``,
+  carries *named sites* (``engine.step``, ``engine.megastep`` — the
+  batched K-token decode path, fired at megastep launch so a fault never
+  leaves half-committed tokens — ``rpc.send``, ``health.probe``,
   ``fleet.spawn``, ``fleet.heartbeat``) as one-line hooks that are
   zero-cost when no injector is armed (the default is ``None`` unless the
   ``PADDLE_TPU_FAULTS`` env var carries a JSON spec).  Each armed site
@@ -317,11 +319,13 @@ class FaultyReplica:
                        timeout_exc=self._timeout_exc)
 
     def add_request(self, prompt_ids, max_new_tokens: int = 32,
-                    eos_token_id=None):
+                    eos_token_id=None, **kwargs):
+        # sampling / sample_offset (and any future engine kwargs) pass
+        # through untouched — the proxy only injects faults
         self._fire("add_request", prompt_signature(prompt_ids))
         return self._eng.add_request(prompt_ids,
                                      max_new_tokens=max_new_tokens,
-                                     eos_token_id=eos_token_id)
+                                     eos_token_id=eos_token_id, **kwargs)
 
     def step(self):
         self._fire("step", self._detail())

@@ -21,7 +21,12 @@ Three pieces, layered on four existing subsystems:
   slots/blocks is exactly what an in-process engine would show — which
   is why routing, priority admission, deadlines, and recompute
   preemption work unchanged, and why a local and a remote fleet produce
-  token-identical schedules.
+  token-identical schedules.  With megastep decode (ISSUE 9) one step
+  RPC returns up to ``megastep_k`` tokens per running sequence — the
+  engine batches K decode iterations into one compiled scan, so the
+  per-token HTTP round trips that capped the r8 fleet rung collapse by
+  K; host-side control (deadlines, cancel, autoscaling signals) runs at
+  those megastep boundaries.
 * **``ServingFleet``** — spawns/attaches workers (parallel process
   launch + KV-registration wait), builds the ``ServingFrontend`` over
   the ``RemoteReplica`` set, and adds what only the fleet layer can see:
@@ -76,7 +81,8 @@ from typing import Any, Callable, Dict, List, Optional
 
 from .control_plane import ServingFrontend
 from .faults import FaultInjector, RespawnCircuitBreaker
-from .metrics import ServingMetrics, fold_prefix_counters
+from .metrics import (MEGASTEP_COUNTERS, ServingMetrics,
+                      fold_counter_deltas, fold_prefix_counters)
 
 __all__ = ["RemoteReplica", "ServingFleet", "FleetAutoscaler",
            "AutoscalePolicy", "init_worker"]
@@ -107,7 +113,7 @@ class _BoundedErrors(OrderedDict):
 # --------------------------------------------------------------------------
 _WORKER: Dict[str, Any] = {
     "engine": None, "metrics": None, "stop": None, "name": None,
-    "prefix_seen": (0, 0, 0), "faults": None,
+    "prefix_seen": (0, 0, 0), "mega_seen": (0, 0), "faults": None,
 }
 
 
@@ -126,6 +132,7 @@ def init_worker(engine, name: str,
     _WORKER["stop"] = stop if stop is not None else threading.Event()
     _WORKER["name"] = name
     _WORKER["prefix_seen"] = (0, 0, 0)
+    _WORKER["mega_seen"] = (0, 0)
     _WORKER["faults"] = (fault_injector if fault_injector is not None
                          else FaultInjector.from_env())
     return _WORKER["stop"]
@@ -147,17 +154,24 @@ def _w_config() -> Dict:
     }
 
 
-def _w_add_request(prompt, max_new_tokens, eos_token_id=None):
+def _w_add_request(prompt, max_new_tokens, eos_token_id=None,
+                   sampling=None, sample_offset=0):
     eng = _engine()
     rid = eng.add_request(prompt, max_new_tokens=max_new_tokens,
-                          eos_token_id=eos_token_id)
+                          eos_token_id=eos_token_id, sampling=sampling,
+                          sample_offset=sample_offset)
     return rid, eng.state_summary()
 
 
 def _w_step():
+    """One engine step per RPC — which, with megastep decode (ISSUE 9),
+    means up to ``megastep_k`` tokens per round trip: the per-token HTTP
+    transport cost the r8 fleet rung identified collapses by K."""
     eng = _engine()
     emitted = eng.step()
     finished = eng.pop_finished()
+    lp_fn = getattr(eng, "pop_token_logprobs", None)
+    logprobs = lp_fn() if lp_fn is not None else {}
     m = _WORKER["metrics"]
     m.inc("engine_steps_total")
     n_tok = sum(len(t) for t in emitted.values())
@@ -169,15 +183,19 @@ def _w_step():
     m.set_gauge("blocks_total", st["blocks_total"])
     m.set_gauge("blocks_free", st["blocks_free"])
     m.set_gauge_peak("block_pool_utilization", st["pool_utilization"])
-    # prefix-cache counters: the engine counts monotonically; fold the
-    # per-step deltas so _w_reset_metrics windows stay correct
+    # engine-level counters are monotone; fold the per-step deltas so
+    # _w_reset_metrics windows stay correct
     pc = st.get("prefix_cache") or {}
     cur = (int(pc.get("hit_blocks", 0)), int(pc.get("miss_blocks", 0)),
            int(pc.get("evictions", 0)))
     _WORKER["prefix_seen"] = fold_prefix_counters(m, cur,
                                                   _WORKER["prefix_seen"])
+    ms = st.get("megastep") or {}
+    mcur = (int(ms.get("megasteps", 0)), int(ms.get("tokens", 0)))
+    _WORKER["mega_seen"] = fold_counter_deltas(m, MEGASTEP_COUNTERS, mcur,
+                                               _WORKER["mega_seen"])
     m.inc("completed_total", len(finished))
-    return emitted, finished, st
+    return emitted, finished, st, logprobs
 
 
 def _w_evict(rid):
@@ -287,6 +305,7 @@ class RemoteReplica:
         self._active: Dict[int, _ActiveView] = {}
         self._free_slots: List[int] = list(range(self.B))
         self._finished: Dict[int, List[int]] = {}
+        self._logprobs: Dict[int, List[float]] = {}
         self._pending_step = None
         self._apply_state(h["state"])
 
@@ -310,6 +329,13 @@ class RemoteReplica:
         self.prefix_hit_blocks = int(pc.get("hit_blocks", 0))
         self.prefix_miss_blocks = int(pc.get("miss_blocks", 0))
         self.prefix_evictions = int(pc.get("evictions", 0))
+        # megastep mirror (the worker folds these into its own registry;
+        # prefix_counters_self_reported keeps the frontend from double-
+        # counting the mirror, same as the prefix counters)
+        ms = st.get("megastep") or {}
+        self.megastep_k = int(ms.get("k", 1))
+        self.megasteps = int(ms.get("megasteps", 0))
+        self.megastep_tokens = int(ms.get("tokens", 0))
 
     def cached_block_hashes(self):
         """Last-synced mirror of the worker engine's content-addressable
@@ -322,10 +348,14 @@ class RemoteReplica:
         return len(self._active)
 
     def add_request(self, prompt_ids, max_new_tokens: int = 32,
-                    eos_token_id: Optional[int] = None) -> int:
+                    eos_token_id: Optional[int] = None,
+                    sampling=None, sample_offset: int = 0) -> int:
         prompt = [int(t) for t in prompt_ids]
+        if sampling is not None and not isinstance(sampling, dict):
+            # ship the dict wire form (no class pickling across versions)
+            sampling = sampling.to_wire()
         rid, st = self._call(_w_add_request, prompt, int(max_new_tokens),
-                             eos_token_id)
+                             eos_token_id, sampling, int(sample_offset))
         self._apply_state(st)
         return rid
 
@@ -342,16 +372,23 @@ class RemoteReplica:
         fut = self._pending_step
         self._pending_step = None
         if fut is not None:
-            emitted, finished, st = fut.result()
+            emitted, finished, st, lps = fut.result()
         else:
-            emitted, finished, st = self._call(_w_step)
+            emitted, finished, st, lps = self._call(_w_step)
         self._apply_state(st)
         self._finished.update(finished)
+        for rid, vals in lps.items():
+            self._logprobs.setdefault(rid, []).extend(vals)
         return emitted
 
     def pop_finished(self) -> Dict[int, List[int]]:
         out = self._finished
         self._finished = {}
+        return out
+
+    def pop_token_logprobs(self) -> Dict[int, List[float]]:
+        out = self._logprobs
+        self._logprobs = {}
         return out
 
     def evict(self, rid: int):

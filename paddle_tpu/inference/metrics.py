@@ -29,7 +29,7 @@ from __future__ import annotations
 import time
 from typing import Callable, Dict, Iterable, List, Mapping, Optional, Union
 
-__all__ = ["ServingMetrics", "fold_prefix_counters"]
+__all__ = ["ServingMetrics", "fold_prefix_counters", "fold_counter_deltas"]
 
 _PREFIX = "paddle_tpu_serving_"
 
@@ -47,6 +47,11 @@ COUNTERS = (
     "shed_brownout_total", "brownout_capped_total",
     "brownout_transitions_total",
     "spawn_failures_total", "breaker_open_total",
+    # megastep decode (ISSUE 9): compiled K-step scan launches and the
+    # tokens they emitted (megastep_tokens/megasteps ~ the realized K),
+    # plus streaming-callback faults the step loop absorbed
+    "megasteps_total", "megastep_tokens_total",
+    "stream_callback_errors_total",
 )
 GAUGES = (
     "queue_depth", "queue_depth_peak", "running_requests", "replicas_alive",
@@ -61,17 +66,29 @@ SAMPLES = ("ttft_seconds", "token_latency_seconds", "e2e_latency_seconds")
 # expects its (hit_blocks, miss_blocks, evictions) tuples
 PREFIX_COUNTERS = ("prefix_hit_blocks_total", "prefix_miss_blocks_total",
                    "prefix_evictions_total")
+# engine-level megastep counters, in the order their (megasteps, tokens)
+# fold tuples are built (control_plane gauge sampler / fleet _w_step)
+MEGASTEP_COUNTERS = ("megasteps_total", "megastep_tokens_total")
+
+
+def fold_counter_deltas(metrics: "ServingMetrics", names, cur, seen):
+    """Fold one engine's monotone counter tuple into a registry as
+    deltas; returns ``cur`` (the caller's next ``seen``).  Delta-folding
+    keeps registry counters monotone across replica death and
+    ``reset()`` windows — the same contract for every engine-level
+    counter the control plane or a fleet worker mirrors."""
+    for name, c, s in zip(names, cur, seen):
+        if c > s:
+            metrics.inc(name, c - s)
+    return cur
 
 
 def fold_prefix_counters(metrics: "ServingMetrics", cur, seen):
     """Fold one engine's monotone prefix counters into a registry as
     deltas and refresh the hit-rate gauge; returns ``cur`` (the caller's
     next ``seen``).  Shared by the frontend's gauge sampler (per replica)
-    and the fleet worker's step handler — delta-folding keeps registry
-    counters monotone across replica death and ``reset()`` windows."""
-    for name, c, s in zip(PREFIX_COUNTERS, cur, seen):
-        if c > s:
-            metrics.inc(name, c - s)
+    and the fleet worker's step handler."""
+    cur = fold_counter_deltas(metrics, PREFIX_COUNTERS, cur, seen)
     hit = metrics.counter("prefix_hit_blocks_total")
     miss = metrics.counter("prefix_miss_blocks_total")
     metrics.set_gauge("prefix_cache_hit_rate",

@@ -12,9 +12,25 @@ Design:
   length enter and retire without recompilation — admission/eviction is pure
   host bookkeeping over the block free-list.
 - KV lives in per-layer block pools [num_blocks, KV, bs, D] indexed through
-  per-sequence block tables (ops/paged_attention.py). Greedy sampling runs
-  in-graph; the host reads back [B] next-token ids per step (one small
+  per-sequence block tables (ops/paged_attention.py). Sampling runs
+  in-graph — temperature / top-k / top-p with per-request PRNG keys and
+  optional logprobs; ``temperature=0`` (the default) takes the exact
+  argmax path, so greedy serving is bit-identical to the pre-sampling
+  engine.  The host reads back [B] next-token ids per step (one small
   transfer, the same shape every step).
+- MEGASTEP decode (ISSUE 9): once every active sequence is past prefill,
+  ``step()`` runs K decode iterations inside ONE compiled ``lax.scan``
+  instead of K host round trips — the host syncs only at megastep
+  boundaries (finish / chunk / admission).  Rows that finish mid-scan
+  (EOS or token budget) are masked: their carry freezes, so remaining
+  iterations rewrite the same KV bits and their sampled tokens are
+  dropped on the host.  K rounds up to a power of two (bounded compile
+  count) capped at ``megastep_k``; ``megastep_k=1`` restores per-token
+  stepping, and the int8 KV cache keeps the single-step path (its scale
+  threading predates the scan).  Consequence for callers: admission and
+  any host-side control (deadlines, cancellation — control_plane.py)
+  observe the engine only at megastep boundaries, so a request can run
+  up to K-1 tokens past such an event before the host sees it.
 - This is the vLLM-style schedule expressed the XLA way: static shapes +
   dynamic lengths as data, not as shapes.
 - Automatic prefix caching (on by default, ``prefix_cache="auto"``):
@@ -67,9 +83,106 @@ import jax.numpy as jnp
 from ..ops.paged_attention import blha_attention
 
 __all__ = ["BlockManager", "ServingRequest", "ServingEngine",
-           "prefix_block_hash", "prompt_block_hashes"]
+           "SamplingParams", "prefix_block_hash", "prompt_block_hashes"]
 # the policy layer above this engine lives in control_plane.py
 # (ServingFrontend) and metrics.py (ServingMetrics)
+
+
+@dataclass
+class SamplingParams:
+    """Per-request decode sampling knobs, applied IN-GRAPH.
+
+    ``temperature=0`` (default) is exact greedy argmax — bit-identical to
+    the engine's historical path, which is what the preempt/resume,
+    prefix-cache-parity, and chaos token-identity contracts are stated
+    over.  With ``temperature > 0``: logits are scaled, the top-k then
+    top-p (nucleus) filters apply, and the token is drawn with a
+    per-request PRNG key derived ONLY from ``(seed, sample index)`` —
+    never from batch slot, megastep size, or replica — so the same seed
+    replays the same token stream across preemption, failover resume,
+    and worker restarts.  ``logprobs=True`` additionally returns the
+    log-softmax of the RAW logits at each sampled token (temperature- and
+    filter-independent, so greedy and sampled runs report comparable
+    values)."""
+
+    temperature: float = 0.0
+    top_k: int = 0          # 0 = no top-k filter
+    top_p: float = 1.0      # 1.0 = no nucleus filter
+    seed: int = 0
+    logprobs: bool = False
+
+    def __post_init__(self):
+        if self.temperature < 0:
+            raise ValueError("temperature must be >= 0")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError("top_p must be in (0, 1]")
+        if self.top_k < 0:
+            raise ValueError("top_k must be >= 0")
+        # the seed feeds an int32 PRNG-key array inside the step program:
+        # reject out-of-range here (submit time) — otherwise numpy raises
+        # mid-step and the control plane reads that as a replica DEATH,
+        # burning the whole retry budget on one bad user parameter
+        if not 0 <= self.seed < 2 ** 31:
+            raise ValueError("seed must be in [0, 2**31)")
+
+    @classmethod
+    def coerce(cls, v) -> "SamplingParams":
+        if v is None:
+            return cls()
+        if isinstance(v, cls):
+            return v
+        return cls(**dict(v))   # plain dict: the RPC wire format
+
+    def to_wire(self) -> Dict:
+        """The dict form shipped over RPC (and back through ``coerce``) —
+        the ONE place the field list is enumerated, so a new sampling
+        knob cannot be silently dropped at a transport boundary."""
+        return {"temperature": self.temperature, "top_k": self.top_k,
+                "top_p": self.top_p, "seed": self.seed,
+                "logprobs": self.logprobs}
+
+
+def _sample_tokens(logits, temps, top_ks, top_ps, seeds, sample_pos):
+    """In-graph next-token selection for one batch of logits rows [B, V].
+
+    Greedy rows (``temps <= 0``) take the exact float32 argmax the engine
+    always used.  Sampled rows divide by temperature, apply top-k and
+    top-p in sorted space (ties at the threshold are kept), and draw via
+    ``jax.random.categorical`` under a key folded from ``(seed,
+    sample_pos)``.  A ``lax.cond`` skips the two [B, V] sorts entirely
+    when the whole batch is greedy, so the default serving path pays
+    nothing for the sampling machinery.  Returns (next_token [B] int32,
+    raw-logit logprob of that token [B] float32)."""
+    lg = logits.astype(jnp.float32)
+    B, V = lg.shape
+    greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+
+    def _sampled(_):
+        scaled = lg / jnp.maximum(temps, 1e-6)[:, None]
+        srt = jnp.sort(scaled, axis=-1)[:, ::-1]            # descending
+        kth = jnp.take_along_axis(
+            srt, jnp.clip(top_ks - 1, 0, V - 1)[:, None], axis=-1)
+        keep_k = (top_ks[:, None] <= 0) | (scaled >= kth)
+        probs_srt = jax.nn.softmax(srt, axis=-1)            # sorted probs
+        csum = jnp.cumsum(probs_srt, axis=-1)
+        # nucleus cutoff: the prob of the first sorted token at which the
+        # cumulative mass reaches p (so at least one token always stays)
+        first = jnp.argmax(csum >= top_ps[:, None], axis=-1)
+        cutoff = jnp.take_along_axis(probs_srt, first[:, None], axis=-1)
+        probs = jax.nn.softmax(scaled, axis=-1)
+        keep_p = (top_ps[:, None] >= 1.0) | (probs >= cutoff)
+        filt = jnp.where(keep_k & keep_p, scaled, -jnp.inf)
+        keys = jax.vmap(
+            lambda s, p: jax.random.fold_in(jax.random.PRNGKey(s), p)
+        )(seeds, sample_pos)
+        return jax.vmap(jax.random.categorical)(keys, filt).astype(jnp.int32)
+
+    drawn = jax.lax.cond(jnp.all(temps <= 0.0), lambda _: greedy,
+                         _sampled, None)
+    nxt = jnp.where(temps <= 0.0, greedy, drawn).astype(jnp.int32)
+    logprob = jnp.take_along_axis(jax.nn.log_softmax(lg, axis=-1),
+                                  nxt[:, None], axis=-1)[:, 0]
+    return nxt, logprob
 
 
 def prefix_block_hash(parent: Optional[str], tokens: Sequence[int]) -> str:
@@ -248,8 +361,15 @@ class ServingRequest:
     prompt: List[int]
     max_new_tokens: int
     eos_token_id: Optional[int] = None
+    sampling: SamplingParams = field(default_factory=SamplingParams)
+    # sample index of this request's FIRST new token: a preempted request
+    # resumed with prompt+generated as its new prefill passes the number
+    # of tokens already sampled here, so the seeded key stream continues
+    # exactly where the evicted run stopped
+    sample_offset: int = 0
     # runtime state
     generated: List[int] = field(default_factory=list)
+    logprob_values: List[float] = field(default_factory=list)
     blocks: List[int] = field(default_factory=list)
     prefill_pos: int = 0          # prompt tokens already cached
     cached_prefix_tokens: int = 0  # of those, tokens REUSED from the cache
@@ -277,7 +397,7 @@ class ServingEngine:
                  block_size: int = 16, token_budget: int = 32,
                  num_blocks: Optional[int] = None, cache_dtype=None,
                  cache_quant: str = "none", prefix_cache="auto",
-                 fault_injector=None):
+                 megastep_k: int = 8, fault_injector=None):
         from .faults import FaultInjector
 
         # seeded failpoint registry (faults.py): the 'engine.step' site
@@ -352,9 +472,21 @@ class ServingEngine:
         self._queue: List[ServingRequest] = []
         self._active: Dict[int, ServingRequest] = {}
         self._finished: Dict[int, List[int]] = {}
+        self._emitted_logprobs: Dict[int, List[float]] = {}
         self._next_rid = 0
         self._free_slots = list(range(self.B - 1, -1, -1))
+        # megastep decode: K compiled decode iterations per host round
+        # trip once every active row is past prefill (1 = per-token
+        # stepping; int8 KV-quant keeps the single-step path — its scale
+        # threading predates the scan)
+        if int(megastep_k) < 1:
+            raise ValueError("megastep_k must be >= 1")
+        self.megastep_k = int(megastep_k)
+        self.megasteps = 0          # megastep program launches (monotone)
+        self.megastep_tokens = 0    # tokens emitted via the megastep path
+        self._forward = self._build_forward()
         self._step_fn = self._build_step()
+        self._mega_fn = None  # lazy: compiled lax.scan megastep program
         self._cow_fn = None   # lazy: compiled block-copy for COW forks
         self.compile_count = 0
 
@@ -397,7 +529,7 @@ class ServingEngine:
             jnp.float32)
 
     # ------------------------------------------------------- compiled step
-    def _build_step(self):
+    def _build_forward(self):
         cfg = self.cfg
         H, KV, D, E = self.H, self.KV, self.D, self.E
         eps = cfg.rms_norm_eps
@@ -410,8 +542,8 @@ class ServingEngine:
 
         quant = self.cache_quant
 
-        def step(weights, key_caches, value_caches, rope, token_ids,
-                 enc, dec, now, cu, bt, mq, scales=None):
+        def forward(weights, key_caches, value_caches, rope, token_ids,
+                    enc, dec, now, cu, bt, mq, scales=None):
             # mq (static): padded per-sequence query length for the attention
             # compute — T for steps carrying prefill chunks, 1 for pure
             # decode steps (avoids T× padded-query attention waste). Two
@@ -448,18 +580,94 @@ class ServingEngine:
             # one logits row per batch slot: its LAST packed token
             rows = jnp.clip(cu[1:] - 1, 0, token_ids.shape[0] - 1)
             logits = hidden[rows] @ weights["head"]  # [B, V]
-            nxt = jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32)
-            return nxt, key_caches, value_caches, new_scales
+            return logits, key_caches, value_caches, new_scales
 
-        self._step_raw = step  # undonated body (in-graph benching/scans)
+        return forward
+
+    def _step_raw(self, weights, key_caches, value_caches, rope, token_ids,
+                  enc, dec, now, cu, bt, mq, scales=None):
+        """Undonated greedy step body (in-graph benching/scans keep the
+        historical (nxt, kcs, vcs, scales) contract)."""
+        logits, kcs, vcs, ns = self._forward(
+            weights, key_caches, value_caches, rope, token_ids, enc, dec,
+            now, cu, bt, mq, scales)
+        nxt = jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32)
+        return nxt, kcs, vcs, ns
+
+    def _build_step(self):
+        fwd = self._forward
+
+        def step(weights, key_caches, value_caches, rope, token_ids,
+                 enc, dec, now, cu, bt, temps, top_ks, top_ps, seeds,
+                 sample_pos, mq, scales=None):
+            logits, kcs, vcs, new_scales = fwd(
+                weights, key_caches, value_caches, rope, token_ids, enc,
+                dec, now, cu, bt, mq, scales)
+            nxt, logprob = _sample_tokens(logits, temps, top_ks, top_ps,
+                                          seeds, sample_pos)
+            return nxt, logprob, kcs, vcs, new_scales
+
         return jax.jit(step, donate_argnums=(1, 2), static_argnames=("mq",))
+
+    def _build_megastep(self):
+        """K decode iterations inside one compiled ``lax.scan``: the
+        megastep program.  Per-row masking implements early exit — a row
+        whose sequence finishes (EOS / budget) freezes its carry (token,
+        cache position, sample index), so every later iteration re-feeds
+        the same token at the same position and rewrites the SAME KV
+        bits (deterministic fn of token, position, weights), while its
+        sampled outputs are marked invalid and dropped on the host.
+        Rows with ``now=0`` (empty batch slots) never write at all."""
+        fwd = self._forward
+        B = self.B
+
+        def mega(weights, key_caches, value_caches, rope, toks, dec, now,
+                 cu, occ_idx, bt, active, remaining, eos, temps, top_ks,
+                 top_ps, seeds, sample_pos, K):
+            enc = jnp.zeros((B,), jnp.int32)
+
+            def body(carry, _):
+                toks, kcs, vcs, dec, active, remaining, sample_pos = carry
+                packed = toks[occ_idx]    # slot-order -> packed layout
+                logits, kcs, vcs, _ = fwd(weights, kcs, vcs, rope, packed,
+                                          enc, dec, now, cu, bt, 1, None)
+                nxt, lps = _sample_tokens(logits, temps, top_ks, top_ps,
+                                          seeds, sample_pos)
+                valid = active
+                fin = (nxt == eos) | (remaining <= 1)
+                nxt_active = active & jnp.logical_not(fin)
+                # freeze finished rows: token/position/sample-index only
+                # advance while the row stays active
+                toks = jnp.where(nxt_active, nxt, toks)
+                dec = dec + nxt_active.astype(jnp.int32)
+                remaining = remaining - active.astype(jnp.int32)
+                sample_pos = sample_pos + active.astype(jnp.int32)
+                return ((toks, kcs, vcs, dec, nxt_active, remaining,
+                         sample_pos), (nxt, valid, lps))
+
+            carry0 = (toks, key_caches, value_caches, dec, active,
+                      remaining, sample_pos)
+            carry, (toks_o, valid_o, lps_o) = jax.lax.scan(
+                body, carry0, None, length=K)
+            return carry[1], carry[2], toks_o, valid_o, lps_o
+
+        return jax.jit(mega, static_argnames=("K",), donate_argnums=(1, 2))
 
     # ------------------------------------------------------------- serving
     def add_request(self, prompt_ids, max_new_tokens: int = 32,
-                    eos_token_id: Optional[int] = None) -> int:
+                    eos_token_id: Optional[int] = None,
+                    sampling=None, sample_offset: int = 0) -> int:
+        """Queue one request.  ``sampling`` is a :class:`SamplingParams`
+        (or its dict wire form; None = greedy argmax).  ``sample_offset``
+        is the sample index of the first NEW token — a resumed request
+        (prompt+generated re-prefilled after preemption/failover) passes
+        the number of tokens already sampled so the seeded key stream
+        continues exactly where it stopped."""
         prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
+        if sample_offset < 0:
+            raise ValueError("sample_offset must be >= 0")
         total = len(prompt) + max_new_tokens
         if total > self.max_seq_len:
             raise ValueError(f"prompt+max_new_tokens={total} exceeds "
@@ -475,8 +683,10 @@ class ServingEngine:
                 "budget or use the unquantized cache")
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append(ServingRequest(rid, prompt, max_new_tokens,
-                                          eos_token_id))
+        self._queue.append(ServingRequest(
+            rid, prompt, max_new_tokens, eos_token_id,
+            sampling=SamplingParams.coerce(sampling),
+            sample_offset=int(sample_offset)))
         return rid
 
     def _match_cached_prefix(self, prompt: List[int]):
@@ -641,6 +851,14 @@ class ServingEngine:
                 "miss_blocks": self.prefix_miss_blocks,
                 "evictions": self.blocks.evictions,
             },
+            # megastep decode counters (monotone; workers fold the deltas
+            # into their registries, the frontend folds for in-process
+            # engines) + the configured K for observability
+            "megastep": {
+                "k": self.megastep_k,
+                "megasteps": self.megasteps,
+                "tokens": self.megastep_tokens,
+            },
         }
 
     def pop_finished(self) -> Dict[int, List[int]]:
@@ -652,9 +870,39 @@ class ServingEngine:
         self._finished = {}
         return out
 
+    def pop_token_logprobs(self) -> Dict[int, List[float]]:
+        """Drain per-token logprobs recorded since the last call for
+        requests with ``SamplingParams.logprobs=True`` — aligned 1:1 with
+        the token lists ``step()`` emitted over the same window.  The
+        control plane harvests this next to the emitted tokens; greedy
+        default requests never appear here."""
+        out = self._emitted_logprobs
+        self._emitted_logprobs = {}
+        return out
+
+    @staticmethod
+    def _fill_sampling(req: ServingRequest, slot: int, temps, top_ks,
+                       top_ps, seeds, spos):
+        """Marshal one request's sampling params into the per-slot host
+        arrays — the ONE fill both the single-step and megastep paths
+        use, so a new knob cannot reach one program and not the other."""
+        sp = req.sampling
+        temps[slot] = sp.temperature
+        top_ks[slot] = sp.top_k
+        top_ps[slot] = sp.top_p
+        seeds[slot] = sp.seed
+        spos[slot] = req.sample_offset + len(req.generated)
+
     def step(self) -> Dict[int, List[int]]:
-        """One engine iteration: schedule -> compiled step -> sample/retire.
-        Returns tokens appended this step, {rid: [tok]}."""
+        """One engine iteration: schedule -> compiled step(s) -> retire.
+        Returns tokens appended this step, {rid: [tok, ...]}.
+
+        Steps carrying prefill chunks run the single-step program (one
+        token per sequence emitted at most).  Once every scheduled row is
+        decoding, up to ``megastep_k`` decode iterations run inside ONE
+        compiled ``lax.scan`` (the megastep), so the returned lists carry
+        up to K tokens per request and the host — admission included —
+        only observes the engine at megastep boundaries."""
         self._try_admit()
         if not self._active:
             return {}
@@ -697,10 +945,20 @@ class ServingEngine:
         # carrying prefill chunks run the [T]-token program (mq=T) — decide
         # first, allocate the one token buffer the program actually takes
         decode_only = all(not r.in_prefill for r, _, _ in sched)
+        if (decode_only and self.megastep_k > 1
+                and self.cache_quant != "int8"
+                and max(r.max_new_tokens - len(r.generated)
+                        for r, _, _ in sched) > 1):
+            return self._megastep([s[0] for s in sched])
         tokens = np.zeros((self.B if decode_only else self.T,), np.int32)
         # stable slot order so cu_seqlens is monotone over batch rows
         sched.sort(key=lambda s: s[0].slot)
         cu = np.zeros((self.B + 1,), np.int32)
+        temps = np.zeros((self.B,), np.float32)
+        top_ks = np.zeros((self.B,), np.int32)
+        top_ps = np.ones((self.B,), np.float32)
+        seeds = np.zeros((self.B,), np.int32)
+        spos = np.zeros((self.B,), np.int32)
         per_slot = {s[0].slot: s for s in sched}
         pos = 0
         for slot in range(self.B):
@@ -708,6 +966,8 @@ class ServingEngine:
             if slot not in per_slot:
                 continue
             req, n, _ = per_slot[slot]
+            self._fill_sampling(req, slot, temps, top_ks, top_ps, seeds,
+                                spos)
             if req.in_prefill:
                 chunk = req.prompt[req.prefill_pos:req.prefill_pos + n]
                 enc[slot] = n
@@ -725,16 +985,21 @@ class ServingEngine:
             cu[slot + 1] = pos
 
         had_cache = self._step_fn._cache_size() if hasattr(self._step_fn, "_cache_size") else None
-        nxt, self.key_caches, self.value_caches, new_scales = self._step_fn(
-            self._weights, self.key_caches, self.value_caches, self._rope,
-            jnp.asarray(tokens), jnp.asarray(enc), jnp.asarray(dec),
-            jnp.asarray(now), jnp.asarray(cu), jnp.asarray(self.block_tables),
-            mq=1 if decode_only else self.T, scales=self.cache_scales)
+        nxt, lps, self.key_caches, self.value_caches, new_scales = \
+            self._step_fn(
+                self._weights, self.key_caches, self.value_caches,
+                self._rope, jnp.asarray(tokens), jnp.asarray(enc),
+                jnp.asarray(dec), jnp.asarray(now), jnp.asarray(cu),
+                jnp.asarray(self.block_tables), jnp.asarray(temps),
+                jnp.asarray(top_ks), jnp.asarray(top_ps),
+                jnp.asarray(seeds), jnp.asarray(spos),
+                mq=1 if decode_only else self.T, scales=self.cache_scales)
         if self.cache_scales is not None:
             self.cache_scales = new_scales
         if had_cache is not None:
             self.compile_count += self._step_fn._cache_size() - had_cache
         nxt = np.asarray(nxt)
+        lps = np.asarray(lps)
 
         emitted: Dict[int, List[int]] = {}
         for req, n, finishes in sched:
@@ -744,8 +1009,103 @@ class ServingEngine:
                     continue  # mid-prompt chunk: sampled token is meaningless
             tok = int(nxt[req.slot])
             req.generated.append(tok)
+            if req.sampling.logprobs:
+                req.logprob_values.append(float(lps[req.slot]))
+                self._emitted_logprobs.setdefault(req.rid, []).append(
+                    float(lps[req.slot]))
             emitted.setdefault(req.rid, []).append(tok)
             hit_eos = (req.eos_token_id is not None and tok == req.eos_token_id)
+            if hit_eos or len(req.generated) >= req.max_new_tokens:
+                self._retire(req)
+        return emitted
+
+    def _megastep(self, reqs: List[ServingRequest]) -> Dict[int, List[int]]:
+        """Run up to ``megastep_k`` decode iterations in one compiled
+        scan over the scheduled (all-decoding) requests.  K rounds up to
+        a power of two (bounded compile count: one program per distinct
+        K) capped at ``megastep_k``; rows that finish inside the scan are
+        masked in-graph and their trailing samples dropped here."""
+        if self._faults is not None:
+            from .faults import prompt_signature
+
+            # same poison-routing contract as the engine.step site, on the
+            # batched-decode path: chaos schedules arm this to cover the
+            # one-RPC-per-K-tokens fleet plumbing
+            self._faults.fire(
+                "engine.megastep",
+                detail=" ".join(prompt_signature(r.prompt) for r in reqs))
+        kmax = max(r.max_new_tokens - len(r.generated) for r in reqs)
+        K = 1
+        while K < min(self.megastep_k, kmax):
+            K *= 2
+        K = min(K, self.megastep_k)
+        B = self.B
+        toks = np.zeros((B,), np.int32)
+        dec = np.zeros((B,), np.int32)
+        now = np.zeros((B,), np.int32)
+        occ_idx = np.zeros((B,), np.int32)
+        cu = np.zeros((B + 1,), np.int32)
+        active = np.zeros((B,), bool)
+        remaining = np.zeros((B,), np.int32)
+        eos = np.full((B,), -1, np.int32)
+        temps = np.zeros((B,), np.float32)
+        top_ks = np.zeros((B,), np.int32)
+        top_ps = np.ones((B,), np.float32)
+        seeds = np.zeros((B,), np.int32)
+        spos = np.zeros((B,), np.int32)
+        reqs = sorted(reqs, key=lambda r: r.slot)
+        by_slot = {r.slot: r for r in reqs}
+        pos = 0
+        for slot in range(B):
+            req = by_slot.get(slot)
+            if req is not None:
+                occ_idx[pos] = slot
+                toks[slot] = (req.generated[-1] if req.generated
+                              else req.prompt[-1])
+                dec[slot] = req.context_len - 1
+                now[slot] = 1
+                active[slot] = True
+                remaining[slot] = req.max_new_tokens - len(req.generated)
+                if req.eos_token_id is not None:
+                    eos[slot] = req.eos_token_id
+                self._fill_sampling(req, slot, temps, top_ks, top_ps,
+                                    seeds, spos)
+                pos += 1
+            cu[slot + 1] = pos
+        if self._mega_fn is None:
+            self._mega_fn = self._build_megastep()
+        had = (self._mega_fn._cache_size()
+               if hasattr(self._mega_fn, "_cache_size") else None)
+        kcs, vcs, toks_o, valid_o, lps_o = self._mega_fn(
+            self._weights, self.key_caches, self.value_caches, self._rope,
+            jnp.asarray(toks), jnp.asarray(dec), jnp.asarray(now),
+            jnp.asarray(cu), jnp.asarray(occ_idx),
+            jnp.asarray(self.block_tables), jnp.asarray(active),
+            jnp.asarray(remaining), jnp.asarray(eos), jnp.asarray(temps),
+            jnp.asarray(top_ks), jnp.asarray(top_ps), jnp.asarray(seeds),
+            jnp.asarray(spos), K=K)
+        self.key_caches, self.value_caches = kcs, vcs
+        if had is not None:
+            self.compile_count += self._mega_fn._cache_size() - had
+        toks_o = np.asarray(toks_o)       # [K, B]
+        valid_o = np.asarray(valid_o)
+        lps_o = np.asarray(lps_o)
+        self.megasteps += 1
+
+        emitted: Dict[int, List[int]] = {}
+        for req in reqs:
+            s = req.slot
+            col = valid_o[:, s]
+            new = [int(t) for t in toks_o[:, s][col]]
+            req.generated.extend(new)
+            if req.sampling.logprobs:
+                row_lps = [float(v) for v in lps_o[:, s][col]]
+                req.logprob_values.extend(row_lps)
+                self._emitted_logprobs.setdefault(req.rid, []).extend(row_lps)
+            emitted[req.rid] = new
+            self.megastep_tokens += len(new)
+            hit_eos = (req.eos_token_id is not None and new
+                       and new[-1] == req.eos_token_id)
             if hit_eos or len(req.generated) >= req.max_new_tokens:
                 self._retire(req)
         return emitted

@@ -6,6 +6,7 @@ uses with its fake custom_cpu plugin device
 (/root/reference/test/custom_runtime/test_custom_cpu_plugin.py:23).
 """
 import os
+import tempfile
 
 # force CPU regardless of the shell's JAX_PLATFORMS (the dev shell points at a
 # tunneled TPU and its sitecustomize pins jax_platforms=axon,cpu in the CONFIG,
@@ -13,11 +14,30 @@ import os
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
 if os.environ.get("PADDLE_TPU_TEST_ON_TPU") != "1":
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+    # persistent XLA compilation cache for SUBPROCESSES ONLY (ROADMAP
+    # item 6, tier-1 budget): the fleet/standalone-serving tests each pay
+    # a ~10 s jax import + engine first-step compile per spawned worker —
+    # exporting the cache dir lets every worker after the first hit the
+    # disk cache.  The env var is set AFTER `import jax` above,
+    # deliberately: jax snapshots env-derived config at import, so the
+    # PYTEST process itself keeps the cache OFF.  In-process caching is
+    # NOT safe here — jaxlib 0.4.37 SEGFAULTS deserializing cached
+    # executables built on the 8-virtual-device CPU platform (reproduced:
+    # cold test_compiled_pipeline run green, warm run fatal during
+    # dispatch) — while worker processes only build single-device serving
+    # programs, which round-trip fine.  Kept inside the CPU branch: on a
+    # PADDLE_TPU_TEST_ON_TPU run jax is imported later, and setting the
+    # env first would arm the in-process cache this comment forbids.
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(tempfile.gettempdir(), "paddle_tpu_jax_cache"))
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -594,6 +594,11 @@ class FakeRemote(RemoteReplica):
     def pop_finished(self):
         return self._eng.pop_finished()
 
+    def pop_token_logprobs(self):
+        # the inherited RemoteReplica method reads the RPC mirror this
+        # stand-in never initialises — read the engine directly
+        return self._eng.pop_token_logprobs()
+
     def health(self, include_samples=False, timeout=None, retries=0,
                retry_backoff_s=0.0):
         self._chk()
@@ -629,9 +634,8 @@ class TestDrainHeartbeatRace:
             rep0 = fe.replicas[0]
             rids = [fe.submit([3 + i, 17, 101], max_new_tokens=6)
                     for i in range(4)]
-            fleet.step()
-            clock.advance(1.0)
-            fleet.step()
+            fleet.step()   # prefill + first token (another step would
+            clock.advance(1.0)   # megastep every request to completion)
             in_flight = len(rep0.requests)
             assert in_flight > 0
             fleet.drain_replica(rep0)

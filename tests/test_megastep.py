@@ -1,0 +1,297 @@
+"""Megastep decode + in-graph sampling + token streaming (ISSUE 9).
+
+Contracts under test:
+
+* K>1 megastep decode is token-identical to K=1 per-token stepping and
+  to the engine-independent greedy reference (``models.generate``) —
+  with the prefix cache on AND off, and across recompute preemption
+  (evict at a megastep boundary, resume with prompt+generated);
+* ``temperature=0`` sampling is the argmax path exactly (same tokens as
+  the greedy engine), and seeded sampling is deterministic: same seed →
+  same tokens across K values, across an engine rebuild (the worker-
+  restart shape), and across a preempt/resume with ``sample_offset``;
+* streaming surfaces every token exactly once, in order, both through
+  ``on_token`` callbacks and the ``stream()`` iterator;
+* deadline sheds fire at megastep boundaries with the overshoot bounded
+  by the engine's K (the documented small-fix semantics);
+* logprobs align 1:1 with tokens and survive the result plumbing.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu.inference import (
+    Priority,
+    RequestStatus,
+    SamplingParams,
+    ServingEngine,
+    ServingFrontend,
+)
+
+pytestmark = pytest.mark.quick
+
+ENGINE = dict(max_batch_size=2, max_seq_len=64, block_size=8,
+              token_budget=16)
+SAMPLED = dict(temperature=0.8, top_k=50, top_p=0.95, seed=13)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture(scope="module")
+def model():
+    from paddle_tpu.distributed.topology import set_hybrid_communicate_group
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    set_hybrid_communicate_group(None)
+    P.seed(11)
+    return LlamaForCausalLM(LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=160,
+        num_hidden_layers=1, num_attention_heads=2,
+        max_position_embeddings=256))
+
+
+def ref_greedy(model, prompt, n):
+    from paddle_tpu.models.generation import generate
+
+    ids = P.to_tensor(np.asarray(prompt, np.int32)[None, :])
+    out = generate(model, ids, max_new_tokens=n, do_sample=False)
+    return list(np.asarray(out.numpy()).reshape(-1))
+
+
+def run_engine(model, prompt, n, k, sampling=None, **kw):
+    eng = ServingEngine(model, megastep_k=k, **{**ENGINE, **kw})
+    rid = eng.add_request(prompt, max_new_tokens=n, sampling=sampling)
+    return eng.run()[rid], eng
+
+
+class TestTokenIdentity:
+    def test_k_gt_1_identical_to_k1_and_reference(self, model):
+        """The headline contract: megastep partitioning of decode never
+        changes greedy output — K=1, K=2, K=8 and the pre-megastep
+        per-step reference all agree."""
+        prompt = [3, 17, 101, 7, 250]
+        ref = ref_greedy(model, prompt, 12)
+        for k in (1, 2, 8):
+            out, eng = run_engine(model, prompt, 12, k)
+            assert out == ref, f"megastep_k={k} diverged"
+            if k > 1:
+                assert eng.megasteps > 0          # the scan path actually ran
+                assert eng.megastep_tokens > 0
+
+    def test_identical_with_prefix_cache_on_and_off(self, model):
+        """Cache-on and cache-off megastep runs are token-identical (the
+        shared-prefix second request prefill-skips into a megastep)."""
+        shared = list(range(30, 46))              # 2 full blocks
+        prompts = [shared + [7, 9], shared + [5]]
+        outs = {}
+        for cache in (False, "auto"):
+            eng = ServingEngine(model, prefix_cache=cache, megastep_k=8,
+                                **ENGINE)
+            r0 = eng.add_request(prompts[0], max_new_tokens=8)
+            first = eng.run()[r0]
+            r1 = eng.add_request(prompts[1], max_new_tokens=8)
+            outs[cache] = (first, eng.run()[r1])
+            if cache == "auto":
+                assert eng.prefix_hit_blocks > 0  # the cache really engaged
+        assert outs[False] == outs["auto"]
+
+    def test_preempt_resume_across_megastep_boundary(self, model):
+        """Evict at a megastep boundary mid-generation, resume with
+        prompt+generated: the concatenated stream equals the unpreempted
+        run (greedy-deterministic contract carried through megastep)."""
+        prompt = [3, 17, 101]
+        full = ref_greedy(model, prompt, 12)
+        eng = ServingEngine(model, megastep_k=4, **ENGINE)
+        rid = eng.add_request(prompt, max_new_tokens=12)
+        eng.step()       # prefill + first token
+        eng.step()       # one K=4 megastep -> 5 tokens
+        req = eng.evict(rid)
+        assert 0 < len(req.generated) < 12
+        rid2 = eng.add_request(prompt + req.generated,
+                               max_new_tokens=12 - len(req.generated))
+        out = eng.run()[rid2]
+        assert req.generated + out == full
+
+
+class TestSamplingDeterminism:
+    def test_temperature_zero_is_argmax(self, model):
+        """temperature=0 sampling takes the exact greedy path."""
+        prompt = [3, 17, 101, 7]
+        ref = ref_greedy(model, prompt, 10)
+        out, _ = run_engine(model, prompt, 10, 8,
+                            sampling={"temperature": 0.0, "seed": 99})
+        assert out == ref
+
+    def test_same_seed_same_tokens_across_k(self, model):
+        """The key depends only on (seed, sample index): K=1 and K=8
+        produce the same sampled stream; a different seed diverges."""
+        prompt = [3, 17, 101, 7]
+        out1, _ = run_engine(model, prompt, 10, 1, sampling=SAMPLED)
+        out8, _ = run_engine(model, prompt, 10, 8, sampling=SAMPLED)
+        assert out1 == out8
+        other, _ = run_engine(model, prompt, 10, 8,
+                              sampling={**SAMPLED, "seed": 14})
+        assert other != out8
+
+    def test_replay_across_engine_rebuild(self, model):
+        """The worker-restart shape: a fresh engine (rebuilt caches and
+        programs, same seeded model) replays the same sampled stream."""
+        prompt = [42, 5, 7]
+        first, eng = run_engine(model, prompt, 8, 8, sampling=SAMPLED)
+        del eng
+        again, _ = run_engine(model, prompt, 8, 8, sampling=SAMPLED)
+        assert first == again
+
+    def test_resume_continues_key_stream_via_sample_offset(self, model):
+        """A preempted sampled request resumed with ``sample_offset``
+        continues the seeded stream exactly where it stopped."""
+        prompt = [3, 17, 101]
+        full, _ = run_engine(model, prompt, 12, 8, sampling=SAMPLED)
+        eng = ServingEngine(model, megastep_k=4, **ENGINE)
+        rid = eng.add_request(prompt, max_new_tokens=12, sampling=SAMPLED)
+        eng.step()
+        eng.step()
+        req = eng.evict(rid)
+        assert 0 < len(req.generated) < 12
+        assert full[:len(req.generated)] == req.generated
+        rid2 = eng.add_request(prompt + req.generated,
+                               max_new_tokens=12 - len(req.generated),
+                               sampling=SAMPLED,
+                               sample_offset=len(req.generated))
+        out = eng.run()[rid2]
+        assert req.generated + out == full
+
+    def test_frontend_preemption_preserves_sampled_stream(self, model):
+        """End to end through the control plane: a LOW sampled request
+        preempted for a HIGH one resumes (the frontend passes
+        sample_offset) and finishes with the unpreempted stream."""
+        plo = [3, 17, 101]
+        want, _ = run_engine(model, plo, 8, 8, sampling=SAMPLED,
+                             max_seq_len=32, num_blocks=4)
+        eng = ServingEngine(model, megastep_k=8, **{**ENGINE,
+                                                    "max_seq_len": 32,
+                                                    "num_blocks": 4})
+        fe = ServingFrontend([eng])
+        rlo = fe.submit(plo, max_new_tokens=8, priority=Priority.LOW,
+                        **SAMPLED)
+        fe.step()                                # prefill + first token
+        rhi = fe.submit(list(range(40, 50)), max_new_tokens=8,
+                        priority=Priority.HIGH)
+        res = fe.run()
+        assert res[rhi].ok
+        assert res[rlo].ok and res[rlo].preemptions >= 1
+        assert res[rlo].tokens == want
+
+    def test_sampling_validation(self, model):
+        with pytest.raises(ValueError, match="temperature"):
+            SamplingParams(temperature=-0.1)
+        with pytest.raises(ValueError, match="top_p"):
+            SamplingParams(top_p=0.0)
+        with pytest.raises(ValueError, match="top_k"):
+            SamplingParams(top_k=-1)
+
+
+class TestStreaming:
+    def test_on_token_callback_order_and_completeness(self, model):
+        fe = ServingFrontend([ServingEngine(model, **ENGINE)])
+        seen = {}
+        rids = [fe.submit([3 + i, 17, 101], max_new_tokens=10,
+                          on_token=lambda rid, t: seen.setdefault(
+                              rid, []).append(t))
+                for i in range(3)]
+        res = fe.run()
+        for rid in rids:
+            assert res[rid].ok
+            assert seen[rid] == res[rid].tokens   # every token, in order
+
+    def test_stream_iterator(self, model):
+        fe = ServingFrontend([ServingEngine(model, **ENGINE)])
+        rid = fe.submit([3, 17, 101], max_new_tokens=10)
+        toks = list(fe.stream(rid))
+        assert toks == fe.result(rid).tokens
+        assert fe.result(rid).ok
+        with pytest.raises(KeyError):
+            next(fe.stream(999))
+
+    def test_raising_callback_disables_stream_not_replica(self, model):
+        """A buggy on_token callback must not kill the replica or the
+        request — the callback is dropped, the request completes."""
+        def boom(rid, tok):
+            raise RuntimeError("consumer bug")
+
+        fe = ServingFrontend([ServingEngine(model, **ENGINE)])
+        rid = fe.submit([3, 17, 101], max_new_tokens=8, on_token=boom)
+        res = fe.run()
+        assert res[rid].ok
+        assert res[rid].tokens == ref_greedy(model, [3, 17, 101], 8)
+        assert fe.metrics.counter("stream_callback_errors_total") == 1
+        assert fe.metrics.counter("replica_deaths_total") == 0
+
+
+class TestMegastepBoundaries:
+    def test_deadline_overshoot_bounded_by_k(self, model):
+        """The small-fix contract: shed/cancel fire at megastep
+        boundaries, so a request past deadline carries at most K extra
+        tokens from the megastep that straddled it — never unbounded."""
+        clock = FakeClock()
+        eng = ServingEngine(model, megastep_k=4, **ENGINE)
+        fe = ServingFrontend([eng], clock=clock)
+        rid = fe.submit([3, 17, 101], max_new_tokens=30, deadline_s=5.0)
+        fe.step()                     # prefill + first token
+        clock.advance(10.0)           # deadline passes between boundaries
+        fe.step()                     # boundary: shed fires HERE
+        res = fe.result(rid)
+        assert res is not None
+        assert res.status is RequestStatus.DEADLINE_EXCEEDED
+        # 1 pre-deadline token; the straddling megastep can add at most K
+        assert len(res.tokens) <= 1 + eng.megastep_k
+        assert res.tokens == ref_greedy(model, [3, 17, 101],
+                                        30)[:len(res.tokens)]
+
+    def test_logprobs_align_with_tokens(self, model):
+        fe = ServingFrontend([ServingEngine(model, **ENGINE)])
+        r1 = fe.submit([3, 17, 101], max_new_tokens=9, logprobs=True)
+        r2 = fe.submit([42, 5], max_new_tokens=6, logprobs=True,
+                       **SAMPLED)
+        res = fe.run()
+        for rid in (r1, r2):
+            lps = res[rid].logprobs
+            assert lps is not None and len(lps) == len(res[rid].tokens)
+            assert all(lp <= 0.0 for lp in lps)   # log-probabilities
+        # greedy default requests don't pay for logprob plumbing
+        r3 = fe.submit([9, 9], max_new_tokens=4)
+        assert fe.run()[r3].logprobs is None
+
+    def test_megastep_counters_and_state_summary(self, model):
+        eng = ServingEngine(model, megastep_k=8, **ENGINE)
+        fe = ServingFrontend([eng])
+        rid = fe.submit([3, 17, 101], max_new_tokens=10)
+        res = fe.run()
+        assert res[rid].ok
+        ms = eng.state_summary()["megastep"]
+        assert ms["k"] == 8
+        assert ms["megasteps"] == eng.megasteps > 0
+        assert ms["tokens"] == eng.megastep_tokens > 0
+        assert fe.metrics.counter("megasteps_total") == eng.megasteps
+        assert (fe.metrics.counter("megastep_tokens_total")
+                == eng.megastep_tokens)
+
+    def test_megastep_k1_never_scans(self, model):
+        out_ref = ref_greedy(model, [3, 17, 101], 8)
+        eng = ServingEngine(model, megastep_k=1, **ENGINE)
+        rid = eng.add_request([3, 17, 101], max_new_tokens=8)
+        assert eng.run()[rid] == out_ref
+        assert eng.megasteps == 0 and eng._mega_fn is None
+
+    def test_megastep_k_validation(self, model):
+        with pytest.raises(ValueError, match="megastep_k"):
+            ServingEngine(model, megastep_k=0, **ENGINE)

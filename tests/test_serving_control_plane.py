@@ -151,8 +151,7 @@ class TestDeadlines:
         fe = ServingFrontend([make_engine(model)], clock=clock)
         rid = fe.submit([3, 17, 101, 7], max_new_tokens=12, deadline_s=5.0)
         fe.step()   # prefill + first token
-        fe.step()
-        fe.step()
+        fe.step()   # one megastep (K=8): 9 of 12 tokens — still running
         clock.advance(10.0)
         res = fe.run()
         r = res[rid]
@@ -176,8 +175,9 @@ class TestPreemption:
         fe = ServingFrontend([eng])
         plo = [3, 17, 101]                       # 3 + 8 = 11 -> 2 blocks
         rlo = fe.submit(plo, max_new_tokens=8, priority=Priority.LOW)
-        for _ in range(3):                       # lo prefills + decodes
-            fe.step()
+        # prefill + first token only: a second step would be a megastep
+        # and finish all 8 tokens before the HIGH request ever arrives
+        fe.step()
         assert len(fe._requests[rlo].generated) > 0
         phi = list(range(40, 50))                # 10 + 8 = 18 -> 3 blocks
         rhi = fe.submit(phi, max_new_tokens=8, priority=Priority.HIGH)
@@ -225,9 +225,8 @@ class TestFailover:
         fe = ServingFrontend([make_engine(model), make_engine(model)])
         prompts = [[3, 17, 101], [42, 5, 7], [250, 4], [88, 13, 77]]
         rids = [fe.submit(p, max_new_tokens=6) for p in prompts]
-        fe.step()
-        fe.step()
-        doomed = fe.replicas[1]
+        fe.step()   # prefill + first token; the next step's megastep
+        doomed = fe.replicas[1]   # would retire everything (K=8 > 6)
         on_doomed = [fr.rid for fr in doomed.requests.values()]
         assert on_doomed, "routing should have spread load to replica 1"
 
@@ -335,9 +334,8 @@ class TestEngineEvict:
         eng = make_engine(model)
         prompt = [3, 17, 101, 7, 250]
         rid = eng.add_request(prompt, max_new_tokens=10)
-        eng.step()
-        eng.step()
-        eng.step()
+        eng.step()   # prefill + first token
+        eng.step()   # megastep: +8 -> 9 of 10, still active
         req = eng.evict(rid)
         assert req.generated and eng.num_active == 0
         assert eng.blocks.num_free == eng.blocks.num_blocks

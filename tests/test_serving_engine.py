@@ -20,7 +20,16 @@ def model():
 
     set_hybrid_communicate_group(None)
     P.seed(11)
-    return LlamaForCausalLM(llama_tiny())
+    # narrow config (ROADMAP item 6, tier-1 budget): these tests exercise
+    # scheduling/admission/parity, none of which depends on width — but
+    # KEEP 2 layers so the per-layer cache/scale threading stays covered
+    from paddle_tpu.models.llama import LlamaConfig
+
+    # (vocab stays 512: test prompts carry ids up to 410)
+    return LlamaForCausalLM(LlamaConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=160,
+        num_hidden_layers=2, num_attention_heads=2,
+        max_position_embeddings=256))
 
 
 def ref_greedy(model, prompt, n):
@@ -117,8 +126,10 @@ class TestServingEngine:
         eng = ServingEngine(model, max_batch_size=2, max_seq_len=64,
                             block_size=8, token_budget=16)
         eng.add_request([3, 17, 101], max_new_tokens=8)
+        # one step = prefill + first token; the megastep would finish the
+        # remaining 7 in step two, so step ONE is the truncation point
         with pytest.raises(RuntimeError, match="max_steps"):
-            eng.run(max_steps=2)
+            eng.run(max_steps=1)
         # draining the remaining steps finishes normally
         out = eng.run()
         assert len(next(iter(out.values()))) == 8

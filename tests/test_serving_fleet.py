@@ -193,7 +193,8 @@ class TestFaultInjection:
         with make_fleet(2, heartbeat_interval_s=10.0) as fleet:
             rids = [fleet.frontend.submit(p, max_new_tokens=6)
                     for p in PROMPTS]
-            fleet.step()
+            # ONE step only (prefill + first token): a second would run a
+            # megastep and retire every request before the SIGKILL lands
             fleet.step()
             doomed = next(r for r in fleet.frontend.replicas if r.requests)
             name = doomed.engine.worker

@@ -90,7 +90,17 @@ class TestStaticTraining:
         for _ in range(30):
             (lv,) = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
             losses.append(float(lv))
-        assert losses[-1] < losses[0] * 0.3, losses[:3] + losses[-3:]
+        # gate against the ACHIEVABLE optimum, not a fixed ratio of the
+        # init-dependent first loss: for this seeded (x, y) the least-
+        # squares MSE floor is ~0.389, so the old `< losses[0] * 0.3`
+        # (= 0.258 here) demanded the impossible — the loop converged to
+        # the optimum and still "failed" (surfaced once tier-1 first ran
+        # this file to completion, r11)
+        X = np.hstack([xv, np.ones((8, 1), np.float32)])
+        w, *_ = np.linalg.lstsq(X, yv, rcond=None)
+        opt_mse = float(np.mean((yv - X @ w) ** 2))
+        assert losses[-1] < losses[0], losses[:3] + losses[-3:]
+        assert losses[-1] <= opt_mse * 1.05, (losses[-1], opt_mse)
 
     def test_param_values_updated(self):
         main = fresh_program()

@@ -63,8 +63,13 @@ def _workload(seed, num_requests, rate_rps):
                       token_budget=16, num_blocks=8)
         # pool binds before slots: preemption pressure
         prompt_lens, max_new = (4, 8, 12), 8
-        num_requests = num_requests or 24
-        rate_rps = rate_rps or 200.0  # ~4x service rate: queue must form
+        num_requests = num_requests or 48
+        # ~4x service rate so a queue must form: megastep decode (r11)
+        # lifted the service rate past the old 200 rps offered load —
+        # the rung was arrival-limited and measured the Poisson schedule,
+        # not the frontend (rate_rps/num_requests are perf_gate identity
+        # keys, so this re-baselines loudly)
+        rate_rps = rate_rps or 800.0
     rng = np.random.RandomState(seed)
     prompts = [rng.randint(0, model["vocab_size"],
                            (int(rng.choice(prompt_lens)),)).tolist()
@@ -115,12 +120,18 @@ def _report(metric, fe, rids, wall_s, extra):
     completed = [res[r] for r in rids if res[r].ok]
     # TTFT percentiles come from the metrics registry itself (every
     # first-token event this run — all requests completed, so identical
-    # population to a completed-only view)
+    # population to a completed-only view); inter-token latency is the
+    # token_latency_seconds series, i.e. per-token time between harvest
+    # boundaries (a megastep's K-token burst amortizes over the burst)
     ttft = snap["latency"]["ttft_seconds"]
+    itl = snap["latency"]["token_latency_seconds"]
     out = {
         "host": bench_ladder.host_fingerprint(),
         "p50_ttft_ms": round(ttft["p50"] * 1e3, 1),
         "p95_ttft_ms": round(ttft["p95"] * 1e3, 1),
+        "p50_itl_ms": round(itl["p50"] * 1e3, 2),
+        "p95_itl_ms": round(itl["p95"] * 1e3, 2),
+        "megasteps": snap["counters"]["megasteps_total"],
         "completed": len(completed),
         "shed_deadline": snap["counters"]["shed_deadline_total"],
         "rejected_overloaded":
@@ -313,6 +324,112 @@ def run_bench_prefix(num_requests=None, shared_prefix_len=None, seed=0):
     }
 
 
+def run_bench_megastep(num_requests=None, megastep_k=8, seed=0):
+    """Megastep rung (ISSUE 9): a closed batch of requests served to
+    completion with in-graph K-step decode vs per-token stepping.  The
+    gated ``value`` is host round trips per generated token with the
+    megastep ON (engine_steps_total / tokens_emitted_total — deterministic
+    scheduling counters, no wall clock; lower is better, bounded below by
+    the prefill steps plus 1/K).  Token parity megastep-on vs -off is
+    asserted inside the bench, and per-mode tokens/s + ITL ride in
+    ``extra`` for the wall-clock story."""
+    import jax
+
+    import bench_ladder  # repo root is on sys.path (top of this file)
+    import paddle_tpu as P
+    from paddle_tpu.inference import ServingEngine, ServingFrontend
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    backend = jax.default_backend()
+    on_accel = backend in ("tpu", "axon")
+    if on_accel:
+        model_cfg = dict(vocab_size=32000, hidden_size=2560,
+                         intermediate_size=8192, num_hidden_layers=9,
+                         num_attention_heads=10,
+                         max_position_embeddings=2048, dtype="bfloat16")
+        engine_cfg = dict(max_batch_size=8, max_seq_len=448, block_size=64,
+                          token_budget=64, num_blocks=56)
+        prompt_lens, max_new = (96, 160), 32
+        num_requests = num_requests or 16
+    else:
+        model_cfg = dict(vocab_size=512, hidden_size=128,
+                         intermediate_size=352, num_hidden_layers=2,
+                         num_attention_heads=4, max_position_embeddings=256)
+        engine_cfg = dict(max_batch_size=4, max_seq_len=64, block_size=8,
+                          token_budget=16, num_blocks=16)
+        prompt_lens, max_new = (4, 8, 12), 16
+        num_requests = num_requests or 12
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    prompts = [rng.randint(0, model_cfg["vocab_size"],
+                           (int(rng.choice(prompt_lens)),)).tolist()
+               for _ in range(num_requests)]
+    P.seed(0)
+    model = LlamaForCausalLM(LlamaConfig(**model_cfg))
+    if on_accel:
+        model.bfloat16()
+    model.eval()
+
+    def serve(k):
+        eng = ServingEngine(model, megastep_k=k, **engine_cfg)
+        fe = ServingFrontend(eng)
+        # closed batch, submitted up front: the step/token counters are a
+        # pure function of the schedule — deterministic, wall-clock-free
+        warm = fe.submit(prompts[0], max_new_tokens=max_new)
+        fe.run()
+        assert fe.result(warm).ok
+        fe.metrics.reset()
+        t0 = time.monotonic()
+        rids = [fe.submit(p, max_new_tokens=max_new) for p in prompts]
+        fe.run()
+        wall = time.monotonic() - t0
+        res = fe.results()
+        snap = fe.metrics.snapshot()
+        itl = snap["latency"]["token_latency_seconds"]
+        return {
+            "tokens": [res[r].tokens for r in rids],
+            "steps": snap["counters"]["engine_steps_total"],
+            "emitted": snap["counters"]["tokens_emitted_total"],
+            "megasteps": snap["counters"]["megasteps_total"],
+            "tokens_per_sec": round(snap["tokens_per_sec"], 1),
+            "p50_itl_ms": round(itl["p50"] * 1e3, 2),
+            "p95_itl_ms": round(itl["p95"] * 1e3, 2),
+            "wall_s": round(wall, 3),
+        }
+
+    off = serve(1)
+    on = serve(megastep_k)
+    assert on["tokens"] == off["tokens"], \
+        "megastep decode changed greedy outputs — parity violation"
+    value = on["steps"] / max(on["emitted"], 1)
+    return {
+        "metric": "serving_megastep_steps_per_token",
+        "value": round(value, 4),
+        "unit": "host round trips/token (lower=better)",
+        "extra": {
+            "host": bench_ladder.host_fingerprint(),
+            "backend": backend,
+            "megastep_k": megastep_k,
+            "num_requests": num_requests,
+            "max_new_tokens": max_new,
+            "steps_on": on["steps"], "steps_off": off["steps"],
+            "steps_per_token_off": round(off["steps"]
+                                         / max(off["emitted"], 1), 4),
+            "megasteps": on["megasteps"],
+            "tokens_per_sec_on": on["tokens_per_sec"],
+            "tokens_per_sec_off": off["tokens_per_sec"],
+            "p50_itl_ms_on": on["p50_itl_ms"],
+            "p50_itl_ms_off": off["p50_itl_ms"],
+            "wall_s_on": on["wall_s"], "wall_s_off": off["wall_s"],
+            "outputs_token_identical": True,
+            "method": "closed batch served megastep-on vs -off; value = "
+                      "engine steps per emitted token with megastep on "
+                      "(deterministic counters, wall-clock-free)",
+        },
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--num-requests", type=int, default=None)
@@ -328,8 +445,17 @@ def main(argv=None):
                          "with the same S-token system prompt (>= 2 full "
                          "blocks); reports hit rate + prefill tokens "
                          "computed cache-on vs cache-off")
+    ap.add_argument("--megastep", action="store_true",
+                    help="megastep workload — a closed batch served with "
+                         "in-graph K-step decode vs per-token stepping; "
+                         "reports host round trips per token + parity")
+    ap.add_argument("--megastep-k", type=int, default=8)
     args = ap.parse_args(argv)
-    if args.shared_prefix_len > 0:
+    if args.megastep:
+        line = run_bench_megastep(num_requests=args.num_requests,
+                                  megastep_k=args.megastep_k,
+                                  seed=args.seed)
+    elif args.shared_prefix_len > 0:
         line = run_bench_prefix(num_requests=args.num_requests,
                                 shared_prefix_len=args.shared_prefix_len,
                                 seed=args.seed)

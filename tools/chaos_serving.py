@@ -44,12 +44,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # sub-tiny config (same scale the serving control-plane tests use): the
 # soak builds replicas+spares engines and steps them hundreds of times on
-# a 2-vCPU CI container
+# a 2-vCPU CI container.  megastep_k=2 (not the engine default 8): the
+# soak's faults are scheduled in STEP counts, and K=8 retires these 3-7
+# token requests in one boundary — the run would compress so far that
+# deaths outpace breaker-gated recovery and brownout never sustains.
+# K=2 still drives the engine.megastep site + batched-RPC path every
+# decode while keeping enough boundaries for the schedule to interleave.
 MODEL = dict(vocab_size=256, hidden_size=64, intermediate_size=160,
              num_hidden_layers=1, num_attention_heads=2,
              max_position_embeddings=256)
 ENGINE = dict(max_batch_size=2, max_seq_len=64, block_size=8,
-              token_budget=16)
+              token_budget=16, megastep_k=2)
 POISON_PROMPT = [66, 6, 6]   # signature "p66-6-6-" for the poison match
 
 
@@ -92,7 +97,11 @@ def _fault_schedule(seed, total_names, poison):
     """Seeded failpoint schedule: each initial replica gets one scheduled
     step fault (error/timeout/drop round-robin so >= 3 kinds fire), a
     delay rides the first replica's add_request path, and some respawn
-    names are doomed too (that is what drives the breaker)."""
+    names are doomed too (that is what drives the breaker).  The
+    ``engine.megastep`` site (ISSUE 9) is always armed: one scheduled
+    crash fires at a megastep launch — i.e. mid-batched-decode, the
+    one-RPC-per-K-tokens path — so the soak proves failover from a
+    megastep death keeps every request terminal and token-identical."""
     import random
 
     rng = random.Random(f"chaos-sched:{seed}")
@@ -107,6 +116,8 @@ def _fault_schedule(seed, total_names, poison):
                 "times": 1,
             }
     sites["r0.add_request"] = {"kind": "delay", "delay_s": 0.001, "times": 2}
+    sites["engine.megastep"] = {"kind": kinds[rng.randrange(3)],
+                                "after": rng.randrange(1, 5), "times": 1}
     if poison:
         sites["engine.step"] = {"kind": "error", "match": "p66-6-6-"}
     return sites
@@ -149,8 +160,12 @@ def run_chaos(seed=0, replicas=3, num_requests=18, max_request_retries=2,
     def wrap(engine, name):
         return FaultyReplica(engine, inj, name=name, timeout_exc=RpcTimeout)
 
+    # the chaos engines carry the injector themselves too: the
+    # engine.megastep site lives INSIDE ServingEngine.step (it fires at
+    # megastep launch, covering the batched K-token decode path), which
+    # the FaultyReplica proxy cannot see from outside
     fe = ServingFrontend(
-        [wrap(ServingEngine(model, **ENGINE), f"r{i}")
+        [wrap(ServingEngine(model, fault_injector=inj, **ENGINE), f"r{i}")
          for i in range(replicas)],
         max_request_retries=max_request_retries,
         # sensitive thresholds: the 2-requests-per-step trickle over 3
@@ -305,6 +320,11 @@ def run_chaos_fleet(seed=0, workers=3, num_requests=8, max_steps=3000):
         # spares the RemoteReplica.__init__ readiness probe)
         "faults": {"seed": seed, "sites": {
             "engine.step": {"kind": "delay", "delay_s": 0.002, "times": 3},
+            # the batched-decode failpoint (ISSUE 9): a couple of delays
+            # at megastep launch prove the one-RPC-per-K-tokens path is
+            # traversed and survivable in real worker processes
+            "engine.megastep": {"kind": "delay", "delay_s": 0.002,
+                                "times": 2},
             "health.probe": {"kind": "error", "match": "worker0",
                              "after": 1, "times": 2},
         }},

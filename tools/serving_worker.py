@@ -10,7 +10,9 @@ tunnel must not hang the fleet), build the seeded model + engine from
 ``rpc.init_rpc``, then park until the frontend's ``_w_shutdown`` RPC (or
 SIGTERM).  All serving traffic — add_request / step / evict / health —
 arrives as RPC calls into ``paddle_tpu.inference.fleet``'s ``_w_*``
-handlers; this file is only the bootstrap.
+handlers; this file is only the bootstrap.  One ``_w_step`` RPC drives
+one engine step — which, with megastep decode (ISSUE 9), returns up to
+``megastep_k`` tokens per running sequence per round trip.
 
 Spec JSON (everything the worker needs to be a bit-identical replica):
 

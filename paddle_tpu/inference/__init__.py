@@ -25,7 +25,7 @@ __all__ = ["Config", "create_predictor", "Predictor", "PredictorPool",
            "Priority", "RequestStatus", "RequestResult", "ServingFleet",
            "RemoteReplica", "FleetAutoscaler", "AutoscalePolicy",
            "BrownoutPolicy", "FaultInjector", "FaultSpec",
-           "RespawnCircuitBreaker"]
+           "RespawnCircuitBreaker", "RequestJournal", "JournalCorruption"]
 
 from .control_plane import (  # noqa: E402
     BrownoutPolicy,
@@ -45,6 +45,7 @@ from .fleet import (  # noqa: E402
     RemoteReplica,
     ServingFleet,
 )
+from .journal import JournalCorruption, RequestJournal  # noqa: E402
 from .metrics import ServingMetrics  # noqa: E402
 from .serving import (  # noqa: E402
     BlockManager,

@@ -70,6 +70,30 @@ stay pure execution loops driven via ``ServingEngine.step()``:
   counters, block-pool utilization) with ``snapshot()`` and a
   Prometheus-text export.
 
+Durability (ISSUE 11).  Pass ``journal=RequestJournal(path)`` and the
+frontend write-ahead-journals the request LIFECYCLE: an ``admit`` record
+(prompt ids, ``SamplingParams`` wire dict, priority/deadline/budget
+fields, idempotency key) lands before the request can reach a replica, a
+``progress`` record at each megastep boundary that harvested tokens, and
+exactly one typed ``terminal`` record from ``_finish``.  What is NOT
+journaled: the tokens.  They don't need to be — greedy decode is
+deterministic and sampled streams depend only on ``(seed, sample
+index)``, so a recovered request re-prefilled from its journaled prompt
+provably reproduces the crash-free token stream.  ``recover(journal,
+engines)`` rebuilds a frontend after a crash: it reaps orphaned
+sequences the dead frontend left on still-live engines/workers
+(``reap_orphans``, over RPC for ``RemoteReplica``), re-admits every
+journaled request without a terminal record as fresh prefill (deadlines
+re-arm with their remaining budget), restores the idempotency map, and
+compacts the journal to a snapshot before serving resumes.
+``submit(..., idempotency_key=...)`` dedupes client retries — including
+retries that straddle the restart — against a bounded terminal-result
+cache, so "exactly one typed terminal status per admitted request"
+survives frontend death plus client redelivery.  Journal I/O faults
+(their ``journal.append``/``journal.fsync`` failpoints included) NEVER
+kill serving: the frontend degrades to non-durable mode and raises the
+``journal_degraded`` gauge loudly instead.
+
 Frontend → fleet → engine split: a replica is anything exposing the
 ServingEngine driving surface — an in-process engine or a
 ``fleet.RemoteReplica`` proxy whose engine lives in a
@@ -85,13 +109,16 @@ placements).
 """
 from __future__ import annotations
 
+import os
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from enum import Enum, IntEnum
 from typing import Callable, Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
+from .journal import ADMIT, PROGRESS, TERMINAL, RequestJournal
 from .metrics import (MEGASTEP_COUNTERS, ServingMetrics,
                       fold_counter_deltas, fold_prefix_counters)
 from .serving import SamplingParams, ServingEngine, prompt_block_hashes
@@ -202,6 +229,8 @@ class _FrontendRequest:
     seq: int                       # FIFO tie-break within a priority class
     sampling: SamplingParams = field(default_factory=SamplingParams)
     on_token: Optional[Callable[[int, int], None]] = None
+    idempotency_key: Optional[str] = None
+    admitted: bool = False         # past admission checks (journaled scope)
     generated: List[int] = field(default_factory=list)
     logprob_values: List[float] = field(default_factory=list)
     preemptions: int = 0
@@ -272,6 +301,9 @@ class ServingFrontend:
                  preemption: bool = True,
                  max_request_retries: int = 3,
                  brownout: Optional[BrownoutPolicy] = None,
+                 journal: Optional[RequestJournal] = None,
+                 journal_compact_every: int = 1024,
+                 idempotency_cache_size: int = 4096,
                  clock: Callable[[], float] = time.monotonic,
                  metrics: Optional[ServingMetrics] = None):
         if isinstance(engines, ServingEngine):
@@ -309,6 +341,54 @@ class ServingFrontend:
         self._next_seq = 0
         self._rr = 0  # round-robin cursor for routing tie-breaks
         self._next_replica_idx = len(self._replicas)
+        # durable control plane (ISSUE 11): write-ahead request journal +
+        # idempotent submission.  The journal (when armed) records the
+        # lifecycle, never the tokens — see the Durability docstring.
+        if isinstance(journal, (str, os.PathLike)):
+            journal = RequestJournal(journal)
+        if journal is not None:
+            # (recover() constructs the frontend journal-less and
+            # attaches the replayed journal afterwards, so this guard
+            # only ever sees the fresh-start path)
+            # arm-time guard: a fresh frontend restarts rids at 0, so
+            # appending into a previous life's journal would merge two
+            # rid generations — a later recover() would then stub live
+            # requests with the old life's terminals (silent loss).  A
+            # journal with history belongs to recover(); a corrupt file
+            # raises loudly here, at operator setup time
+            prev_snap, prev_recs = journal.replay()
+            if prev_snap is not None or prev_recs:
+                raise ValueError(
+                    f"journal {journal.path!r} already holds "
+                    f"{len(prev_recs)} record(s)"
+                    + (" + a snapshot" if prev_snap is not None else "")
+                    + " from a previous frontend life — recover it with "
+                    "ServingFrontend.recover(journal, engines) instead of "
+                    "arming a fresh frontend with it (rid generations "
+                    "would silently merge)")
+        self.journal = journal
+        self.journal_compact_every = int(journal_compact_every)
+        self._journal_degraded = False
+        self._journal_error: Optional[str] = None
+        self._records_since_compact = 0
+        # one step's PROGRESS + in-step TERMINAL records, group-committed
+        # with a single fsync at the end of step() (per-record fsync on
+        # the decode hot path would cost a disk barrier per active or
+        # completing request per megastep).  Safe for terminals because
+        # a result only becomes observable after step() returns, by
+        # which point the batch is flushed; a crash inside the window
+        # just re-executes the request token-identically on recovery.
+        self._step_records: List[Dict] = []
+        self._in_step = False
+        if idempotency_cache_size < 1:
+            raise ValueError("idempotency_cache_size must be >= 1")
+        self.idempotency_cache_size = int(idempotency_cache_size)
+        self._idem_open: Dict[str, int] = {}     # key -> rid, in flight
+        # key -> rid for terminal requests; bounded ring (the "bounded
+        # terminal-result cache" client retries dedupe against)
+        self._idem_done: "OrderedDict[str, int]" = OrderedDict()
+        if journal is not None:
+            self.metrics.set_gauge("journal_degraded", 0.0)
 
     @classmethod
     def from_model(cls, model, num_replicas: int = 1, frontend_kwargs=None,
@@ -373,6 +453,7 @@ class ServingFrontend:
                eos_token_id: Optional[int] = None,
                temperature: float = 0.0, top_k: int = 0,
                top_p: float = 1.0, seed: int = 0, logprobs: bool = False,
+               idempotency_key: Optional[str] = None,
                on_token: Optional[Callable[[int, int], None]] = None) -> int:
         """Enqueue a request; never blocks. Returns a rid whose outcome is
         readable via ``result(rid)`` — immediately for typed rejections
@@ -386,7 +467,29 @@ class ServingFrontend:
         logprobs to the result.  ``on_token(rid, tok)`` is invoked for
         every harvested token in order (in bursts of up to the engine's
         ``megastep_k`` per step); a callback that raises is disabled for
-        that request and counted in ``stream_callback_errors_total``."""
+        that request and counted in ``stream_callback_errors_total``.
+
+        ``idempotency_key`` dedupes client retries: a resubmission whose
+        key matches an in-flight or terminal request returns the ORIGINAL
+        rid (counted in ``idempotent_hits_total``) instead of executing
+        twice — across frontend restarts too, when a journal is armed
+        (keys ride the admit/terminal records).  Only ADMITTED requests
+        claim their key: a typed rejection (OVERLOADED etc.) never
+        executed, so retrying it for real is safe and correct."""
+        if idempotency_key is not None:
+            prev = self._idem_open.get(idempotency_key,
+                                       self._idem_done.get(idempotency_key))
+            if prev is not None:
+                # a reconnecting streaming client gets its NEW callback
+                # attached to the still-open request (future tokens only;
+                # tokens generated before the reconnect are in
+                # result(prev)/the request state once terminal)
+                live = self._requests.get(prev)
+                if (on_token is not None and live is not None
+                        and prev not in self._results):
+                    live.on_token = on_token
+                self.metrics.inc("idempotent_hits_total")
+                return prev
         prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -403,7 +506,8 @@ class ServingFrontend:
             priority=Priority(priority),
             deadline_t=(now + deadline_s) if deadline_s is not None else None,
             eos_token_id=eos_token_id, submit_t=now, seq=self._next_seq,
-            sampling=sampling, on_token=on_token)
+            sampling=sampling, on_token=on_token,
+            idempotency_key=idempotency_key)
         self._next_seq += 1
         self._requests[rid] = req
 
@@ -461,6 +565,12 @@ class ServingFrontend:
         req.counted_tokens = req.total_tokens
         self._class_tokens[req.priority] += req.counted_tokens
         self._queue.append(req)
+        req.admitted = True
+        if idempotency_key is not None:
+            self._idem_open[idempotency_key] = rid
+        # write-ahead: the admit record is durable BEFORE the request can
+        # reach a replica, so a crash after this line cannot lose it
+        self._journal_append(self._admit_record(req))
         self.metrics.inc("admitted_total")
         return rid
 
@@ -519,9 +629,17 @@ class ServingFrontend:
                     begin()
                 except Exception:  # noqa: BLE001 — surfaced by step() below
                     pass
-        for rep in stepping:
-            self._step_replica(rep)
+        self._in_step = True
+        try:
+            for rep in stepping:
+                self._step_replica(rep)
+        finally:
+            self._in_step = False
+            self._flush_step_records()
         self._sample_gauges()
+        if (self._journaling
+                and self._records_since_compact >= self.journal_compact_every):
+            self._compact_journal()
 
     def run(self, max_steps: int = 10_000) -> Dict[int, RequestResult]:
         """Drive ``step()`` until every submitted request has a result.
@@ -566,6 +684,278 @@ class ServingFrontend:
         raise RuntimeError(
             f"ServingFrontend.stream: max_steps={max_steps} exhausted with "
             f"request {rid} still unresolved")
+
+    # ---------------------------------------------------------- durability
+    @property
+    def journal_degraded(self) -> bool:
+        """True when a journal I/O fault forced non-durable serving (the
+        ``journal_degraded`` gauge's backing flag; ``_journal_error``
+        carries the fault)."""
+        return self._journal_degraded
+
+    @property
+    def _journaling(self) -> bool:
+        """The ONE armed-and-healthy check every journal site gates on."""
+        return self.journal is not None and not self._journal_degraded
+
+    def _journal_append(self, rec: Dict) -> None:
+        """Append one lifecycle record; a failing journal DEGRADES the
+        frontend to non-durable serving (loud gauge + counter) — it never
+        kills the data plane."""
+        self._journal_append_batch([rec])
+
+    def _journal_append_batch(self, recs: List[Dict]) -> None:
+        if not self._journaling or not recs:
+            return
+        try:
+            n = self.journal.append_batch(recs)
+        except Exception as e:  # noqa: BLE001 — any I/O fault degrades
+            self._journal_degrade(e)
+            return
+        self._records_since_compact += len(recs)
+        self.metrics.inc("journal_records_total", len(recs))
+        self.metrics.inc("journal_bytes_total", n)
+
+    def _flush_step_records(self):
+        """Group-commit the step's buffered PROGRESS and in-step
+        TERMINAL records: one fsync per control step, not one per
+        active/completing request."""
+        if self._step_records:
+            pending, self._step_records = self._step_records, []
+            self._journal_append_batch(pending)
+
+    def _progress_record(self, req: _FrontendRequest) -> Dict:
+        """Durable mid-flight state: token count (observability), the
+        live retry budget, and the REMAINING deadline — recovery re-arms
+        the SLO clock from the latest of these, not from the admit
+        record's submit-time (near-full) budget."""
+        rec = {"t": PROGRESS, "rid": req.rid, "n": len(req.generated),
+               "attempts": req.attempts}
+        if req.deadline_t is not None:
+            rec["dl"] = req.deadline_t - self._clock()
+        return rec
+
+    def _journal_degrade(self, exc: BaseException):
+        self._journal_degraded = True
+        self._journal_error = repr(exc)
+        self.metrics.inc("journal_errors_total")
+        self.metrics.set_gauge("journal_degraded", 1.0)
+
+    def _admit_record(self, req: _FrontendRequest) -> Dict:
+        """The durable form of one admitted request — everything needed
+        to re-admit it after a crash (prompt, sampling wire dict, class,
+        REMAINING deadline seconds, budget fields, idempotency key).
+        Shared by submit-time journaling and compaction snapshots."""
+        rem = (req.deadline_t - self._clock()
+               if req.deadline_t is not None else None)
+        # "nr" pins the rid high-water mark (typed rejections consume
+        # rids WITHOUT being journaled, so recovery must not re-issue
+        # them to new requests); "attempts" preserves the r10 retry
+        # budget across restarts — a poison request must not get a fresh
+        # budget per frontend life (snapshots re-serialize open requests
+        # through here, so a compacted journal carries the current count)
+        return {"t": ADMIT, "rid": req.rid, "prompt": list(req.prompt),
+                "max_new_tokens": req.max_new_tokens,
+                "priority": int(req.priority),
+                "deadline_s": rem, "eos": req.eos_token_id,
+                "sampling": req.sampling.to_wire(),
+                "key": req.idempotency_key,
+                "attempts": req.attempts, "nr": self._next_rid}
+
+    def _snapshot_state(self) -> Dict:
+        """Compaction snapshot: open admits + the bounded keyed-terminal
+        cache + the rid high-water mark.  Closed unkeyed requests need
+        nothing — their admit+terminal pair cancels out."""
+        open_recs = [self._admit_record(r)
+                     for r in sorted(self._requests.values(),
+                                     key=lambda r: r.rid)
+                     if r.admitted and r.rid not in self._results]
+        done = []
+        for key, rid in self._idem_done.items():
+            res = self._results.get(rid)
+            if res is None:
+                continue
+            done.append({"rid": rid, "key": key, "status": res.status.value,
+                         "n_tokens": len(res.tokens),
+                         "attempts": res.attempts})
+        return {"t": "snapshot", "next_rid": self._next_rid,
+                "open": open_recs, "done": done}
+
+    def _compact_journal(self):
+        try:
+            self.journal.rewrite(self._snapshot_state())
+        except Exception as e:  # noqa: BLE001 — degrade, never crash
+            self._journal_degrade(e)
+            return
+        self._records_since_compact = 0
+        self.metrics.inc("journal_compactions_total")
+
+    @classmethod
+    def recover(cls, journal, engines, *, reap_orphans: bool = True,
+                **kwargs) -> "ServingFrontend":
+        """Rebuild a frontend from a dead one's journal (crash-consistent
+        recovery, ISSUE 11).
+
+        ``journal`` is a :class:`RequestJournal` or a path.  ``engines``
+        are the replicas the recovered frontend serves with — fresh
+        in-process engines, or ``fleet.RemoteReplica`` proxies for
+        workers that OUTLIVED the frontend (discovered via the fleet's
+        KV registry).  Steps:
+
+        1. replay the journal (snapshot + suffix; torn tail tolerated,
+           mid-file corruption raises ``JournalCorruption`` — recovered
+           state over corrupt records would drop or duplicate requests);
+        2. reap orphans: every sequence a still-live engine is running
+           belongs to the dead frontend and is no longer observed —
+           ``reap_orphans()`` evicts them (worker-side over RPC), and
+           re-admission below resumes them under supervision (a replica
+           whose reap fails is marked dead, normal failover scope);
+        3. re-admit every journaled request WITHOUT a terminal record as
+           fresh prefill, original rid/priority/sampling preserved,
+           deadline re-armed with its journaled remaining budget.
+           Greedy determinism + (seed, sample-index) streams make the
+           recovered COMPLETED survivors token-identical to a crash-free
+           run;
+        4. restore the idempotency map (in-flight + bounded terminal
+           cache) so client retries straddling the restart dedupe;
+        5. compact the journal to a snapshot of the recovered state and
+           keep journaling into it.
+
+        Counted in ``recoveries_total`` / ``recovered_requests_total`` /
+        ``orphans_reaped_total`` (the latter only for engines that do
+        not self-report — a RemoteReplica's worker counts its own reap).
+
+        Rid continuity: journaled rids (admitted requests) are never
+        re-issued — every record carries the rid high-water mark ``nr``.
+        Typed REJECTIONS are not journaled, so rids they consumed after
+        the last journaled record may be re-issued by the recovered
+        frontend; rejections resolve synchronously at submit, so clients
+        must not hold their rids across a crash."""
+        if "journal" in kwargs:
+            raise ValueError("recover() owns the journal argument — the "
+                             "replayed journal is reattached after the "
+                             "snapshot rewrite")
+        if isinstance(journal, (str, os.PathLike)):
+            journal = RequestJournal(journal)
+        snapshot, records = journal.replay()
+        admits: Dict[int, Dict] = {}
+        terminals: Dict[int, Dict] = {}
+        attempts: Dict[int, int] = {}
+        deadlines: Dict[int, float] = {}   # latest REMAINING deadline
+        next_rid = 0
+        if snapshot is not None:
+            next_rid = int(snapshot.get("next_rid", 0))
+            for a in snapshot.get("open", ()):
+                admits[int(a["rid"])] = a
+            for t in snapshot.get("done", ()):
+                terminals[int(t["rid"])] = t
+        for rec in records:
+            kind = rec.get("t")
+            if kind == ADMIT:
+                admits[int(rec["rid"])] = rec
+            elif kind == TERMINAL:
+                terminals[int(rec["rid"])] = rec
+            elif kind == PROGRESS:
+                # tokens replay from scratch, but the retry budget and
+                # the SLO clock do not reset: keep the latest journaled
+                # attempts count and remaining deadline
+                attempts[int(rec["rid"])] = int(rec.get("attempts", 0))
+                if "dl" in rec:
+                    deadlines[int(rec["rid"])] = rec["dl"]
+            # every record kind may carry the rid high-water mark "nr"
+            if "nr" in rec:
+                next_rid = max(next_rid, int(rec["nr"]))
+
+        fe = cls(engines, **kwargs)
+        reaped = 0
+        if reap_orphans:
+            for rep in list(fe._replicas):
+                fn = getattr(rep.engine, "reap_orphans", None)
+                if fn is None:
+                    continue
+                try:
+                    n = int(fn())
+                except Exception as e:  # noqa: BLE001 — dead worker
+                    fe._kill_replica(rep, e)
+                    continue
+                # exactly-once counter discipline (same as the prefix/
+                # megastep folds): a RemoteReplica's worker already
+                # counted its reap into its own registry, which the
+                # fleet scrape page exports — only count engines that
+                # do NOT self-report
+                if not getattr(rep.engine, "prefix_counters_self_reported",
+                               False):
+                    reaped += n
+        if reaped:
+            fe.metrics.inc("orphans_reaped_total", reaped)
+
+        all_rids = list(admits) + list(terminals)
+        fe._next_rid = max([next_rid] + [r + 1 for r in all_rids], default=0)
+        now = fe._clock()
+        # terminal stubs: result(rid) keeps answering for requests that
+        # closed before the crash (status is authoritative; tokens were
+        # delivered pre-crash and are not journaled)
+        for rid, t in sorted(terminals.items()):
+            stub = _FrontendRequest(
+                rid=rid, prompt=[], max_new_tokens=0,
+                priority=Priority.NORMAL, deadline_t=None,
+                eos_token_id=None, submit_t=now, seq=fe._next_seq,
+                idempotency_key=t.get("key"))
+            fe._next_seq += 1
+            fe._requests[rid] = stub
+            fe._results[rid] = RequestResult(
+                rid=rid, status=RequestStatus(t["status"]), tokens=[],
+                detail="recovered terminal from journal (tokens are not "
+                       "journaled; if this result was never delivered "
+                       "before the crash, resubmit WITHOUT the "
+                       "idempotency key — greedy/seeded decode "
+                       "re-executes token-identically)",
+                attempts=int(t.get("attempts", 0)))
+            if t.get("key") is not None:
+                fe._idem_done[t["key"]] = rid
+        while len(fe._idem_done) > fe.idempotency_cache_size:
+            fe._idem_done.popitem(last=False)
+        # re-admit the open requests as fresh prefill, rid order (oldest
+        # first keeps their original relative FIFO position per class)
+        recovered = 0
+        for rid, a in sorted(admits.items()):
+            if rid in terminals:
+                continue
+            # SLO clock: the latest progress record's remaining deadline
+            # beats the admit record's submit-time (near-full) budget —
+            # a request that was 1 s from its deadline at the crash must
+            # not get its whole window back
+            rem = deadlines.get(rid, a.get("deadline_s"))
+            req = _FrontendRequest(
+                rid=rid, prompt=[int(x) for x in a["prompt"]],
+                max_new_tokens=int(a["max_new_tokens"]),
+                priority=Priority(int(a["priority"])),
+                deadline_t=(now + rem) if rem is not None else None,
+                eos_token_id=a.get("eos"), submit_t=now, seq=fe._next_seq,
+                sampling=SamplingParams.coerce(a.get("sampling")),
+                idempotency_key=a.get("key"))
+            fe._next_seq += 1
+            # retry budget survives the restart: the admit record (or a
+            # compaction snapshot) carries the count at write time, and
+            # progress records carry the live value — take the max
+            req.attempts = max(int(a.get("attempts", 0)),
+                               attempts.get(rid, 0))
+            req.admitted = True
+            req.counted_tokens = req.total_tokens
+            fe._class_tokens[req.priority] += req.counted_tokens
+            fe._requests[rid] = req
+            fe._queue.append(req)
+            if req.idempotency_key is not None:
+                fe._idem_open[req.idempotency_key] = rid
+            recovered += 1
+        fe.metrics.inc("recoveries_total")
+        fe.metrics.inc("recovered_requests_total", recovered)
+        # the recovered state becomes the journal's snapshot; from here
+        # the frontend journals into it like any fresh one
+        fe.journal = journal
+        fe.metrics.set_gauge("journal_degraded", 0.0)
+        fe._compact_journal()
+        return fe
 
     # ------------------------------------------------------------ internals
     @property
@@ -843,6 +1233,13 @@ class ServingFrontend:
         self.metrics.inc("engine_steps_total")
         lp_fn = getattr(rep.engine, "pop_token_logprobs", None)
         lps = lp_fn() if lp_fn is not None else {}
+        if getattr(rep.engine, "capture_sample_probs", False):
+            # the frontend has no per-token consumer for the [V]-sized
+            # distributions — drain them so a capture-enabled engine
+            # driven by a long-lived frontend doesn't accumulate one
+            # array per emitted token forever (spec-decode verifiers
+            # harvest by driving the engine directly)
+            rep.engine.pop_sample_probs()
         t = self._clock()
         for erid, toks in emitted.items():
             req = rep.requests.get(erid)
@@ -873,6 +1270,13 @@ class ServingFrontend:
                     req.on_token = None
                     self.metrics.inc("stream_callback_errors_total")
             self.metrics.note_tokens(len(toks), t)
+            if req.admitted and self._journaling:
+                # megastep-boundary progress marker, group-committed at
+                # the end of this step(): observability, the live retry-
+                # budget count, and the REMAINING deadline (recovery
+                # re-prefills from the prompt — tokens replay — but
+                # attempts and the SLO clock must survive the crash)
+                self._step_records.append(self._progress_record(req))
         for erid in rep.engine.pop_finished():
             req = rep.requests.pop(erid, None)
             if req is None:
@@ -910,6 +1314,11 @@ class ServingFrontend:
                 f"{rep.last_error}")
             return
         self._queue.append(req)
+        # make the bumped retry budget durable NOW (not batched) — a
+        # crash before the request's next harvested token would
+        # otherwise hand a poison request a fresh budget on recovery
+        if req.admitted:
+            self._journal_append(self._progress_record(req))
         self.metrics.inc("requeued_on_failover_total")
         self.metrics.inc("requests_retried_total")
 
@@ -938,6 +1347,28 @@ class ServingFrontend:
         if req.counted_tokens:
             self._class_tokens[req.priority] -= req.counted_tokens
             req.counted_tokens = 0
+        if req.admitted:
+            # exactly one typed terminal record per admitted rid (the
+            # first-terminal-wins guard above makes this exact); tokens
+            # ride only as a count — they replay, they are not journaled.
+            # In-step completions ride the step's group commit (durable
+            # before the result is observable — step() flushes before
+            # returning); out-of-step finishes (cancel, shed at submit
+            # time) append immediately
+            rec = {"t": TERMINAL, "rid": req.rid, "status": status.value,
+                   "n_tokens": len(req.generated), "attempts": req.attempts,
+                   "key": req.idempotency_key, "nr": self._next_rid}
+            if self._in_step and self._journaling:
+                self._step_records.append(rec)
+            else:
+                self._journal_append(rec)
+        if req.idempotency_key is not None and req.admitted:
+            # only ADMITTED requests claim their key (a typed rejection
+            # never executed, so a client retry must re-attempt for real)
+            self._idem_open.pop(req.idempotency_key, None)
+            self._idem_done[req.idempotency_key] = req.rid
+            while len(self._idem_done) > self.idempotency_cache_size:
+                self._idem_done.popitem(last=False)
         self.metrics.inc(_STATUS_COUNTER[status])
         if status is RequestStatus.COMPLETED:
             self.metrics.observe("e2e_latency_seconds", res.e2e_s)

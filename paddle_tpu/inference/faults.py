@@ -49,10 +49,47 @@ from typing import Callable, Dict, List, Optional, Tuple, Union
 __all__ = [
     "FaultSpec", "FaultInjector", "InjectedFault", "InjectedTimeout",
     "InjectedDrop", "RespawnCircuitBreaker", "FaultyReplica",
-    "FAULTS_ENV_VAR",
+    "FAULTS_ENV_VAR", "KNOWN_SITES", "register_failpoint",
 ]
 
 FAULTS_ENV_VAR = "PADDLE_TPU_FAULTS"
+
+# Every failpoint site production code traverses.  FaultInjector
+# VALIDATES armed site names against this registry at construction time
+# (ISSUE 11 satellite): a typo'd site in PADDLE_TPU_FAULTS or a chaos
+# schedule used to arm successfully and then never fire — a chaos run
+# that silently degraded to calm.  New instrumented components extend
+# the registry with ``register_failpoint`` next to the code that fires
+# the site, so the two lists cannot drift apart.
+KNOWN_SITES = {
+    "engine.step",        # ServingEngine.step scheduling boundary
+    "engine.megastep",    # batched K-token decode launch
+    "engine.add_request",  # FaultyReplica admission path
+    "engine.evict",       # FaultyReplica eviction path
+    "rpc.send",           # distributed/rpc._post transport
+    "health.probe",       # worker-side _w_health handler
+    "fleet.spawn",        # ServingFleet worker registration wait
+    "fleet.heartbeat",    # fleet-side heartbeat loop
+    "journal.append",     # request-journal record write (ISSUE 11)
+    "journal.fsync",      # request-journal durability barrier
+}
+# FaultyReplica also fires replica-scoped sites "<replica name>.<op>"
+# (so a schedule can doom one replica); any prefix is legal for these
+# ops, the op suffix is what gets validated.  KNOWN CAVEAT: this escape
+# hatch means a typo in the NAMESPACE of a registered site whose op
+# suffix is also a replica op ("enigne.step") still arms silently as a
+# replica-scoped site — only suffix typos ("engine.stpe") are caught.
+# Replica names in this repo's chaos schedules are "r<N>"; keep custom
+# replica names visually distinct from the registry namespaces.
+_REPLICA_OPS = {"step", "add_request", "evict"}
+
+
+def register_failpoint(site: str) -> str:
+    """Add ``site`` to the known-site registry (call next to the code
+    that fires it).  Returns the name so registration can double as the
+    site constant: ``MY_SITE = register_failpoint("cache.flush")``."""
+    KNOWN_SITES.add(site)
+    return site
 
 
 class InjectedFault(RuntimeError):
@@ -115,6 +152,8 @@ class FaultInjector:
                  seed: int = 0, sleep: Callable[[float], None] = time.sleep):
         self.seed = int(seed)
         self._sleep = sleep
+        for site in (sites or {}):
+            self._validate_site(site)
         self._specs: Dict[str, FaultSpec] = {
             site: spec if isinstance(spec, FaultSpec) else FaultSpec(**spec)
             for site, spec in (sites or {}).items()}
@@ -126,6 +165,23 @@ class FaultInjector:
         self._traversals: Dict[str, int] = {}
         self._fires: Dict[str, int] = {}
         self.log: List[Tuple[str, str, str]] = []  # (site, kind, detail)
+
+    @staticmethod
+    def _validate_site(site: str):
+        """Arm-time check against the known-site registry: a site no
+        production code fires would otherwise arm fine and never fire —
+        a chaos schedule (or PADDLE_TPU_FAULTS) silently degrading to
+        calm.  Both the constructor and the env-JSON path funnel here."""
+        if site in KNOWN_SITES:
+            return
+        if "." in site and site.rsplit(".", 1)[1] in _REPLICA_OPS:
+            return                 # replica-scoped "<name>.<op>" site
+        raise ValueError(
+            f"unknown failpoint site {site!r}: nothing fires it, so the "
+            "spec would never trigger. Known sites: "
+            f"{sorted(KNOWN_SITES)}; replica-scoped sites end in one of "
+            f"{sorted(_REPLICA_OPS)}. New production sites register via "
+            "faults.register_failpoint")
 
     @classmethod
     def from_env(cls, var: str = FAULTS_ENV_VAR) -> Optional["FaultInjector"]:

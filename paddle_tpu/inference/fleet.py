@@ -63,6 +63,16 @@ scale-up, so a crash-looping worker config backs off exponentially
 ``fleet.heartbeat`` failpoints (``inference/faults.py``) let the chaos
 soak drive all of it deterministically.
 
+Durability (ISSUE 11): workers are separate processes, so they OUTLIVE
+a crashed frontend.  Arm the frontend with a write-ahead journal
+(``frontend_kwargs={"journal": path}``); after a frontend death, a new
+process reattaches — ``discover_workers(master_endpoint)`` lists the
+still-registered workers (external KV master), ``RemoteReplica`` each,
+and ``ServingFrontend.recover(journal, replicas)`` reaps the orphaned
+sequences worker-side (``_w_reap_orphans`` RPC; eviction publishes
+their full KV blocks, so the recovered re-prefill largely hits the
+prefix cache on the same worker) and re-admits from the journal.
+
 Scope note: each worker is still one host / one engine; true multi-host
 TPU meshes *per replica* (a sharded engine spanning hosts) remain open.
 """
@@ -77,7 +87,7 @@ import threading
 import time
 from collections import OrderedDict
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from .control_plane import ServingFrontend
 from .faults import FaultInjector, RespawnCircuitBreaker
@@ -85,7 +95,33 @@ from .metrics import (MEGASTEP_COUNTERS, ServingMetrics,
                       fold_counter_deltas, fold_prefix_counters)
 
 __all__ = ["RemoteReplica", "ServingFleet", "FleetAutoscaler",
-           "AutoscalePolicy", "init_worker"]
+           "AutoscalePolicy", "init_worker", "discover_workers"]
+
+
+def discover_workers(master_endpoint: str,
+                     exclude: Sequence[str] = ("fleet-frontend",)
+                     ) -> List[str]:
+    """Worker names currently registered with the launch KV master —
+    what a RESTARTED frontend reattaches to (ISSUE 11 recovery): workers
+    are separate processes and outlive a crashed frontend, so recovery
+    is ``[RemoteReplica(n) for n in discover_workers(ep)]`` (after
+    ``rpc.init_rpc``/``refresh_workers``) handed to
+    ``ServingFrontend.recover``, which reaps their orphaned sequences
+    and re-admits from the journal.  Requires an external KV master (the
+    production shape); a fleet that started its OWN in-process KVServer
+    took the registry down with it.
+
+    ``exclude`` filters non-worker registrations: the rpc layer
+    registers EVERY participant under ``/rpc/workers/``, including the
+    frontend itself (``ServingFleet`` registers as ``fleet-frontend``) —
+    and a SIGKILLed frontend never deregisters, so its stale entry would
+    otherwise come back as a bogus "worker".  Pass the recovering
+    process's own rpc name too if it differs."""
+    from ..distributed.launch.master import KVClient
+
+    entries = KVClient(master_endpoint).get_prefix("/rpc/workers/")
+    names = (k.rsplit("/", 1)[-1] for k in entries)
+    return sorted(n for n in names if n not in set(exclude))
 
 
 class _BoundedErrors(OrderedDict):
@@ -172,6 +208,11 @@ def _w_step():
     finished = eng.pop_finished()
     lp_fn = getattr(eng, "pop_token_logprobs", None)
     logprobs = lp_fn() if lp_fn is not None else {}
+    if getattr(eng, "capture_sample_probs", False):
+        # same drain the frontend does for in-process engines: nothing
+        # ships the [V]-sized distributions over RPC, so a capture-
+        # enabled worker spec must not accumulate them forever
+        eng.pop_sample_probs()
     m = _WORKER["metrics"]
     m.inc("engine_steps_total")
     n_tok = sum(len(t) for t in emitted.values())
@@ -202,6 +243,20 @@ def _w_evict(rid):
     eng = _engine()
     eng.evict(rid)
     return eng.state_summary()
+
+
+def _w_reap_orphans():
+    """Evict every queued/active sequence on this worker — the recovery
+    hook (ISSUE 11) a RESTARTED frontend calls when it reattaches: the
+    worker outlived the dead frontend, so whatever it is running belongs
+    to nobody and would otherwise decode unobserved forever.  The
+    recovered frontend re-admits the journaled requests afterwards (and
+    with the prefix cache on, eviction published their full blocks, so
+    the re-prefill largely hits cache on this same worker)."""
+    eng = _engine()
+    n = eng.reap_orphans()
+    _WORKER["metrics"].inc("orphans_reaped_total", n)
+    return n, eng.state_summary()
 
 
 def _w_health(include_samples: bool = False):
@@ -394,6 +449,18 @@ class RemoteReplica:
     def evict(self, rid: int):
         st = self._call(_w_evict, rid)
         self._apply_state(st)
+
+    def reap_orphans(self) -> int:
+        """Evict every sequence the worker is running (crash recovery:
+        the worker outlived its frontend and those sequences are
+        orphans); returns the count.  ``ServingFrontend.recover`` calls
+        this on every still-live replica before re-admitting from the
+        journal."""
+        n, st = self._call(_w_reap_orphans)
+        self._apply_state(st)
+        self._finished.clear()
+        self._logprobs.clear()
+        return int(n)
 
     # --------------------------------------------------- fleet-layer extras
     def health(self, include_samples: bool = False,

@@ -52,6 +52,12 @@ COUNTERS = (
     # plus streaming-callback faults the step loop absorbed
     "megasteps_total", "megastep_tokens_total",
     "stream_callback_errors_total",
+    # durable control plane (ISSUE 11): write-ahead request journal,
+    # crash recovery, idempotent submission
+    "journal_records_total", "journal_bytes_total",
+    "journal_compactions_total", "journal_errors_total",
+    "recoveries_total", "recovered_requests_total",
+    "orphans_reaped_total", "idempotent_hits_total",
 )
 GAUGES = (
     "queue_depth", "queue_depth_peak", "running_requests", "replicas_alive",
@@ -59,6 +65,10 @@ GAUGES = (
     "block_pool_utilization_peak", "prefix_cache_hit_rate",
     # 0/1/2 brownout level and 0 / 0.5 / 1 breaker state (closed/half/open)
     "degraded_mode", "respawn_breaker_open",
+    # 1 when a journal-armed frontend hit a journal I/O fault and fell
+    # back to NON-DURABLE serving (the loud flag ops alert on: requests
+    # keep flowing but a crash now loses them)
+    "journal_degraded",
 )
 SAMPLES = ("ttft_seconds", "token_latency_seconds", "e2e_latency_seconds")
 
@@ -249,7 +259,8 @@ class ServingMetrics:
         gauges: Dict[str, float] = {}
         # level/state gauges are ordinal, not additive: two replicas at
         # brownout level 1 are NOT a fleet at level 2
-        _maxed = ("degraded_mode", "respawn_breaker_open")
+        _maxed = ("degraded_mode", "respawn_breaker_open",
+                  "journal_degraded")
         for s in snaps:
             for k, v in (s.get("gauges") or {}).items():
                 if k.endswith("_peak") or k in _maxed:

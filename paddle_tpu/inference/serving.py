@@ -142,7 +142,8 @@ class SamplingParams:
                 "logprobs": self.logprobs}
 
 
-def _sample_tokens(logits, temps, top_ks, top_ps, seeds, sample_pos):
+def _sample_tokens(logits, temps, top_ks, top_ps, seeds, sample_pos,
+                   return_probs: bool = False):
     """In-graph next-token selection for one batch of logits rows [B, V].
 
     Greedy rows (``temps <= 0``) take the exact float32 argmax the engine
@@ -152,13 +153,21 @@ def _sample_tokens(logits, temps, top_ks, top_ps, seeds, sample_pos):
     sample_pos)``.  A ``lax.cond`` skips the two [B, V] sorts entirely
     when the whole batch is greedy, so the default serving path pays
     nothing for the sampling machinery.  Returns (next_token [B] int32,
-    raw-logit logprob of that token [B] float32)."""
+    raw-logit logprob of that token [B] float32).
+
+    ``return_probs=True`` (ISSUE 11 satellite; trace-time constant)
+    additionally returns the renormalized POST-top-k/top-p distribution
+    the token was actually drawn from, [B, V] float32 — a one-hot at the
+    argmax for greedy rows — which is exactly the q(x) a speculative-
+    decode verifier needs.  The drawn token is bit-identical either way
+    (same filtered logits, same key; categorical is shift-invariant),
+    but the probs path always computes the filter, so the all-greedy
+    sort skip is forfeited — keep it off for plain serving."""
     lg = logits.astype(jnp.float32)
     B, V = lg.shape
     greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
 
-    def _sampled(_):
-        scaled = lg / jnp.maximum(temps, 1e-6)[:, None]
+    def _filtered(scaled):
         srt = jnp.sort(scaled, axis=-1)[:, ::-1]            # descending
         kth = jnp.take_along_axis(
             srt, jnp.clip(top_ks - 1, 0, V - 1)[:, None], axis=-1)
@@ -171,18 +180,33 @@ def _sample_tokens(logits, temps, top_ks, top_ps, seeds, sample_pos):
         cutoff = jnp.take_along_axis(probs_srt, first[:, None], axis=-1)
         probs = jax.nn.softmax(scaled, axis=-1)
         keep_p = (top_ps[:, None] >= 1.0) | (probs >= cutoff)
-        filt = jnp.where(keep_k & keep_p, scaled, -jnp.inf)
+        return jnp.where(keep_k & keep_p, scaled, -jnp.inf)
+
+    def _draw(filt):
         keys = jax.vmap(
             lambda s, p: jax.random.fold_in(jax.random.PRNGKey(s), p)
         )(seeds, sample_pos)
         return jax.vmap(jax.random.categorical)(keys, filt).astype(jnp.int32)
 
+    if return_probs:
+        filt = _filtered(lg / jnp.maximum(temps, 1e-6)[:, None])
+        nxt = jnp.where(temps <= 0.0, greedy, _draw(filt)).astype(jnp.int32)
+        sample_probs = jnp.where(
+            (temps <= 0.0)[:, None],
+            jax.nn.one_hot(greedy, V, dtype=jnp.float32),
+            jax.nn.softmax(filt, axis=-1))
+        logprob = jnp.take_along_axis(jax.nn.log_softmax(lg, axis=-1),
+                                      nxt[:, None], axis=-1)[:, 0]
+        return nxt, logprob, sample_probs
+
     drawn = jax.lax.cond(jnp.all(temps <= 0.0), lambda _: greedy,
-                         _sampled, None)
+                         lambda _: _draw(
+                             _filtered(lg / jnp.maximum(temps, 1e-6)[:, None])),
+                         None)
     nxt = jnp.where(temps <= 0.0, greedy, drawn).astype(jnp.int32)
     logprob = jnp.take_along_axis(jax.nn.log_softmax(lg, axis=-1),
                                   nxt[:, None], axis=-1)[:, 0]
-    return nxt, logprob
+    return nxt, logprob, None
 
 
 def prefix_block_hash(parent: Optional[str], tokens: Sequence[int]) -> str:
@@ -397,7 +421,8 @@ class ServingEngine:
                  block_size: int = 16, token_budget: int = 32,
                  num_blocks: Optional[int] = None, cache_dtype=None,
                  cache_quant: str = "none", prefix_cache="auto",
-                 megastep_k: int = 8, fault_injector=None):
+                 megastep_k: int = 8, fault_injector=None,
+                 capture_sample_probs: bool = False):
         from .faults import FaultInjector
 
         # seeded failpoint registry (faults.py): the 'engine.step' site
@@ -469,10 +494,17 @@ class ServingEngine:
             self.cache_scales = None
         self.block_tables = np.full((self.B, self.P), -1, np.int32)
 
+        # capture the renormalized post-top-k/top-p distribution each
+        # drawn token was sampled from (ISSUE 11 satellite — speculative-
+        # decode verification needs q(x), not just the drawn token);
+        # engine-local debug/verification knob: costs the [B,V] filter
+        # even for greedy batches and is not mirrored over fleet RPC
+        self.capture_sample_probs = bool(capture_sample_probs)
         self._queue: List[ServingRequest] = []
         self._active: Dict[int, ServingRequest] = {}
         self._finished: Dict[int, List[int]] = {}
         self._emitted_logprobs: Dict[int, List[float]] = {}
+        self._emitted_sample_probs: Dict[int, List[np.ndarray]] = {}
         self._next_rid = 0
         self._free_slots = list(range(self.B - 1, -1, -1))
         # megastep decode: K compiled decode iterations per host round
@@ -596,6 +628,7 @@ class ServingEngine:
 
     def _build_step(self):
         fwd = self._forward
+        with_probs = self.capture_sample_probs
 
         def step(weights, key_caches, value_caches, rope, token_ids,
                  enc, dec, now, cu, bt, temps, top_ks, top_ps, seeds,
@@ -603,9 +636,10 @@ class ServingEngine:
             logits, kcs, vcs, new_scales = fwd(
                 weights, key_caches, value_caches, rope, token_ids, enc,
                 dec, now, cu, bt, mq, scales)
-            nxt, logprob = _sample_tokens(logits, temps, top_ks, top_ps,
-                                          seeds, sample_pos)
-            return nxt, logprob, kcs, vcs, new_scales
+            nxt, logprob, probs = _sample_tokens(
+                logits, temps, top_ks, top_ps, seeds, sample_pos,
+                return_probs=with_probs)
+            return nxt, logprob, probs, kcs, vcs, new_scales
 
         return jax.jit(step, donate_argnums=(1, 2), static_argnames=("mq",))
 
@@ -620,6 +654,7 @@ class ServingEngine:
         Rows with ``now=0`` (empty batch slots) never write at all."""
         fwd = self._forward
         B = self.B
+        with_probs = self.capture_sample_probs
 
         def mega(weights, key_caches, value_caches, rope, toks, dec, now,
                  cu, occ_idx, bt, active, remaining, eos, temps, top_ks,
@@ -631,8 +666,9 @@ class ServingEngine:
                 packed = toks[occ_idx]    # slot-order -> packed layout
                 logits, kcs, vcs, _ = fwd(weights, kcs, vcs, rope, packed,
                                           enc, dec, now, cu, bt, 1, None)
-                nxt, lps = _sample_tokens(logits, temps, top_ks, top_ps,
-                                          seeds, sample_pos)
+                nxt, lps, probs = _sample_tokens(
+                    logits, temps, top_ks, top_ps, seeds, sample_pos,
+                    return_probs=with_probs)
                 valid = active
                 fin = (nxt == eos) | (remaining <= 1)
                 nxt_active = active & jnp.logical_not(fin)
@@ -643,13 +679,13 @@ class ServingEngine:
                 remaining = remaining - active.astype(jnp.int32)
                 sample_pos = sample_pos + active.astype(jnp.int32)
                 return ((toks, kcs, vcs, dec, nxt_active, remaining,
-                         sample_pos), (nxt, valid, lps))
+                         sample_pos), (nxt, valid, lps, probs))
 
             carry0 = (toks, key_caches, value_caches, dec, active,
                       remaining, sample_pos)
-            carry, (toks_o, valid_o, lps_o) = jax.lax.scan(
+            carry, (toks_o, valid_o, lps_o, probs_o) = jax.lax.scan(
                 body, carry0, None, length=K)
-            return carry[1], carry[2], toks_o, valid_o, lps_o
+            return carry[1], carry[2], toks_o, valid_o, lps_o, probs_o
 
         return jax.jit(mega, static_argnames=("K",), donate_argnums=(1, 2))
 
@@ -880,6 +916,40 @@ class ServingEngine:
         self._emitted_logprobs = {}
         return out
 
+    def pop_sample_probs(self) -> Dict[int, List[np.ndarray]]:
+        """Drain the renormalized post-top-k/top-p distributions each
+        emitted token was drawn from (``capture_sample_probs=True``
+        engines only) — {rid: [float32 [V], ...]} aligned 1:1 with the
+        token lists ``step()`` emitted over the same window; greedy rows
+        report a one-hot at the argmax.  This is the q(x) a speculative-
+        decode verifier scores draft tokens against (ROADMAP item 2);
+        harvested exactly like ``pop_token_logprobs``.  NB a
+        ``ServingFrontend`` driving this engine drains (and discards)
+        the buffer every step — it has no per-token consumer for [V]
+        arrays and must not leak them — so verifiers harvest by driving
+        the engine directly."""
+        out = self._emitted_sample_probs
+        self._emitted_sample_probs = {}
+        return out
+
+    def reap_orphans(self) -> int:
+        """Evict EVERY queued and active request and drop any unharvested
+        finished/logprob state; returns how many sequences were reaped.
+
+        The crash-recovery hook (ISSUE 11): a restarted frontend
+        reattaching to a still-live engine/worker must not leave the dead
+        frontend's sequences decoding unobserved forever — recovery reaps
+        them and re-admits from the journal (with the prefix cache on,
+        the reaped requests' full blocks were published on eviction, so
+        the re-prefill largely hits cache)."""
+        rids = [q.rid for q in self._queue] + list(self._active)
+        for rid in rids:
+            self.evict(rid)
+        self._finished.clear()
+        self._emitted_logprobs.clear()
+        self._emitted_sample_probs.clear()
+        return len(rids)
+
     @staticmethod
     def _fill_sampling(req: ServingRequest, slot: int, temps, top_ks,
                        top_ps, seeds, spos):
@@ -985,7 +1055,7 @@ class ServingEngine:
             cu[slot + 1] = pos
 
         had_cache = self._step_fn._cache_size() if hasattr(self._step_fn, "_cache_size") else None
-        nxt, lps, self.key_caches, self.value_caches, new_scales = \
+        nxt, lps, probs, self.key_caches, self.value_caches, new_scales = \
             self._step_fn(
                 self._weights, self.key_caches, self.value_caches,
                 self._rope, jnp.asarray(tokens), jnp.asarray(enc),
@@ -1000,6 +1070,7 @@ class ServingEngine:
             self.compile_count += self._step_fn._cache_size() - had_cache
         nxt = np.asarray(nxt)
         lps = np.asarray(lps)
+        probs = np.asarray(probs) if probs is not None else None
 
         emitted: Dict[int, List[int]] = {}
         for req, n, finishes in sched:
@@ -1013,6 +1084,12 @@ class ServingEngine:
                 req.logprob_values.append(float(lps[req.slot]))
                 self._emitted_logprobs.setdefault(req.rid, []).append(
                     float(lps[req.slot]))
+            if probs is not None:
+                # .copy(): probs[slot] is a view pinning the whole [B,V]
+                # step array alive (the megastep path's fancy-indexing
+                # already copies)
+                self._emitted_sample_probs.setdefault(req.rid, []).append(
+                    probs[req.slot].copy())
             emitted.setdefault(req.rid, []).append(tok)
             hit_eos = (req.eos_token_id is not None and tok == req.eos_token_id)
             if hit_eos or len(req.generated) >= req.max_new_tokens:
@@ -1076,7 +1153,7 @@ class ServingEngine:
             self._mega_fn = self._build_megastep()
         had = (self._mega_fn._cache_size()
                if hasattr(self._mega_fn, "_cache_size") else None)
-        kcs, vcs, toks_o, valid_o, lps_o = self._mega_fn(
+        kcs, vcs, toks_o, valid_o, lps_o, probs_o = self._mega_fn(
             self._weights, self.key_caches, self.value_caches, self._rope,
             jnp.asarray(toks), jnp.asarray(dec), jnp.asarray(now),
             jnp.asarray(cu), jnp.asarray(occ_idx),
@@ -1090,6 +1167,7 @@ class ServingEngine:
         toks_o = np.asarray(toks_o)       # [K, B]
         valid_o = np.asarray(valid_o)
         lps_o = np.asarray(lps_o)
+        probs_o = np.asarray(probs_o) if probs_o is not None else None
         self.megasteps += 1
 
         emitted: Dict[int, List[int]] = {}
@@ -1102,6 +1180,9 @@ class ServingEngine:
                 row_lps = [float(v) for v in lps_o[:, s][col]]
                 req.logprob_values.extend(row_lps)
                 self._emitted_logprobs.setdefault(req.rid, []).extend(row_lps)
+            if probs_o is not None and new:
+                self._emitted_sample_probs.setdefault(req.rid, []).extend(
+                    probs_o[:, s][col])   # [n_valid, V]
             emitted[req.rid] = new
             self.megastep_tokens += len(new)
             hit_eos = (req.eos_token_id is not None and new

@@ -87,6 +87,30 @@ class TestChaosSoak:
         assert a == b
 
 
+class TestKillFrontend:
+    def test_sigkill_recover_idempotent_replay(self):
+        """Durable-control-plane soak (ISSUE 11 acceptance): the serve
+        phase SIGKILLs itself mid-soak (a true crash — nothing flushes),
+        the parent recovers from the write-ahead journal and replays the
+        client with the original idempotency keys.  The harness asserts
+        exactly-one-typed-terminal per admitted request, zero duplicate
+        executions under retry, COMPLETED survivors (greedy AND seeded
+        non-greedy) token-identical to a crash-free same-seed run, and
+        that journal failpoints degrade serving instead of crashing it."""
+        import chaos_serving
+
+        report = chaos_serving.run_kill_frontend(seed=7, num_requests=16,
+                                                 kill_after=5)
+        assert report["terminal_before_kill"] >= 5
+        assert report["recovered_requests"] == 16 - report[
+            "terminal_before_kill"]
+        assert report["idempotent_hits"] == 16
+        assert report["exactly_one_terminal_per_admit"]
+        assert report["survivors_token_identical"]
+        assert report["sampled_survivors_token_identical"] >= 1
+        assert report["journal_fault_degrades_not_crashes"]
+
+
 class TestChaosFleet:
     def test_fleet_chaos_with_real_workers(self):
         """Fleet-level variant: real worker processes, failpoints armed

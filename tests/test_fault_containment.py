@@ -89,26 +89,28 @@ class FakeClock:
 # ---------------------------------------------------------------- injector
 class TestFaultInjector:
     def test_unarmed_site_is_free_and_false(self):
-        inj = FaultInjector({"a": {"kind": "error"}})
-        assert inj.fire("not.armed") is False
+        inj = FaultInjector({"engine.step": {"kind": "error"}})
+        assert inj.fire("rpc.send") is False
         assert inj.total_fires == 0
 
     def test_after_times_and_counts(self):
-        inj = FaultInjector({"s": {"kind": "error", "after": 2, "times": 2}})
-        assert inj.fire("s") is False and inj.fire("s") is False
+        inj = FaultInjector(
+            {"r0.step": {"kind": "error", "after": 2, "times": 2}})
+        assert inj.fire("r0.step") is False and inj.fire("r0.step") is False
         for _ in range(2):
-            with pytest.raises(InjectedFault, match="failpoint 's'"):
-                inj.fire("s")
-        assert inj.fire("s") is False      # budget spent
-        assert inj.fires("s") == 2 and inj.kinds_fired() == ["error"]
+            with pytest.raises(InjectedFault, match="failpoint 'r0.step'"):
+                inj.fire("r0.step")
+        assert inj.fire("r0.step") is False      # budget spent
+        assert inj.fires("r0.step") == 2 and inj.kinds_fired() == ["error"]
 
     def test_seeded_probability_deterministic_per_site(self):
         def schedule(seed):
-            inj = FaultInjector({"x": {"kind": "error", "p": 0.3}}, seed=seed)
+            inj = FaultInjector({"rpc.send": {"kind": "error", "p": 0.3}},
+                                seed=seed)
             out = []
             for _ in range(64):
                 try:
-                    inj.fire("x")
+                    inj.fire("rpc.send")
                     out.append(0)
                 except InjectedFault:
                     out.append(1)
@@ -119,8 +121,8 @@ class TestFaultInjector:
         assert 0 < sum(schedule(7)) < 64
 
     def test_sites_independent_of_interleaving(self):
-        spec = {"a": {"kind": "error", "p": 0.5},
-                "b": {"kind": "error", "p": 0.5}}
+        spec = {"ra.step": {"kind": "error", "p": 0.5},
+                "rb.step": {"kind": "error", "p": 0.5}}
 
         def fires_of_a(interleave_b):
             inj = FaultInjector(spec, seed=3)
@@ -128,11 +130,11 @@ class TestFaultInjector:
             for _ in range(32):
                 if interleave_b:
                     try:
-                        inj.fire("b")
+                        inj.fire("rb.step")
                     except InjectedFault:
                         pass
                 try:
-                    inj.fire("a")
+                    inj.fire("ra.step")
                     out.append(0)
                 except InjectedFault:
                     out.append(1)
@@ -156,15 +158,16 @@ class TestFaultInjector:
         class TypedTO(TimeoutError):
             pass
 
-        inj = FaultInjector({"t": {"kind": "timeout"}, "d": {"kind": "drop"},
-                             "w": {"kind": "delay", "delay_s": 0.0}})
+        inj = FaultInjector(
+            {"rpc.send": {"kind": "timeout"}, "health.probe": {"kind": "drop"},
+             "fleet.spawn": {"kind": "delay", "delay_s": 0.0}})
         with pytest.raises(TypedTO):
-            inj.fire("t", timeout_exc=TypedTO)
+            inj.fire("rpc.send", timeout_exc=TypedTO)
         with pytest.raises(InjectedTimeout):
-            inj.fire("t")
+            inj.fire("rpc.send")
         with pytest.raises(InjectedDrop):
-            inj.fire("d")
-        assert inj.fire("w") is True
+            inj.fire("health.probe")
+        assert inj.fire("fleet.spawn") is True
         assert sorted(inj.kinds_fired()) == ["delay", "drop", "timeout"]
 
     def test_env_activation_round_trip(self, monkeypatch):
@@ -182,6 +185,39 @@ class TestFaultInjector:
             FaultSpec(kind="explode")
         with pytest.raises(ValueError, match="p must be"):
             FaultSpec(kind="error", p=1.5)
+
+    def test_unknown_site_rejected_at_arm_time(self):
+        # a typo'd site used to arm fine and then never fire — a chaos
+        # schedule silently degrading to calm (ISSUE 11 satellite)
+        with pytest.raises(ValueError, match="engine.stpe"):
+            FaultInjector({"engine.stpe": {"kind": "error"}})
+        # replica-scoped sites validate on the op suffix
+        with pytest.raises(ValueError, match="r0.stpe"):
+            FaultInjector({"r0.stpe": {"kind": "error"}})
+        FaultInjector({"r0.step": {"kind": "error"}})     # any replica name
+
+    def test_unknown_site_rejected_from_env_json(self, monkeypatch):
+        # NB the typo must not end in a replica op suffix: "enigne.step"
+        # would legally arm as a replica-scoped "<name>.step" site
+        monkeypatch.setenv(
+            "PADDLE_TPU_FAULTS",
+            '{"sites": {"health.prob": {"kind": "error"}}}')
+        with pytest.raises(ValueError, match="health.prob"):
+            FaultInjector.from_env()
+
+    def test_register_failpoint_extends_registry(self):
+        from paddle_tpu.inference.faults import (KNOWN_SITES,
+                                                 register_failpoint)
+
+        name = "testonly.flush"
+        assert name not in KNOWN_SITES
+        try:
+            assert register_failpoint(name) == name
+            inj = FaultInjector({name: {"kind": "error"}})
+            with pytest.raises(InjectedFault):
+                inj.fire(name)
+        finally:
+            KNOWN_SITES.discard(name)
 
 
 # ----------------------------------------------------------------- breaker

@@ -136,6 +136,15 @@ MULTINODE_WORKER = textwrap.dedent("""
 
 
 class TestMultiNodeRestart:
+    # same saturated-container flake family as TestElasticScaleOut /
+    # TestElasticScaleIn / test_heartbeat_flaps (r10/r11 triage): two
+    # controller subprocesses racing real heartbeat TTLs pass solo
+    # (verified both on this tree and pristine HEAD, ~3 s) but flake and
+    # burn up to ~3 min under the overloaded tier-1 run — the r12 tier-1
+    # A/B showed the identical F at the identical spot on the UNMODIFIED
+    # seed.  Marked slow per the same precedent: the CI 'parallel' shard
+    # runs this file with no marker filter, so it still gates merges.
+    @pytest.mark.slow
     def test_cross_node_epoch_coordination(self, tmp_path):
         """Two controller processes (nnodes=2): a worker failure on node 1
         must pull BOTH nodes into a new rendezvous epoch and both must

@@ -200,6 +200,69 @@ class TestSamplingDeterminism:
             SamplingParams(top_k=-1)
 
 
+class TestSampleProbs:
+    """capture_sample_probs=True (ISSUE 11 satellite): the engine
+    exposes the renormalized POST-top-k/top-p distribution each token
+    was drawn from — the q(x) a speculative-decode verifier scores draft
+    tokens against — harvested like pop_token_logprobs()."""
+
+    def test_probs_align_and_respect_filters(self, model):
+        eng = ServingEngine(model, megastep_k=4,
+                            capture_sample_probs=True, **ENGINE)
+        rs = eng.add_request([3, 17, 101, 7], max_new_tokens=6,
+                             sampling={"temperature": 0.8, "top_k": 8,
+                                       "top_p": 0.9, "seed": 13})
+        rg = eng.add_request([42, 5], max_new_tokens=6)     # greedy
+        toks = eng.run()
+        probs = eng.pop_sample_probs()
+        assert set(probs) == {rs, rg}
+        for rid in (rs, rg):
+            assert len(probs[rid]) == len(toks[rid])   # 1:1 with tokens
+            for q, t in zip(probs[rid], toks[rid]):
+                assert float(q.sum()) == pytest.approx(1.0, abs=1e-4)
+                assert q[t] > 0          # drawn token is inside support
+        for q in probs[rs]:
+            assert int((q > 0).sum()) <= 8        # top-k support bound
+        for q, t in zip(probs[rg], toks[rg]):
+            assert q[t] == 1.0 and int((q > 0).sum()) == 1   # one-hot
+        assert eng.pop_sample_probs() == {}       # drained
+
+    def test_capture_does_not_change_tokens(self, model):
+        """Bit-identical draws with the capture on and off, single-step
+        (K=1) and megastep (K=8) paths both."""
+        prompt = [3, 17, 101, 7]
+        for k in (1, 8):
+            off, _ = run_engine(model, prompt, 8, k, sampling=SAMPLED)
+            on, _ = run_engine(model, prompt, 8, k, sampling=SAMPLED,
+                               capture_sample_probs=True)
+            assert on == off, f"K={k}"
+            goff, _ = run_engine(model, prompt, 8, k)
+            gon, _ = run_engine(model, prompt, 8, k,
+                                capture_sample_probs=True)
+            assert gon == goff, f"K={k} greedy"
+
+    def test_probs_are_the_sampled_distribution(self, model):
+        """The spec-decode verification property: redrawing under the
+        request's own (seed, sample-index) key from the EXPOSED
+        distribution reproduces the engine's token exactly (categorical
+        is shift-invariant, so log q and the filtered logits draw the
+        same sample)."""
+        import jax
+        import jax.numpy as jnp
+
+        sp = {"temperature": 0.8, "top_k": 16, "top_p": 0.95, "seed": 21}
+        eng = ServingEngine(model, megastep_k=4,
+                            capture_sample_probs=True, **ENGINE)
+        rid = eng.add_request([9, 2, 77], max_new_tokens=6, sampling=sp)
+        toks = eng.run()[rid]
+        qs = eng.pop_sample_probs()[rid]
+        for i, (q, t) in enumerate(zip(qs, toks)):
+            key = jax.random.fold_in(jax.random.PRNGKey(sp["seed"]), i)
+            redraw = int(jax.random.categorical(
+                key, jnp.log(jnp.asarray(q))))
+            assert redraw == t, f"sample index {i}"
+
+
 class TestStreaming:
     def test_on_token_callback_order_and_completeness(self, model):
         fe = ServingFrontend([ServingEngine(model, **ENGINE)])

@@ -1,0 +1,571 @@
+"""Durable control plane (ISSUE 11): write-ahead request journal,
+crash-consistent frontend recovery, idempotent submission.
+
+The acceptance-critical properties checked here:
+
+* journal framing — torn TAIL records are tolerated (and truncated
+  before the next append), a CRC-mismatched MID-FILE record fails loud
+  (never skip-and-continue), an empty file is a valid empty journal, and
+  snapshot-compaction + suffix replay rebuilds the same state;
+* every admitted request is journaled BEFORE it can reach a replica and
+  reaches exactly one typed terminal record; immediate typed rejections
+  are never journaled (they never executed);
+* ``ServingFrontend.recover`` re-admits in-flight requests as fresh
+  prefill and the recovered COMPLETED survivors — greedy AND seeded
+  non-greedy — are token-identical to a crash-free run (tokens are not
+  journaled; they replay from (seed, sample index));
+* ``submit(idempotency_key=...)`` dedupes client retries within a
+  process AND across a restart (the regression the bounded
+  terminal-result cache exists for);
+* a failing journal (``journal.append``/``journal.fsync`` failpoints)
+  degrades the frontend to non-durable serving with the
+  ``journal_degraded`` gauge raised — it never kills the data plane;
+* recovery reaps orphaned sequences on still-live engines (worker-side
+  over RPC in the slow fleet test).
+
+Everything but ``TestWorkerSideRecovery`` is fast and in-process —
+tier-1 scope; the subprocess half of the contract (a REAL SIGKILL) is
+the ``--kill-frontend`` soak in tests/test_chaos_serving.py.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu.inference import (
+    FaultInjector,
+    JournalCorruption,
+    Priority,
+    RequestJournal,
+    RequestStatus,
+    ServingEngine,
+    ServingFrontend,
+)
+
+pytestmark = pytest.mark.quick
+
+
+@pytest.fixture(scope="module")
+def model():
+    # sub-tiny single-process model, same scale as the control-plane
+    # tests: these tests build several engines, each compiling its own
+    # step programs on a 2-vCPU CI container
+    from paddle_tpu.distributed.topology import set_hybrid_communicate_group
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    set_hybrid_communicate_group(None)
+    P.seed(11)
+    m = LlamaForCausalLM(LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=160,
+        num_hidden_layers=1, num_attention_heads=2,
+        max_position_embeddings=256))
+    m.eval()
+    return m
+
+
+def make_engine(model, **kw):
+    kw.setdefault("max_batch_size", 2)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("token_budget", 16)
+    return ServingEngine(model, **kw)
+
+
+def journal(tmp_path, name="req.wal", **kw):
+    kw.setdefault("fsync", False)   # process-death semantics; fast
+    return RequestJournal(str(tmp_path / name), **kw)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------- framing
+class TestJournalFraming:
+    def test_round_trip_and_counters(self, tmp_path):
+        j = journal(tmp_path)
+        recs = [{"t": "admit", "rid": i, "prompt": [1, 2, i]}
+                for i in range(5)]
+        total = sum(j.append(r) for r in recs)
+        j.close()
+        assert j.records_appended == 5 and j.bytes_appended == total
+        snap, out = RequestJournal(j.path).replay()
+        assert snap is None and out == recs
+
+    def test_empty_and_missing_file(self, tmp_path):
+        j = journal(tmp_path)
+        assert j.replay() == (None, [])          # missing file
+        open(j.path, "wb").close()
+        assert j.replay() == (None, [])          # empty file
+
+    def test_torn_tail_tolerated_and_truncated_on_append(self, tmp_path):
+        j = journal(tmp_path)
+        for i in range(3):
+            j.append({"t": "progress", "rid": i, "n": 1})
+        j.close()
+        data = open(j.path, "rb").read()
+        # tearing mid-header keeps no partial record
+        open(j.path, "wb").write(data[:3])
+        _, out = RequestJournal(j.path).replay()
+        assert out == []
+        # tearing the last record's payload keeps records 0-1 exactly
+        open(j.path, "wb").write(data[:-5])
+        _, out = RequestJournal(j.path).replay()
+        assert [r["rid"] for r in out] == [0, 1]
+        # appending truncates the tear first, so the file stays readable
+        j2 = RequestJournal(j.path, fsync=False)
+        j2.append({"t": "progress", "rid": 9, "n": 9})
+        j2.close()
+        _, out = RequestJournal(j.path).replay()
+        assert [r["rid"] for r in out] == [0, 1, 9]
+
+    def test_crc_mismatch_mid_file_fails_loud(self, tmp_path):
+        j = journal(tmp_path)
+        for i in range(4):
+            j.append({"t": "progress", "rid": i, "n": 1})
+        j.close()
+        data = bytearray(open(j.path, "rb").read())
+        data[12] ^= 0xFF                 # inside the FIRST record's payload
+        open(j.path, "wb").write(bytes(data))
+        with pytest.raises(JournalCorruption, match="CRC mismatch"):
+            RequestJournal(j.path).replay()
+        # ...and opening for append must refuse too, not write after junk
+        with pytest.raises(JournalCorruption):
+            RequestJournal(j.path, fsync=False).append({"t": "x"})
+
+    def test_garbage_length_field_is_corruption(self, tmp_path):
+        j = journal(tmp_path)
+        j.append({"t": "progress", "rid": 0, "n": 1})
+        j.close()
+        with open(j.path, "ab") as f:     # complete-looking insane header
+            f.write(b"\xff\xff\xff\x7f" + b"\x00" * 40)
+        with pytest.raises(JournalCorruption, match="length field"):
+            RequestJournal(j.path).replay()
+
+    def test_oversize_record_rejected_at_write_time(self, tmp_path,
+                                                    monkeypatch):
+        """A correctly-CRC'd frame past _MAX_RECORD would poison the
+        journal (replay rejects it as corruption), so the writer must
+        refuse it instead of producing it."""
+        from paddle_tpu.inference import journal as jmod
+
+        monkeypatch.setattr(jmod, "_MAX_RECORD", 64)
+        j = journal(tmp_path)
+        j.append({"t": "progress", "rid": 0, "n": 1})   # under the cap
+        with pytest.raises(ValueError, match="frame cap"):
+            j.append({"t": "admit", "rid": 1, "prompt": list(range(64))})
+        j.close()
+        _, recs = RequestJournal(j.path).replay()       # file stays sane
+        assert [r["rid"] for r in recs] == [0]
+
+    def test_rewrite_fsync_traverses_failpoint(self, tmp_path):
+        """Compaction's durability barrier must be chaos-coverable: the
+        journal.fsync failpoint fires on rewrite too, and a fault there
+        leaves the OLD journal intact."""
+        j = journal(tmp_path)
+        j.append({"t": "admit", "rid": 0, "prompt": [1]})
+        j.close()
+        inj = FaultInjector({"journal.fsync": {"kind": "error"}})
+        j2 = RequestJournal(j.path, fsync=False, fault_injector=inj)
+        with pytest.raises(Exception, match="journal.fsync"):
+            j2.rewrite({"next_rid": 1, "open": [], "done": []})
+        _, recs = RequestJournal(j.path).replay()
+        assert [r["rid"] for r in recs] == [0]          # old file intact
+
+    def test_compaction_snapshot_plus_suffix_equivalence(self, tmp_path):
+        j = journal(tmp_path)
+        for i in range(6):
+            j.append({"t": "admit", "rid": i, "prompt": [i]})
+        snap = {"next_rid": 6, "open": [{"rid": 4}, {"rid": 5}],
+                "done": [{"rid": 1, "key": "k1", "status": "completed"}]}
+        j.rewrite(snap, suffix=[{"t": "admit", "rid": 6, "prompt": [6]}])
+        j.append({"t": "terminal", "rid": 4, "status": "completed"})
+        j.close()
+        got_snap, got = RequestJournal(j.path).replay()
+        assert got_snap["t"] == "snapshot"
+        assert got_snap["next_rid"] == 6
+        assert [r["rid"] for r in got_snap["open"]] == [4, 5]
+        assert got == [{"t": "admit", "rid": 6, "prompt": [6]},
+                       {"t": "terminal", "rid": 4, "status": "completed"}]
+        assert j.compactions == 1
+
+
+# ------------------------------------------------------ lifecycle records
+class TestFrontendJournaling:
+    def test_admit_before_dispatch_then_exactly_one_terminal(
+            self, model, tmp_path):
+        j = journal(tmp_path)
+        fe = ServingFrontend([make_engine(model)], journal=j)
+        r0 = fe.submit([3, 17, 101], max_new_tokens=4)
+        r1 = fe.submit([42, 5], max_new_tokens=4, priority=Priority.LOW)
+        # write-ahead: both admits durable before any step ran
+        _, recs = RequestJournal(j.path).replay()
+        assert [r["rid"] for r in recs if r["t"] == "admit"] == [r0, r1]
+        assert not [r for r in recs if r["t"] != "admit"]
+        fe.cancel(r1)
+        fe.run()
+        _, recs = RequestJournal(j.path).replay()
+        terms = [r for r in recs if r["t"] == "terminal"]
+        assert sorted(t["rid"] for t in terms) == [r0, r1]
+        by_rid = {t["rid"]: t for t in terms}
+        assert by_rid[r0]["status"] == "completed"
+        assert by_rid[r0]["n_tokens"] == 4
+        assert by_rid[r1]["status"] == "cancelled"
+        assert fe.metrics.counter("journal_records_total") == len(recs)
+
+    def test_progress_at_megastep_boundaries(self, model, tmp_path):
+        j = journal(tmp_path)
+        fe = ServingFrontend([make_engine(model, megastep_k=2)], journal=j)
+        rid = fe.submit([9, 9, 9], max_new_tokens=6)
+        fe.run()
+        _, recs = RequestJournal(j.path).replay()
+        prog = [r["n"] for r in recs if r["t"] == "progress"]
+        # prefill boundary emits 1 token, then K=2 megasteps: monotone
+        # counts, more than one boundary, final count = all tokens
+        assert prog and prog == sorted(prog) and prog[-1] == 6
+        assert len(prog) >= 3
+        assert fe.result(rid).status is RequestStatus.COMPLETED
+
+    def test_rejections_not_journaled_and_do_not_claim_key(
+            self, model, tmp_path):
+        j = journal(tmp_path)
+        fe = ServingFrontend([make_engine(model)], journal=j,
+                             max_queue_requests=1)
+        r0 = fe.submit([1, 2], max_new_tokens=2, idempotency_key="a")
+        r1 = fe.submit([3, 4], max_new_tokens=2, idempotency_key="b")
+        assert fe.result(r1).status is RequestStatus.OVERLOADED
+        fe.run()
+        _, recs = RequestJournal(j.path).replay()
+        assert [r["rid"] for r in recs if r["t"] == "admit"] == [r0]
+        assert [r["rid"] for r in recs if r["t"] == "terminal"] == [r0]
+        # the rejected key was never claimed: a retry admits for real
+        r2 = fe.submit([3, 4], max_new_tokens=2, idempotency_key="b")
+        assert r2 != r1
+        assert fe.metrics.counter("idempotent_hits_total") == 0
+        fe.run()
+        assert fe.result(r2).status is RequestStatus.COMPLETED
+
+    def test_append_fault_degrades_not_crashes(self, model, tmp_path):
+        inj = FaultInjector({"journal.append": {"kind": "error",
+                                                "after": 1, "times": 1}})
+        j = journal(tmp_path, fault_injector=inj)
+        fe = ServingFrontend([make_engine(model)], journal=j)
+        rids = [fe.submit([5 + i, 7], max_new_tokens=3) for i in range(3)]
+        res = fe.run()
+        assert all(res[r].status is RequestStatus.COMPLETED for r in rids)
+        assert fe.journal_degraded
+        assert fe.metrics.gauge("journal_degraded") == 1.0
+        assert fe.metrics.counter("journal_errors_total") == 1
+
+    def test_fresh_frontend_refuses_previous_lifes_journal(
+            self, model, tmp_path):
+        """Arming a FRESH frontend with a journal that has history would
+        merge two rid generations (life 2 restarts rids at 0) and a
+        later recover() would stub live requests with life 1's
+        terminals — refused at arm time, recover() is the path."""
+        j = journal(tmp_path)
+        fe = ServingFrontend([make_engine(model)], journal=j)
+        fe.submit([1, 2, 3], max_new_tokens=2)
+        fe.run()
+        with pytest.raises(ValueError, match="recover"):
+            ServingFrontend([make_engine(model)], journal=j.path)
+        # ...and recover() itself still works on the same file
+        fe2 = ServingFrontend.recover(j.path, [make_engine(model)])
+        assert fe2.metrics.counter("recoveries_total") == 1
+
+    def test_frontend_drains_capture_enabled_engine(self, model, tmp_path):
+        """A capture_sample_probs engine driven by a frontend must not
+        accumulate [V] arrays forever — the step loop drains them."""
+        eng = make_engine(model, capture_sample_probs=True, megastep_k=4)
+        fe = ServingFrontend([eng])
+        rid = fe.submit([5, 6, 7], max_new_tokens=6)
+        res = fe.run()
+        assert res[rid].status is RequestStatus.COMPLETED
+        assert eng._emitted_sample_probs == {}
+
+    def test_fsync_fault_degrades_not_crashes(self, model, tmp_path):
+        inj = FaultInjector({"journal.fsync": {"kind": "error",
+                                               "times": 1}})
+        j = journal(tmp_path, fault_injector=inj)
+        fe = ServingFrontend([make_engine(model)], journal=j)
+        rid = fe.submit([5, 7, 9], max_new_tokens=3)
+        res = fe.run()
+        assert res[rid].status is RequestStatus.COMPLETED
+        assert fe.journal_degraded
+
+
+# --------------------------------------------------------------- recovery
+class TestRecovery:
+    def _reference(self, model, reqs):
+        fe = ServingFrontend([make_engine(model)])
+        rids = [fe.submit(p, max_new_tokens=m, **kw) for p, m, kw in reqs]
+        res = fe.run()
+        return [res[r].tokens for r in rids]
+
+    def test_recover_token_identical_greedy_and_seeded(
+            self, model, tmp_path):
+        reqs = [([3, 17, 101, 7], 6, {}),
+                ([42, 5, 9], 6, dict(temperature=0.9, top_k=12, seed=77)),
+                ([8, 8, 8, 8, 8], 6, {}),
+                ([100, 2], 6, dict(temperature=0.7, top_p=0.9, seed=5))]
+        want = self._reference(model, reqs)
+        j = journal(tmp_path)
+        fe = ServingFrontend([make_engine(model)], journal=j)
+        rids = [fe.submit(p, max_new_tokens=m, idempotency_key=f"k{i}",
+                          **kw) for i, (p, m, kw) in enumerate(reqs)]
+        fe.step()
+        fe.step()                       # mid-flight "crash" (abandon)
+        pre_done = set(fe.results())
+        assert pre_done and len(pre_done) < len(rids)
+        fe2 = ServingFrontend.recover(j.path, [make_engine(model)])
+        assert fe2.metrics.counter("recoveries_total") == 1
+        assert (fe2.metrics.counter("recovered_requests_total")
+                == len(rids) - len(pre_done))
+        res = fe2.run()
+        for i, rid in enumerate(rids):
+            if rid in pre_done:
+                assert res[rid].detail.startswith("recovered terminal")
+            else:
+                assert res[rid].status is RequestStatus.COMPLETED
+                assert res[rid].tokens == want[i], f"request {i} diverged"
+
+    def test_recover_rearms_remaining_deadline(self, model, tmp_path):
+        clk = FakeClock()
+        j = journal(tmp_path)
+        fe = ServingFrontend([make_engine(model)], journal=j, clock=clk)
+        rid = fe.submit([1, 2, 3], max_new_tokens=4, deadline_s=5.0)
+        clk2 = FakeClock(t=100.0)
+        fe2 = ServingFrontend.recover(j.path, [make_engine(model)],
+                                      clock=clk2)
+        req = fe2._requests[rid]
+        assert req.deadline_t == pytest.approx(105.0)
+        # and an expired re-armed deadline still sheds typed
+        clk2.advance(6.0)
+        fe2.step()
+        assert fe2.result(rid).status is RequestStatus.DEADLINE_EXCEEDED
+
+    def test_recover_uses_remaining_not_submit_time_deadline(
+            self, model, tmp_path):
+        """The SLO clock survives the crash: progress records carry the
+        REMAINING deadline, so a request 2 s from its deadline does not
+        get its full window back on recovery."""
+        clk = FakeClock()
+        j = journal(tmp_path)
+        fe = ServingFrontend([make_engine(model)], journal=j, clock=clk)
+        rid = fe.submit([1, 2, 3], max_new_tokens=12, deadline_s=5.0)
+        clk.advance(3.0)
+        fe.step()               # harvests tokens -> progress with dl=2.0
+        assert rid not in fe.results()
+        clk2 = FakeClock(t=100.0)
+        fe2 = ServingFrontend.recover(j.path, [make_engine(model)],
+                                      clock=clk2)
+        assert fe2._requests[rid].deadline_t == pytest.approx(102.0)
+
+    def test_orphans_reaped_on_recover(self, model, tmp_path):
+        j = journal(tmp_path)
+        eng = make_engine(model)
+        fe = ServingFrontend([eng], journal=j)
+        rid = fe.submit([9, 9, 9, 1], max_new_tokens=6)
+        fe.step()
+        assert eng.num_active == 1       # the orphan a live engine holds
+        fe2 = ServingFrontend.recover(j.path, [eng])
+        assert eng.num_active == 0
+        assert fe2.metrics.counter("orphans_reaped_total") == 1
+        res = fe2.run()
+        assert res[rid].status is RequestStatus.COMPLETED
+
+    def test_idempotency_dedupe_within_process(self, model, tmp_path):
+        fe = ServingFrontend([make_engine(model)])
+        r0 = fe.submit([4, 5, 6], max_new_tokens=3, idempotency_key="x")
+        # a reconnecting streaming client's NEW callback attaches to the
+        # still-open request on the dedupe hit (future tokens flow to it)
+        got = []
+        assert fe.submit([4, 5, 6], max_new_tokens=3, idempotency_key="x",
+                         on_token=lambda rid, t: got.append(t)) == r0
+        fe.run()
+        assert got == fe.result(r0).tokens
+        assert fe.submit([4, 5, 6], max_new_tokens=3,
+                         idempotency_key="x") == r0   # terminal
+        assert fe.metrics.counter("idempotent_hits_total") == 2
+        assert fe.metrics.counter("admitted_total") == 1
+
+    def test_idempotency_dedupe_across_restart(self, model, tmp_path):
+        """Regression (ISSUE 11 satellite): a client retry delivered to
+        the RECOVERED frontend must dedupe against both the journaled
+        terminals and the re-admitted in-flight set — zero duplicate
+        executions across the crash."""
+        j = journal(tmp_path)
+        fe = ServingFrontend([make_engine(model)], journal=j)
+        prompts = [[3, 17, 101, 7], [42, 5, 9], [8, 8, 8, 8, 8]]
+        rids = [fe.submit(p, max_new_tokens=5, idempotency_key=f"k{i}")
+                for i, p in enumerate(prompts)]
+        fe.step()
+        fe.step()
+        done_before = set(fe.results())
+        assert done_before                 # some terminal, some in flight
+        fe2 = ServingFrontend.recover(j.path, [make_engine(model)])
+        retries = [fe2.submit(p, max_new_tokens=5, idempotency_key=f"k{i}")
+                   for i, p in enumerate(prompts)]
+        assert retries == rids
+        assert fe2.metrics.counter("idempotent_hits_total") == len(prompts)
+        res = fe2.run()
+        assert set(res) == set(rids)       # no duplicate rids admitted
+
+    def test_auto_compaction_then_recover_from_snapshot(
+            self, model, tmp_path):
+        j = journal(tmp_path)
+        fe = ServingFrontend([make_engine(model)], journal=j,
+                             journal_compact_every=8)
+        done_rids = [fe.submit([2 + i, 3], max_new_tokens=2,
+                               idempotency_key=f"d{i}") for i in range(3)]
+        fe.run()
+        assert fe.metrics.counter("journal_compactions_total") >= 1
+        # post-compaction suffix: one open admit on top of the snapshot
+        open_rid = fe.submit([50, 60, 70], max_new_tokens=4,
+                             idempotency_key="open")
+        snap, recs = RequestJournal(j.path).replay()
+        assert snap is not None            # compaction produced a snapshot
+        assert any(r["t"] == "admit" and r["rid"] == open_rid for r in recs)
+        fe2 = ServingFrontend.recover(j.path, [make_engine(model)])
+        # snapshot terminals still dedupe, suffix admit recovered
+        assert fe2.submit([2, 3], max_new_tokens=2,
+                          idempotency_key="d0") == done_rids[0]
+        assert fe2.submit([50, 60, 70], max_new_tokens=4,
+                          idempotency_key="open") == open_rid
+        res = fe2.run()
+        assert res[open_rid].status is RequestStatus.COMPLETED
+        assert fe2._next_rid == open_rid + 1
+
+    def test_recover_never_reissues_journaled_rid_space(
+            self, model, tmp_path):
+        """Typed rejections consume rids without being journaled; the
+        ``nr`` high-water mark on every admit/terminal record keeps the
+        recovered frontend from re-issuing them to new requests (a
+        client's old rid answering with a different request's result)."""
+        j = journal(tmp_path)
+        fe = ServingFrontend([make_engine(model)], journal=j,
+                             max_queue_requests=1)
+        ra = fe.submit([1, 2, 3], max_new_tokens=2)     # admitted
+        rb = fe.submit([4, 5, 6], max_new_tokens=2)     # rejected, rid 1
+        assert fe.result(rb).status is RequestStatus.OVERLOADED
+        fe.run()
+        rc = fe.submit([7, 8, 9], max_new_tokens=2)     # admitted, rid 2
+        fe2 = ServingFrontend.recover(j.path, [make_engine(model)])
+        rd = fe2.submit([9, 9], max_new_tokens=2)
+        assert rd > rc and rd != rb, (ra, rb, rc, rd)
+
+    def test_recover_preserves_retry_budget(self, model, tmp_path):
+        """r10's poison-quarantine invariant must survive the restart: a
+        request that already charged replica deaths does not get a fresh
+        ``max_request_retries`` budget per frontend life."""
+        j = journal(tmp_path)
+        fe = ServingFrontend([make_engine(model)], journal=j,
+                             max_request_retries=3)
+        rid = fe.submit([5, 6, 7, 8], max_new_tokens=4)
+        fe._dispatch()
+        fe.fail_replica(fe.replicas[0], RuntimeError("injected death"))
+        assert fe._requests[rid].attempts == 1
+        fe2 = ServingFrontend.recover(j.path, [make_engine(model)])
+        assert fe2._requests[rid].attempts == 1
+        res = fe2.run()
+        assert res[rid].status is RequestStatus.COMPLETED
+        assert res[rid].attempts == 1
+
+    def test_discover_workers_filters_non_worker_registrations(self):
+        """The rpc layer registers EVERY participant (the frontend too)
+        under /rpc/workers/ and a SIGKILLed frontend never deregisters —
+        discovery must not hand its stale entry back as a 'worker'."""
+        from paddle_tpu.distributed.launch.master import KVClient, KVServer
+        from paddle_tpu.inference.fleet import discover_workers
+
+        srv = KVServer(0).start()
+        try:
+            ep = f"127.0.0.1:{srv.port}"
+            kv = KVClient(ep)
+            kv.put("/rpc/workers/worker0", "0:127.0.0.1:1")
+            kv.put("/rpc/workers/worker1", "0:127.0.0.1:2")
+            kv.put("/rpc/workers/fleet-frontend", "0:127.0.0.1:3")
+            assert discover_workers(ep) == ["worker0", "worker1"]
+            assert discover_workers(
+                ep, exclude=("worker0", "fleet-frontend")) == ["worker1"]
+        finally:
+            srv.stop()
+
+    def test_recover_preserves_priority_and_class_budget(
+            self, model, tmp_path):
+        j = journal(tmp_path)
+        fe = ServingFrontend([make_engine(model)], journal=j)
+        rid = fe.submit([7, 7, 7], max_new_tokens=4,
+                        priority=Priority.HIGH)
+        fe2 = ServingFrontend.recover(j.path, [make_engine(model)])
+        req = fe2._requests[rid]
+        assert req.priority is Priority.HIGH
+        assert fe2._class_tokens[Priority.HIGH] == req.total_tokens
+        res = fe2.run()
+        assert res[rid].status is RequestStatus.COMPLETED
+        assert fe2._class_tokens[Priority.HIGH] == 0
+
+
+# --------------------------------------------- worker-side orphan reaping
+@pytest.mark.slow
+class TestWorkerSideRecovery:
+    MODEL = dict(vocab_size=256, hidden_size=64, intermediate_size=160,
+                 num_hidden_layers=1, num_attention_heads=2,
+                 max_position_embeddings=256)
+    ENGINE = dict(max_batch_size=2, max_seq_len=64, block_size=8,
+                  token_budget=16, megastep_k=2)
+
+    def test_frontend_death_with_live_worker(self, model, tmp_path):
+        """The fleet half of recovery: the WORKER outlives the frontend.
+        A new frontend recovers from the journal over the same
+        RemoteReplica, reaps the orphaned sequences worker-side (over
+        RPC), and finishes token-identically."""
+        from paddle_tpu.inference import ServingFleet
+
+        ref_eng = ServingEngine(model, **self.ENGINE)
+        p0, p1 = [3, 17, 101, 7], [42, 5, 9]
+        ra = ref_eng.add_request(p0, max_new_tokens=5)
+        rb = ref_eng.add_request(p1, max_new_tokens=5)
+        ref = ref_eng.run()
+        want = {0: ref[ra], 1: ref[rb]}
+
+        spec = {"seed": 11, "model": self.MODEL, "engine": self.ENGINE}
+        jpath = str(tmp_path / "fleet.wal")
+        with ServingFleet(spec, num_workers=1,
+                          frontend_kwargs={"journal": jpath}) as fleet:
+            fe = fleet.frontend
+            r0 = fe.submit(p0, max_new_tokens=5, idempotency_key="w0")
+            r1 = fe.submit(p1, max_new_tokens=5, idempotency_key="w1")
+            rep = fe.replicas[0].engine
+            for _ in range(50):
+                fleet.step()
+                if rep.num_active and any(
+                        r.generated for r in fe._requests.values()):
+                    break
+            assert rep.num_active >= 1
+            # the frontend "dies" here (abandoned); the worker process is
+            # alive and still owns the in-flight sequences
+            fe2 = ServingFrontend.recover(jpath, [rep])
+            assert rep.num_active == 0
+            # exactly-once counters: the WORKER self-reports the reap
+            # (its registry rides the fleet scrape page); the recovered
+            # frontend must not double-count the mirror
+            assert fe2.metrics.counter("orphans_reaped_total") == 0
+            wm = rep.health()["metrics"]["counters"]
+            assert wm.get("orphans_reaped_total", 0) >= 1
+            # idempotent retry straddling the restart
+            assert fe2.submit(p0, max_new_tokens=5,
+                              idempotency_key="w0") == r0
+            res = fe2.run()
+            assert res[r0].status is RequestStatus.COMPLETED
+            assert res[r1].status is RequestStatus.COMPLETED
+            assert res[r0].tokens == want[0]
+            assert res[r1].tokens == want[1]

@@ -14,6 +14,28 @@ the fault-containment contract end to end:
   schedules it) is quarantined after ``max_request_retries`` replica
   deaths instead of cascading through the whole fleet.
 
+``--kill-frontend`` runs the DURABLE-CONTROL-PLANE phase (ISSUE 11):
+a child process serves a seeded request stream (greedy AND seeded
+sampled requests, all submitted with idempotency keys) through a
+``ServingFrontend`` armed with a write-ahead ``RequestJournal``, then
+SIGKILLs itself mid-soak at a deterministic point (>= K terminals with
+work still in flight — a real SIGKILL: no atexit, no flushing, exactly
+a crash).  The parent then replays the journal, recovers with
+``ServingFrontend.recover`` (fresh engines), REPLAYS THE CLIENT — every
+request retried with its original idempotency key — and asserts the
+durability contract:
+
+* every journaled admit reaches EXACTLY ONE typed terminal status
+  (pre-crash terminal XOR post-recovery result, never both executions);
+* zero duplicate executions under the idempotent client retry (every
+  retry returns its original rid);
+* COMPLETED survivors — including the seeded non-greedy streams — are
+  token-identical to a crash-free same-seed run (greedy determinism +
+  (seed, sample-index) streams; tokens are NOT journaled, they replay);
+* a journal I/O failpoint (``journal.append``) degrades the frontend to
+  non-durable serving with the ``journal_degraded`` gauge raised — it
+  never kills the data plane.
+
 In-process mode (default) wraps N ``ServingEngine`` replicas in
 ``faults.FaultyReplica`` proxies behind one ``ServingFrontend``: the
 seeded ``FaultInjector`` crashes/hangs/drops specific replicas at
@@ -289,6 +311,208 @@ def run_chaos(seed=0, replicas=3, num_requests=18, max_request_retries=2,
     }
 
 
+def _kill_request_stream(seed, num_requests):
+    """The shared seeded stream with per-request sampling attached:
+    every third request is a seeded NON-GREEDY stream, so recovery has
+    to prove the (seed, sample-index) replay contract, not just greedy
+    determinism.  Wraps ``_request_stream`` (one generator for both
+    soaks — the two can't drift apart); attaching sampling consumes no
+    rng draws, so the prompt/priority cadence is identical."""
+    return [(p, m, pr,
+             {"temperature": 0.8, "top_k": 16, "top_p": 0.95,
+              "seed": 1000 + i} if i % 3 == 1 else {})
+            for i, (p, m, pr)
+            in enumerate(_request_stream(seed, num_requests, poison=False))]
+
+
+def serve_phase(journal_path, seed, num_requests, kill_after,
+                max_steps=3000):
+    """Child half of --kill-frontend: journal-armed frontend serving the
+    seeded stream, SIGKILLing ITSELF once >= ``kill_after`` requests are
+    terminal with work still in flight.  Self-SIGKILL keeps the crash
+    point deterministic in STEP counts (no wall-clock race with the
+    parent) while still being a true SIGKILL — nothing flushes, nothing
+    runs atexit.  Each terminal result the "client" observed is appended
+    (flushed) to ``journal_path + '.client'`` so the parent can check
+    pre-crash completions' tokens too."""
+    import signal
+
+    from paddle_tpu.inference import RequestJournal, ServingEngine, \
+        ServingFrontend
+
+    model = _build_model()
+    reqs = _kill_request_stream(seed, num_requests)
+    # fsync=False: the failure model here is process death (SIGKILL),
+    # which the OS page cache survives; fsync=True is for machine crash
+    fe = ServingFrontend(
+        [ServingEngine(model, **ENGINE) for _ in range(2)],
+        journal=RequestJournal(journal_path, fsync=False))
+    rids = [fe.submit(p, max_new_tokens=m, priority=pr,
+                      idempotency_key=f"req-{i}", **sk)
+            for i, (p, m, pr, sk) in enumerate(reqs)]
+    client_log = open(journal_path + ".client", "w")
+    seen = set()
+    for _ in range(max_steps):
+        fe.step()
+        for rid, res in fe.results().items():
+            if rid in seen:
+                continue
+            seen.add(rid)
+            client_log.write(json.dumps(
+                {"rid": rid, "status": res.status.value,
+                 "tokens": res.tokens}) + "\n")
+            client_log.flush()
+        in_flight = any(r.generated and rid not in seen
+                        for rid, r in fe._requests.items())
+        if len(seen) >= kill_after and in_flight:
+            os.kill(os.getpid(), signal.SIGKILL)   # never returns
+        if len(seen) == len(rids):
+            break
+    # reaching here means the stream drained before the kill condition
+    # ever held — the soak parameters are wrong; exit 0 and let the
+    # parent fail on the returncode
+    sys.exit(0)
+
+
+def run_kill_frontend(seed=0, num_requests=16, kill_after=5,
+                      max_steps=3000, journal_dir=None):
+    """Parent half of --kill-frontend; returns the report dict (raises
+    AssertionError on any durability-contract violation)."""
+    import signal
+    import subprocess
+    import tempfile
+
+    from paddle_tpu.inference import (
+        FaultInjector,
+        RequestJournal,
+        RequestStatus,
+        ServingEngine,
+        ServingFrontend,
+    )
+
+    model = _build_model()
+    reqs = _kill_request_stream(seed, num_requests)
+
+    # ---- crash-free same-seed reference
+    ref_fe = ServingFrontend([ServingEngine(model, **ENGINE)
+                              for _ in range(2)])
+    ref_rids = [ref_fe.submit(p, max_new_tokens=m, priority=pr, **sk)
+                for p, m, pr, sk in reqs]
+    ref_res = ref_fe.run()
+    ref_tokens = {i: ref_res[r].tokens for i, r in enumerate(ref_rids)}
+
+    # ---- serve phase in a child process, SIGKILLed mid-soak
+    journal_dir = journal_dir or tempfile.mkdtemp(prefix="paddle_tpu_kill_")
+    jpath = os.path.join(journal_dir, "requests.wal")
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--serve-phase",
+         "--journal", jpath, "--seed", str(seed),
+         "--requests", str(num_requests), "--kill-after", str(kill_after)],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, timeout=600)
+    assert proc.returncode == -signal.SIGKILL, (
+        f"serve phase exited rc={proc.returncode}, expected SIGKILL "
+        f"(-{int(signal.SIGKILL)}) — the stream drained before the kill "
+        "condition held; grow --requests or shrink --kill-after")
+
+    # what the client saw before the crash (flushed line-by-line)
+    pre_client = {}
+    with open(jpath + ".client") as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue       # torn final line: the crash's prerogative
+            pre_client[rec["rid"]] = rec
+
+    # journal replay BEFORE recover (recover compacts the file)
+    snapshot, records = RequestJournal(jpath).replay()
+    assert snapshot is None, "serve phase should not have compacted yet"
+    admits = {r["rid"]: r for r in records if r["t"] == "admit"}
+    pre_terminals = {r["rid"]: r for r in records if r["t"] == "terminal"}
+    progressed = {r["rid"] for r in records if r["t"] == "progress"}
+    assert len(admits) == num_requests, (
+        f"only {len(admits)}/{num_requests} admits journaled")
+    for i, (p, _, _, _) in enumerate(reqs):
+        assert admits[i]["prompt"] == p, f"admit {i} prompt mismatch"
+    assert len(pre_terminals) >= kill_after
+    assert len(pre_terminals) < num_requests, "nothing was left in flight"
+    assert progressed - set(pre_terminals), (
+        "no open request had journaled progress — the kill did not land "
+        "mid-generation")
+    # the client must never have seen a terminal the journal missed
+    assert set(pre_client) <= set(pre_terminals), (
+        "client observed terminals the journal lost: "
+        f"{sorted(set(pre_client) - set(pre_terminals))}")
+
+    # ---- recover + idempotent client replay
+    fe = ServingFrontend.recover(
+        jpath, [ServingEngine(model, **ENGINE) for _ in range(2)])
+    recovered = fe.metrics.counter("recovered_requests_total")
+    assert recovered == num_requests - len(pre_terminals)
+    retry_rids = [fe.submit(p, max_new_tokens=m, priority=pr,
+                            idempotency_key=f"req-{i}", **sk)
+                  for i, (p, m, pr, sk) in enumerate(reqs)]
+    assert retry_rids == list(range(num_requests)), (
+        f"client retries re-executed instead of deduping: {retry_rids}")
+    assert fe.metrics.counter("idempotent_hits_total") == num_requests
+    res = fe.run(max_steps=max_steps)
+
+    # ---- durability contract
+    statuses = {}
+    mismatched = []
+    for i in range(num_requests):
+        r = res[i]
+        if i in pre_terminals:
+            # closed before the crash: recovery must NOT have re-executed
+            # it (its terminal is the journaled one, tokens delivered
+            # pre-crash), and the client's record must match the journal
+            assert r.detail.startswith("recovered terminal"), (
+                f"rid {i} was terminal pre-crash but re-executed")
+            assert r.status.value == pre_terminals[i]["status"]
+            cl = pre_client.get(i)
+            if cl is not None and cl["status"] == "completed" \
+                    and cl["tokens"] != ref_tokens[i]:
+                mismatched.append(i)
+            statuses[r.status.value] = statuses.get(r.status.value, 0) + 1
+        else:
+            statuses[r.status.value] = statuses.get(r.status.value, 0) + 1
+            if r.status is RequestStatus.COMPLETED \
+                    and r.tokens != ref_tokens[i]:
+                mismatched.append(i)
+    assert not mismatched, (
+        f"survivors diverged from the crash-free run: rids {mismatched}")
+    sampled_survivors = [i for i in range(num_requests)
+                         if i not in pre_terminals and reqs[i][3]
+                         and res[i].status is RequestStatus.COMPLETED]
+
+    # ---- journal failpoints degrade, never crash (same model, cheap)
+    inj = FaultInjector({"journal.append": {"kind": "error", "after": 2,
+                                            "times": 1}}, seed=seed)
+    dj = RequestJournal(os.path.join(journal_dir, "degrade.wal"),
+                        fsync=False, fault_injector=inj)
+    dfe = ServingFrontend([ServingEngine(model, **ENGINE)], journal=dj)
+    drids = [dfe.submit(p, max_new_tokens=m) for p, m, _, _ in reqs[:4]]
+    dres = dfe.run()
+    assert all(dres[r].status is RequestStatus.COMPLETED for r in drids)
+    assert dfe.journal_degraded
+    assert dfe.metrics.gauge("journal_degraded") == 1.0
+
+    return {
+        "mode": "kill-frontend",
+        "seed": seed,
+        "requests": num_requests,
+        "terminal_before_kill": len(pre_terminals),
+        "recovered_requests": recovered,
+        "orphans_reaped": fe.metrics.counter("orphans_reaped_total"),
+        "idempotent_hits": fe.metrics.counter("idempotent_hits_total"),
+        "statuses": statuses,
+        "sampled_survivors_token_identical": len(sampled_survivors),
+        "survivors_token_identical": True,
+        "exactly_one_terminal_per_admit": True,
+        "journal_fault_degrades_not_crashes": True,
+    }
+
+
 def run_chaos_fleet(seed=0, workers=3, num_requests=8, max_steps=3000):
     """Fleet-level chaos: real worker processes, worker-side failpoints
     armed through the spec JSON, frontend-side rpc fault, heartbeat
@@ -394,8 +618,28 @@ def main(argv=None):
     ap.add_argument("--workers", type=int, default=0,
                     help="N>0: fleet mode — real serving_worker.py "
                          "processes with spec-armed failpoints")
+    ap.add_argument("--kill-frontend", action="store_true",
+                    help="durable-control-plane phase: SIGKILL a "
+                         "journal-armed frontend mid-soak, recover, and "
+                         "assert exactly-one-terminal + idempotent-retry "
+                         "dedupe + token-identical survivors")
+    ap.add_argument("--kill-after", type=int, default=5,
+                    help="kill-frontend: self-SIGKILL once this many "
+                         "requests are terminal (with work in flight)")
+    ap.add_argument("--journal", default=None,
+                    help="journal path (internal: --serve-phase)")
+    ap.add_argument("--serve-phase", action="store_true",
+                    help="internal: the child half of --kill-frontend")
     args = ap.parse_args(argv)
-    if args.workers > 0:
+    if args.serve_phase:
+        serve_phase(args.journal, args.seed, args.requests,
+                    args.kill_after)
+        return
+    if args.kill_frontend:
+        report = run_kill_frontend(seed=args.seed,
+                                   num_requests=args.requests,
+                                   kill_after=args.kill_after)
+    elif args.workers > 0:
         report = run_chaos_fleet(seed=args.seed, workers=args.workers,
                                  num_requests=args.requests)
     else:

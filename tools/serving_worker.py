@@ -14,6 +14,14 @@ handlers; this file is only the bootstrap.  One ``_w_step`` RPC drives
 one engine step — which, with megastep decode (ISSUE 9), returns up to
 ``megastep_k`` tokens per running sequence per round trip.
 
+The worker deliberately OUTLIVES its frontend (ISSUE 11): it parks on
+the stop event, not on the frontend's liveness, so a crashed frontend
+leaves the worker registered and serving-ready.  The recovered frontend
+reattaches (``fleet.discover_workers`` + ``RemoteReplica``), calls the
+``_w_reap_orphans`` handler to evict the dead frontend's sequences
+(publishing their KV blocks into the prefix cache), and re-admits from
+its write-ahead journal.
+
 Spec JSON (everything the worker needs to be a bit-identical replica):
 
     {"seed": 11,

@@ -61,6 +61,34 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(200)
         self.end_headers()
 
+    def do_POST(self):
+        # /cas — atomic compare-and-swap, the primitive leases need (a
+        # plain GET-then-PUT acquire would let two standbys both win the
+        # race for an expired frontend lease).  Body: JSON
+        # {"key": ..., "expect": str|null, "new": str}; expect=null means
+        # "key must be absent".  Replies "1" (swapped) or "0" (lost).
+        if self.path != "/cas":
+            self.send_response(404)
+            self.end_headers()
+            return
+        length = int(self.headers.get("Content-Length", 0))
+        try:
+            req = json.loads(self.rfile.read(length).decode())
+            key, expect, new = req["key"], req.get("expect"), req["new"]
+        except (ValueError, KeyError):
+            self.send_response(400)
+            self.end_headers()
+            return
+        with self.lock:
+            cur = self.kv.get(key)
+            cur_s = cur.decode() if cur is not None else None
+            ok = cur_s == expect
+            if ok:
+                self.kv[key] = new.encode()
+        self.send_response(200)
+        self.end_headers()
+        self.wfile.write(b"1" if ok else b"0")
+
 
 class KVServer:
     """The master-side store; runs in a daemon thread on node 0."""
@@ -112,6 +140,24 @@ class KVClient:
         try:
             with urllib.request.urlopen(req, timeout=5) as r:
                 return r.status == 200
+        except OSError:
+            return False
+
+    def cas(self, key: str, expect: Optional[str], new: str,
+            timeout: float = 5) -> bool:
+        """Atomic compare-and-swap: install ``new`` under ``key`` iff the
+        current value equals ``expect`` (``None`` = key absent).  Returns
+        True when the swap happened — the read-modify-write primitive the
+        serving frontend lease (inference/ha.py) is built on.  A
+        transport fault reads as False: the caller must not assume it
+        won."""
+        body = json.dumps({"key": key, "expect": expect,
+                           "new": new}).encode()
+        req = urllib.request.Request(f"{self.base}/cas", data=body,
+                                     method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return r.status == 200 and r.read() == b"1"
         except OSError:
             return False
 

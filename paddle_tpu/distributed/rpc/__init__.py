@@ -251,6 +251,15 @@ def _post(info: WorkerInfo, payload: bytes, timeout: float, ctx: str = ""):
                 f"after {timeout}s") from e
         raise
     if status == "err":
+        # mark the exception as REMOTE (the peer answered and its
+        # handler raised) so callers can tell it apart from a local
+        # transport fault of the same type — e.g. a worker-side
+        # ConnectionResetError failpoint vs a genuinely dead endpoint
+        # (fleet.connect_workers prunes only the latter)
+        try:
+            value._rpc_remote = True
+        except AttributeError:
+            pass               # __slots__ exception: stays unmarked
         raise value
     return value
 

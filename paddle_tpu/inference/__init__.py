@@ -25,7 +25,9 @@ __all__ = ["Config", "create_predictor", "Predictor", "PredictorPool",
            "Priority", "RequestStatus", "RequestResult", "ServingFleet",
            "RemoteReplica", "FleetAutoscaler", "AutoscalePolicy",
            "BrownoutPolicy", "FaultInjector", "FaultSpec",
-           "RespawnCircuitBreaker", "RequestJournal", "JournalCorruption"]
+           "RespawnCircuitBreaker", "RequestJournal", "JournalCorruption",
+           "JournalSuperseded", "StaleEpoch", "EpochFence", "FencedEngine",
+           "FrontendLease", "StandbyFrontend"]
 
 from .control_plane import (  # noqa: E402
     BrownoutPolicy,
@@ -45,7 +47,18 @@ from .fleet import (  # noqa: E402
     RemoteReplica,
     ServingFleet,
 )
-from .journal import JournalCorruption, RequestJournal  # noqa: E402
+from .ha import (  # noqa: E402
+    EpochFence,
+    FencedEngine,
+    FrontendLease,
+    StaleEpoch,
+    StandbyFrontend,
+)
+from .journal import (  # noqa: E402
+    JournalCorruption,
+    JournalSuperseded,
+    RequestJournal,
+)
 from .metrics import ServingMetrics  # noqa: E402
 from .serving import (  # noqa: E402
     BlockManager,

@@ -94,6 +94,51 @@ survives frontend death plus client redelivery.  Journal I/O faults
 kill serving: the frontend degrades to non-durable mode and raises the
 ``journal_degraded`` gauge loudly instead.
 
+Leadership & fencing (ISSUE 12).  Recovery alone is a manual,
+single-incarnation story; the HA layer (``inference/ha.py``) makes it
+automatic and zombie-safe:
+
+* **Lease** — pass ``lease=FrontendLease(master_endpoint)`` (acquired)
+  and the frontend renews it inside ``step()`` (ttl/3 cadence).  The
+  lease guarantees exactly one holder *as the KV master sees it* and
+  arbitrates who gets the next epoch — it does NOT by itself stop a
+  paused-then-resumed zombie, which cannot observe its own expiry.
+* **Epoch fencing** — the frontend's ``epoch`` (from the lease, or
+  explicit) rides every control RPC; workers/``FencedEngine`` wrappers
+  remember the highest epoch seen and reject lower ones with the typed
+  ``StaleEpoch``.  A ``StaleEpoch`` from any replica is TERMINAL for
+  this frontend: it marks itself deposed, stops journaling (the file
+  belongs to the successor), and re-raises — never treated as a
+  replica fault, never re-queued (the new incarnation already owns the
+  requests; re-queueing would double-execute them).  Losing the lease
+  at renew time deposes the same way, before any worker RPC is wasted.
+  The journal FILE is fenced too: RPC epochs cannot see file writes,
+  so the journal tracks the inode it owns (a successor's recovery
+  compaction installs a new one) and a stale writer's append/compaction
+  raises ``JournalSuperseded`` — surfaced as the same typed deposition
+  — instead of clobbering the successor's WAL.
+* **Takeover** — a ``StandbyFrontend`` watches the lease; on expiry it
+  acquires at epoch+1 and runs ``recover`` — whose orphan reap is the
+  FIRST rpc of the new epoch, so the workers fence every older
+  incarnation out before any request is re-admitted.  ``recover``
+  refuses a journal recorded by a HIGHER epoch (the caller is the
+  stale one) and, given no explicit epoch, arms at journal epoch + 1.
+* **Handoff** — ``handoff()`` is the rolling-upgrade path: stop
+  admitting, flush the buffered terminal group-commit, write a final
+  compaction snapshot (through the ``handoff.flush`` failpoint),
+  release the lease EARLY, and stop.  The successor recovers with zero
+  dropped admitted requests and the idempotency map intact, and no
+  ``StaleEpoch`` fires anywhere — a clean handoff never manufactures a
+  zombie.
+
+Epoch semantics: epochs are integers, monotone across incarnations
+forever (release preserves the counter); ``epoch=None`` disables
+fencing entirely (pre-HA single-frontend deployments).  Rid spaces:
+admitted requests draw non-negative rids journaled with a high-water
+mark; synchronous typed rejections draw NEGATIVE rids from a separate,
+never-journaled space — so a recovered frontend can never re-issue a
+rid a pre-crash client saw, journaled or not.
+
 Frontend → fleet → engine split: a replica is anything exposing the
 ServingEngine driving surface — an in-process engine or a
 ``fleet.RemoteReplica`` proxy whose engine lives in a
@@ -118,13 +163,15 @@ from typing import Callable, Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
-from .journal import ADMIT, PROGRESS, TERMINAL, RequestJournal
+from .ha import HANDOFF_FLUSH, FrontendLease, StaleEpoch
+from .journal import (ADMIT, EPOCH, PROGRESS, TERMINAL, JournalSuperseded,
+                      RequestJournal)
 from .metrics import (MEGASTEP_COUNTERS, ServingMetrics,
                       fold_counter_deltas, fold_prefix_counters)
 from .serving import SamplingParams, ServingEngine, prompt_block_hashes
 
 __all__ = ["Priority", "RequestStatus", "RequestResult", "ServingFrontend",
-           "BrownoutPolicy"]
+           "BrownoutPolicy", "StaleEpoch"]
 
 
 class Priority(IntEnum):
@@ -304,6 +351,8 @@ class ServingFrontend:
                  journal: Optional[RequestJournal] = None,
                  journal_compact_every: int = 1024,
                  idempotency_cache_size: int = 4096,
+                 epoch: Optional[int] = None,
+                 lease: Optional[FrontendLease] = None,
                  clock: Callable[[], float] = time.monotonic,
                  metrics: Optional[ServingMetrics] = None):
         if isinstance(engines, ServingEngine):
@@ -338,7 +387,37 @@ class ServingFrontend:
         self._requests: Dict[int, _FrontendRequest] = {}
         self._results: Dict[int, RequestResult] = {}
         self._next_rid = 0
+        # synchronous typed rejections draw from a separate NEGATIVE rid
+        # space: they are never journaled, so giving them durable-space
+        # rids would let a recovered frontend re-issue a rid some client
+        # still holds (the r12-documented reuse hole, now closed)
+        self._next_reject_rid = -1
         self._next_seq = 0
+        # HA leadership (ISSUE 12): fencing epoch + renewable lease.
+        # The epoch rides every control RPC; a StaleEpoch back from any
+        # replica (or a failed renew) deposes this frontend terminally.
+        if lease is not None:
+            if lease.epoch is None:
+                raise ValueError(
+                    "lease not acquired — call lease.acquire() (or go "
+                    "through StandbyFrontend) before constructing the "
+                    "frontend with it")
+            if epoch is None:
+                epoch = lease.epoch
+            elif epoch != lease.epoch:
+                raise ValueError(
+                    f"explicit epoch {epoch} != held lease epoch "
+                    f"{lease.epoch} — the lease is the epoch authority")
+        self.lease = lease
+        self.epoch = int(epoch) if epoch is not None else None
+        self._next_renew_t = -float("inf")
+        self._deposed = False
+        self._deposed_reason: Optional[str] = None
+        self._handed_off = False
+        if self.epoch is not None:
+            self.metrics.set_gauge("lease_epoch", float(self.epoch))
+        for rep in self._replicas:
+            self._propagate_epoch(rep)
         self._rr = 0  # round-robin cursor for routing tie-breaks
         self._next_replica_idx = len(self._replicas)
         # durable control plane (ISSUE 11): write-ahead request journal +
@@ -389,6 +468,14 @@ class ServingFrontend:
         self._idem_done: "OrderedDict[str, int]" = OrderedDict()
         if journal is not None:
             self.metrics.set_gauge("journal_degraded", 0.0)
+            if self.epoch is not None:
+                # journal header: the writer epoch is the first durable
+                # record a fresh epoch-armed frontend lays down, so a
+                # later recover() can refuse stale incarnations and arm
+                # at epoch+1 (recover() reattaches its journal after the
+                # snapshot rewrite and the snapshot carries the epoch)
+                self._journal_append({"t": EPOCH, "epoch": self.epoch,
+                                      "nr": self._next_rid})
 
     @classmethod
     def from_model(cls, model, num_replicas: int = 1, frontend_kwargs=None,
@@ -413,7 +500,99 @@ class ServingFrontend:
         rep = _Replica(self._next_replica_idx, engine)
         self._next_replica_idx += 1
         self._replicas.append(rep)
+        self._propagate_epoch(rep)
         return rep
+
+    # --------------------------------------------------- leadership (HA)
+    @property
+    def deposed(self) -> bool:
+        """True once this frontend lost leadership (a replica fenced it
+        with ``StaleEpoch``, or a lease renew found a newer epoch): it
+        must stop stepping — the successor owns the requests and the
+        journal."""
+        return self._deposed
+
+    @property
+    def handed_off(self) -> bool:
+        return self._handed_off
+
+    def _propagate_epoch(self, rep: _Replica):
+        """Stamp the frontend's epoch on a replica that supports fencing
+        (``RemoteReplica`` / ``FencedEngine`` ``set_epoch``); plain
+        engines ignore epochs — fencing is opt-in per replica type."""
+        if self.epoch is None:
+            return
+        fn = getattr(rep.engine, "set_epoch", None)
+        if fn is not None:
+            fn(self.epoch)
+
+    def _depose(self, reason: str):
+        """Terminal loss of leadership.  No replica is killed and NOTHING
+        is re-queued or finished: the new incarnation already recovered
+        every admitted request from the journal, so acting on them here
+        would double-execute.  Journaling stops too — the file belongs
+        to the successor now."""
+        if self._deposed:
+            return
+        self._deposed = True
+        self._deposed_reason = reason
+        self._step_records = []
+        if self.journal is not None:
+            try:
+                self.journal.close()
+            except Exception:  # noqa: BLE001 — already the stale writer
+                pass
+
+    def _fenced(self, exc: StaleEpoch,
+                replica: Optional[_Replica] = None) -> None:
+        """A replica rejected this frontend's epoch: count it, depose,
+        and re-raise — the typed 'stop stepping' signal, never a
+        failover.  Exactly-once counter discipline (same as the prefix/
+        orphan-reap folds): a RemoteReplica's WORKER already counted the
+        fence into its own scraped registry, so only count fences from
+        replicas that do not self-report (in-process FencedEngines) —
+        an aggregation folding both registries must see one event per
+        fenced RPC, not two."""
+        eng = replica.engine if replica is not None else None
+        if not getattr(eng, "fences_self_reported", False):
+            self.metrics.inc("fenced_rpcs_total")
+        self._depose(f"fenced by a replica: {exc}")
+        raise exc
+
+    def _depose_and_raise(self, reason: str,
+                          cause: Optional[BaseException] = None):
+        """Depose and raise the typed 'stop stepping' signal — shared by
+        every non-replica deposition source (lost lease renew,
+        superseded journal)."""
+        self._depose(reason)
+        raise StaleEpoch(
+            f"frontend epoch {self.epoch} deposed: {self._deposed_reason}"
+            " — stop stepping and defer to the current incarnation"
+        ) from cause
+
+    def _maintain_lease(self):
+        """Renew the leadership lease on a ttl/3 cadence; losing it
+        deposes this frontend BEFORE any worker RPC is wasted (a resumed
+        zombie usually dies here, not at a worker fence).  Transport
+        faults are absorbed by the lease's own jittered retries; a
+        definitive 'someone newer holds it' answer is terminal."""
+        now = self._clock()
+        if now < self._next_renew_t:
+            return
+        self._next_renew_t = now + self.lease.ttl_s / 3.0
+        try:
+            ok = self.lease.renew()
+        except Exception:  # noqa: BLE001 — injected lease fault
+            # a faulted renew path (lease.renew failpoint, KV wedge) is
+            # indistinguishable from a slow KV: keep serving — fencing
+            # is the safety net — and retry at the NEXT cadence point
+            # (already armed above).  Retrying every step would block
+            # the decode hot path in renew()'s backoff sleeps for the
+            # whole outage, collapsing throughput for every request.
+            return
+        if not ok:
+            self._depose_and_raise("lease lost: a newer epoch holds "
+                                   f"{self.lease.key!r}")
 
     def remove_replica(self, replica: _Replica):
         """Detach a replica.  It must be idle (drained) or dead — removing
@@ -475,7 +654,20 @@ class ServingFrontend:
         twice — across frontend restarts too, when a journal is armed
         (keys ride the admit/terminal records).  Only ADMITTED requests
         claim their key: a typed rejection (OVERLOADED etc.) never
-        executed, so retrying it for real is safe and correct."""
+        executed, so retrying it for real is safe and correct.
+
+        Rid spaces: admitted requests get non-negative rids (durable,
+        journaled with a high-water mark); synchronous typed rejections
+        get NEGATIVE rids — valid handles for ``result``/``cancel`` in
+        this process, never journaled and never re-issued by a
+        recovered frontend (do not hold them across a restart)."""
+        if self._deposed:
+            raise StaleEpoch(
+                f"frontend deposed ({self._deposed_reason}) — submit to "
+                "the current incarnation")
+        if self._handed_off:
+            raise RuntimeError(
+                "frontend handed off — submit to the successor")
         if idempotency_key is not None:
             prev = self._idem_open.get(idempotency_key,
                                        self._idem_done.get(idempotency_key))
@@ -499,37 +691,37 @@ class ServingFrontend:
                                   top_k=int(top_k), top_p=float(top_p),
                                   seed=int(seed), logprobs=bool(logprobs))
         now = self._clock()
-        rid = self._next_rid
-        self._next_rid += 1
+        # the durable rid is only CLAIMED on admission below; a rejected
+        # request is re-homed into the negative space by _reject
         req = _FrontendRequest(
-            rid=rid, prompt=prompt, max_new_tokens=int(max_new_tokens),
+            rid=self._next_rid, prompt=prompt,
+            max_new_tokens=int(max_new_tokens),
             priority=Priority(priority),
             deadline_t=(now + deadline_s) if deadline_s is not None else None,
             eos_token_id=eos_token_id, submit_t=now, seq=self._next_seq,
             sampling=sampling, on_token=on_token,
             idempotency_key=idempotency_key)
         self._next_seq += 1
-        self._requests[rid] = req
 
         live = [r for r in self._replicas if r.alive]
         if not live:
-            self._finish(req, RequestStatus.FAILED, "no live replicas")
-            return rid
+            return self._reject(req, RequestStatus.FAILED,
+                                "no live replicas")
         accepting = [r for r in live if not r.draining]
         if not accepting:
-            self._finish(req, RequestStatus.OVERLOADED,
-                         "every live replica is draining (fleet scale-down "
-                         "in progress) — not admitting")
-            return rid
+            return self._reject(
+                req, RequestStatus.OVERLOADED,
+                "every live replica is draining (fleet scale-down "
+                "in progress) — not admitting")
         # brownout degradation (level maintained by step() with
         # hysteresis): shed the cheapest class first, then shrink NORMAL
         # work; HIGH is never degraded
         if self._brownout_level >= 1 and req.priority is Priority.LOW:
-            self._finish(req, RequestStatus.REJECTED_BROWNOUT,
-                         f"brownout level {self._brownout_level}: LOW "
-                         "admission shed under sustained queue/pool "
-                         "pressure — retry later or raise priority")
-            return rid
+            return self._reject(
+                req, RequestStatus.REJECTED_BROWNOUT,
+                f"brownout level {self._brownout_level}: LOW "
+                "admission shed under sustained queue/pool "
+                "pressure — retry later or raise priority")
         if self._brownout_level >= 2 and req.priority is Priority.NORMAL:
             cap = self.brownout.normal_max_new_tokens
             if req.max_new_tokens > cap:
@@ -537,31 +729,34 @@ class ServingFrontend:
                 req.max_new_tokens = cap
                 self.metrics.inc("brownout_capped_total")
         if not any(self._fits_at_all(r, req) for r in accepting):
-            self._finish(req, RequestStatus.OVERLOADED,
-                         f"prompt+max_new_tokens={req.total_tokens} exceeds "
-                         "every live replica's capacity")
-            return rid
+            return self._reject(
+                req, RequestStatus.OVERLOADED,
+                f"prompt+max_new_tokens={req.total_tokens} exceeds "
+                "every live replica's capacity")
         if (self.max_queue_requests is not None
                 and len(self._queue) >= self.max_queue_requests):
-            self._finish(req, RequestStatus.OVERLOADED,
-                         f"queue full ({self.max_queue_requests} requests)")
-            return rid
+            return self._reject(
+                req, RequestStatus.OVERLOADED,
+                f"queue full ({self.max_queue_requests} requests)")
         if self.max_queue_tokens is not None:
             committed = sum(q.total_tokens for q in self._queue)
             if committed + req.total_tokens > self.max_queue_tokens:
-                self._finish(req, RequestStatus.OVERLOADED,
-                             f"queued token budget exhausted ({committed}"
-                             f"+{req.total_tokens} > {self.max_queue_tokens})")
-                return rid
+                return self._reject(
+                    req, RequestStatus.OVERLOADED,
+                    f"queued token budget exhausted ({committed}"
+                    f"+{req.total_tokens} > {self.max_queue_tokens})")
         if self.class_token_budgets is not None:
             cap = self.class_token_budgets.get(req.priority)
             held = self._class_tokens[req.priority]
             if cap is not None and held + req.total_tokens > cap:
-                self._finish(req, RequestStatus.OVERLOADED,
-                             f"class {req.priority.name} token budget "
-                             f"exhausted ({held}+{req.total_tokens} > {cap} "
-                             "fleet-wide)")
-                return rid
+                return self._reject(
+                    req, RequestStatus.OVERLOADED,
+                    f"class {req.priority.name} token budget "
+                    f"exhausted ({held}+{req.total_tokens} > {cap} "
+                    "fleet-wide)")
+        rid = req.rid
+        self._next_rid += 1
+        self._requests[rid] = req
         req.counted_tokens = req.total_tokens
         self._class_tokens[req.priority] += req.counted_tokens
         self._queue.append(req)
@@ -574,9 +769,34 @@ class ServingFrontend:
         self.metrics.inc("admitted_total")
         return rid
 
+    def _reject(self, req: _FrontendRequest, status: RequestStatus,
+                detail: str) -> int:
+        """Resolve a synchronous typed rejection.  The request moves to
+        the NEGATIVE rid space: it never executed and is never
+        journaled, so the durable (non-negative) rid space stays exactly
+        'rids the journal's high-water mark covers' — recovery can never
+        re-issue a rid any client saw."""
+        req.rid = self._next_reject_rid
+        self._next_reject_rid -= 1
+        self._requests[req.rid] = req
+        self._finish(req, status, detail)
+        return req.rid
+
     def cancel(self, rid: int) -> bool:
         """Cancel a queued or running request; returns False if already
         resolved (or unknown)."""
+        if self._deposed:
+            raise StaleEpoch(
+                f"frontend deposed ({self._deposed_reason}) — the "
+                "current incarnation owns this request; cancel there")
+        if self._handed_off:
+            # same inertness contract as submit/step: the successor owns
+            # every open request — an evict from here would kill ITS
+            # in-flight sequence (epoch=None deployments have no fence
+            # to stop it), and a terminal append would reopen the WAL
+            # behind the final handoff snapshot
+            raise RuntimeError(
+                "frontend handed off — cancel on the successor")
         req = self._requests.get(rid)
         if req is None or rid in self._results:
             return False
@@ -588,6 +808,8 @@ class ServingFrontend:
                 rep.engine.evict(req.engine_rid)
             except KeyError:
                 pass  # engine already retired it; harvest races are benign
+            except StaleEpoch as e:
+                self._fenced(e, rep)     # deposed: raises, never failover
             except Exception as e:  # noqa: BLE001 — remote replica fault
                 # a dead/hung remote replica fails over like a step() fault;
                 # _kill_replica re-queues its requests (incl. this one) —
@@ -602,9 +824,19 @@ class ServingFrontend:
         return True
 
     def step(self):
-        """One control-plane iteration: shed expired deadlines, dispatch
-        (with preemption), step every live replica, harvest tokens and
-        completions, sample metrics."""
+        """One control-plane iteration: renew leadership (when leased),
+        shed expired deadlines, dispatch (with preemption), step every
+        live replica, harvest tokens and completions, sample metrics.
+        Raises the typed ``StaleEpoch`` once this frontend is deposed —
+        the driver must stop and defer to the current incarnation."""
+        if self._deposed:
+            raise StaleEpoch(
+                f"frontend deposed ({self._deposed_reason}) — stop "
+                "stepping and defer to the current incarnation")
+        if self._handed_off:
+            raise RuntimeError("frontend handed off — drive the successor")
+        if self.lease is not None:
+            self._maintain_lease()
         live = [r for r in self._replicas if r.alive]
         if not live:
             for req in list(self._queue):
@@ -695,8 +927,12 @@ class ServingFrontend:
 
     @property
     def _journaling(self) -> bool:
-        """The ONE armed-and-healthy check every journal site gates on."""
-        return self.journal is not None and not self._journal_degraded
+        """The ONE armed-and-healthy check every journal site gates on
+        (a deposed OR handed-off frontend stops writing too — the
+        journal belongs to the successor, and stale appends would
+        corrupt ITS state)."""
+        return (self.journal is not None and not self._journal_degraded
+                and not self._deposed and not self._handed_off)
 
     def _journal_append(self, rec: Dict) -> None:
         """Append one lifecycle record; a failing journal DEGRADES the
@@ -709,6 +945,12 @@ class ServingFrontend:
             return
         try:
             n = self.journal.append_batch(recs)
+        except JournalSuperseded as e:
+            # the journal FILE was replaced by a successor's recovery
+            # compaction: that is a deposition signal (RPC fencing can't
+            # see file writes), never a degradable I/O fault — degrading
+            # would keep this stale incarnation serving un-journaled
+            self._depose_and_raise(f"journal superseded: {e}", cause=e)
         except Exception as e:  # noqa: BLE001 — any I/O fault degrades
             self._journal_degrade(e)
             return
@@ -748,9 +990,10 @@ class ServingFrontend:
         Shared by submit-time journaling and compaction snapshots."""
         rem = (req.deadline_t - self._clock()
                if req.deadline_t is not None else None)
-        # "nr" pins the rid high-water mark (typed rejections consume
-        # rids WITHOUT being journaled, so recovery must not re-issue
-        # them to new requests); "attempts" preserves the r10 retry
+        # "nr" pins the rid high-water mark so recovery continues the
+        # durable rid space exactly where this life left it (typed
+        # rejections live in their own negative space and never touch
+        # it); "attempts" preserves the r10 retry
         # budget across restarts — a poison request must not get a fresh
         # budget per frontend life (snapshots re-serialize open requests
         # through here, so a compacted journal carries the current count)
@@ -779,19 +1022,90 @@ class ServingFrontend:
                          "n_tokens": len(res.tokens),
                          "attempts": res.attempts})
         return {"t": "snapshot", "next_rid": self._next_rid,
-                "open": open_recs, "done": done}
+                "open": open_recs, "done": done, "epoch": self.epoch}
 
     def _compact_journal(self):
         try:
             self.journal.rewrite(self._snapshot_state())
+        except JournalSuperseded as e:
+            # a successor already os.replace'd the path (recovery always
+            # compacts): proceeding would install THIS incarnation's
+            # stale snapshot over the successor's live WAL — the exact
+            # split-brain corruption the epoch fence exists to prevent.
+            # Depose instead; the old journal content is untouched.
+            self._depose_and_raise(f"journal superseded: {e}", cause=e)
         except Exception as e:  # noqa: BLE001 — degrade, never crash
             self._journal_degrade(e)
             return
         self._records_since_compact = 0
         self.metrics.inc("journal_compactions_total")
 
+    def handoff(self):
+        """Zero-downtime leadership handoff (rolling frontend upgrades,
+        ISSUE 12): stop admitting, group-commit the buffered in-step
+        terminals, write a final compaction snapshot (open admits + the
+        idempotency map + the writer epoch, through the
+        ``handoff.flush`` failpoint), release the lease EARLY, and stop.
+
+        The successor (a ``StandbyFrontend`` polling the lease, or an
+        operator running ``recover``) takes over at epoch+1 with ZERO
+        dropped admitted requests — open requests ride the snapshot and
+        re-admit; in-flight sequences on the engines are reaped and
+        replay token-identically — and the idempotency map intact, so
+        clients that replay their keys get their original rids.  Unlike
+        a crash, nothing ever fences: this frontend stops itself before
+        the successor's epoch exists, so no ``StaleEpoch`` fires
+        anywhere (the chaos soak asserts exactly that).
+
+        After handoff this frontend is inert: ``step``/``submit`` raise
+        RuntimeError pointing at the successor.  A journal-flush fault
+        degrades (the un-compacted journal still recovers fully) — it
+        never blocks the handoff."""
+        if self._handed_off:
+            return
+        if self._deposed:
+            raise StaleEpoch(
+                f"cannot hand off a deposed frontend "
+                f"({self._deposed_reason}) — the successor already took "
+                "over the hard way")
+        # terminal records buffered inside an interrupted step (callers
+        # normally invoke handoff between steps; this makes mid-step
+        # invocation safe too) become durable before the snapshot
+        self._flush_step_records()
+        if self._journaling:
+            inj = self.journal._faults
+            try:
+                if inj is not None:
+                    inj.fire(HANDOFF_FLUSH, detail=str(self.epoch))
+                self._compact_journal()
+            except StaleEpoch:
+                # journal superseded mid-handoff: a successor already
+                # took over the hard way — this is a deposition, not a
+                # completed handoff
+                raise
+            except Exception as e:  # noqa: BLE001 — degrade, keep going
+                self._journal_degrade(e)
+        if self.journal is not None:
+            try:
+                self.journal.close()   # the successor owns the file now
+            except Exception as e:  # noqa: BLE001 — same contract as the
+                # compaction above: a flush fault (ENOSPC draining the
+                # fsync=False buffer) degrades — aborting HERE would
+                # leave the lease held for a full TTL with _handed_off
+                # unset, turning a clean handoff into a failover
+                self._journal_degrade(e)
+        if self.lease is not None:
+            try:
+                self.lease.release()
+            except Exception:  # noqa: BLE001 — TTL expiry still hands off
+                pass
+        self._handed_off = True
+        self.metrics.inc("handoffs_total")
+
     @classmethod
     def recover(cls, journal, engines, *, reap_orphans: bool = True,
+                epoch: Optional[int] = None,
+                lease: Optional[FrontendLease] = None,
                 **kwargs) -> "ServingFrontend":
         """Rebuild a frontend from a dead one's journal (crash-consistent
         recovery, ISSUE 11).
@@ -826,11 +1140,22 @@ class ServingFrontend:
         not self-report — a RemoteReplica's worker counts its own reap).
 
         Rid continuity: journaled rids (admitted requests) are never
-        re-issued — every record carries the rid high-water mark ``nr``.
-        Typed REJECTIONS are not journaled, so rids they consumed after
-        the last journaled record may be re-issued by the recovered
-        frontend; rejections resolve synchronously at submit, so clients
-        must not hold their rids across a crash."""
+        re-issued — every record carries the rid high-water mark ``nr``
+        — and typed REJECTIONS draw from a separate negative rid space
+        that never intersects it, so NO rid any pre-crash client saw
+        can come back attached to a different request.
+
+        Epoch fencing (ISSUE 12): ``epoch`` (or the acquired ``lease``'s
+        epoch) becomes the recovered frontend's fencing epoch and MUST
+        exceed the journal's recorded writer epoch — a journal written
+        by a higher epoch means the caller is the stale incarnation, and
+        recover raises the typed ``StaleEpoch`` instead of silently
+        merging two rid generations.  With no explicit epoch, an
+        epoch-recorded journal arms the new incarnation at
+        ``journal epoch + 1`` automatically.  The orphan reap below is
+        the FIRST rpc issued under the new epoch, so taking over also
+        fences every older incarnation out of the workers before any
+        request is re-admitted."""
         if "journal" in kwargs:
             raise ValueError("recover() owns the journal argument — the "
                              "replayed journal is reattached after the "
@@ -843,8 +1168,11 @@ class ServingFrontend:
         attempts: Dict[int, int] = {}
         deadlines: Dict[int, float] = {}   # latest REMAINING deadline
         next_rid = 0
+        journal_epoch: Optional[int] = None
         if snapshot is not None:
             next_rid = int(snapshot.get("next_rid", 0))
+            if snapshot.get("epoch") is not None:
+                journal_epoch = int(snapshot["epoch"])
             for a in snapshot.get("open", ()):
                 admits[int(a["rid"])] = a
             for t in snapshot.get("done", ()):
@@ -862,11 +1190,34 @@ class ServingFrontend:
                 attempts[int(rec["rid"])] = int(rec.get("attempts", 0))
                 if "dl" in rec:
                     deadlines[int(rec["rid"])] = rec["dl"]
+            elif kind == EPOCH:
+                journal_epoch = max(journal_epoch or 0, int(rec["epoch"]))
             # every record kind may carry the rid high-water mark "nr"
             if "nr" in rec:
                 next_rid = max(next_rid, int(rec["nr"]))
 
-        fe = cls(engines, **kwargs)
+        # journal-side fencing: a journal recorded by a HIGHER epoch
+        # belongs to a newer incarnation — the caller is the stale one,
+        # and "recovering" it would merge two rid generations and stub
+        # the successor's live requests with ghost terminals
+        if lease is not None and epoch is None:
+            epoch = lease.epoch
+        if journal_epoch is not None:
+            if epoch is None:
+                epoch = journal_epoch + 1   # new incarnation arms above
+            elif epoch <= journal_epoch:
+                # equality is NOT safe: EpochFence admits epoch >= its
+                # highest, so recovering at the journal's own epoch
+                # would let a zombie of the prior incarnation (same
+                # epoch) keep passing every worker fence alongside us
+                raise StaleEpoch(
+                    f"journal {journal.path!r} was written by epoch "
+                    f"{journal_epoch} >= yours ({epoch}): recovery must "
+                    "arm STRICTLY above the journal's writer epoch to "
+                    "fence the prior incarnation out — pass a higher "
+                    "epoch (or none, to auto-arm at journal epoch + 1)")
+
+        fe = cls(engines, epoch=epoch, lease=lease, **kwargs)
         reaped = 0
         if reap_orphans:
             for rep in list(fe._replicas):
@@ -875,6 +1226,10 @@ class ServingFrontend:
                     continue
                 try:
                     n = int(fn())
+                except StaleEpoch:
+                    # OUR epoch got fenced mid-recovery: a yet-newer
+                    # incarnation raced past us — abort, we lost
+                    raise
                 except Exception as e:  # noqa: BLE001 — dead worker
                     fe._kill_replica(rep, e)
                     continue
@@ -1039,6 +1394,8 @@ class ServingFrontend:
                         rep.engine.evict(erid)
                     except KeyError:
                         pass
+                    except StaleEpoch as e:
+                        self._fenced(e, rep)
                     except Exception as e:  # noqa: BLE001 — replica fault
                         # failover re-queues the replica's requests; the
                         # expired one is finished below either way
@@ -1175,6 +1532,8 @@ class ServingFrontend:
             rep.engine.evict(victim.engine_rid)
         except KeyError:
             pass  # retired between planning and eviction; slot is free
+        except StaleEpoch as e:
+            self._fenced(e, rep)
         except Exception as e:  # noqa: BLE001 — remote replica fault
             self._kill_replica(rep, e)
             return False
@@ -1208,6 +1567,12 @@ class ServingFrontend:
             self._finish(req, RequestStatus.OVERLOADED,
                          f"engine rejected request: {e}")
             return
+        except StaleEpoch as e:
+            # the request stays queued untouched: the successor already
+            # owns it (recovered from the journal) — nothing to do here
+            # but stop being a zombie
+            self._queue.append(req)
+            self._fenced(e, rep)
         except Exception as e:  # noqa: BLE001 — remote replica fault
             # a worker that died between heartbeats surfaces here when
             # dispatch tries to place on it: fail over (re-queues its
@@ -1227,6 +1592,11 @@ class ServingFrontend:
     def _step_replica(self, rep: _Replica):
         try:
             emitted = rep.engine.step()
+        except StaleEpoch as e:
+            # a fenced step is the worker saying "you are deposed", not a
+            # replica fault: no kill, no re-queue (the new incarnation
+            # owns these requests — re-queueing would double-execute)
+            self._fenced(e, rep)
         except Exception as e:  # noqa: BLE001 — any replica fault fails over
             self._kill_replica(rep, e)
             return

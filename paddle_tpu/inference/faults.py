@@ -44,12 +44,13 @@ import os
 import random
 import time
 from dataclasses import dataclass
-from typing import Callable, Dict, List, Optional, Tuple, Union
+from typing import Callable, Dict, Iterable, List, Optional, Tuple, Union
 
 __all__ = [
     "FaultSpec", "FaultInjector", "InjectedFault", "InjectedTimeout",
     "InjectedDrop", "RespawnCircuitBreaker", "FaultyReplica",
     "FAULTS_ENV_VAR", "KNOWN_SITES", "register_failpoint",
+    "REPLICA_NAMESPACES", "register_replica_namespace",
 ]
 
 FAULTS_ENV_VAR = "PADDLE_TPU_FAULTS"
@@ -72,16 +73,31 @@ KNOWN_SITES = {
     "fleet.heartbeat",    # fleet-side heartbeat loop
     "journal.append",     # request-journal record write (ISSUE 11)
     "journal.fsync",      # request-journal durability barrier
+    # HA control plane (ISSUE 12) — canonical registrations live next
+    # to the firing code in inference/ha.py / control_plane.handoff;
+    # listed here too so an env-armed injector in a process that never
+    # imports the HA stack still validates them
+    "lease.acquire",      # FrontendLease.acquire (standby takeover)
+    "lease.renew",        # FrontendLease.renew (active heartbeat)
+    "handoff.flush",      # ServingFrontend.handoff final snapshot
 }
-# FaultyReplica also fires replica-scoped sites "<replica name>.<op>"
-# (so a schedule can doom one replica); any prefix is legal for these
-# ops, the op suffix is what gets validated.  KNOWN CAVEAT: this escape
-# hatch means a typo in the NAMESPACE of a registered site whose op
-# suffix is also a replica op ("enigne.step") still arms silently as a
-# replica-scoped site — only suffix typos ("engine.stpe") are caught.
-# Replica names in this repo's chaos schedules are "r<N>"; keep custom
-# replica names visually distinct from the registry namespaces.
+# FaultyReplica/FencedEngine also fire replica-scoped sites
+# "<replica name>.<op>" (so a schedule can doom one replica).  The
+# NAMESPACE must be registered (register_replica_namespace, the
+# constructor/env "replica_namespaces" lists, or wrapping a
+# FaultyReplica with that name) — closing the r12 round-3 hole where a
+# namespace typo whose op suffix was legal ("enigne.step") armed
+# silently and the chaos run degraded to calm.  KNOWN SCOPE LIMIT: the
+# set is process-global and grow-only (wrap-first-arm-later and
+# register-up-front both need registrations to outlive any one
+# injector), so a LATER injector in the same process validates against
+# every name an EARLIER run registered — a stale copy-paste site like
+# "r0.step" arms silently if some previous schedule spawned an "r0".
+# Run-scoped registration would need an explicit registry handle
+# threaded through FaultyReplica/run_chaos; not worth it until a second
+# real collision shows up.
 _REPLICA_OPS = {"step", "add_request", "evict"}
+REPLICA_NAMESPACES: set = set()
 
 
 def register_failpoint(site: str) -> str:
@@ -90,6 +106,16 @@ def register_failpoint(site: str) -> str:
     site constant: ``MY_SITE = register_failpoint("cache.flush")``."""
     KNOWN_SITES.add(site)
     return site
+
+
+def register_replica_namespace(name: str) -> str:
+    """Allow ``<name>.<op>`` replica-scoped sites (op in step /
+    add_request / evict) to arm.  Chaos harnesses register the replica
+    names they plan to spawn BEFORE building the injector;
+    ``FaultyReplica`` registers its own name at construction for the
+    wrap-first-arm-later order.  Returns the name."""
+    REPLICA_NAMESPACES.add(name)
+    return name
 
 
 class InjectedFault(RuntimeError):
@@ -149,9 +175,12 @@ class FaultInjector:
     path carries zero overhead."""
 
     def __init__(self, sites: Dict[str, Union[FaultSpec, Dict]],
-                 seed: int = 0, sleep: Callable[[float], None] = time.sleep):
+                 seed: int = 0, sleep: Callable[[float], None] = time.sleep,
+                 replica_namespaces: Iterable[str] = ()):
         self.seed = int(seed)
         self._sleep = sleep
+        for ns in replica_namespaces:
+            register_replica_namespace(ns)
         for site in (sites or {}):
             self._validate_site(site)
         self._specs: Dict[str, FaultSpec] = {
@@ -174,12 +203,28 @@ class FaultInjector:
         calm.  Both the constructor and the env-JSON path funnel here."""
         if site in KNOWN_SITES:
             return
-        if "." in site and site.rsplit(".", 1)[1] in _REPLICA_OPS:
-            return                 # replica-scoped "<name>.<op>" site
+        if "." in site:
+            ns, op = site.rsplit(".", 1)
+            # replica-scoped "<name>.<op>": BOTH halves validate — the
+            # op against the fixed replica surface, the namespace
+            # against the registered set, so "typo-replica.step" raises
+            # here instead of silently never firing (r12 round-3 hole)
+            if op in _REPLICA_OPS and ns in REPLICA_NAMESPACES:
+                return
+            if op in _REPLICA_OPS:
+                raise ValueError(
+                    f"failpoint site {site!r} has a replica-op suffix but "
+                    f"unregistered namespace {ns!r}: nothing would fire "
+                    "it. Register planned replica names first "
+                    "(faults.register_replica_namespace, the injector's "
+                    "replica_namespaces= argument, or the env spec's "
+                    '"replica_namespaces" list); currently registered: '
+                    f"{sorted(REPLICA_NAMESPACES)}")
         raise ValueError(
             f"unknown failpoint site {site!r}: nothing fires it, so the "
             "spec would never trigger. Known sites: "
-            f"{sorted(KNOWN_SITES)}; replica-scoped sites end in one of "
+            f"{sorted(KNOWN_SITES)}; replica-scoped sites are "
+            f"'<registered namespace>.<op>' with op in "
             f"{sorted(_REPLICA_OPS)}. New production sites register via "
             "faults.register_failpoint")
 
@@ -194,7 +239,8 @@ class FaultInjector:
         if not raw:
             return None
         cfg = json.loads(raw)
-        return cls(cfg.get("sites", {}), seed=cfg.get("seed", 0))
+        return cls(cfg.get("sites", {}), seed=cfg.get("seed", 0),
+                   replica_namespaces=cfg.get("replica_namespaces", ()))
 
     def spec(self, site: str) -> Optional[FaultSpec]:
         return self._specs.get(site)
@@ -358,7 +404,7 @@ class FaultyReplica:
                  name: str = "replica", timeout_exc: Optional[type] = None):
         self._eng = engine
         self._inj = injector
-        self.name = name
+        self.name = register_replica_namespace(name)
         self._timeout_exc = timeout_exc
 
     def __getattr__(self, attr):

@@ -73,11 +73,25 @@ sequences worker-side (``_w_reap_orphans`` RPC; eviction publishes
 their full KV blocks, so the recovered re-prefill largely hits the
 prefix cache on the same worker) and re-admits from the journal.
 
+High availability (ISSUE 12): every control RPC handler below is
+FENCED — it carries the calling frontend's epoch (``epoch=`` kwarg,
+stamped by ``RemoteReplica.set_epoch``), the worker's ``EpochFence``
+remembers the highest epoch its process has ever seen, and an older
+epoch raises the typed ``StaleEpoch`` before the handler touches the
+engine.  This is what makes standby failover safe against zombies: a
+SIGSTOP'd frontend resumed after its lease expired cannot know it was
+deposed, but its first write lands as a typed rejection instead of
+corrupting streams the new incarnation owns.  ``_w_health`` stays
+unfenced (read-only; standbys watch through it) and reports the
+highest epoch seen.  ``connect_workers`` is the standby's replica
+factory: discovery + liveness probe + stale-entry pruning.
+
 Scope note: each worker is still one host / one engine; true multi-host
 TPU meshes *per replica* (a sharded engine spanning hosts) remain open.
 """
 from __future__ import annotations
 
+import errno
 import json
 import os
 import subprocess
@@ -91,11 +105,13 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from .control_plane import ServingFrontend
 from .faults import FaultInjector, RespawnCircuitBreaker
+from .ha import EpochFence, StaleEpoch
 from .metrics import (MEGASTEP_COUNTERS, ServingMetrics,
                       fold_counter_deltas, fold_prefix_counters)
 
 __all__ = ["RemoteReplica", "ServingFleet", "FleetAutoscaler",
-           "AutoscalePolicy", "init_worker", "discover_workers"]
+           "AutoscalePolicy", "init_worker", "discover_workers",
+           "connect_workers"]
 
 
 def discover_workers(master_endpoint: str,
@@ -112,16 +128,97 @@ def discover_workers(master_endpoint: str,
     took the registry down with it.
 
     ``exclude`` filters non-worker registrations: the rpc layer
-    registers EVERY participant under ``/rpc/workers/``, including the
-    frontend itself (``ServingFleet`` registers as ``fleet-frontend``) —
-    and a SIGKILLed frontend never deregisters, so its stale entry would
-    otherwise come back as a bogus "worker".  Pass the recovering
-    process's own rpc name too if it differs."""
+    registers EVERY participant under ``/rpc/workers/``, including
+    frontends (``ServingFleet`` registers as ``fleet-frontend``; HA
+    incarnations and standbys register under their own names) — and a
+    SIGKILLed frontend never deregisters, so its stale entry would
+    otherwise come back as a bogus "worker".  Any name CONTAINING
+    ``"frontend"`` is excluded by construction (the repo's frontend
+    naming convention — never name a worker that), plus the exact names
+    in ``exclude``; pass the recovering process's own rpc name too if
+    it does not match the convention."""
     from ..distributed.launch.master import KVClient
 
     entries = KVClient(master_endpoint).get_prefix("/rpc/workers/")
     names = (k.rsplit("/", 1)[-1] for k in entries)
-    return sorted(n for n in names if n not in set(exclude))
+    drop = set(exclude)
+    return sorted(n for n in names if n not in drop and "frontend" not in n)
+
+
+# the only probe failures that PROVE nothing is listening at the
+# advertised endpoint; every other OSError (reset, broken pipe) can come
+# from a live worker's transient connection blip and must not prune
+_DEAD_ENDPOINT_ERRNOS = frozenset({
+    errno.ECONNREFUSED, errno.EHOSTUNREACH, errno.ENETUNREACH,
+    errno.EHOSTDOWN, errno.ENETDOWN})
+
+
+def _is_dead_endpoint(e: OSError) -> bool:
+    # urllib surfaces a refused connect as URLError(reason=
+    # ConnectionRefusedError) with errno=None on the wrapper — check
+    # the wrapped reason too
+    for err in (e, getattr(e, "reason", None)):
+        if isinstance(err, ConnectionRefusedError) \
+                or getattr(err, "errno", None) in _DEAD_ENDPOINT_ERRNOS:
+            return True
+    return False
+
+
+def connect_workers(master_endpoint: str,
+                    exclude: Sequence[str] = ("fleet-frontend",),
+                    rpc_timeout: float = 60.0,
+                    prune_stale: bool = True,
+                    probe_timeout_s: float = 5.0) -> List["RemoteReplica"]:
+    """``discover_workers`` + a liveness probe: wrap every discovered
+    name in a ``RemoteReplica`` (whose constructor round-trips the
+    health RPC) and SKIP the ones that don't answer — a dead worker's
+    stale KV entry (SIGKILLed between heartbeats, host gone) must not
+    come back as a bogus replica in a recovered frontend.
+    ``prune_stale`` deletes the dead entries from the registry so the
+    next discovery is clean — but ONLY for probes that failed with a
+    definitive dead-endpoint error (connection refused, no route): a
+    probe that merely TIMED OUT may be a live worker mid-megastep or
+    mid-XLA-compile, and one whose HANDLER raised (an armed
+    ``health.probe`` failpoint, a transient engine error) answered over
+    a healthy connection — registration is one-shot (``init_rpc``), so
+    deleting either entry would delist a healthy worker forever.  Both
+    are skipped this takeover and re-probed by the next discovery.
+    ``probe_timeout_s`` bounds each liveness probe SEPARATELY from the
+    replicas' data-plane ``rpc_timeout``: probes run sequentially, and a
+    black-holed dead host (no RST, just silence) would otherwise burn
+    the full step timeout per worker on the takeover path the lease TTL
+    was tuned for.  Requires an rpc session (``rpc.init_rpc``);
+    refreshes the routing table itself.  This is the
+    ``replica_factory`` a ``StandbyFrontend`` should use."""
+    from ..distributed import rpc
+    from ..distributed.launch.master import KVClient
+
+    rpc.refresh_workers()
+    kv = KVClient(master_endpoint)
+    out: List[RemoteReplica] = []
+    for name in discover_workers(master_endpoint, exclude):
+        try:
+            out.append(RemoteReplica(name, rpc_timeout=rpc_timeout,
+                                     probe_timeout=probe_timeout_s))
+        except rpc.RpcTimeout:
+            continue           # live-but-slow ≠ stale: skip, never prune
+        except OSError as e:
+            # ...unless the error is REMOTE (rpc marks handler-raised
+            # exceptions): a worker whose health handler raised an
+            # OSError subclass — e.g. an armed health.probe failpoint of
+            # kind timeout/drop — ANSWERED over a healthy connection
+            if getattr(e, "_rpc_remote", False):
+                continue
+            # only DEFINITIVE dead-endpoint errnos may prune: a local
+            # reset/broken-pipe is a transient blip (listener mid-
+            # restart, full accept backlog) from a worker that is very
+            # much alive — deleting its one-shot registration on that
+            # would delist it forever
+            if prune_stale and _is_dead_endpoint(e):
+                kv.delete(f"/rpc/workers/{name}")
+        except Exception:  # noqa: BLE001 — the worker ANSWERED (its
+            continue       # handler raised): alive, keep the entry
+    return out
 
 
 class _BoundedErrors(OrderedDict):
@@ -150,6 +247,7 @@ class _BoundedErrors(OrderedDict):
 _WORKER: Dict[str, Any] = {
     "engine": None, "metrics": None, "stop": None, "name": None,
     "prefix_seen": (0, 0, 0), "mega_seen": (0, 0), "faults": None,
+    "fence": EpochFence(),
 }
 
 
@@ -162,7 +260,19 @@ def init_worker(engine, name: str,
     tools/serving_worker.py before ``rpc.init_rpc``).  Returns the stop
     event ``_w_shutdown`` sets.  ``fault_injector`` arms the worker-side
     failpoints (``health.probe`` here; the engine carries its own
-    ``engine.step`` site) for chaos runs."""
+    ``engine.step`` site) for chaos runs.  A fresh ``EpochFence`` is
+    armed too: it lives for the worker PROCESS — frontends come and go
+    across it (that is the whole point), each bumping the highest epoch
+    seen with its first control RPC."""
+    if "frontend" in name:
+        # discover_workers/connect_workers drop any registration whose
+        # name contains "frontend" (that's how stale frontend-generation
+        # entries are excluded) — a worker registered under such a name
+        # would serve fine but be invisible to every takeover: never
+        # probed, never orphan-reaped, decoding unobserved forever
+        raise ValueError(
+            f"worker name {name!r} contains 'frontend', which recovery "
+            "discovery excludes by construction — pick another name")
     _WORKER["engine"] = engine
     _WORKER["metrics"] = metrics if metrics is not None else ServingMetrics()
     _WORKER["stop"] = stop if stop is not None else threading.Event()
@@ -171,6 +281,7 @@ def init_worker(engine, name: str,
     _WORKER["mega_seen"] = (0, 0)
     _WORKER["faults"] = (fault_injector if fault_injector is not None
                          else FaultInjector.from_env())
+    _WORKER["fence"] = EpochFence()
     return _WORKER["stop"]
 
 
@@ -179,6 +290,23 @@ def _engine():
     if eng is None:
         raise RuntimeError("serving worker not initialised (init_worker)")
     return eng
+
+
+def _fence(epoch, op: str):
+    """Worker-side epoch fence (ISSUE 12), first line of every control
+    RPC handler: the highest epoch this process has ever seen wins, and
+    a call from an older one raises the typed ``StaleEpoch`` BEFORE the
+    handler touches the engine — a zombie frontend's write lands as a
+    typed rejection, never as duplicate token execution.  Unfenced
+    (``epoch=None``) callers pass: fencing arms the moment any frontend
+    carries an epoch.  Counted in the worker's ``fenced_rpcs_total``
+    (the worker did the fencing, so the worker's registry — which the
+    fleet scrape page exports — owns the count)."""
+    try:
+        _WORKER["fence"].check(epoch, op)
+    except StaleEpoch:
+        _WORKER["metrics"].inc("fenced_rpcs_total")
+        raise
 
 
 def _w_config() -> Dict:
@@ -191,7 +319,8 @@ def _w_config() -> Dict:
 
 
 def _w_add_request(prompt, max_new_tokens, eos_token_id=None,
-                   sampling=None, sample_offset=0):
+                   sampling=None, sample_offset=0, epoch=None):
+    _fence(epoch, "add_request")
     eng = _engine()
     rid = eng.add_request(prompt, max_new_tokens=max_new_tokens,
                           eos_token_id=eos_token_id, sampling=sampling,
@@ -199,10 +328,11 @@ def _w_add_request(prompt, max_new_tokens, eos_token_id=None,
     return rid, eng.state_summary()
 
 
-def _w_step():
+def _w_step(epoch=None):
     """One engine step per RPC — which, with megastep decode (ISSUE 9),
     means up to ``megastep_k`` tokens per round trip: the per-token HTTP
     transport cost the r8 fleet rung identified collapses by K."""
+    _fence(epoch, "step")
     eng = _engine()
     emitted = eng.step()
     finished = eng.pop_finished()
@@ -239,20 +369,26 @@ def _w_step():
     return emitted, finished, st, logprobs
 
 
-def _w_evict(rid):
+def _w_evict(rid, epoch=None):
+    _fence(epoch, "evict")
     eng = _engine()
     eng.evict(rid)
     return eng.state_summary()
 
 
-def _w_reap_orphans():
+def _w_reap_orphans(epoch=None):
     """Evict every queued/active sequence on this worker — the recovery
     hook (ISSUE 11) a RESTARTED frontend calls when it reattaches: the
     worker outlived the dead frontend, so whatever it is running belongs
     to nobody and would otherwise decode unobserved forever.  The
     recovered frontend re-admits the journaled requests afterwards (and
     with the prefix cache on, eviction published their full blocks, so
-    the re-prefill largely hits cache on this same worker)."""
+    the re-prefill largely hits cache on this same worker).
+
+    With fencing armed this is the FIRST rpc of the new incarnation's
+    epoch: the fence bumps here, so the dead/zombie frontend is locked
+    out of this worker before recovery re-admits anything."""
+    _fence(epoch, "reap_orphans")
     eng = _engine()
     n = eng.reap_orphans()
     _WORKER["metrics"].inc("orphans_reaped_total", n)
@@ -267,6 +403,8 @@ def _w_health(include_samples: bool = False):
         # a probe that raises here travels back as an RPC error — exactly
         # the shape a wedged health handler produces
         inj.fire("health.probe", detail=str(_WORKER.get("name")))
+    # deliberately UNFENCED (read-only): standbys watch workers through
+    # this probe, and a deposed frontend's monitoring may keep scraping
     eng = _engine()
     return {
         "state": eng.state_summary(),
@@ -274,18 +412,25 @@ def _w_health(include_samples: bool = False):
         "config": _w_config(),
         "draining": False,  # drain state is frontend-side; kept for probes
         "name": _WORKER["name"],
+        "epoch": _WORKER["fence"].highest,   # highest epoch ever seen
     }
 
 
-def _w_reset_metrics():
+def _w_reset_metrics(epoch=None):
     """Zero the worker's registry (benches call this after the warmup/
     compile phase so engine-level counters cover the same measured window
-    as the frontend's)."""
+    as the frontend's).  Fenced: a zombie must not erase the counters —
+    including ``fenced_rpcs_total`` itself — out from under the current
+    incarnation."""
+    _fence(epoch, "reset_metrics")
     _WORKER["metrics"].reset()
     return True
 
 
-def _w_shutdown():
+def _w_shutdown(epoch=None):
+    # fenced: a deposed frontend must not shut down workers the current
+    # incarnation is serving with
+    _fence(epoch, "shutdown")
     _WORKER["stop"].set()
     return True
 
@@ -340,13 +485,28 @@ class RemoteReplica:
     # frontend's gauge sampler must not fold the mirror a second time
     prefix_counters_self_reported = True
 
-    def __init__(self, worker_name: str, rpc_timeout: float = 60.0):
+    # the worker counts each fence into its own scraped registry, so
+    # the frontend must not count it again (see ServingFrontend._fenced)
+    fences_self_reported = True
+
+    def __init__(self, worker_name: str, rpc_timeout: float = 60.0,
+                 probe_timeout: Optional[float] = None):
         from ..distributed import rpc
 
         self._rpc = rpc
         self.worker = worker_name
         self.rpc_timeout = float(rpc_timeout)
-        h = self._call(_w_health)
+        # fencing epoch (ISSUE 12): stamped by the owning frontend via
+        # set_epoch and carried on every control RPC; the worker rejects
+        # older epochs with the typed StaleEpoch.  None = unfenced.
+        self._epoch: Optional[int] = None
+        # the constructor's liveness probe may use a SHORTER deadline
+        # than data-plane calls: discovery over N workers probes them
+        # sequentially, and a black-holed host would otherwise burn the
+        # full step timeout per dead worker on the takeover path
+        t = (float(probe_timeout) if probe_timeout is not None
+             else self.rpc_timeout)
+        h = self._rpc.rpc_sync(self.worker, _w_health, timeout=t)
         cfg = h["config"]
         self.B = int(cfg["max_batch_size"])
         self.T = int(cfg["token_budget"])
@@ -365,9 +525,14 @@ class RemoteReplica:
         self._apply_state(h["state"])
 
     # ------------------------------------------------------------ plumbing
-    def _call(self, fn, *args):
+    def _call(self, fn, *args, **kwargs):
         return self._rpc.rpc_sync(self.worker, fn, args=args,
-                                  timeout=self.rpc_timeout)
+                                  kwargs=kwargs, timeout=self.rpc_timeout)
+
+    def set_epoch(self, epoch: int):
+        """Stamp the caller epoch every subsequent control RPC carries
+        (the frontend propagates its epoch here at attach/recover)."""
+        self._epoch = int(epoch)
 
     def _apply_state(self, st: Dict):
         self._queue = [_QView(rid, pl, mn) for rid, pl, mn in st["queued"]]
@@ -410,7 +575,8 @@ class RemoteReplica:
             # ship the dict wire form (no class pickling across versions)
             sampling = sampling.to_wire()
         rid, st = self._call(_w_add_request, prompt, int(max_new_tokens),
-                             eos_token_id, sampling, int(sample_offset))
+                             eos_token_id, sampling, int(sample_offset),
+                             epoch=self._epoch)
         self._apply_state(st)
         return rid
 
@@ -421,7 +587,8 @@ class RemoteReplica:
         HTTP round trips)."""
         if self._pending_step is None:
             self._pending_step = self._rpc.rpc_async(
-                self.worker, _w_step, timeout=self.rpc_timeout)
+                self.worker, _w_step, kwargs={"epoch": self._epoch},
+                timeout=self.rpc_timeout)
 
     def step(self) -> Dict[int, List[int]]:
         fut = self._pending_step
@@ -429,7 +596,8 @@ class RemoteReplica:
         if fut is not None:
             emitted, finished, st, lps = fut.result()
         else:
-            emitted, finished, st, lps = self._call(_w_step)
+            emitted, finished, st, lps = self._call(_w_step,
+                                                    epoch=self._epoch)
         self._apply_state(st)
         self._finished.update(finished)
         for rid, vals in lps.items():
@@ -447,7 +615,7 @@ class RemoteReplica:
         return out
 
     def evict(self, rid: int):
-        st = self._call(_w_evict, rid)
+        st = self._call(_w_evict, rid, epoch=self._epoch)
         self._apply_state(st)
 
     def reap_orphans(self) -> int:
@@ -456,7 +624,7 @@ class RemoteReplica:
         orphans); returns the count.  ``ServingFrontend.recover`` calls
         this on every still-live replica before re-admitting from the
         journal."""
-        n, st = self._call(_w_reap_orphans)
+        n, st = self._call(_w_reap_orphans, epoch=self._epoch)
         self._apply_state(st)
         self._finished.clear()
         self._logprobs.clear()
@@ -497,6 +665,7 @@ class RemoteReplica:
 
     def request_shutdown(self, timeout: Optional[float] = None):
         self._rpc.rpc_sync(self.worker, _w_shutdown,
+                           kwargs={"epoch": self._epoch},
                            timeout=self.rpc_timeout
                            if timeout is None else timeout)
 
@@ -1067,6 +1236,7 @@ class ServingFleet:
                 continue
             try:
                 self._rpc.rpc_sync(rep.engine.worker, _w_reset_metrics,
+                                   kwargs={"epoch": rep.engine._epoch},
                                    timeout=rep.engine.rpc_timeout)
             except Exception:
                 pass

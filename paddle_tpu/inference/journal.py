@@ -21,9 +21,16 @@ compaction kind:
   and the tokens replay (they are deliberately NOT journaled).
 * ``terminal`` — rid, typed ``RequestStatus`` value, token count,
   attempts, idempotency key.  Exactly one per admitted rid.
+* ``epoch`` — the writer's fencing epoch (ISSUE 12), appended when an
+  epoch-armed frontend arms a fresh journal; compaction snapshots carry
+  the same field.  ``ServingFrontend.recover`` REFUSES a journal whose
+  recorded epoch exceeds the recovering frontend's (the caller is the
+  stale incarnation) and, absent an explicit epoch, arms at the
+  journal's epoch + 1 — the journal-side half of the zombie fence.
 * ``snapshot`` — whole-state record written by compaction
   (``rewrite``): open admits + the bounded keyed-terminal cache +
-  ``next_rid``.  Replay = snapshot state, then the suffix records.
+  ``next_rid`` + the writer epoch.  Replay = snapshot state, then the
+  suffix records.
 
 Failure semantics on replay (``replay``):
 
@@ -55,8 +62,9 @@ import struct
 import zlib
 from typing import Dict, Iterable, List, Optional, Tuple
 
-__all__ = ["RequestJournal", "JournalCorruption",
-           "ADMIT", "PROGRESS", "TERMINAL", "SNAPSHOT"]
+__all__ = ["RequestJournal", "JournalCorruption", "JournalSuperseded",
+           "recorded_epoch",
+           "ADMIT", "PROGRESS", "TERMINAL", "SNAPSHOT", "EPOCH"]
 
 _HDR = struct.Struct("<II")          # payload length, crc32(payload)
 # a complete frame claiming a payload larger than this is corruption,
@@ -68,6 +76,7 @@ ADMIT = "admit"
 PROGRESS = "progress"
 TERMINAL = "terminal"
 SNAPSHOT = "snapshot"
+EPOCH = "epoch"
 
 
 class JournalCorruption(RuntimeError):
@@ -82,6 +91,17 @@ class JournalCorruption(RuntimeError):
             "trusted); restore the file or start a fresh journal")
         self.path = path
         self.offset = offset
+
+
+class JournalSuperseded(RuntimeError):
+    """The file at ``path`` is no longer the one this journal instance
+    owns: a successor incarnation recovered and compacted it (recovery
+    always compacts, which ``os.replace``s the path with a NEW inode).
+    Raised instead of writing — RPC-level epoch fencing cannot protect
+    the journal FILE, so a resumed zombie's compaction would otherwise
+    ``os.replace`` its stale snapshot over the successor's live WAL.
+    Terminal for the writer: the frontend treats it like a worker fence
+    (depose, stop journaling), not like a degradable I/O fault."""
 
 
 class RequestJournal:
@@ -106,6 +126,11 @@ class RequestJournal:
         self._faults = (fault_injector if fault_injector is not None
                         else FaultInjector.from_env())
         self._fh = None
+        # (st_dev, st_ino) of the file this instance owns, recorded at
+        # first open / after each compaction.  A mismatch with the path
+        # later means a successor os.replace'd the journal — see
+        # JournalSuperseded.  None until the first write.
+        self._owned_id: Optional[Tuple[int, int]] = None
         # local instrumentation for tools/tests; the frontend keeps its
         # own registry counters (journal_records/bytes_total) from
         # append() return values rather than reading these
@@ -164,6 +189,26 @@ class RequestJournal:
         return records, off
 
     # -------------------------------------------------------------- append
+    def _check_owner(self):
+        """Refuse to touch the path once it stopped being OUR file.
+        Best-effort (a replace can still land between this check and the
+        write), but the deterministic zombie case — the successor already
+        recovered, which always compacts to a new inode — is caught."""
+        if self._owned_id is None:
+            return
+        try:
+            st = os.stat(self.path)
+        except OSError as e:
+            raise JournalSuperseded(
+                f"journal {self.path!r} vanished from under its writer "
+                "(moved or deleted) — a successor owns the path now; "
+                "stop journaling") from e
+        if (st.st_dev, st.st_ino) != self._owned_id:
+            raise JournalSuperseded(
+                f"journal {self.path!r} was replaced by another "
+                "incarnation (recovery compaction installs a new inode) "
+                "— this writer is the stale one; stop journaling")
+
     def _open_for_append(self):
         if self._fh is not None:
             return
@@ -176,6 +221,9 @@ class RequestJournal:
             fh.truncate(clean_end)
             fh.seek(clean_end)
         self._fh = fh
+        if self._owned_id is None:
+            st = os.fstat(fh.fileno())
+            self._owned_id = (st.st_dev, st.st_ino)
 
     def _fsync(self):
         if self._faults is not None:
@@ -208,6 +256,14 @@ class RequestJournal:
             frames.append(self._frame(rec))
         if not frames:
             return 0
+        # one stat per group commit: a resumed zombie with its handle
+        # still OPEN would otherwise keep "successfully" appending into
+        # the orphaned inode after a successor os.replace'd the path —
+        # the write cannot corrupt the successor, but the caller must
+        # learn it is deposed, not get a silent no-op ack.  Also covers
+        # the closed-then-reopened writer before _open_for_append would
+        # land its records in the SUCCESSOR's live file.
+        self._check_owner()
         self._open_for_append()
         for frame in frames:
             self._fh.write(frame)
@@ -243,7 +299,11 @@ class RequestJournal:
         """Snapshot-based compaction: atomically replace the journal with
         ``snapshot`` (+ optional ``suffix`` records).  The write goes to
         a sibling temp file first, so a crash mid-compaction leaves the
-        old journal intact."""
+        old journal intact.  Raises :class:`JournalSuperseded` instead of
+        replacing a file another incarnation already installed over the
+        path — the one journal write RPC epoch fencing cannot stop (a
+        resumed zombie compacting would clobber the successor's WAL)."""
+        self._check_owner()
         if self._faults is not None:
             self._faults.fire("journal.append", detail=SNAPSHOT)
         if snapshot.get("t") != SNAPSHOT:
@@ -284,6 +344,8 @@ class RequestJournal:
         # the snapshot on the serving control path right after every
         # compaction) is provably unnecessary here
         self._fh = open(self.path, "ab")
+        st = os.fstat(self._fh.fileno())
+        self._owned_id = (st.st_dev, st.st_ino)
 
     # ------------------------------------------------------------ lifecycle
     def close(self):
@@ -299,3 +361,30 @@ class RequestJournal:
 
     def __exit__(self, *exc):
         self.close()
+
+
+def recorded_epoch(journal) -> Optional[int]:
+    """Highest writer epoch a journal records (the snapshot ``epoch``
+    field or ``EPOCH`` records), or None for a pre-HA journal / missing
+    file.  Standbys pass this as the acquisition FLOOR
+    (``FrontendLease.acquire(min_epoch=...)``): if the lease record is
+    lost while the fleet is at epoch N (KV master restart, an operator
+    deleting the key), acquiring at epoch 1 would depose the healthy
+    active AND be refused by the journal — a full outage that only
+    heals one TTL per epoch increment.  The journal remembers N.
+
+    This is a second full replay on the takeover path (``recover``
+    replays again right after) — accepted: compaction every
+    ``journal_compact_every`` records bounds the file to one snapshot
+    plus a short suffix, and the floor is needed BEFORE ``acquire``,
+    which is needed before ``recover`` may touch anything."""
+    if not isinstance(journal, RequestJournal):
+        journal = RequestJournal(journal)
+    snapshot, records = journal.replay()
+    epoch = None
+    if snapshot is not None and snapshot.get("epoch") is not None:
+        epoch = int(snapshot["epoch"])
+    for rec in records:
+        if rec.get("t") == EPOCH:
+            epoch = max(epoch or 0, int(rec["epoch"]))
+    return epoch

@@ -58,6 +58,13 @@ COUNTERS = (
     "journal_compactions_total", "journal_errors_total",
     "recoveries_total", "recovered_requests_total",
     "orphans_reaped_total", "idempotent_hits_total",
+    # HA control plane (ISSUE 12): lease-based leadership + fencing
+    # epochs.  fenced_rpcs_total counts in the registry of whoever did
+    # the fencing (worker-side for remote replicas, the deposed
+    # frontend's own registry when IT observes StaleEpoch) — each fence
+    # event lands in exactly one scraped registry
+    "fenced_rpcs_total", "failovers_total", "handoffs_total",
+    "standby_takeovers_total",
 )
 GAUGES = (
     "queue_depth", "queue_depth_peak", "running_requests", "replicas_alive",
@@ -69,6 +76,9 @@ GAUGES = (
     # back to NON-DURABLE serving (the loud flag ops alert on: requests
     # keep flowing but a crash now loses them)
     "journal_degraded",
+    # the frontend's fencing epoch (monotone across incarnations; a
+    # fleet-wide scrape shows every registry agreeing on the current one)
+    "lease_epoch",
 )
 SAMPLES = ("ttft_seconds", "token_latency_seconds", "e2e_latency_seconds")
 
@@ -260,7 +270,7 @@ class ServingMetrics:
         # level/state gauges are ordinal, not additive: two replicas at
         # brownout level 1 are NOT a fleet at level 2
         _maxed = ("degraded_mode", "respawn_breaker_open",
-                  "journal_degraded")
+                  "journal_degraded", "lease_epoch")
         for s in snaps:
             for k, v in (s.get("gauges") or {}).items():
                 if k.endswith("_peak") or k in _maxed:

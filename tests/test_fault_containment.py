@@ -95,7 +95,8 @@ class TestFaultInjector:
 
     def test_after_times_and_counts(self):
         inj = FaultInjector(
-            {"r0.step": {"kind": "error", "after": 2, "times": 2}})
+            {"r0.step": {"kind": "error", "after": 2, "times": 2}},
+            replica_namespaces=["r0"])
         assert inj.fire("r0.step") is False and inj.fire("r0.step") is False
         for _ in range(2):
             with pytest.raises(InjectedFault, match="failpoint 'r0.step'"):
@@ -125,7 +126,8 @@ class TestFaultInjector:
                 "rb.step": {"kind": "error", "p": 0.5}}
 
         def fires_of_a(interleave_b):
-            inj = FaultInjector(spec, seed=3)
+            inj = FaultInjector(spec, seed=3,
+                                replica_namespaces=["ra", "rb"])
             out = []
             for _ in range(32):
                 if interleave_b:
@@ -186,24 +188,63 @@ class TestFaultInjector:
         with pytest.raises(ValueError, match="p must be"):
             FaultSpec(kind="error", p=1.5)
 
-    def test_unknown_site_rejected_at_arm_time(self):
+    def test_unknown_site_rejected_at_arm_time(self, monkeypatch):
+        import paddle_tpu.inference.faults as faults_mod
+
         # a typo'd site used to arm fine and then never fire — a chaos
         # schedule silently degrading to calm (ISSUE 11 satellite)
         with pytest.raises(ValueError, match="engine.stpe"):
             FaultInjector({"engine.stpe": {"kind": "error"}})
-        # replica-scoped sites validate on the op suffix
+        # replica-scoped sites validate BOTH halves (ISSUE 12 satellite:
+        # the r12-documented namespace hole is closed); isolate from
+        # namespaces other tests registered process-wide
+        monkeypatch.setattr(faults_mod, "REPLICA_NAMESPACES", set())
         with pytest.raises(ValueError, match="r0.stpe"):
             FaultInjector({"r0.stpe": {"kind": "error"}})
-        FaultInjector({"r0.step": {"kind": "error"}})     # any replica name
+        with pytest.raises(ValueError, match="unregistered namespace"):
+            FaultInjector({"r0.step": {"kind": "error"}})
+        # the namespace typo whose op suffix is legal — the exact hole —
+        # now raises instead of silently arming as a replica site
+        with pytest.raises(ValueError, match="enigne"):
+            FaultInjector({"enigne.step": {"kind": "error"}})
+        FaultInjector({"r0.step": {"kind": "error"}},
+                      replica_namespaces=["r0"])    # registered: fine
 
     def test_unknown_site_rejected_from_env_json(self, monkeypatch):
-        # NB the typo must not end in a replica op suffix: "enigne.step"
-        # would legally arm as a replica-scoped "<name>.step" site
         monkeypatch.setenv(
             "PADDLE_TPU_FAULTS",
             '{"sites": {"health.prob": {"kind": "error"}}}')
         with pytest.raises(ValueError, match="health.prob"):
             FaultInjector.from_env()
+
+    def test_replica_namespace_env_and_registration_paths(self,
+                                                          monkeypatch):
+        """ISSUE 12 satellite: the namespace set is honored on every arm
+        path — env JSON carries "replica_namespaces", and wrapping a
+        FaultyReplica registers its own name for arm-after-wrap flows."""
+        import paddle_tpu.inference.faults as faults_mod
+        from paddle_tpu.inference.faults import register_replica_namespace
+
+        monkeypatch.setattr(faults_mod, "REPLICA_NAMESPACES", set())
+        monkeypatch.setenv(
+            "PADDLE_TPU_FAULTS",
+            '{"sites": {"rz.step": {"kind": "error"}}}')
+        with pytest.raises(ValueError, match="rz"):
+            FaultInjector.from_env()
+        monkeypatch.setenv(
+            "PADDLE_TPU_FAULTS",
+            '{"sites": {"rz.step": {"kind": "error"}},'
+            ' "replica_namespaces": ["rz"]}')
+        inj = FaultInjector.from_env()
+        assert inj.spec("rz.step").kind == "error"
+        # module-level registration works for pre-planned names
+        register_replica_namespace("ry")
+        FaultInjector({"ry.evict": {"kind": "drop"}})
+        # FaultyReplica registers its own name at construction
+        class _E:  # noqa: N801 — minimal engine stand-in
+            _active = {}
+        FaultyReplica(_E(), FaultInjector({}), name="rw")
+        FaultInjector({"rw.add_request": {"kind": "error"}})
 
     def test_register_failpoint_extends_registry(self):
         from paddle_tpu.inference.faults import (KNOWN_SITES,
@@ -391,7 +432,8 @@ class TestPoisonQuarantine:
         """A request whose replica dies ONCE (not poison, just unlucky)
         is retried within budget and completes token-identical, with the
         attempt count surfaced in its result."""
-        inj = FaultInjector({"r0.step": {"kind": "drop", "times": 1}})
+        inj = FaultInjector({"r0.step": {"kind": "drop", "times": 1}},
+                            replica_namespaces=["r0"])
         fe = ServingFrontend(
             [FaultyReplica(ServingEngine(model, **ENGINE), inj, name=f"r{i}")
              for i in range(2)],

@@ -1,0 +1,977 @@
+"""HA control plane (ISSUE 12): lease-based leadership, worker fencing
+epochs, automatic standby failover, zero-downtime handoff.
+
+The acceptance-critical properties checked here (fast, in-process —
+tier-1 scope; the multi-process SIGKILL/SIGSTOP halves live in
+tests/test_chaos_standby.py):
+
+* the KV master's compare-and-swap is atomic w.r.t. expectation, so two
+  standbys racing for an expired lease cannot both win;
+* ``FrontendLease``: acquire-at-epoch+1 on absent/expired/released
+  records only, renewal extends, losing the record deposes, release
+  preserves the epoch counter, and the ``lease.acquire``/``lease.renew``
+  failpoints fire;
+* ``EpochFence``/``FencedEngine``/worker ``_w_*`` handlers: highest
+  epoch seen wins, a lower epoch raises the typed ``StaleEpoch``
+  BEFORE the engine executes anything, the worker registry counts
+  ``fenced_rpcs_total``, and ``_w_health`` stays unfenced;
+* a ``StaleEpoch`` (or a failed lease renew) deposes the frontend
+  terminally: no replica killed, nothing re-queued, journaling stops,
+  and every later ``step``/``submit`` re-raises typed;
+* journal epoch fencing: a fresh epoch-armed frontend records its
+  epoch, ``recover`` refuses a journal written by a HIGHER epoch and
+  auto-arms at journal epoch + 1 otherwise;
+* ``handoff()``: final snapshot + early lease release, successor
+  recovers with zero dropped admitted requests, idempotency map intact,
+  and nothing ever fences;
+* ``StandbyFrontend`` takes over exactly once, at epoch+1, counted in
+  ``standby_takeovers_total`` (+ ``failovers_total`` only on expiry);
+* satellites: replica-namespace failpoint validation (see
+  test_fault_containment.py for the registration-path matrix),
+  synchronous typed rejections draw NEGATIVE rids a recovered frontend
+  can never re-issue, and worker discovery excludes every frontend
+  generation while pruning dead workers' stale KV entries.
+"""
+import json
+import os
+
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu.inference import (
+    EpochFence,
+    FaultInjector,
+    FencedEngine,
+    FrontendLease,
+    Priority,
+    RequestJournal,
+    RequestStatus,
+    ServingEngine,
+    ServingFrontend,
+    StaleEpoch,
+    StandbyFrontend,
+)
+
+pytestmark = pytest.mark.quick
+
+
+@pytest.fixture(scope="module")
+def model():
+    # sub-tiny single-process model, same scale as the journal tests
+    from paddle_tpu.distributed.topology import set_hybrid_communicate_group
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    set_hybrid_communicate_group(None)
+    P.seed(11)
+    m = LlamaForCausalLM(LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=160,
+        num_hidden_layers=1, num_attention_heads=2,
+        max_position_embeddings=256))
+    m.eval()
+    return m
+
+
+def make_engine(model, **kw):
+    kw.setdefault("max_batch_size", 2)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("token_budget", 16)
+    kw.setdefault("megastep_k", 2)
+    return ServingEngine(model, **kw)
+
+
+def journal(tmp_path, name="req.wal", **kw):
+    kw.setdefault("fsync", False)
+    return RequestJournal(str(tmp_path / name), **kw)
+
+
+@pytest.fixture()
+def kv_master():
+    from paddle_tpu.distributed.launch.master import KVClient, KVServer
+
+    srv = KVServer(0).start()
+    try:
+        yield f"127.0.0.1:{srv.port}", KVClient(f"127.0.0.1:{srv.port}")
+    finally:
+        srv.stop()
+
+
+class Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def lease(ep, holder, clock, **kw):
+    kw.setdefault("ttl_s", 10.0)
+    return FrontendLease(ep, holder=holder, clock=clock, **kw)
+
+
+# ------------------------------------------------------------------ KV CAS
+class TestKvCas:
+    def test_cas_semantics(self, kv_master):
+        _, kv = kv_master
+        assert kv.cas("/x", None, "a")          # absent + expect-absent
+        assert not kv.cas("/x", None, "b")      # present now
+        assert not kv.cas("/x", "z", "b")       # wrong expectation
+        assert kv.cas("/x", "a", "b")
+        assert kv.get("/x") == "b"
+        kv.delete("/x")
+        assert kv.cas("/x", None, "c")
+
+    def test_racing_acquires_one_winner(self, kv_master):
+        ep, _ = kv_master
+        clk = Clock()
+        a = lease(ep, "a", clk)
+        b = lease(ep, "b", clk)
+        # both observe "absent" and race the CAS: exactly one wins
+        assert a.acquire() == 1
+        assert b.acquire() is None
+        assert a.held and not b.held
+
+
+# -------------------------------------------------------------------- lease
+class TestFrontendLease:
+    def test_lifecycle_epoch_monotone(self, kv_master):
+        ep, _ = kv_master
+        clk = Clock()
+        a = lease(ep, "a", clk)
+        b = lease(ep, "b", clk)
+        assert a.acquire() == 1
+        assert b.acquire() is None            # live under a
+        assert a.renew() is True
+        clk.advance(11.0)                     # a's ttl expired
+        assert b.acquire() == 2
+        assert a.renew() is False and not a.held   # deposed
+        assert b.release() is True
+        # release preserved the counter: the next holder is epoch 3
+        assert a.acquire() == 3
+
+    def test_release_is_immediate_no_ttl_wait(self, kv_master):
+        ep, _ = kv_master
+        clk = Clock()
+        a = lease(ep, "a", clk)
+        b = lease(ep, "b", clk)
+        assert a.acquire() == 1
+        assert b.acquire() is None
+        a.release()
+        assert b.acquire() == 2               # no clock advance needed
+
+    def test_failpoints_fire(self, kv_master):
+        ep, _ = kv_master
+        clk = Clock()
+        inj = FaultInjector({"lease.acquire": {"kind": "error",
+                                               "times": 1}})
+        a = lease(ep, "a", clk, fault_injector=inj)
+        from paddle_tpu.inference.faults import InjectedFault
+
+        with pytest.raises(InjectedFault):
+            a.acquire()
+        assert a.acquire() == 1               # budget spent: proceeds
+        inj2 = FaultInjector({"lease.renew": {"kind": "error",
+                                              "times": 1}})
+        a._faults = inj2
+        with pytest.raises(InjectedFault):
+            a.renew()
+        assert a.renew() is True
+
+    def test_inconclusive_renew_raises_not_deposes(self, kv_master):
+        """A KV blip far shorter than the TTL must NOT depose a healthy
+        holder: an inconclusive renew (no rival record ever observed)
+        raises TimeoutError — the caller keeps serving, fencing is the
+        safety net — and the lease is still held on the next attempt."""
+        ep, _ = kv_master
+        clk = Clock()
+        a = lease(ep, "a", clk, sleep=lambda s: None)
+        assert a.acquire() == 1
+
+        class DeadKV:
+            def get(self, key):
+                return None      # exactly what KVClient returns on OSError
+
+            def cas(self, key, expect, new):
+                return False
+
+        good_kv = a._kv
+        a._kv = DeadKV()
+        with pytest.raises(TimeoutError, match="inconclusive"):
+            a.renew()
+        assert a.held                  # NOT deposed by the blip
+        a._kv = good_kv
+        assert a.renew() is True       # KV back: still the leader
+
+    def test_frontend_keeps_serving_through_kv_blip(self, model,
+                                                    kv_master):
+        ep, _ = kv_master
+        clk = Clock()
+        la = lease(ep, "a", clk, sleep=lambda s: None)
+        assert la.acquire() == 1
+        fe = ServingFrontend([make_engine(model)], lease=la, clock=clk)
+        rid = fe.submit([3, 17, 9], max_new_tokens=4)
+
+        class DeadKV:
+            def get(self, key):
+                return None
+
+            def cas(self, key, expect, new):
+                return False
+
+        good_kv = la._kv
+        la._kv = DeadKV()
+        fe.step()                      # renew inconclusive: absorbed
+        assert not fe.deposed
+        la._kv = good_kv
+        res = fe.run()
+        assert res[rid].status is RequestStatus.COMPLETED
+
+    def test_damaged_record_does_not_wedge_acquire(self, kv_master):
+        """A valid-JSON-but-wrong-shape lease record (operator or tool
+        wrote ``{}``) must be treated as free, not raise KeyError on
+        every poll forever; the journal floor keeps epochs monotone."""
+        ep, kv = kv_master
+        clk = Clock()
+        la = lease(ep, "a", clk)
+        kv.put(la.key, "{}")
+        assert la.acquire() == 1
+        lb = lease(ep, "b", clk)
+        kv.put(lb.key, '{"epoch": "garbage"}')
+        assert lb.acquire(min_epoch=6) == 7      # floor preserved
+
+    def test_default_holder_unique_per_instance(self, kv_master):
+        """Two frontends defaulting their holder name (e.g. two
+        containers both running as pid 1) must NOT collide: acquire()'s
+        same-holder re-acquisition guard keys on the name, so equal
+        defaults would let each steal the other's LIVE lease."""
+        ep, _ = kv_master
+        clk = Clock()
+        la = FrontendLease(ep, clock=clk, ttl_s=10.0)
+        lb = FrontendLease(ep, clock=clk, ttl_s=10.0)
+        assert la.holder != lb.holder
+        assert la.acquire() == 1
+        assert lb.acquire() is None       # live lease, different holder
+
+    def test_acquire_race_on_absent_key_loses_cleanly(self, kv_master):
+        """A rival's CAS landing between our read of an ABSENT key and
+        our own CAS must read as a clean lost race (None), not crash the
+        standby supervisor."""
+        ep, _ = kv_master
+        clk = Clock()
+        a = lease(ep, "a", clk)
+        b = lease(ep, "b", clk)
+
+        class RacingKV:
+            def __init__(self, inner, rival):
+                self.inner = inner
+                self.rival = rival
+
+            def get(self, key):
+                raw = self.inner.get(key)
+                # the rival acquires right after our read
+                if raw is None:
+                    self.rival.acquire()
+                return raw
+
+            def cas(self, key, expect, new):
+                return self.inner.cas(key, expect, new)
+
+        a._kv = RacingKV(a._kv, b)
+        assert a.acquire() is None     # lost the race, no AttributeError
+        assert b.held and b.epoch == 1
+        clk.advance(11.0)
+        assert a.acquire() == 2        # and can still win later
+
+    def test_renew_survives_cas_race_with_jittered_retry(self, kv_master):
+        ep, kv = kv_master
+        clk = Clock()
+        slept = []
+        a = lease(ep, "a", clk, sleep=slept.append)
+        assert a.acquire() == 1
+
+        # interpose a kv whose FIRST cas refuses (a racing reader), then
+        # delegates — renew must retry with backoff and still succeed
+        class FlakyKV:
+            def __init__(self, inner):
+                self.inner = inner
+                self.failed = False
+
+            def get(self, key):
+                return self.inner.get(key)
+
+            def cas(self, key, expect, new):
+                if not self.failed:
+                    self.failed = True
+                    return False
+                return self.inner.cas(key, expect, new)
+
+        a._kv = FlakyKV(a._kv)
+        assert a.renew() is True
+        assert len(slept) == 1 and slept[0] > 0   # seeded jittered backoff
+
+
+# ----------------------------------------------------------- fence + proxy
+class TestEpochFence:
+    def test_monotone_and_typed(self):
+        f = EpochFence()
+        f.check(None)                          # unfenced callers pass
+        f.check(3, "step")
+        f.check(3, "step")                     # equal is fine
+        f.check(5, "step")
+        with pytest.raises(StaleEpoch, match="seen epoch 5"):
+            f.check(4, "step")
+        assert f.fenced_total == 1 and f.highest == 5
+        f.check(None)                          # still passes after arming
+
+    def test_fenced_engine_never_reaches_engine(self, model):
+        calls = []
+
+        class Probe:
+            def step(self):
+                calls.append("step")
+
+            def add_request(self, *a, **k):
+                calls.append("add")
+
+            def evict(self, rid):
+                calls.append("evict")
+
+            def reap_orphans(self):
+                calls.append("reap")
+                return 0
+
+        fence = EpochFence()
+        new = FencedEngine(Probe(), fence, epoch=2)
+        old = FencedEngine(Probe(), fence, epoch=1)
+        new.step()
+        for op in (old.step, lambda: old.add_request([1]),
+                   lambda: old.evict(0), old.reap_orphans):
+            with pytest.raises(StaleEpoch):
+                op()
+        assert calls == ["step"]               # zero stale execution
+        assert fence.fenced_total == 4
+        old.set_epoch(3)
+        old.step()                             # re-epoched caller passes
+        assert calls == ["step", "step"]
+
+
+class TestWorkerHandlerFencing:
+    """The real ``fleet._w_*`` handlers, driven in-process (no RPC): the
+    exact functions a worker serves are fenced with the exact counter
+    discipline the chaos soak asserts on."""
+
+    def test_handlers_fence_and_count(self, model):
+        from paddle_tpu.inference import fleet
+
+        eng = make_engine(model)
+        fleet.init_worker(eng, "w0")
+        rid, _ = fleet._w_add_request([3, 17, 9], 4, epoch=2)
+        fleet._w_step(epoch=2)
+        # a zombie (epoch 1) is fenced on EVERY control handler, before
+        # the engine is touched
+        steps_before = eng.megasteps
+        for call in (lambda: fleet._w_step(epoch=1),
+                     lambda: fleet._w_add_request([5], 2, epoch=1),
+                     lambda: fleet._w_evict(rid, epoch=1),
+                     lambda: fleet._w_reap_orphans(epoch=1),
+                     lambda: fleet._w_reset_metrics(epoch=1),
+                     lambda: fleet._w_shutdown(epoch=1)):
+            with pytest.raises(StaleEpoch):
+                call()
+        assert eng.megasteps == steps_before
+        assert not fleet._WORKER["stop"].is_set()   # shutdown fenced too
+        m = fleet._WORKER["metrics"]
+        assert m.counter("fenced_rpcs_total") == 6
+        # health is read-only and deliberately UNFENCED: standbys (and a
+        # deposed frontend's monitoring) keep watching; it reports the
+        # highest epoch seen
+        h = fleet._w_health()
+        assert h["epoch"] == 2
+        # unfenced legacy callers (epoch=None) still pass
+        fleet._w_step()
+        # the current epoch can still shut the worker down
+        fleet._w_shutdown(epoch=2)
+        assert fleet._WORKER["stop"].is_set()
+
+
+# --------------------------------------------------- frontend depose paths
+class TestFrontendFencing:
+    def test_stale_step_deposes_no_failover_no_requeue(self, model,
+                                                       tmp_path):
+        eng = make_engine(model)
+        fence = EpochFence()
+        j = journal(tmp_path)
+        fe = ServingFrontend([FencedEngine(eng, fence)], journal=j,
+                             epoch=1)
+        fe.submit([3, 17, 9], max_new_tokens=6)
+        fe.step()
+        records_before = j.records_appended
+        fence.check(2, "takeover")             # a successor took over
+        with pytest.raises(StaleEpoch):
+            fe.step()
+        assert fe.deposed
+        # NOT a failover: replica alive, nothing re-queued or finished
+        assert fe.replicas[0].alive
+        assert fe.metrics.counter("replica_deaths_total") == 0
+        assert fe.metrics.counter("requeued_on_failover_total") == 0
+        assert fe.metrics.counter("fenced_rpcs_total") == 1
+        assert not fe._queue
+        # deposed short-circuit: typed again, and no journal writes ever
+        # again (the file belongs to the successor)
+        with pytest.raises(StaleEpoch):
+            fe.step()
+        with pytest.raises(StaleEpoch):
+            fe.submit([5], max_new_tokens=2)
+        with pytest.raises(StaleEpoch):
+            fe.cancel(0)
+        assert j.records_appended == records_before
+
+    def test_fence_counted_once_for_self_reporting_replicas(self, model):
+        """Exactly-once discipline for fenced_rpcs_total: a
+        RemoteReplica's WORKER counts each fence into its own scraped
+        registry, so the frontend must not count it again — an
+        aggregation folding both registries would see 2 events per
+        fenced RPC.  In-process FencedEngines don't self-report, so the
+        frontend counts those (the in-process soak's gate)."""
+        eng = FencedEngine(make_engine(model), EpochFence(), epoch=1)
+        eng.fences_self_reported = True       # worker-like replica
+        fe = ServingFrontend([eng], epoch=1)
+        fe.submit([3, 17], max_new_tokens=4)
+        fe.step()
+        eng.fence.check(2, "takeover")
+        with pytest.raises(StaleEpoch):
+            fe.step()
+        assert fe.deposed
+        assert fe.metrics.counter("fenced_rpcs_total") == 0
+
+    def test_lease_loss_deposes_before_worker_rpcs(self, model,
+                                                   kv_master):
+        ep, _ = kv_master
+        clk = Clock()
+        la = lease(ep, "a", clk)
+        assert la.acquire() == 1
+        eng = make_engine(model)
+        fence = EpochFence()
+        fe = ServingFrontend([FencedEngine(eng, fence)], lease=la,
+                             clock=clk)
+        fe.submit([3, 17], max_new_tokens=4)
+        fe.step()
+        # standby steals the lease while fe is "paused"
+        clk.advance(11.0)
+        lb = lease(ep, "b", clk)
+        assert lb.acquire() == 2
+        with pytest.raises(StaleEpoch):
+            fe.step()
+        assert fe.deposed
+        # the depose came from the RENEW, not a worker fence
+        assert fence.fenced_total == 0
+
+    def test_epoch_propagates_to_added_replicas(self, model):
+        eng1, eng2 = make_engine(model), make_engine(model)
+        f1, f2 = EpochFence(), EpochFence()
+        fe = ServingFrontend([FencedEngine(eng1, f1)], epoch=4)
+        rep2 = fe.add_replica(FencedEngine(eng2, f2))
+        assert fe.replicas[0].engine.epoch == 4
+        assert rep2.engine.epoch == 4           # stamped at attach
+        fe.submit([3, 17], max_new_tokens=2)
+        fe.run()
+        # whichever replica served it bumped its fence to the epoch
+        assert 4 in (f1.highest, f2.highest)
+        assert fe.metrics.gauge("lease_epoch") == 4.0
+
+
+# --------------------------------------------------- journal epoch fencing
+class TestJournalEpochFencing:
+    def test_fresh_frontend_records_epoch(self, model, tmp_path):
+        j = journal(tmp_path)
+        ServingFrontend([make_engine(model)], journal=j, epoch=3)
+        _, recs = RequestJournal(j.path).replay()
+        assert recs and recs[0] == {"t": "epoch", "epoch": 3, "nr": 0}
+
+    def test_recover_refuses_higher_epoch_journal(self, model, tmp_path):
+        j = journal(tmp_path)
+        fe = ServingFrontend([make_engine(model)], journal=j, epoch=5)
+        fe.submit([3, 17], max_new_tokens=2)
+        j.close()
+        with pytest.raises(StaleEpoch, match="epoch 5"):
+            ServingFrontend.recover(j.path, [make_engine(model)], epoch=4)
+
+    def test_recover_refuses_equal_epoch_journal(self, model, tmp_path):
+        """Equality is not safe either: EpochFence admits epoch >= its
+        highest, so recovering AT the journal's writer epoch would let
+        a same-epoch zombie keep passing every worker fence alongside
+        the recovered frontend."""
+        j = journal(tmp_path)
+        ServingFrontend([make_engine(model)], journal=j, epoch=5)
+        j.close()
+        with pytest.raises(StaleEpoch, match="STRICTLY above"):
+            ServingFrontend.recover(j.path, [make_engine(model)], epoch=5)
+
+    def test_recover_auto_arms_at_epoch_plus_one(self, model, tmp_path):
+        j = journal(tmp_path)
+        fe = ServingFrontend([make_engine(model)], journal=j, epoch=5)
+        rid = fe.submit([3, 17, 9], max_new_tokens=4,
+                        idempotency_key="k")
+        j.close()
+        fe2 = ServingFrontend.recover(j.path, [make_engine(model)])
+        assert fe2.epoch == 6
+        assert fe2.metrics.gauge("lease_epoch") == 6.0
+        res = fe2.run()
+        assert res[rid].status is RequestStatus.COMPLETED
+        # the compacted snapshot carries the NEW epoch, so a third life
+        # arms at 7
+        snap, _ = RequestJournal(j.path).replay()
+        assert snap["epoch"] == 6
+        fe3 = ServingFrontend.recover(j.path, [make_engine(model)])
+        assert fe3.epoch == 7
+
+    def test_recover_without_epochs_stays_unfenced(self, model, tmp_path):
+        # pre-HA journals (no epoch records) recover exactly as before
+        j = journal(tmp_path)
+        fe = ServingFrontend([make_engine(model)], journal=j)
+        fe.submit([3, 17], max_new_tokens=2)
+        j.close()
+        fe2 = ServingFrontend.recover(j.path, [make_engine(model)])
+        assert fe2.epoch is None
+        fe2.run()
+
+    def test_lease_is_epoch_authority(self, model, kv_master, tmp_path):
+        ep, _ = kv_master
+        clk = Clock()
+        la = lease(ep, "a", clk)
+        assert la.acquire() == 1
+        with pytest.raises(ValueError, match="epoch authority"):
+            ServingFrontend([make_engine(model)], lease=la, epoch=9)
+        lb = lease(ep, "b", clk)
+        with pytest.raises(ValueError, match="not acquired"):
+            ServingFrontend([make_engine(model)], lease=lb)
+
+
+# ------------------------------------------------------------- handoff
+class TestHandoff:
+    def test_handoff_zero_drop_and_never_fenced(self, model, kv_master,
+                                                tmp_path):
+        ep, _ = kv_master
+        clk = Clock()
+        eng = make_engine(model)
+        fence = EpochFence()
+        la = lease(ep, "a", clk)
+        assert la.acquire() == 1
+        j = journal(tmp_path)
+        fe = ServingFrontend([FencedEngine(eng, fence)], journal=j,
+                             lease=la, clock=clk)
+        # reference for token identity
+        ref = ServingFrontend([make_engine(model)])
+        ref_rid = ref.submit([3, 17, 9], max_new_tokens=6)
+        ref_tok = ref.run()[ref_rid].tokens
+        rid = fe.submit([3, 17, 9], max_new_tokens=6,
+                        idempotency_key="k0")
+        fe.step()                               # partial progress
+        fe.handoff()
+        assert fe.handed_off
+        assert fe.metrics.counter("handoffs_total") == 1
+        with pytest.raises(RuntimeError, match="handed off"):
+            fe.step()
+        with pytest.raises(RuntimeError, match="handed off"):
+            fe.submit([5], max_new_tokens=2)
+        # lease released with the epoch preserved; the journal holds a
+        # final snapshot with the open admit
+        assert not la.held
+        snap, _ = RequestJournal(j.path).replay()
+        assert snap is not None and snap["epoch"] == 1
+        assert [a["rid"] for a in snap["open"]] == [rid]
+        # successor: immediate takeover (released lease), epoch 2, the
+        # idempotency map intact, ZERO dropped admitted requests
+        lb = lease(ep, "b", clk)
+        standby = StandbyFrontend(
+            lb, j.path, lambda: [FencedEngine(eng, fence)],
+            frontend_kwargs={"clock": clk})
+        fe2 = standby.poll()
+        assert fe2 is not None and fe2.epoch == 2
+        assert fe2.metrics.counter("standby_takeovers_total") == 1
+        assert fe2.metrics.counter("failovers_total") == 0   # clean
+        assert fe2.submit([3, 17, 9], max_new_tokens=6,
+                          idempotency_key="k0") == rid
+        res = fe2.run()
+        assert res[rid].status is RequestStatus.COMPLETED
+        assert res[rid].tokens == ref_tok
+        assert fence.fenced_total == 0          # nothing EVER fenced
+
+    def test_handoff_flush_fault_degrades_not_blocks(self, model,
+                                                     tmp_path):
+        inj = FaultInjector({"handoff.flush": {"kind": "error"}})
+        j = journal(tmp_path, fault_injector=inj)
+        fe = ServingFrontend([make_engine(model)], journal=j, epoch=1)
+        fe.submit([3, 17], max_new_tokens=2)
+        fe.handoff()                            # must not raise
+        assert fe.handed_off and fe.journal_degraded
+        # the un-compacted journal still recovers the open request
+        fe2 = ServingFrontend.recover(j.path, [make_engine(model)])
+        assert fe2.metrics.counter("recovered_requests_total") == 1
+
+    def test_handoff_close_fault_degrades_not_blocks(self, model,
+                                                     tmp_path,
+                                                     monkeypatch):
+        """A journal close() fault (ENOSPC flushing the buffered
+        frames) must not abort the handoff after the snapshot phase:
+        aborting there would leave the lease held for a full TTL with
+        ``_handed_off`` unset — a failover dressed up as an error."""
+        j = journal(tmp_path)
+        fe = ServingFrontend([make_engine(model)], journal=j, epoch=1)
+        fe.submit([3, 17], max_new_tokens=2)
+        monkeypatch.setattr(
+            j, "close",
+            lambda: (_ for _ in ()).throw(OSError("disk full")))
+        fe.handoff()                            # must not raise
+        assert fe.handed_off and fe.journal_degraded
+        assert fe.metrics.counter("handoffs_total") == 1
+
+
+# ----------------------------------------------------------- standby watch
+class TestStandbyFrontend:
+    def test_no_takeover_while_lease_live(self, model, kv_master,
+                                          tmp_path):
+        ep, _ = kv_master
+        clk = Clock()
+        la = lease(ep, "a", clk)
+        assert la.acquire() == 1
+        j = journal(tmp_path)
+        ServingFrontend([make_engine(model)], journal=j, epoch=la.epoch)
+        j.close()
+        lb = lease(ep, "b", clk)
+        standby = StandbyFrontend(lb, j.path,
+                                  lambda: [make_engine(model)])
+        assert standby.poll() is None           # active still holds it
+        la.renew()
+        clk.advance(5.0)
+        assert standby.poll() is None           # renewed: still live
+        clk.advance(11.0)
+        fe = standby.poll()
+        assert fe is not None and fe.epoch == 2
+        assert standby.poll() is fe             # idempotent after takeover
+
+    def test_bootstrap_takeover_is_not_a_failover(self, model, kv_master,
+                                                  tmp_path):
+        """First-ever takeover (no lease record has ever existed) counts
+        in standby_takeovers_total but NOT failovers_total — nothing
+        crashed, so counter-keyed chaos gates and alerts must stay 0."""
+        ep, _ = kv_master
+        j = journal(tmp_path)
+        ServingFrontend([make_engine(model)], journal=j)
+        j.close()
+        standby = StandbyFrontend(lease(ep, "b", Clock()), j.path,
+                                  lambda: [make_engine(model)])
+        fe = standby.poll()
+        assert fe is not None and fe.epoch == 1
+        counters = fe.metrics.snapshot()["counters"]
+        assert counters.get("standby_takeovers_total") == 1
+        assert counters.get("failovers_total", 0) == 0
+
+    def test_lost_lease_record_does_not_restart_epochs(self, model,
+                                                       kv_master,
+                                                       tmp_path):
+        """Losing the lease RECORD (KV master restart, operator deletes
+        the key to force failover) must not restart the monotone epoch
+        counter at 1 — that would depose the fleet backwards and be
+        refused by the journal.  The journal's recorded epoch floors the
+        acquisition instead."""
+        ep, kv = kv_master
+        clk = Clock()
+        la = lease(ep, "a", clk)
+        la.acquire(); la.release()
+        lb = lease(ep, "b", clk)
+        lb.acquire(); lb.release()
+        lc = lease(ep, "c", clk)
+        assert lc.acquire() == 3
+        j = journal(tmp_path)
+        ServingFrontend([make_engine(model)], journal=j, epoch=3)
+        j.close()
+        kv.delete(lc.key)                  # the record is gone entirely
+        standby = StandbyFrontend(lease(ep, "d", clk), j.path,
+                                  lambda: [make_engine(model)])
+        fe = standby.poll()
+        assert fe is not None and fe.epoch == 4     # NOT 1
+
+    def test_failed_takeover_releases_lease(self, model, kv_master,
+                                            tmp_path):
+        """replica_factory raising mid-takeover must not leave the fresh
+        lease held: every standby would then wait out a full TTL per
+        attempt with nobody serving.  Release (epoch preserved) lets the
+        very next poll retry."""
+        ep, _ = kv_master
+        clk = Clock()
+        la = lease(ep, "a", clk)
+        assert la.acquire() == 1
+        j = journal(tmp_path)
+        ServingFrontend([make_engine(model)], journal=j, epoch=1)
+        j.close()
+        clk.advance(11.0)                  # active's lease expires
+        boom = {"on": True}
+
+        def factory():
+            if boom["on"]:
+                raise ConnectionError("transient KV/RPC outage")
+            return [make_engine(model)]
+
+        standby = StandbyFrontend(lease(ep, "b", clk), j.path, factory)
+        with pytest.raises(ConnectionError):
+            standby.poll()
+        boom["on"] = False
+        fe = standby.poll()                # immediate retry, no TTL wait
+        assert fe is not None and fe.epoch >= 2
+
+    def test_racing_standbys_one_takeover(self, model, kv_master,
+                                          tmp_path):
+        ep, _ = kv_master
+        clk = Clock()
+        la = lease(ep, "a", clk)
+        assert la.acquire() == 1
+        j = journal(tmp_path)
+        ServingFrontend([make_engine(model)], journal=j, epoch=1)
+        j.close()
+        clk.advance(11.0)
+
+        # standby b wins the CAS; standby c must observe b's LIVE lease
+        # and keep waiting instead of double-recovering
+        sb = StandbyFrontend(lease(ep, "b", clk), j.path,
+                             lambda: [make_engine(model)])
+        sc = StandbyFrontend(lease(ep, "c", clk), j.path,
+                             lambda: [make_engine(model)])
+        fe_b = sb.poll()
+        assert fe_b is not None and fe_b.epoch == 2
+        assert sc.poll() is None
+
+
+# ------------------------------------------------------------- satellites
+class TestRejectionRidSpace:
+    def test_rejections_draw_negative_rids(self, model):
+        fe = ServingFrontend([make_engine(model)], max_queue_requests=1)
+        ok = fe.submit([3, 17], max_new_tokens=2)
+        r1 = fe.submit([5, 8], max_new_tokens=2)    # queue full
+        r2 = fe.submit(list(range(1, 60)), max_new_tokens=30)  # capacity
+        assert ok == 0 and r1 == -1 and r2 == -2
+        assert fe.result(r1).status is RequestStatus.OVERLOADED
+        assert fe.result(r2).status is RequestStatus.OVERLOADED
+        # rejection handles still work for cancel/result bookkeeping
+        assert fe.cancel(r1) is False               # already resolved
+        res = fe.run()
+        assert res[ok].status is RequestStatus.COMPLETED
+        # the durable space was never consumed by the rejections
+        assert fe._next_rid == 1
+
+    def test_recovery_never_reissues_a_rejected_rid(self, model,
+                                                    tmp_path):
+        """The r12-documented hole: rejections AFTER the last journal
+        record used to consume durable rid space that recovery would
+        hand to new requests.  Now they cannot — different namespace."""
+        j = journal(tmp_path)
+        fe = ServingFrontend([make_engine(model)], journal=j,
+                             max_queue_requests=1)
+        admitted = fe.submit([3, 17, 9], max_new_tokens=4)
+        rejected = fe.submit([5, 8], max_new_tokens=2)   # unjournaled
+        assert admitted == 0 and rejected < 0
+        j.close()                                   # "crash" here
+        fe2 = ServingFrontend.recover(j.path, [make_engine(model)])
+        fresh = fe2.submit([7, 7], max_new_tokens=2)
+        # the fresh rid collides with NEITHER the journaled admit nor
+        # the pre-crash client's rejection handle
+        assert fresh not in (admitted, rejected) and fresh >= 1
+        _, recs = RequestJournal(j.path).replay()
+        assert all(r.get("rid", 0) >= 0 for r in recs)
+
+    def test_rejection_storm_never_touches_journal(self, model,
+                                                   tmp_path):
+        j = journal(tmp_path)
+        fe = ServingFrontend([make_engine(model)], journal=j,
+                             max_queue_requests=0)
+        before = j.records_appended
+        for i in range(8):
+            assert fe.submit([i + 1], max_new_tokens=2) == -(i + 1)
+        assert j.records_appended == before
+
+
+class TestDiscovery:
+    def test_discover_excludes_every_frontend_generation(self, kv_master):
+        ep, kv = kv_master
+        from paddle_tpu.inference.fleet import discover_workers
+
+        kv.put("/rpc/workers/w0", "0:127.0.0.1:1")
+        kv.put("/rpc/workers/w1", "0:127.0.0.1:2")
+        # three frontend generations: the r8 fleet name, a dead HA
+        # active, and a standby — none may come back as a "worker"
+        kv.put("/rpc/workers/fleet-frontend", "0:127.0.0.1:3")
+        kv.put("/rpc/workers/frontend-a", "0:127.0.0.1:4")
+        kv.put("/rpc/workers/standby-frontend", "0:127.0.0.1:5")
+        assert discover_workers(ep) == ["w0", "w1"]
+        assert discover_workers(ep, exclude=("w0",)) == ["w1"]
+
+    def test_init_worker_rejects_frontend_in_name(self, model):
+        """The discovery filter drops any registration whose name
+        contains "frontend" — a worker allowed to register under such a
+        name would serve fine but be invisible to every takeover (never
+        probed, never orphan-reaped).  The convention is enforced at the
+        one registration chokepoint instead."""
+        from paddle_tpu.inference import fleet
+
+        with pytest.raises(ValueError, match="frontend"):
+            fleet.init_worker(make_engine(model), name="frontend-gpu0")
+
+    def test_connect_workers_prunes_dead_entries(self, kv_master):
+        ep, kv = kv_master
+        from paddle_tpu.distributed import rpc
+        from paddle_tpu.inference.fleet import connect_workers
+
+        rpc.init_rpc("test-ha-frontend", rank=0, world_size=1,
+                     master_endpoint=ep)
+        try:
+            # a SIGKILLed worker's stale registration: entry present,
+            # nothing listening at the advertised port
+            kv.put("/rpc/workers/w-dead", "0:127.0.0.1:1")
+            reps = connect_workers(ep, rpc_timeout=2.0)
+            assert reps == []
+            # the stale entry was pruned so the next discovery is clean
+            assert kv.get("/rpc/workers/w-dead") is None
+        finally:
+            rpc.shutdown()
+
+    @staticmethod
+    def _remote_reset(rpc):
+        # what rpc._post re-raises when the worker's HANDLER raised a
+        # ConnectionResetError (e.g. a health.probe failpoint of kind
+        # 'drop'): same type as a transport fault, but marked remote
+        e = ConnectionResetError("injected by health.probe")
+        e._rpc_remote = True
+        return e
+
+    @pytest.mark.parametrize("exc_factory", [
+        lambda rpc: rpc.RpcTimeout("probe timed out"),   # live-but-slow
+        lambda rpc: RuntimeError("health.probe injected"),  # handler raised
+        _remote_reset.__func__,             # handler raised an OSError kind
+        # a LOCAL transport blip from a live worker (listener mid-
+        # restart, RST off a full accept backlog): an OSError, but not a
+        # definitive dead-endpoint errno — must not prune either
+        lambda rpc: ConnectionResetError("transient local blip"),
+    ], ids=["timeout", "handler-error", "remote-oserror", "local-reset"])
+    def test_connect_workers_keeps_non_dead_worker(self, kv_master,
+                                                   monkeypatch,
+                                                   exc_factory):
+        """Only a DEAD endpoint (refused/unreachable) may be pruned.  A
+        probe TIMEOUT is live-but-slow (mid-megastep, mid-compile), and
+        a handler-raised error (an armed health.probe failpoint) arrived
+        over a healthy connection: registration is one-shot, so pruning
+        either would delist a healthy worker from every future
+        discovery forever."""
+        ep, kv = kv_master
+        from paddle_tpu.distributed import rpc
+        from paddle_tpu.inference import fleet as fleet_mod
+
+        class _Probe:
+            def __init__(self, name, **kw):
+                raise exc_factory(rpc)
+
+        monkeypatch.setattr(fleet_mod, "RemoteReplica", _Probe)
+        rpc.init_rpc("test-ha-frontend", rank=0, world_size=1,
+                     master_endpoint=ep)
+        try:
+            kv.put("/rpc/workers/w-alive", "0:127.0.0.1:1")
+            reps = fleet_mod.connect_workers(ep, rpc_timeout=2.0)
+            assert reps == []                      # skipped this takeover
+            # ...but the entry survives for the next discovery
+            assert kv.get("/rpc/workers/w-alive") is not None
+        finally:
+            rpc.shutdown()
+
+
+class TestJournalSupersession:
+    """File-level half of the zombie fence (review round 2): RPC epoch
+    fencing cannot see journal WRITES, so a resumed zombie's compaction
+    would ``os.replace`` its stale snapshot over the successor's live
+    WAL.  The journal tracks the inode it owns (recovery always
+    compacts, which installs a NEW inode) and raises the typed
+    ``JournalSuperseded`` instead of clobbering; the frontend treats
+    that as a deposition, not a degradable I/O fault."""
+
+    def test_open_writer_compaction_fenced(self, tmp_path):
+        from paddle_tpu.inference.journal import JournalSuperseded
+
+        j1 = journal(tmp_path)
+        j1.append({"t": "admit", "rid": 0, "prompt": [1]})   # owns inode
+        j2 = RequestJournal(j1.path, fsync=False)            # successor
+        j2.rewrite({"next_rid": 7, "open": [], "done": []})  # new inode
+        with pytest.raises(JournalSuperseded, match="replaced"):
+            j1.rewrite({"next_rid": 1, "open": [], "done": []})
+        snap, _ = RequestJournal(j1.path).replay()
+        assert snap["next_rid"] == 7                 # successor's intact
+
+    def test_open_writer_append_fenced(self, tmp_path):
+        """The canonical resumed zombie: its handle is still OPEN, so an
+        append would 'succeed' into the orphaned inode — harmless to the
+        successor, but the caller must learn it is deposed rather than
+        get a silent no-op ack for a request journaled nowhere real."""
+        from paddle_tpu.inference.journal import JournalSuperseded
+
+        j1 = journal(tmp_path)
+        j1.append({"t": "admit", "rid": 0, "prompt": [1]})   # fh open
+        j2 = RequestJournal(j1.path, fsync=False)
+        j2.rewrite({"next_rid": 7, "open": [], "done": []})
+        with pytest.raises(JournalSuperseded):
+            j1.append({"t": "admit", "rid": 1, "prompt": [2]})
+        snap, recs = RequestJournal(j1.path).replay()
+        assert snap["next_rid"] == 7 and recs == []
+
+    def test_reopened_writer_append_fenced(self, tmp_path):
+        from paddle_tpu.inference.journal import JournalSuperseded
+
+        j1 = journal(tmp_path)
+        j1.append({"t": "admit", "rid": 0, "prompt": [1]})
+        j1.close()                                   # fh gone, inode known
+        j2 = RequestJournal(j1.path, fsync=False)
+        j2.rewrite({"next_rid": 7, "open": [], "done": []})
+        with pytest.raises(JournalSuperseded):
+            j1.append({"t": "progress", "rid": 0, "n": 1})
+        snap, recs = RequestJournal(j1.path).replay()
+        assert snap["next_rid"] == 7 and recs == []
+
+    def test_zombie_frontend_compaction_deposes_not_clobbers(
+            self, model, tmp_path):
+        j = journal(tmp_path)
+        fe1 = ServingFrontend([make_engine(model)], journal=j, epoch=1)
+        fe1.submit([3, 17, 9], max_new_tokens=2)
+        fe1.run()
+        # successor recovers from the same path (auto-arms epoch 2 and
+        # compacts — the journal file is now a different inode)
+        fe2 = ServingFrontend.recover(j.path, [make_engine(model)])
+        assert fe2.epoch == 2
+        # the zombie's forced compaction must fence typed, depose it,
+        # and leave the successor's journal byte-untouched
+        before = open(j.path, "rb").read()
+        with pytest.raises(StaleEpoch):
+            fe1._compact_journal()
+        assert fe1.deposed
+        assert open(j.path, "rb").read() == before
+        snap, _ = RequestJournal(j.path).replay()
+        assert snap["epoch"] == 2
+
+
+class TestMergeAndScrape:
+    def test_lease_epoch_merges_maxed_and_counters_sum(self):
+        from paddle_tpu.inference import ServingMetrics
+
+        a, b = ServingMetrics(), ServingMetrics()
+        a.set_gauge("lease_epoch", 3.0)
+        b.set_gauge("lease_epoch", 3.0)
+        a.inc("fenced_rpcs_total", 2)
+        b.inc("standby_takeovers_total")
+        b.inc("failovers_total")
+        b.inc("handoffs_total")
+        merged = ServingMetrics.merge([a.snapshot(), b.snapshot()])
+        # epochs are ordinal: two registries at epoch 3 are NOT epoch 6
+        assert merged["gauges"]["lease_epoch"] == 3.0
+        assert merged["counters"]["fenced_rpcs_total"] == 2
+        assert merged["counters"]["standby_takeovers_total"] == 1
+        text = a.prometheus_text()
+        assert "paddle_tpu_serving_fenced_rpcs_total 2" in text
+        assert "paddle_tpu_serving_lease_epoch 3" in text

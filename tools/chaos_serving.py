@@ -52,10 +52,37 @@ the spec JSON (``engine.step`` delays, a ``health.probe`` fault on one
 worker) plus a frontend-side ``rpc.send`` timeout — the same terminal
 status + token-parity assertions across real process boundaries.
 
+``--standby`` runs the HA-CONTROL-PLANE phase (ISSUE 12): an ACTIVE
+frontend holds the leadership lease and serves a journal-armed seeded
+stream; a STANDBY watches the lease and takes over at epoch+1 when it
+expires.  In-process mode (no ``--workers``) shares the engines between
+both incarnations through ``EpochFence``/``FencedEngine`` wrappers and
+manufactures the zombie deterministically (stop driving the active
+frontend, expire the lease on an injected counter clock, resume it
+after the takeover); ``--workers N`` uses real worker processes and a
+real active-frontend child that the parent SIGKILLs (``default``) or
+SIGSTOPs/SIGCONTs (``--zombie``) — a true paused-through-expiry zombie.
+Asserted either way:
+
+* the standby acquires the lease at epoch+1 and recovers every
+  journaled admit (exactly one typed terminal each);
+* every RPC the resumed zombie issues lands typed ``StaleEpoch``
+  (``fenced_rpcs_total`` > 0 on whoever fenced) with ZERO duplicate
+  token execution — worker/engine step+token counters are captured at
+  takeover and unchanged by the zombie;
+* clients replaying idempotency keys get their ORIGINAL rids from the
+  new incarnation;
+* COMPLETED survivors are token-identical to a crash-free same-seed
+  run; and the ``handoff()`` leg (in-process mode) additionally shows
+  zero dropped admitted requests with NO StaleEpoch anywhere — a clean
+  early lease release never manufactures a zombie.
+
 One JSON report on stdout:
 
     python tools/chaos_serving.py --seed 7 --replicas 3 --requests 18
     python tools/chaos_serving.py --workers 3 --requests 8
+    python tools/chaos_serving.py --standby --seed 3
+    python tools/chaos_serving.py --standby --workers 2 --zombie
 """
 import argparse
 import json
@@ -90,6 +117,23 @@ def _build_model():
     model = LlamaForCausalLM(LlamaConfig(**MODEL))
     model.eval()
     return model
+
+
+def _reference_tokens(model, reqs, replicas=1):
+    """Fault/crash-free same-seed reference: {stream index: tokens} for
+    the shared seeded request stream, served by fresh engines with no
+    injector.  The ONE definition every soak compares its survivors
+    against (stream tuples may carry a sampling-kwargs dict as their
+    optional 4th element)."""
+    from paddle_tpu.inference import ServingEngine, ServingFrontend
+
+    fe = ServingFrontend([ServingEngine(model, **ENGINE)
+                          for _ in range(replicas)])
+    rids = [fe.submit(p, max_new_tokens=m, priority=pr,
+                      **(rest[0] if rest else {}))
+            for p, m, pr, *rest in reqs]
+    res = fe.run()
+    return {i: res[r].tokens for i, r in enumerate(rids)}
 
 
 def _request_stream(seed, num_requests, poison):
@@ -162,19 +206,18 @@ def run_chaos(seed=0, replicas=3, num_requests=18, max_request_retries=2,
 
     model = _build_model()
     reqs = _request_stream(seed, num_requests, poison)
-
-    # ---- fault-free reference: same stream, no injector, no respawns
-    ref_fe = ServingFrontend([ServingEngine(model, **ENGINE)])
-    ref_rids = [ref_fe.submit(p, max_new_tokens=m, priority=pr)
-                for p, m, pr in reqs]
-    ref_tokens = {i: ref_fe.run()[r].tokens
-                  for i, r in enumerate(ref_rids)}
+    ref_tokens = _reference_tokens(model, reqs)
 
     # ---- chaos run
     max_respawns = replicas * 3
     total_names = replicas + max_respawns
+    # every replica name this soak may ever spawn, registered up front:
+    # arm-time validation then catches a schedule/namespace typo instead
+    # of letting the run silently degrade to calm (ISSUE 12 satellite)
     inj = FaultInjector(_fault_schedule(seed, total_names, poison),
-                        seed=seed)
+                        seed=seed,
+                        replica_namespaces=[f"r{i}"
+                                            for i in range(total_names)])
     # engine pool: respawns recycle a dead replica's engine (a restarted
     # worker rebuilds the same engine; recycling skips the recompile)
     spares = []
@@ -392,14 +435,7 @@ def run_kill_frontend(seed=0, num_requests=16, kill_after=5,
 
     model = _build_model()
     reqs = _kill_request_stream(seed, num_requests)
-
-    # ---- crash-free same-seed reference
-    ref_fe = ServingFrontend([ServingEngine(model, **ENGINE)
-                              for _ in range(2)])
-    ref_rids = [ref_fe.submit(p, max_new_tokens=m, priority=pr, **sk)
-                for p, m, pr, sk in reqs]
-    ref_res = ref_fe.run()
-    ref_tokens = {i: ref_res[r].tokens for i, r in enumerate(ref_rids)}
+    ref_tokens = _reference_tokens(model, reqs, replicas=2)
 
     # ---- serve phase in a child process, SIGKILLed mid-soak
     journal_dir = journal_dir or tempfile.mkdtemp(prefix="paddle_tpu_kill_")
@@ -518,21 +554,12 @@ def run_chaos_fleet(seed=0, workers=3, num_requests=8, max_steps=3000):
     armed through the spec JSON, frontend-side rpc fault, heartbeat
     failover — the cross-process half of the containment contract."""
     from paddle_tpu.distributed import rpc
-    from paddle_tpu.inference import (
-        FaultInjector,
-        RequestStatus,
-        ServingEngine,
-        ServingFleet,
-        ServingFrontend,
-    )
+    from paddle_tpu.inference import FaultInjector, RequestStatus, \
+        ServingFleet
 
     model = _build_model()
     reqs = _request_stream(seed, num_requests, poison=False)
-    ref_fe = ServingFrontend([ServingEngine(model, **ENGINE)])
-    ref_rids = [ref_fe.submit(p, max_new_tokens=m, priority=pr)
-                for p, m, pr in reqs]
-    ref_tokens = {i: ref_fe.run()[r].tokens
-                  for i, r in enumerate(ref_rids)}
+    ref_tokens = _reference_tokens(model, reqs)
 
     spec = {
         "seed": 11, "model": MODEL, "engine": ENGINE,
@@ -605,11 +632,542 @@ def run_chaos_fleet(seed=0, workers=3, num_requests=8, max_steps=3000):
         rpc.set_fault_injector(None)
 
 
+class _CountingEngine:
+    """Thin engine proxy counting ``step`` calls: the in-process proof
+    that a fenced zombie RPC never reached the engine (zero duplicate
+    token execution — the fence raises BEFORE delegation)."""
+
+    def __init__(self, eng):
+        self._eng = eng
+        self.step_calls = 0
+
+    def __getattr__(self, attr):
+        return getattr(self._eng, attr)
+
+    def step(self):
+        self.step_calls += 1
+        return self._eng.step()
+
+
+def run_standby(seed=0, num_requests=14, pause_after=4, max_steps=3000,
+                journal_dir=None):
+    """In-process HA soak: active + standby incarnations over SHARED
+    engines behind EpochFence/FencedEngine wrappers, lease expiry on an
+    injected counter clock (deterministic — no wall-clock gates), a
+    manufactured zombie, and the graceful-handoff leg.  Returns the
+    report dict; raises AssertionError on any contract violation."""
+    import tempfile
+
+    from paddle_tpu.distributed.launch.master import KVServer
+    from paddle_tpu.inference import (
+        RequestJournal,
+        RequestStatus,
+        ServingEngine,
+        ServingFrontend,
+        StaleEpoch,
+    )
+    from paddle_tpu.inference.ha import (EpochFence, FencedEngine,
+                                         FrontendLease, StandbyFrontend)
+
+    model = _build_model()
+    reqs = _kill_request_stream(seed, num_requests)
+    ref_tokens = _reference_tokens(model, reqs, replicas=2)
+
+    journal_dir = journal_dir or tempfile.mkdtemp(prefix="paddle_tpu_sby_")
+    jpath = os.path.join(journal_dir, "requests.wal")
+    kvs = KVServer(0).start()
+    ep = f"127.0.0.1:{kvs.port}"
+    t = [0.0]
+
+    def clock():
+        return t[0]
+
+    engines = [_CountingEngine(ServingEngine(model, **ENGINE))
+               for _ in range(2)]
+    fences = [EpochFence() for _ in engines]
+
+    def wrap():
+        return [FencedEngine(e, f) for e, f in zip(engines, fences)]
+
+    try:
+        # ---- active incarnation: holds the lease; epoch armed but the
+        # lease is NOT wired into step() — the resumed zombie must reach
+        # the WORKER fence (the lease-renew self-depose path has its own
+        # fast unit test; a zombie paused mid-step skips that check in
+        # production too)
+        lease_a = FrontendLease(ep, ttl_s=30.0, holder="frontend-a",
+                                clock=clock, seed=seed)
+        assert lease_a.acquire() == 1
+        fe_a = ServingFrontend(
+            wrap(), journal=RequestJournal(jpath, fsync=False),
+            epoch=lease_a.epoch, clock=clock)
+        rids = [fe_a.submit(p, max_new_tokens=m, priority=pr,
+                            idempotency_key=f"req-{i}", **sk)
+                for i, (p, m, pr, sk) in enumerate(reqs)]
+        pre = {}
+        paused = False
+        for _ in range(max_steps):
+            fe_a.step()
+            t[0] += 1.0
+            pre = dict(fe_a.results())
+            in_flight = any(r.generated and rid not in pre
+                            for rid, r in fe_a._requests.items())
+            if len(pre) >= pause_after and in_flight:
+                paused = True     # SIGSTOP analog: stop driving fe_a
+                break
+        assert paused, (
+            "stream drained before the pause condition held — grow "
+            "--requests or shrink --pause-after")
+
+        # ---- lease expires while the active is paused; standby wins
+        t[0] += lease_a.ttl_s + 1.0
+        lease_b = FrontendLease(ep, ttl_s=30.0, holder="frontend-b",
+                                clock=clock, seed=seed)
+        standby = StandbyFrontend(lease_b, jpath, wrap,
+                                  frontend_kwargs={"clock": clock})
+        fe_b = standby.poll()
+        assert fe_b is not None and fe_b.epoch == 2, fe_b
+        assert fe_b.metrics.counter("standby_takeovers_total") == 1
+        assert fe_b.metrics.counter("failovers_total") == 1
+
+        # ---- client replays every idempotency key to the new
+        # incarnation: original rids, zero re-execution
+        retry_rids = [fe_b.submit(p, max_new_tokens=m, priority=pr,
+                                  idempotency_key=f"req-{i}", **sk)
+                      for i, (p, m, pr, sk) in enumerate(reqs)]
+        assert retry_rids == rids, (
+            f"client retries re-executed instead of deduping: "
+            f"{retry_rids} != {rids}")
+        assert fe_b.metrics.counter("idempotent_hits_total") \
+            == num_requests
+
+        # ---- the zombie resumes while the successor is mid-run
+        # (SIGCONT analog): every RPC lands typed StaleEpoch, the
+        # engines execute NOTHING for it (counters, not wall clock)
+        fe_b.step()
+        steps_at_takeover = [e.step_calls for e in engines]
+        fenced_before = sum(f.fenced_total for f in fences)
+        zombie_typed = False
+        try:
+            fe_a.step()
+        except StaleEpoch:
+            zombie_typed = True
+        assert zombie_typed and fe_a.deposed
+        try:
+            fe_a.step()              # deposed short-circuit, still typed
+            raise AssertionError("deposed frontend stepped again")
+        except StaleEpoch:
+            pass
+        try:
+            fe_a.submit([1, 2], max_new_tokens=2)
+            raise AssertionError("deposed frontend admitted a request")
+        except StaleEpoch:
+            pass
+        zombie_fenced = sum(f.fenced_total for f in fences) - fenced_before
+        assert zombie_fenced >= 1
+        assert fe_a.metrics.counter("fenced_rpcs_total") >= 1
+        assert [e.step_calls for e in engines] == steps_at_takeover, (
+            "zombie RPCs reached an engine — duplicate token execution")
+
+        # ---- successor drains; every admit has exactly one typed
+        # terminal, survivors token-identical to the crash-free run
+        res = fe_b.run(max_steps=max_steps)
+        statuses = {}
+        mismatched = []
+        for i, rid in enumerate(rids):
+            r = res[rid]
+            statuses[r.status.value] = statuses.get(r.status.value, 0) + 1
+            if rid in pre:
+                assert r.detail.startswith("recovered terminal"), (
+                    f"rid {rid} was terminal pre-pause but re-executed")
+                assert r.status.value == pre[rid].status.value
+                if (pre[rid].status is RequestStatus.COMPLETED
+                        and pre[rid].tokens != ref_tokens[i]):
+                    mismatched.append(rid)
+            elif (r.status is RequestStatus.COMPLETED
+                    and r.tokens != ref_tokens[i]):
+                mismatched.append(rid)
+        assert not mismatched, (
+            f"survivors diverged from crash-free run: {mismatched}")
+
+        # ---- handoff leg: clean early release, zero dropped admits,
+        # no StaleEpoch anywhere
+        j2 = os.path.join(journal_dir, "handoff.wal")
+        fences2 = [EpochFence() for _ in engines]
+
+        def wrap2():
+            return [FencedEngine(e, f) for e, f in zip(engines, fences2)]
+
+        lease_c = FrontendLease(ep, key="/serving/handoff-lease",
+                                ttl_s=30.0, holder="frontend-c",
+                                clock=clock, seed=seed)
+        assert lease_c.acquire() == 1
+        fe_c = ServingFrontend(
+            wrap2(), journal=RequestJournal(j2, fsync=False),
+            lease=lease_c, clock=clock)
+        h_rids = [fe_c.submit(p, max_new_tokens=m, priority=pr,
+                              idempotency_key=f"h-{i}", **sk)
+                  for i, (p, m, pr, sk) in enumerate(reqs)]
+        for _ in range(3):            # partial progress, then upgrade
+            fe_c.step()
+            t[0] += 1.0
+        pre_h = dict(fe_c.results())
+        fe_c.handoff()
+        assert fe_c.handed_off
+        assert fe_c.metrics.counter("handoffs_total") == 1
+        lease_d = FrontendLease(ep, key="/serving/handoff-lease",
+                                ttl_s=30.0, holder="frontend-d",
+                                clock=clock, seed=seed)
+        standby2 = StandbyFrontend(lease_d, j2, wrap2,
+                                   frontend_kwargs={"clock": clock})
+        fe_d = standby2.poll()        # immediate: released, no TTL wait
+        assert fe_d is not None and fe_d.epoch == 2
+        assert fe_d.metrics.counter("failovers_total") == 0
+        h_retry = [fe_d.submit(p, max_new_tokens=m, priority=pr,
+                               idempotency_key=f"h-{i}", **sk)
+                   for i, (p, m, pr, sk) in enumerate(reqs)]
+        assert h_retry == h_rids
+        h_res = fe_d.run(max_steps=max_steps)
+        h_mismatched = []
+        for i, rid in enumerate(h_rids):
+            r = h_res[rid]
+            if rid in pre_h:
+                if (pre_h[rid].status is RequestStatus.COMPLETED
+                        and pre_h[rid].tokens != ref_tokens[i]):
+                    h_mismatched.append(rid)
+            elif (r.status is RequestStatus.COMPLETED
+                    and r.tokens != ref_tokens[i]):
+                h_mismatched.append(rid)
+        assert not h_mismatched
+        # zero dropped admitted requests + clean (never-fenced) handoff
+        assert all(rid in h_res for rid in h_rids)
+        assert sum(f.fenced_total for f in fences2) == 0, (
+            "a clean handoff fenced something — zombie manufactured")
+    finally:
+        kvs.stop()
+
+    return {
+        "mode": "standby-in-process",
+        "seed": seed,
+        "requests": num_requests,
+        "terminal_before_pause": len(pre),
+        "recovered_requests":
+            fe_b.metrics.counter("recovered_requests_total"),
+        "idempotent_hits": fe_b.metrics.counter("idempotent_hits_total"),
+        "takeover_epoch": fe_b.epoch,
+        "failovers": fe_b.metrics.counter("failovers_total"),
+        "standby_takeovers":
+            fe_b.metrics.counter("standby_takeovers_total"),
+        "zombie_fenced_rpcs": zombie_fenced,
+        "zombie_executed_steps": 0,
+        "statuses": statuses,
+        "handoff_epoch": fe_d.epoch,
+        "handoffs": fe_c.metrics.counter("handoffs_total"),
+        "handoff_fenced_rpcs": 0,
+        "survivors_token_identical": True,
+        "exactly_one_terminal_per_admit": True,
+    }
+
+
+def standby_serve_phase(master_ep, journal_path, seed, num_requests,
+                        pause_after, self_kill, max_steps=3000):
+    """Child half of ``--standby --workers``: the ACTIVE frontend over
+    real workers.  Acquires the lease at epoch 1, serves the seeded
+    keyed stream through a journal, and at the pause condition either
+    SIGKILLs itself (crash variant) or writes a marker file and keeps
+    stepping SLOWLY until the parent SIGSTOPs it (zombie variant).  A
+    resumed zombie observes its deposition as a typed ``StaleEpoch``,
+    then PROVES the worker fences by issuing one stale-epoch RPC per
+    worker, records the outcome in a sidecar, and exits rc=42."""
+    import signal
+    import time as _time
+
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.inference import (RequestJournal, ServingFrontend,
+                                      StaleEpoch)
+    from paddle_tpu.inference.fleet import connect_workers
+    from paddle_tpu.inference.ha import FrontendLease
+
+    rpc.init_rpc("frontend-a", rank=0, world_size=1,
+                 master_endpoint=master_ep)
+    lease = FrontendLease(master_ep, ttl_s=3.0, holder="frontend-a",
+                          seed=seed)
+    assert lease.acquire() == 1, "active could not acquire a fresh lease"
+    replicas = connect_workers(master_ep)
+    assert replicas, "no workers discovered"
+    fe = ServingFrontend(replicas,
+                         journal=RequestJournal(journal_path, fsync=False),
+                         lease=lease)
+    reqs = _kill_request_stream(seed, num_requests)
+    rids = [fe.submit(p, max_new_tokens=m, priority=pr,
+                      idempotency_key=f"req-{i}", **sk)
+            for i, (p, m, pr, sk) in enumerate(reqs)]
+    client_log = open(journal_path + ".client", "w")
+    marker = journal_path + ".paused"
+    seen = set()
+    signalled = False
+    for _ in range(max_steps):
+        try:
+            fe.step()
+        except StaleEpoch:
+            # the resumed zombie learns it was deposed (lease renew or a
+            # worker fence — whichever it hit first).  Prove the WORKER
+            # fence explicitly: a stale-epoch step RPC per worker must
+            # land typed StaleEpoch, executing nothing
+            worker_fenced = 0
+            other = 0
+            for rep in replicas:
+                # drop any step future issued BEFORE the pause: the
+                # proof must be a FRESH stale-epoch RPC, not the
+                # collected result of a legitimately pre-takeover step
+                rep._pending_step = None
+                try:
+                    rep.step()
+                except StaleEpoch:
+                    worker_fenced += 1
+                except Exception:  # noqa: BLE001 — e.g. worker gone
+                    other += 1
+            with open(journal_path + ".zombie", "w") as f:
+                json.dump({"deposed_typed": True,
+                           "worker_fenced": worker_fenced,
+                           "worker_other_errors": other,
+                           "terminals_observed": len(seen)}, f)
+            sys.exit(42)
+        for rid, res in fe.results().items():
+            if rid in seen:
+                continue
+            seen.add(rid)
+            client_log.write(json.dumps(
+                {"rid": rid, "status": res.status.value,
+                 "tokens": res.tokens}) + "\n")
+            client_log.flush()
+        in_flight = any(r.generated and rid not in seen
+                        for rid, r in fe._requests.items())
+        if not signalled and len(seen) >= pause_after and in_flight:
+            if self_kill:
+                os.kill(os.getpid(), signal.SIGKILL)   # never returns
+            open(marker, "w").write("ready")
+            signalled = True
+        if signalled:
+            # slow-step so the parent's SIGSTOP lands mid-activity
+            _time.sleep(0.05)
+        if len(seen) == len(rids):
+            break
+    # drained before the pause condition (or resumed without being
+    # deposed): parameters wrong — exit 0 and let the parent fail on rc
+    sys.exit(0)
+
+
+def run_standby_fleet(seed=0, workers=2, num_requests=10, pause_after=3,
+                      zombie=False, max_steps=3000):
+    """Parent half of ``--standby --workers``: real worker processes
+    that OUTLIVE the active frontend child, which the parent SIGKILLs
+    (crash) or SIGSTOP/SIGCONTs (true zombie).  The parent then becomes
+    the standby, waits out the lease TTL, takes over at epoch 2, replays
+    the client, and asserts the split-brain contract with worker-side
+    counters."""
+    import signal
+    import subprocess
+    import tempfile
+    import time as _time
+
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.distributed.launch.master import KVClient, KVServer
+    from paddle_tpu.inference import RequestStatus
+    from paddle_tpu.inference.fleet import connect_workers
+    from paddle_tpu.inference.ha import FrontendLease, StandbyFrontend
+
+    model = _build_model()
+    reqs = _kill_request_stream(seed, num_requests)
+    # in-process reference engines are token-identical to worker
+    # processes — the r8 fleet contract
+    ref_tokens = _reference_tokens(model, reqs, replicas=2)
+
+    kvs = KVServer(0).start()
+    ep = f"127.0.0.1:{kvs.port}"
+    kv = KVClient(ep)
+    journal_dir = tempfile.mkdtemp(prefix="paddle_tpu_sbyfleet_")
+    jpath = os.path.join(journal_dir, "requests.wal")
+    spec = {"seed": 11, "model": MODEL, "engine": ENGINE}
+    here = os.path.dirname(os.path.abspath(__file__))
+    procs = {}
+    child = None
+    try:
+        # ---- worker processes (they outlive every frontend)
+        for i in range(workers):
+            name = f"w{i}"
+            log = open(os.path.join(journal_dir, f"{name}.log"), "w")
+            procs[name] = subprocess.Popen(
+                [sys.executable, os.path.join(here, "serving_worker.py"),
+                 "--master", ep, "--name", name,
+                 "--spec-json", json.dumps(spec), "--platform", "cpu"],
+                stdout=log, stderr=subprocess.STDOUT)
+            log.close()
+        deadline = _time.monotonic() + 180
+        for name in procs:
+            while kv.get(f"/rpc/workers/{name}") is None:
+                assert procs[name].poll() is None, f"worker {name} died"
+                assert _time.monotonic() < deadline, "worker boot timeout"
+                _time.sleep(0.1)
+
+        # ---- the ACTIVE frontend child
+        child = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--standby-serve-phase", "--master", ep, "--journal", jpath,
+             "--seed", str(seed), "--requests", str(num_requests),
+             "--pause-after", str(pause_after)]
+            + ([] if zombie else ["--self-kill"]),
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        if zombie:
+            marker = jpath + ".paused"
+            deadline = _time.monotonic() + 300
+            while not os.path.exists(marker):
+                assert child.poll() is None, (
+                    f"active child exited rc={child.returncode} before "
+                    "the pause condition")
+                assert _time.monotonic() < deadline, "pause marker timeout"
+                _time.sleep(0.02)
+            os.kill(child.pid, signal.SIGSTOP)   # a true zombie
+        else:
+            child.wait(timeout=300)
+            assert child.returncode == -signal.SIGKILL, (
+                f"active child exited rc={child.returncode}, expected "
+                "self-SIGKILL — stream drained before the kill condition")
+
+        # ---- the parent becomes the standby
+        rpc.init_rpc("standby-frontend", rank=0, world_size=1,
+                     master_endpoint=ep)
+        lease = FrontendLease(ep, ttl_s=3.0, holder="standby-frontend",
+                              seed=seed)
+        standby = StandbyFrontend(
+            lease, jpath, lambda: connect_workers(ep))
+        fe = standby.wait_for_takeover(timeout_s=60)
+        assert fe.epoch == 2, fe.epoch
+        assert fe.metrics.counter("standby_takeovers_total") == 1
+        assert fe.metrics.counter("failovers_total") == 1
+        # the dead child's stale "frontend-a" registration must not have
+        # come back as a bogus replica (ISSUE 12 satellite)
+        names = sorted(getattr(r.engine, "worker", "?")
+                       for r in fe.replicas)
+        assert names == sorted(procs), names
+
+        def worker_counters(name_):
+            out = {}
+            for rep in fe.replicas:
+                h = rep.engine.health()
+                out[h["name"]] = h["metrics"]["counters"].get(name_, 0)
+            return out
+
+        tokens_at_takeover = worker_counters("tokens_emitted_total")
+        zombie_report = None
+        if zombie:
+            # resume the zombie AFTER takeover: its epoch-1 RPCs must
+            # all land typed StaleEpoch and execute nothing
+            os.kill(child.pid, signal.SIGCONT)
+            child.wait(timeout=120)
+            assert child.returncode == 42, (
+                f"zombie exited rc={child.returncode}, expected the "
+                "deposed-typed marker (42)")
+            with open(jpath + ".zombie") as f:
+                zombie_report = json.load(f)
+            assert zombie_report["deposed_typed"]
+            assert zombie_report["worker_fenced"] >= 1
+            fenced = worker_counters("fenced_rpcs_total")
+            assert sum(fenced.values()) >= 1, fenced
+            # zero duplicate token execution: the standby has not run
+            # yet, so any delta here would be the zombie's
+            assert worker_counters("tokens_emitted_total") \
+                == tokens_at_takeover
+
+        # ---- client replay + drain on the new incarnation
+        retry_rids = [fe.submit(p, max_new_tokens=m, priority=pr,
+                                idempotency_key=f"req-{i}", **sk)
+                      for i, (p, m, pr, sk) in enumerate(reqs)]
+        assert retry_rids == list(range(num_requests)), retry_rids
+        assert fe.metrics.counter("idempotent_hits_total") == num_requests
+        res = fe.run(max_steps=max_steps)
+
+        pre_client = {}
+        if os.path.exists(jpath + ".client"):
+            with open(jpath + ".client") as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue   # torn final line: the crash's right
+                    pre_client[rec["rid"]] = rec
+        statuses = {}
+        mismatched = []
+        for i in range(num_requests):
+            r = res[i]
+            statuses[r.status.value] = statuses.get(r.status.value, 0) + 1
+            if r.detail.startswith("recovered terminal"):
+                cl = pre_client.get(i)
+                if cl is not None and cl["status"] == "completed" \
+                        and cl["tokens"] != ref_tokens[i]:
+                    mismatched.append(i)
+            elif r.status is RequestStatus.COMPLETED \
+                    and r.tokens != ref_tokens[i]:
+                mismatched.append(i)
+        assert not mismatched, (
+            f"survivors diverged from crash-free run: {mismatched}")
+
+        report = {
+            "mode": "standby-fleet",
+            "variant": "zombie" if zombie else "sigkill",
+            "seed": seed,
+            "workers": workers,
+            "requests": num_requests,
+            "takeover_epoch": fe.epoch,
+            "recovered_requests":
+                fe.metrics.counter("recovered_requests_total"),
+            "idempotent_hits":
+                fe.metrics.counter("idempotent_hits_total"),
+            "statuses": statuses,
+            "worker_fenced_rpcs":
+                sum(worker_counters("fenced_rpcs_total").values()),
+            "zombie": zombie_report,
+            "survivors_token_identical": True,
+            "exactly_one_terminal_per_admit": True,
+        }
+        # polite worker shutdown under the CURRENT epoch
+        for rep in fe.replicas:
+            try:
+                rep.engine.request_shutdown(timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
+        return report
+    finally:
+        if child is not None and child.poll() is None:
+            try:
+                os.kill(child.pid, signal.SIGCONT)
+            except OSError:
+                pass
+            child.kill()
+            child.wait(timeout=10)
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in procs.values():
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=10)
+        try:
+            rpc.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        kvs.stop()
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--replicas", type=int, default=3)
-    ap.add_argument("--requests", type=int, default=18)
+    ap.add_argument("--requests", type=int, default=None,
+                    help="request count (default: 18; standby modes use "
+                         "smaller per-mode defaults)")
     ap.add_argument("--max-request-retries", type=int, default=2)
     ap.add_argument("--no-poison", action="store_true")
     ap.add_argument("--brownout", action="store_true",
@@ -630,12 +1188,57 @@ def main(argv=None):
                     help="journal path (internal: --serve-phase)")
     ap.add_argument("--serve-phase", action="store_true",
                     help="internal: the child half of --kill-frontend")
+    ap.add_argument("--standby", action="store_true",
+                    help="HA phase (ISSUE 12): lease-based standby "
+                         "failover + zombie fencing; in-process by "
+                         "default, real processes with --workers N")
+    ap.add_argument("--pause-after", type=int, default=None,
+                    help="standby: pause/kill the active frontend once "
+                         "this many requests are terminal (with work "
+                         "in flight); default 4 in-process, 3 fleet")
+    ap.add_argument("--zombie", action="store_true",
+                    help="standby --workers: SIGSTOP/SIGCONT the active "
+                         "frontend instead of SIGKILL (a true zombie)")
+    ap.add_argument("--master", default=None,
+                    help="KV master endpoint (internal: "
+                         "--standby-serve-phase)")
+    ap.add_argument("--self-kill", action="store_true",
+                    help="internal: standby serve phase SIGKILLs itself")
+    ap.add_argument("--standby-serve-phase", action="store_true",
+                    help="internal: the active-frontend child half of "
+                         "--standby --workers")
     args = ap.parse_args(argv)
+    if args.requests is None:
+        # per-mode defaults (an EXPLICIT --requests always wins — no
+        # sentinel-value guessing): the standby soaks are sized so the
+        # pause lands with work in flight at their pause-after points
+        if args.standby and args.workers > 0:
+            args.requests = 10
+        elif args.standby:
+            args.requests = 14
+        else:
+            args.requests = 18
+    if args.pause_after is None:
+        args.pause_after = 3 if args.workers > 0 else 4
     if args.serve_phase:
         serve_phase(args.journal, args.seed, args.requests,
                     args.kill_after)
         return
-    if args.kill_frontend:
+    if args.standby_serve_phase:
+        standby_serve_phase(args.master, args.journal, args.seed,
+                            args.requests, args.pause_after,
+                            args.self_kill)
+        return
+    if args.standby and args.workers > 0:
+        report = run_standby_fleet(seed=args.seed, workers=args.workers,
+                                   num_requests=args.requests,
+                                   pause_after=args.pause_after,
+                                   zombie=args.zombie)
+    elif args.standby:
+        report = run_standby(seed=args.seed,
+                             num_requests=args.requests,
+                             pause_after=args.pause_after)
+    elif args.kill_frontend:
         report = run_kill_frontend(seed=args.seed,
                                    num_requests=args.requests,
                                    kill_after=args.kill_after)

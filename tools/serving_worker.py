@@ -17,10 +17,22 @@ one engine step — which, with megastep decode (ISSUE 9), returns up to
 The worker deliberately OUTLIVES its frontend (ISSUE 11): it parks on
 the stop event, not on the frontend's liveness, so a crashed frontend
 leaves the worker registered and serving-ready.  The recovered frontend
-reattaches (``fleet.discover_workers`` + ``RemoteReplica``), calls the
-``_w_reap_orphans`` handler to evict the dead frontend's sequences
-(publishing their KV blocks into the prefix cache), and re-admits from
-its write-ahead journal.
+reattaches (``fleet.discover_workers``/``connect_workers`` +
+``RemoteReplica``), calls the ``_w_reap_orphans`` handler to evict the
+dead frontend's sequences (publishing their KV blocks into the prefix
+cache), and re-admits from its write-ahead journal.
+
+Because frontends come and go across one worker life, every control RPC
+handler is EPOCH-FENCED (ISSUE 12): ``fleet.init_worker`` arms an
+``EpochFence`` that remembers the highest frontend epoch this process
+has ever seen, and a call carrying an older epoch — a zombie frontend
+resumed after its lease expired and a standby took over — raises the
+typed ``StaleEpoch`` instead of touching the engine.  The fence lives
+in worker-process memory, which is exactly the failure domain it
+protects: it dies only when the worker does, and a restarted worker is
+re-fenced by the current frontend's first RPC.  ``_w_shutdown`` is
+fenced too (a deposed frontend cannot shut down the new incarnation's
+fleet), but SIGTERM still works for operators.
 
 Spec JSON (everything the worker needs to be a bit-identical replica):
 
@@ -89,8 +101,13 @@ def main(argv=None):
     # ships the same JSON to every worker, so a fault schedule is part of
     # the replica recipe): {"faults": {"seed": 7, "sites": {...}}}
     faults = spec.get("faults")
+    # "replica_namespaces" rides the spec exactly like the env JSON's
+    # (FaultInjector.from_env): without it, replica-scoped sites
+    # ("r0.step") would fail the arm-time namespace validation at boot
     injector = (FaultInjector(faults.get("sites", {}),
-                              seed=faults.get("seed", 0))
+                              seed=faults.get("seed", 0),
+                              replica_namespaces=faults.get(
+                                  "replica_namespaces", ()))
                 if faults else None)
     engine = ServingEngine(model, fault_injector=injector,
                            **spec.get("engine", {}))

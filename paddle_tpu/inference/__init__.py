@@ -27,10 +27,11 @@ __all__ = ["Config", "create_predictor", "Predictor", "PredictorPool",
            "BrownoutPolicy", "FaultInjector", "FaultSpec",
            "RespawnCircuitBreaker", "RequestJournal", "JournalCorruption",
            "JournalSuperseded", "StaleEpoch", "EpochFence", "FencedEngine",
-           "FrontendLease", "StandbyFrontend"]
+           "FrontendLease", "StandbyFrontend", "HandedOff"]
 
 from .control_plane import (  # noqa: E402
     BrownoutPolicy,
+    HandedOff,
     Priority,
     RequestResult,
     RequestStatus,

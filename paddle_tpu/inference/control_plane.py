@@ -171,7 +171,18 @@ from .metrics import (MEGASTEP_COUNTERS, ServingMetrics,
 from .serving import SamplingParams, ServingEngine, prompt_block_hashes
 
 __all__ = ["Priority", "RequestStatus", "RequestResult", "ServingFrontend",
-           "BrownoutPolicy", "StaleEpoch"]
+           "BrownoutPolicy", "StaleEpoch", "HandedOff"]
+
+
+class HandedOff(RuntimeError):
+    """This frontend completed ``handoff()``: the successor owns every
+    open request, so submit/cancel/step here would double-drive state
+    the handoff snapshot already transferred.  Typed (rather than a
+    bare RuntimeError) so callers route to the successor the same way
+    :class:`~paddle_tpu.inference.ha.StaleEpoch` routes a deposed
+    zombie's traffic — the two are the clean and the fenced half of the
+    same succession story.  Subclasses RuntimeError for compatibility
+    with pre-typed callers."""
 
 
 class Priority(IntEnum):
@@ -540,6 +551,9 @@ class ServingFrontend:
         if self.journal is not None:
             try:
                 self.journal.close()
+            # graft-lint: disable=typed-termination — deposed path: we are
+            # the stale writer, the successor owns the file; any close
+            # fault here is moot
             except Exception:  # noqa: BLE001 — already the stale writer
                 pass
 
@@ -666,7 +680,7 @@ class ServingFrontend:
                 f"frontend deposed ({self._deposed_reason}) — submit to "
                 "the current incarnation")
         if self._handed_off:
-            raise RuntimeError(
+            raise HandedOff(
                 "frontend handed off — submit to the successor")
         if idempotency_key is not None:
             prev = self._idem_open.get(idempotency_key,
@@ -795,7 +809,7 @@ class ServingFrontend:
             # in-flight sequence (epoch=None deployments have no fence
             # to stop it), and a terminal append would reopen the WAL
             # behind the final handoff snapshot
-            raise RuntimeError(
+            raise HandedOff(
                 "frontend handed off — cancel on the successor")
         req = self._requests.get(rid)
         if req is None or rid in self._results:
@@ -834,7 +848,7 @@ class ServingFrontend:
                 f"frontend deposed ({self._deposed_reason}) — stop "
                 "stepping and defer to the current incarnation")
         if self._handed_off:
-            raise RuntimeError("frontend handed off — drive the successor")
+            raise HandedOff("frontend handed off — drive the successor")
         if self.lease is not None:
             self._maintain_lease()
         live = [r for r in self._replicas if r.alive]
@@ -859,6 +873,9 @@ class ServingFrontend:
             if begin is not None:
                 try:
                     begin()
+                # graft-lint: disable=typed-termination — begin_step is a
+                # concurrency prefetch; a faulting replica raises the same
+                # fault from step() below, where failover handles it typed
                 except Exception:  # noqa: BLE001 — surfaced by step() below
                     pass
         self._in_step = True
@@ -1097,6 +1114,9 @@ class ServingFrontend:
         if self.lease is not None:
             try:
                 self.lease.release()
+            # graft-lint: disable=typed-termination — best-effort early
+            # release: a failed release only delays the successor by one
+            # TTL, it cannot lose requests
             except Exception:  # noqa: BLE001 — TTL expiry still hands off
                 pass
         self._handed_off = True

@@ -42,6 +42,7 @@ from __future__ import annotations
 import json
 import os
 import random
+import threading
 import time
 from dataclasses import dataclass
 from typing import Callable, Dict, Iterable, List, Optional, Tuple, Union
@@ -87,15 +88,19 @@ KNOWN_SITES = {
 # constructor/env "replica_namespaces" lists, or wrapping a
 # FaultyReplica with that name) — closing the r12 round-3 hole where a
 # namespace typo whose op suffix was legal ("enigne.step") armed
-# silently and the chaos run degraded to calm.  KNOWN SCOPE LIMIT: the
-# set is process-global and grow-only (wrap-first-arm-later and
-# register-up-front both need registrations to outlive any one
-# injector), so a LATER injector in the same process validates against
-# every name an EARLIER run registered — a stale copy-paste site like
-# "r0.step" arms silently if some previous schedule spawned an "r0".
-# Run-scoped registration would need an explicit registry handle
-# threaded through FaultyReplica/run_chaos; not worth it until a second
-# real collision shows up.
+# silently and the chaos run degraded to calm.
+#
+# REPLICA_NAMESPACES is the process-global DEFAULT registry (grow-only:
+# wrap-first-arm-later and register-up-front both need registrations to
+# outlive any one injector).  That default leaks across runs — a later
+# injector in the same process would validate against every name an
+# earlier run registered, so a stale copy-paste site like "r0.step"
+# armed silently if some previous schedule ever spawned an "r0" (the
+# r13-deferred scope hole).  Run-scoped validation closes it: pass a
+# ``namespace_registry=`` set to FaultInjector / FaultyReplica /
+# register_replica_namespace and every registration + arm-time check
+# for that run stays inside the handle (tools/chaos_serving.py threads
+# one per soak).
 _REPLICA_OPS = {"step", "add_request", "evict"}
 REPLICA_NAMESPACES: set = set()
 
@@ -108,13 +113,18 @@ def register_failpoint(site: str) -> str:
     return site
 
 
-def register_replica_namespace(name: str) -> str:
+def register_replica_namespace(name: str,
+                               registry: Optional[set] = None) -> str:
     """Allow ``<name>.<op>`` replica-scoped sites (op in step /
     add_request / evict) to arm.  Chaos harnesses register the replica
     names they plan to spawn BEFORE building the injector;
     ``FaultyReplica`` registers its own name at construction for the
-    wrap-first-arm-later order.  Returns the name."""
-    REPLICA_NAMESPACES.add(name)
+    wrap-first-arm-later order.  Returns the name.
+
+    ``registry`` scopes the registration: None lands in the
+    process-global :data:`REPLICA_NAMESPACES`; a run-scoped set keeps
+    one chaos run's names from validating a later run's typos."""
+    (REPLICA_NAMESPACES if registry is None else registry).add(name)
     return name
 
 
@@ -176,11 +186,17 @@ class FaultInjector:
 
     def __init__(self, sites: Dict[str, Union[FaultSpec, Dict]],
                  seed: int = 0, sleep: Callable[[float], None] = time.sleep,
-                 replica_namespaces: Iterable[str] = ()):
+                 replica_namespaces: Iterable[str] = (),
+                 namespace_registry: Optional[set] = None):
         self.seed = int(seed)
         self._sleep = sleep
+        # run-scoped namespace validation (r13-deferred scope fix): with
+        # a registry handle, this injector neither sees nor pollutes the
+        # process-global set, so arm-time validation cannot be satisfied
+        # by a name some EARLIER same-process run registered
+        self._ns_registry = namespace_registry
         for ns in replica_namespaces:
-            register_replica_namespace(ns)
+            register_replica_namespace(ns, registry=namespace_registry)
         for site in (sites or {}):
             self._validate_site(site)
         self._specs: Dict[str, FaultSpec] = {
@@ -195,21 +211,29 @@ class FaultInjector:
         self._fires: Dict[str, int] = {}
         self.log: List[Tuple[str, str, str]] = []  # (site, kind, detail)
 
-    @staticmethod
-    def _validate_site(site: str):
+    def _namespaces(self) -> set:
+        """The namespace registry THIS injector validates against: its
+        run-scoped handle when one was passed, else the process-global
+        default (resolved at call time so tests can swap the module
+        attribute)."""
+        return (self._ns_registry if self._ns_registry is not None
+                else REPLICA_NAMESPACES)
+
+    def _validate_site(self, site: str):
         """Arm-time check against the known-site registry: a site no
         production code fires would otherwise arm fine and never fire —
         a chaos schedule (or PADDLE_TPU_FAULTS) silently degrading to
         calm.  Both the constructor and the env-JSON path funnel here."""
         if site in KNOWN_SITES:
             return
+        namespaces = self._namespaces()
         if "." in site:
             ns, op = site.rsplit(".", 1)
             # replica-scoped "<name>.<op>": BOTH halves validate — the
             # op against the fixed replica surface, the namespace
             # against the registered set, so "typo-replica.step" raises
             # here instead of silently never firing (r12 round-3 hole)
-            if op in _REPLICA_OPS and ns in REPLICA_NAMESPACES:
+            if op in _REPLICA_OPS and ns in namespaces:
                 return
             if op in _REPLICA_OPS:
                 raise ValueError(
@@ -219,7 +243,7 @@ class FaultInjector:
                     "(faults.register_replica_namespace, the injector's "
                     "replica_namespaces= argument, or the env spec's "
                     '"replica_namespaces" list); currently registered: '
-                    f"{sorted(REPLICA_NAMESPACES)}")
+                    f"{sorted(namespaces)}")
         raise ValueError(
             f"unknown failpoint site {site!r}: nothing fires it, so the "
             "spec would never trigger. Known sites: "
@@ -320,60 +344,80 @@ class RespawnCircuitBreaker:
         self.jitter = float(jitter)
         self._clock = clock
         self._rng = random.Random(f"breaker:{seed}")
-        self.state = "closed"
-        self.open_count = 0          # times the breaker opened (monotone)
-        self._failures: List[float] = []   # timestamps inside the window
-        self._consecutive_opens = 0
-        self._retry_at = -float("inf")
+        # the state machine locks ITSELF: the fleet's async boot threads
+        # report failures while the control thread probes allow() and
+        # records successes — callers get atomicity without knowing the
+        # breaker is shared.  Re-entrant: the transition helpers below
+        # run under the public methods' lock
+        self._lock = threading.RLock()
+        self.state = "closed"              # guarded-by: self._lock
+        self.open_count = 0                # guarded-by: self._lock
+        self._failures: List[float] = []   # guarded-by: self._lock
+        self._consecutive_opens = 0        # guarded-by: self._lock
+        self._retry_at = -float("inf")     # guarded-by: self._lock
 
     def _backoff(self) -> float:
-        raw = min(self.base_backoff_s * (2.0 ** (self._consecutive_opens - 1)),
-                  self.max_backoff_s)
+        with self._lock:
+            raw = min(self.base_backoff_s
+                      * (2.0 ** (self._consecutive_opens - 1)),
+                      self.max_backoff_s)
         return raw * (1.0 + self.jitter * (2.0 * self._rng.random() - 1.0))
 
     def _open(self):
-        self.state = "open"
-        self.open_count += 1
-        self._consecutive_opens += 1
-        self._retry_at = self._clock() + self._backoff()
-        self._failures.clear()
+        with self._lock:
+            self.state = "open"
+            self.open_count += 1
+            self._consecutive_opens += 1
+            self._retry_at = self._clock() + self._backoff()
+            self._failures.clear()
 
     def allow(self) -> bool:
         """May a spawn proceed right now?  An open breaker past its
         backoff deadline transitions to half-open and admits exactly one
         probe (callers MUST report that probe via record_success /
         record_failure, or the breaker stays half-open)."""
-        if self.state == "closed":
-            return True
-        if self.state == "open" and self._clock() >= self._retry_at:
-            self.state = "half_open"
-            return True
-        return False   # open before the deadline, or half-open probe out
+        with self._lock:
+            if self.state == "closed":
+                return True
+            if self.state == "open" and self._clock() >= self._retry_at:
+                self.state = "half_open"
+                return True
+            return False   # open pre-deadline, or half-open probe out
 
-    def record_failure(self):
-        """A spawn failed, or a just-spawned worker died early."""
-        if self.state == "half_open":
-            self._open()               # probe failed: back off, doubled
-            return
-        now = self._clock()
-        self._failures.append(now)
-        cutoff = now - self.window_s
-        self._failures = [t for t in self._failures if t >= cutoff]
-        if self.state == "closed" and len(self._failures) >= self.threshold:
-            self._open()
+    def record_failure(self) -> bool:
+        """A spawn failed, or a just-spawned worker died early.  Returns
+        True iff THIS call opened the breaker — the atomic transition
+        signal callers count (two racing reporters must not both see
+        closed→open and double-count ``breaker_open_total``)."""
+        with self._lock:
+            was_open = self.state == "open"
+            if self.state == "half_open":
+                self._open()           # probe failed: back off, doubled
+                return not was_open
+            now = self._clock()
+            self._failures.append(now)
+            cutoff = now - self.window_s
+            self._failures = [t for t in self._failures if t >= cutoff]
+            if self.state == "closed" \
+                    and len(self._failures) >= self.threshold:
+                self._open()
+            return self.state == "open" and not was_open
 
     def record_success(self):
         """A spawned worker attached and looks healthy."""
-        self.state = "closed"
-        self._failures.clear()
-        self._consecutive_opens = 0
-        self._retry_at = -float("inf")
+        with self._lock:
+            self.state = "closed"
+            self._failures.clear()
+            self._consecutive_opens = 0
+            self._retry_at = -float("inf")
 
     @property
     def open_gauge(self) -> float:
         """0 closed / 0.5 half-open / 1 open — the ``respawn_breaker_open``
         metrics gauge."""
-        return {"closed": 0.0, "half_open": 0.5, "open": 1.0}[self.state]
+        with self._lock:
+            return {"closed": 0.0, "half_open": 0.5,
+                    "open": 1.0}[self.state]
 
 
 def prompt_signature(prompt, limit: int = 6) -> str:
@@ -401,10 +445,17 @@ class FaultyReplica:
     engine, so admission/routing/preemption math sees real state."""
 
     def __init__(self, engine, injector: FaultInjector,
-                 name: str = "replica", timeout_exc: Optional[type] = None):
+                 name: str = "replica", timeout_exc: Optional[type] = None,
+                 namespace_registry: Optional[set] = None):
         self._eng = engine
         self._inj = injector
-        self.name = register_replica_namespace(name)
+        # register into the same run-scoped registry the injector
+        # validates against (wrap-first-arm-later order); defaults to
+        # the injector's own handle so the pair cannot diverge
+        if namespace_registry is None:
+            namespace_registry = injector._ns_registry
+        self.name = register_replica_namespace(
+            name, registry=namespace_registry)
         self._timeout_exc = timeout_exc
 
     def __getattr__(self, attr):

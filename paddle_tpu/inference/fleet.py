@@ -216,6 +216,10 @@ def connect_workers(master_endpoint: str,
             # would delist it forever
             if prune_stale and _is_dead_endpoint(e):
                 kv.delete(f"/rpc/workers/{name}")
+        # graft-lint: disable=typed-termination — liveness probe: the
+        # worker ANSWERED (its handler raised), so it is alive and the
+        # registry entry stays; the fault itself belongs to the caller
+        # that eventually drives this worker, not to discovery
         except Exception:  # noqa: BLE001 — the worker ANSWERED (its
             continue       # handler raised): alive, keep the entry
     return out
@@ -815,7 +819,10 @@ class ServingFleet:
         # respawn containment: spawn failures and early worker deaths feed
         # this breaker; the autoscaler consults it before every spawn, so
         # a crash-looping worker config backs off exponentially instead of
-        # burning a ~10 s boot per observation forever
+        # burning a ~10 s boot per observation forever.  Async boot
+        # threads race record_failure against the control thread's
+        # allow/record_success/open_gauge — the breaker locks its own
+        # state machine, so no caller-side locking is needed here
         self.spawn_breaker = (spawn_breaker if spawn_breaker is not None
                               else RespawnCircuitBreaker(clock=clock))
         self.early_death_s = float(early_death_s)
@@ -838,8 +845,9 @@ class ServingFleet:
         # RemoteReplica here; step() attaches it on the control thread so
         # frontend structures are never mutated concurrently
         self._spawn_lock = threading.Lock()
-        self._pending_spawns: Dict[str, threading.Thread] = {}
-        self._ready_replicas: List = []
+        self._pending_spawns: Dict[str, threading.Thread] = {}  # guarded-by: self._spawn_lock
+        self._ready_replicas: List = []                         # guarded-by: self._spawn_lock
+        # guarded-by: self._spawn_lock
         self.spawn_errors: Dict[str, str] = _BoundedErrors(
             self._max_spawn_errors)
         self._frontend_kwargs = dict(frontend_kwargs or {})
@@ -914,6 +922,8 @@ class ServingFleet:
         # real wall clock, NOT the injectable self._clock: this loop
         # actually sleeps, and a frozen/jumping test clock would make the
         # spawn deadline never (or spuriously) fire
+        # graft-lint: disable=determinism — see above: boot deadline on a
+        # real subprocess, never replayed
         deadline = time.monotonic() + self.spawn_timeout
         while self._kv.get(f"/rpc/workers/{name}") is None:
             if proc.poll() is not None:
@@ -923,6 +933,7 @@ class ServingFleet:
                 raise RuntimeError(
                     f"serving worker '{name}' exited rc={proc.returncode} "
                     f"before registering:\n{err}")
+            # graft-lint: disable=determinism — same real boot deadline
             if time.monotonic() > deadline:
                 proc.kill()
                 proc.wait(timeout=10)  # reap — no zombie behind the raise
@@ -957,11 +968,15 @@ class ServingFleet:
     def _note_spawn_failure(self, name: str, err: str):
         """Shared bookkeeping for every spawn-path fault (blocking spawn,
         async boot thread, early worker death): bounded error ring,
-        breaker failure, counter."""
-        self.spawn_errors[name] = err
-        was_open = self.spawn_breaker.state == "open"
-        self.spawn_breaker.record_failure()
-        if self.spawn_breaker.state == "open" and not was_open:
+        breaker failure, counter.  Runs on the control thread (blocking
+        ``spawn_worker``) AND on async boot threads (``_spawn_wait``)
+        [lock-discipline]: the error ring takes the spawn lock (callers
+        must NOT already hold it); the breaker locks itself, and its
+        record_failure returns the open transition atomically so two
+        racing reporters cannot double-count ``breaker_open_total``."""
+        with self._spawn_lock:
+            self.spawn_errors[name] = err
+        if self.spawn_breaker.record_failure():
             self._inc_metric("breaker_open_total")
         self._inc_metric("spawn_failures_total")
 
@@ -1021,17 +1036,20 @@ class ServingFleet:
             self._rpc.refresh_workers()
             replica = self._make_replica(name)
         except Exception as e:  # noqa: BLE001 — boot fault, record + reap
+            # failure first, seat second: the autoscaler must never
+            # observe the seat free without the failure recorded (it
+            # would spawn a doomed extra worker past max_workers)
+            self._note_spawn_failure(name, repr(e))  # takes _spawn_lock
             with self._spawn_lock:
                 self._pending_spawns.pop(name, None)
-                self._note_spawn_failure(name, repr(e))
             proc = self._procs.pop(name, None)
             if proc is not None:
                 try:
                     if proc.poll() is None:
                         proc.kill()
                     proc.wait(timeout=10)
-                except Exception:  # noqa: BLE001
-                    pass
+                except (OSError, subprocess.TimeoutExpired):
+                    pass   # reaped at shutdown() if truly unkillable
                 self._drop_log(name)
             return
         with self._spawn_lock:
@@ -1168,7 +1186,9 @@ class ServingFleet:
                     # a drained worker is idle; the short probe timeout is
                     # the right bound (a wedged one just gets SIGKILLed)
                     rep.engine.request_shutdown(self.heartbeat_timeout_s)
-                except Exception:
+                # graft-lint: disable=typed-termination — best-effort
+                # polite stop; _reap_proc below SIGTERM/SIGKILLs anyway
+                except Exception:  # noqa: BLE001
                     pass
                 self._attached_at.pop(name, None)   # drained, not dead
                 self.frontend.remove_replica(rep)
@@ -1224,7 +1244,10 @@ class ServingFleet:
             try:
                 out[rep.engine.worker] = \
                     rep.engine.health(include_samples)["metrics"]
-            except Exception:
+            # graft-lint: disable=typed-termination — scrape path: a
+            # worker that cannot answer is simply absent from this page;
+            # the heartbeat (not the scraper) owns declaring it dead
+            except Exception:  # noqa: BLE001
                 pass
         return out
 
@@ -1238,7 +1261,10 @@ class ServingFleet:
                 self._rpc.rpc_sync(rep.engine.worker, _w_reset_metrics,
                                    kwargs={"epoch": rep.engine._epoch},
                                    timeout=rep.engine.rpc_timeout)
-            except Exception:
+            # graft-lint: disable=typed-termination — warmup-window reset
+            # is advisory; an unreachable worker keeps its counters and
+            # the heartbeat owns its fate
+            except Exception:  # noqa: BLE001
                 pass
 
     def merged_snapshot(self) -> Dict:
@@ -1270,7 +1296,9 @@ class ServingFleet:
                         # heartbeat timeout, not the 60 s data-plane one: a
                         # hung worker must not stall shutdown per replica
                         rep.engine.request_shutdown(self.heartbeat_timeout_s)
-                    except Exception:
+                    # graft-lint: disable=typed-termination — best-effort
+                    # polite stop during shutdown; SIGTERM/SIGKILL follow
+                    except Exception:  # noqa: BLE001
                         pass
         for name, proc in list(self._procs.items()):
             # SIGTERM (the worker installs a handler that sets its stop

@@ -96,9 +96,9 @@ class EpochFence:
     Thread-safe: worker RPC handlers run in server threads."""
 
     def __init__(self):
-        self.highest: Optional[int] = None
-        self.fenced_total = 0
         self._lock = threading.Lock()
+        self.highest: Optional[int] = None   # guarded-by: self._lock
+        self.fenced_total = 0                # guarded-by: self._lock
 
     def check(self, epoch: Optional[int], op: str = ""):
         if epoch is None:
@@ -446,6 +446,9 @@ class StandbyFrontend:
             # poll retry immediately.
             try:
                 self.lease.release()
+            # graft-lint: disable=typed-termination — best-effort release
+            # on the failed-takeover path; the recover() fault below is
+            # what propagates, and TTL expiry re-opens the lease anyway
             except Exception:  # noqa: BLE001 — TTL expiry still unblocks
                 pass
             raise
@@ -460,7 +463,10 @@ class StandbyFrontend:
         """Poll until takeover; raises TimeoutError past ``timeout_s``.
         (The wall clock here only BOUNDS the wait — correctness gates
         stay counter-based, per the chaos contract.)"""
+        # graft-lint: disable=determinism — real-time bound on a real
+        # wait; correctness gates stay counter-based (docstring above)
         deadline = time.monotonic() + timeout_s
+        # graft-lint: disable=determinism — same real-time bound
         while time.monotonic() < deadline:
             fe = self.poll()
             if fe is not None:

@@ -26,6 +26,7 @@ under test; nothing here touches the device.
 """
 from __future__ import annotations
 
+import threading
 import time
 from typing import Callable, Dict, Iterable, List, Mapping, Optional, Union
 
@@ -130,79 +131,99 @@ class ServingMetrics:
                  max_samples: int = 65536):
         self._clock = clock
         self._max_samples = int(max_samples)
+        # one registry is written from several threads: worker-side
+        # registries by concurrent RPC handler threads (distributed/rpc
+        # serves from a ThreadingHTTPServer — _w_health snapshots while
+        # _w_step incs), fleet frontend registries by async spawn
+        # threads' failure bookkeeping.  dict get-add-store is not
+        # atomic, so every access below locks; re-entrant because
+        # snapshot() composes the locked summary/rate views
+        self._lock = threading.RLock()
         self.reset()
 
     def reset(self):
         """Zero everything (e.g. after a warmup/compile phase)."""
-        self._t0 = self._clock()
-        self._counters: Dict[str, int] = {k: 0 for k in COUNTERS}
-        self._gauges: Dict[str, float] = {k: 0.0 for k in GAUGES}
-        self._samples: Dict[str, List[float]] = {k: [] for k in SAMPLES}
-        self._sample_counts: Dict[str, int] = {k: 0 for k in SAMPLES}
-        self._sample_sums: Dict[str, float] = {k: 0.0 for k in SAMPLES}
-        self._first_emit_t: Optional[float] = None
-        self._last_emit_t: Optional[float] = None
-        self._tokens_at_first_emit = 0
+        with self._lock:
+            self._t0 = self._clock()
+            self._counters: Dict[str, int] = {k: 0 for k in COUNTERS}    # guarded-by: self._lock
+            self._gauges: Dict[str, float] = {k: 0.0 for k in GAUGES}    # guarded-by: self._lock
+            self._samples: Dict[str, List[float]] = {k: [] for k in SAMPLES}  # guarded-by: self._lock
+            self._sample_counts: Dict[str, int] = {k: 0 for k in SAMPLES}     # guarded-by: self._lock
+            self._sample_sums: Dict[str, float] = {k: 0.0 for k in SAMPLES}   # guarded-by: self._lock
+            self._first_emit_t: Optional[float] = None
+            self._last_emit_t: Optional[float] = None
+            self._tokens_at_first_emit = 0
 
     # ------------------------------------------------------------- record
     def now(self) -> float:
         return self._clock()
 
     def inc(self, name: str, n: int = 1):
-        self._counters[name] = self._counters.get(name, 0) + n
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
 
     def set_gauge(self, name: str, value: float):
-        self._gauges[name] = float(value)
+        with self._lock:
+            self._gauges[name] = float(value)
 
     def set_gauge_peak(self, name: str, value: float):
         """Set ``name`` and keep a high-water mark in ``name + '_peak'``
         (a final snapshot of a drained system would otherwise read 0 for
         every pressure gauge)."""
-        self._gauges[name] = float(value)
-        peak = name + "_peak"
-        self._gauges[peak] = max(self._gauges.get(peak, 0.0), float(value))
+        with self._lock:
+            self._gauges[name] = float(value)
+            peak = name + "_peak"
+            self._gauges[peak] = max(self._gauges.get(peak, 0.0),
+                                     float(value))
 
     def observe(self, name: str, value: float):
-        buf = self._samples.setdefault(name, [])
-        cnt = self._sample_counts.get(name, 0)
-        if len(buf) < self._max_samples:
-            buf.append(float(value))
-        else:
-            buf[cnt % self._max_samples] = float(value)
-        self._sample_counts[name] = cnt + 1
-        self._sample_sums[name] = self._sample_sums.get(name, 0.0) + float(value)
+        with self._lock:
+            buf = self._samples.setdefault(name, [])
+            cnt = self._sample_counts.get(name, 0)
+            if len(buf) < self._max_samples:
+                buf.append(float(value))
+            else:
+                buf[cnt % self._max_samples] = float(value)
+            self._sample_counts[name] = cnt + 1
+            self._sample_sums[name] = (self._sample_sums.get(name, 0.0)
+                                       + float(value))
 
     def note_tokens(self, n: int, t: Optional[float] = None):
         """Record ``n`` tokens emitted at time ``t`` (defaults to now)."""
         if n <= 0:
             return
         t = self._clock() if t is None else t
-        self.inc("tokens_emitted_total", n)
-        if self._first_emit_t is None:
-            self._first_emit_t = t
-            self._tokens_at_first_emit = n
-        self._last_emit_t = t
+        with self._lock:
+            self.inc("tokens_emitted_total", n)
+            if self._first_emit_t is None:
+                self._first_emit_t = t
+                self._tokens_at_first_emit = n
+            self._last_emit_t = t
 
     # -------------------------------------------------------------- views
     def counter(self, name: str) -> int:
-        return self._counters.get(name, 0)
+        with self._lock:
+            return self._counters.get(name, 0)
 
     def gauge(self, name: str) -> float:
-        return self._gauges.get(name, 0.0)
+        with self._lock:
+            return self._gauges.get(name, 0.0)
 
     def tokens_per_sec(self) -> float:
         """Steady-state emission rate: tokens after the first emission
         event over the first→last emission window (excludes compile/queue
         lead-in); falls back to total/uptime for single-emission runs."""
-        tokens = self.counter("tokens_emitted_total")
-        if tokens <= 0:
-            return 0.0
-        if (self._first_emit_t is not None and self._last_emit_t is not None
-                and self._last_emit_t > self._first_emit_t
-                and tokens > self._tokens_at_first_emit):
-            return ((tokens - self._tokens_at_first_emit)
-                    / (self._last_emit_t - self._first_emit_t))
-        return tokens / max(self._clock() - self._t0, 1e-9)
+        with self._lock:
+            tokens = self.counter("tokens_emitted_total")
+            if tokens <= 0:
+                return 0.0
+            if (self._first_emit_t is not None
+                    and self._last_emit_t is not None
+                    and self._last_emit_t > self._first_emit_t
+                    and tokens > self._tokens_at_first_emit):
+                return ((tokens - self._tokens_at_first_emit)
+                        / (self._last_emit_t - self._first_emit_t))
+            return tokens / max(self._clock() - self._t0, 1e-9)
 
     def summary(self, name: str) -> Dict[str, float]:
         """Quantile summary of ONE sample series (count/sum/mean/p50/p95/
@@ -212,12 +233,14 @@ class ServingMetrics:
         return self._summary(name)
 
     def _summary(self, name: str) -> Dict[str, float]:
-        vals = sorted(self._samples.get(name, []))
-        cnt = self._sample_counts.get(name, 0)
+        with self._lock:
+            vals = sorted(self._samples.get(name, []))
+            cnt = self._sample_counts.get(name, 0)
+            total = self._sample_sums.get(name, 0.0)
         return {
             "count": cnt,
-            "sum": self._sample_sums.get(name, 0.0),
-            "mean": (self._sample_sums.get(name, 0.0) / cnt) if cnt else 0.0,
+            "sum": total,
+            "mean": (total / cnt) if cnt else 0.0,
             "p50": _percentile(vals, 0.50),
             "p95": _percentile(vals, 0.95),
             "max": vals[-1] if vals else 0.0,
@@ -230,15 +253,17 @@ class ServingMetrics:
         sample buffers (bounded by ``max_samples``) so a downstream
         ``merge`` can recompute exact percentiles across registries —
         this is what fleet workers ship over RPC."""
-        snap = {
-            "uptime_s": self._clock() - self._t0,
-            "counters": dict(self._counters),
-            "gauges": dict(self._gauges),
-            "latency": {k: self._summary(k) for k in self._samples},
-            "tokens_per_sec": self.tokens_per_sec(),
-        }
-        if include_samples:
-            snap["samples"] = {k: list(v) for k, v in self._samples.items()}
+        with self._lock:
+            snap = {
+                "uptime_s": self._clock() - self._t0,
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "latency": {k: self._summary(k) for k in self._samples},
+                "tokens_per_sec": self.tokens_per_sec(),
+            }
+            if include_samples:
+                snap["samples"] = {k: list(v)
+                                   for k, v in self._samples.items()}
         return snap
 
     # ------------------------------------------------------- fleet merging
@@ -402,23 +427,28 @@ class ServingMetrics:
     def prometheus_text(self) -> str:
         """Prometheus text exposition format (one scrape page)."""
         lines: List[str] = []
-        for name in sorted(self._counters):
-            full = _PREFIX + name
-            lines.append(f"# TYPE {full} counter")
-            lines.append(f"{full} {self._counters[name]}")
-        for name in sorted(self._gauges):
-            full = _PREFIX + name
+        with self._lock:
+            for name in sorted(self._counters):
+                full = _PREFIX + name
+                lines.append(f"# TYPE {full} counter")
+                lines.append(f"{full} {self._counters[name]}")
+            for name in sorted(self._gauges):
+                full = _PREFIX + name
+                lines.append(f"# TYPE {full} gauge")
+                lines.append(f"{full} {self._gauges[name]:.6g}")
+            full = _PREFIX + "tokens_per_sec"
             lines.append(f"# TYPE {full} gauge")
-            lines.append(f"{full} {self._gauges[name]:.6g}")
-        full = _PREFIX + "tokens_per_sec"
-        lines.append(f"# TYPE {full} gauge")
-        lines.append(f"{full} {self.tokens_per_sec():.6g}")
-        for name in sorted(self._samples):
-            full = _PREFIX + name
-            s = self._summary(name)
-            lines.append(f"# TYPE {full} summary")
-            lines.append(f'{full}{{quantile="0.5"}} {s["p50"]:.6g}')
-            lines.append(f'{full}{{quantile="0.95"}} {s["p95"]:.6g}')
-            lines.append(f"{full}_count {s['count']}")
-            lines.append(f"{full}_sum {s['sum']:.6g}")
+            lines.append(f"{full} {self.tokens_per_sec():.6g}")
+            # the sample loop stays INSIDE the lock (re-entrant through
+            # _summary): releasing between sections would let a
+            # concurrent reset() produce one scrape page mixing
+            # pre-reset counters with post-reset latency summaries
+            for name in sorted(self._samples):
+                full = _PREFIX + name
+                s = self._summary(name)
+                lines.append(f"# TYPE {full} summary")
+                lines.append(f'{full}{{quantile="0.5"}} {s["p50"]:.6g}')
+                lines.append(f'{full}{{quantile="0.95"}} {s["p95"]:.6g}')
+                lines.append(f"{full}_count {s['count']}")
+                lines.append(f"{full}_sum {s['sum']:.6g}")
         return "\n".join(lines) + "\n"

@@ -64,3 +64,28 @@ def _seed_everything():
     P.seed(2024)
     np.random.seed(2024)
     yield
+
+
+@pytest.fixture(scope="session")
+def serving_model():
+    """The canonical sub-tiny serving-test model (1 layer, 64 hidden,
+    vocab 256, seed 11), built ONCE per pytest session (ROADMAP item 6,
+    tier-1 budget).  Five serving test files used to build this exact
+    config per-module — five identical weight inits and five jax
+    dispatch warmups inside the 870 s tier-1 cliff.  Module fixtures
+    delegate here (and re-clear any leaked topology group themselves);
+    the weights are seeded at build, so sharing the instance changes no
+    reference tokens.  Treat it as READ-ONLY: a test that must mutate
+    weights (bfloat16(), load_state) builds its own copy."""
+    import paddle_tpu as P
+    from paddle_tpu.distributed.topology import set_hybrid_communicate_group
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    set_hybrid_communicate_group(None)
+    P.seed(11)
+    m = LlamaForCausalLM(LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=160,
+        num_hidden_layers=1, num_attention_heads=2,
+        max_position_embeddings=256))
+    m.eval()
+    return m

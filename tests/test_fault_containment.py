@@ -55,16 +55,13 @@ ENGINE = dict(max_batch_size=2, max_seq_len=64, block_size=8,
 
 
 @pytest.fixture(scope="module")
-def model():
+def model(serving_model):
+    # shared session-scoped sub-tiny model (tests/conftest.py, ROADMAP
+    # item 6); topology reset stays per-module for leaked fleet groups
     from paddle_tpu.distributed.topology import set_hybrid_communicate_group
-    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 
     set_hybrid_communicate_group(None)
-    P.seed(11)
-    return LlamaForCausalLM(LlamaConfig(
-        vocab_size=256, hidden_size=64, intermediate_size=160,
-        num_hidden_layers=1, num_attention_heads=2,
-        max_position_embeddings=256))
+    return serving_model
 
 
 def ref_greedy(model, prompt, n):
@@ -245,6 +242,46 @@ class TestFaultInjector:
             _active = {}
         FaultyReplica(_E(), FaultInjector({}), name="rw")
         FaultInjector({"rw.add_request": {"kind": "error"}})
+
+    def test_run_scoped_namespace_registry(self, monkeypatch):
+        """ISSUE 13 satellite: closes the r13-deferred scope hole — with
+        a run-scoped registry handle, a later injector in the same
+        process no longer validates against every name an earlier run
+        registered (the stale copy-paste "r0.step" class)."""
+        import paddle_tpu.inference.faults as faults_mod
+
+        monkeypatch.setattr(faults_mod, "REPLICA_NAMESPACES", set())
+        # run 1 registers its replica names in its own handle...
+        run1: set = set()
+        inj1 = FaultInjector({"r0.step": {"kind": "error"}},
+                             replica_namespaces=["r0", "r1", "r2"],
+                             namespace_registry=run1)
+        assert inj1.spec("r0.step").kind == "error"
+        assert run1 == {"r0", "r1", "r2"}
+        # ...without polluting the process-global default
+        assert faults_mod.REPLICA_NAMESPACES == set()
+        # run 2, same process, fresh handle: the stale copy-paste site
+        # now FAILS arm-time validation instead of silently arming
+        # against run 1's registrations (and never firing)
+        with pytest.raises(ValueError, match="unregistered namespace"):
+            FaultInjector({"r0.step": {"kind": "error"}},
+                          namespace_registry=set())
+        # the global default path is equally isolated from run 1
+        with pytest.raises(ValueError, match="unregistered namespace"):
+            FaultInjector({"r0.step": {"kind": "error"}})
+
+        # FaultyReplica inherits the injector's handle, so the
+        # wrap-first-arm-later order stays coherent run-scoped too
+        class _E:  # noqa: N801 — minimal engine stand-in
+            _active = {}
+
+        run3: set = set()
+        inj3 = FaultInjector({}, namespace_registry=run3)
+        FaultyReplica(_E(), inj3, name="rq")
+        assert "rq" in run3
+        assert "rq" not in faults_mod.REPLICA_NAMESPACES
+        FaultInjector({"rq.evict": {"kind": "drop"}},
+                      namespace_registry=run3)
 
     def test_register_failpoint_extends_registry(self):
         from paddle_tpu.inference.faults import (KNOWN_SITES,

@@ -47,16 +47,13 @@ class FakeClock:
 
 
 @pytest.fixture(scope="module")
-def model():
+def model(serving_model):
+    # shared session-scoped sub-tiny model (tests/conftest.py, ROADMAP
+    # item 6); topology reset stays per-module for leaked fleet groups
     from paddle_tpu.distributed.topology import set_hybrid_communicate_group
-    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 
     set_hybrid_communicate_group(None)
-    P.seed(11)
-    return LlamaForCausalLM(LlamaConfig(
-        vocab_size=256, hidden_size=64, intermediate_size=160,
-        num_hidden_layers=1, num_attention_heads=2,
-        max_position_embeddings=256))
+    return serving_model
 
 
 def ref_greedy(model, prompt, n):

@@ -47,21 +47,13 @@ pytestmark = pytest.mark.quick
 
 
 @pytest.fixture(scope="module")
-def model():
-    # sub-tiny single-process model, same scale as the control-plane
-    # tests: these tests build several engines, each compiling its own
-    # step programs on a 2-vCPU CI container
+def model(serving_model):
+    # shared session-scoped sub-tiny model (tests/conftest.py, ROADMAP
+    # item 6); topology reset stays per-module for leaked fleet groups
     from paddle_tpu.distributed.topology import set_hybrid_communicate_group
-    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 
     set_hybrid_communicate_group(None)
-    P.seed(11)
-    m = LlamaForCausalLM(LlamaConfig(
-        vocab_size=256, hidden_size=64, intermediate_size=160,
-        num_hidden_layers=1, num_attention_heads=2,
-        max_position_embeddings=256))
-    m.eval()
-    return m
+    return serving_model
 
 
 def make_engine(model, **kw):

@@ -28,22 +28,15 @@ pytestmark = pytest.mark.quick
 
 
 @pytest.fixture(scope="module")
-def model():
-    # single-process model regardless of any leaked fleet group (see
-    # test_serving_engine.py model fixture), and a sub-tiny config: the
-    # control-plane tests spawn MANY engine/frontend instances, each of
-    # which compiles its own step programs — 1 layer / 64 hidden keeps
-    # that affordable on the 2-vCPU CI container
+def model(serving_model):
+    # the shared session-scoped sub-tiny model (tests/conftest.py,
+    # ROADMAP item 6): one weight build for every serving test file.
+    # The topology reset stays per-module — an earlier module may have
+    # leaked a fleet group
     from paddle_tpu.distributed.topology import set_hybrid_communicate_group
 
-    from paddle_tpu.models.llama import LlamaConfig
-
     set_hybrid_communicate_group(None)
-    P.seed(11)
-    return LlamaForCausalLM(LlamaConfig(
-        vocab_size=256, hidden_size=64, intermediate_size=160,
-        num_hidden_layers=1, num_attention_heads=2,
-        max_position_embeddings=256))
+    return serving_model
 
 
 def ref_greedy(model, prompt, n):

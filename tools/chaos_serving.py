@@ -213,11 +213,17 @@ def run_chaos(seed=0, replicas=3, num_requests=18, max_request_retries=2,
     total_names = replicas + max_respawns
     # every replica name this soak may ever spawn, registered up front:
     # arm-time validation then catches a schedule/namespace typo instead
-    # of letting the run silently degrade to calm (ISSUE 12 satellite)
+    # of letting the run silently degrade to calm (ISSUE 12 satellite).
+    # The registry handle is RUN-SCOPED (ISSUE 13): a later soak in this
+    # process starts from an empty set, so this run's names cannot
+    # validate a stale copy-paste site in its schedule — FaultyReplica
+    # inherits the handle from the injector, keeping the pair coherent
+    run_namespaces: set = set()
     inj = FaultInjector(_fault_schedule(seed, total_names, poison),
                         seed=seed,
                         replica_namespaces=[f"r{i}"
-                                            for i in range(total_names)])
+                                            for i in range(total_names)],
+                        namespace_registry=run_namespaces)
     # engine pool: respawns recycle a dead replica's engine (a restarted
     # worker rebuilds the same engine; recycling skips the recompile)
     spares = []

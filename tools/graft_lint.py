@@ -1,0 +1,18 @@
+#!/usr/bin/env python
+"""Standalone driver for the graft-lint invariant-checker suite.
+
+Identical to ``python -m tools.lint`` (see tools/lint/__init__.py for
+the rule table, suppression syntax, and baseline workflow); this wrapper
+exists so the linter runs from a plain checkout without ``-m``:
+
+    python tools/graft_lint.py --json
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.lint import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

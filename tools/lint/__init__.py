@@ -1,0 +1,494 @@
+"""graft-lint: AST invariant checkers for the repo's serving contracts.
+
+Twelve rounds of serving-stack growth rest on invariants that were
+enforced only by reviewer vigilance — no host syncs inside compiled
+bodies, every request terminates typed, every armed failpoint name
+matches the registry, counters fold exactly once, shared control-plane
+state is touched under its lock, replay-relevant code never reads the
+wall clock.  The bug shapes the r9–r13 hardening rounds actually fixed
+(the ``enigne.step`` site typo, the self-reported-counter double-fold,
+unlocked spawn-path state) are exactly what a static pass catches at
+lint time instead of chaos-soak time.  This package machine-enforces
+them.
+
+Drive it as ``python -m tools.lint`` (or ``python tools/graft_lint.py``):
+
+    python -m tools.lint                  # default path set, text output
+    python -m tools.lint --json           # machine-readable findings
+    python -m tools.lint paddle_tpu/inference/fleet.py
+    python -m tools.lint --write-baseline # re-grandfather current findings
+
+Output is ``file:line rule-id message`` per finding; exit status is 0
+iff every finding is suppressed or baselined.
+
+Rules (each in its own module, self-registered via ``@register``):
+
+=====================  ===================================================
+``graph-hygiene``      host-sync / retrace hazards inside compiled bodies
+                       (``jax.jit``/``lax.scan``/``lax.cond`` bodies and
+                       the ``_build_*``/``_sample_tokens`` family)
+``typed-termination``  request-path raises must use the typed exception
+                       vocabulary; ``except Exception: pass`` swallows
+``failpoint-sites``    every armed/fired failpoint string cross-checked
+                       against ``KNOWN_SITES`` + replica-namespace rules,
+                       both directions (armed-but-unregistered AND
+                       registered-but-never-fired)
+``metrics-discipline`` ``*_total`` counters only increment, every name
+                       declared exactly once, delta-folded engine mirrors
+                       are never also inc()'d (the exactly-once contract)
+``lock-discipline``    ``# guarded-by: self._lock``-annotated attributes
+                       only touched lexically inside ``with`` that lock
+``determinism``        replay-relevant inference code may not read the
+                       wall clock or call unseeded RNG
+=====================  ===================================================
+
+Suppressing a finding inline (always give a reason after the marker):
+
+    deadline = time.monotonic() + timeout  # graft-lint: disable=determinism — boot deadline, not replay state
+
+A comment-only line suppresses the NEXT line; ``disable-file=<rule>``
+anywhere in a file suppresses the whole file for that rule.  Findings
+that predate the linter live in ``tools/lint/baseline.json`` (matched by
+(file, rule, message) with per-key counts, so they survive line drift);
+the CI gate is therefore zero NEW findings.  Refresh it after deliberate
+changes with ``--write-baseline``.
+
+Adding a rule
+-------------
+
+1. Create ``tools/lint/my_rule.py``::
+
+       from . import Finding, register
+
+       @register("my-rule")
+       def run(project):
+           out = []
+           for f in project.files:
+               for node in f.walk():   # ast nodes with .lineno
+                   ...
+                   out.append(Finding(f.relpath, node.lineno, "my-rule",
+                                      "what is wrong and what to do"))
+           return out
+
+2. Import it from ``_load_rules`` below (rules are plain modules; the
+   decorator adds them to ``RULES`` in import order).
+3. Add a fixture-driven positive/suppressed/baselined case to
+   ``tests/test_graft_lint.py`` and a row to the README table.
+
+Rules run project-wide (one call per rule, all files parsed up front)
+so cross-file checks — the failpoint registry lives in ``faults.py``,
+the fires everywhere else — are first-class, not bolted on.  Everything
+here is stdlib-only (``ast`` + ``tokenize``); the linter must stay
+importable in environments without jax.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import sys
+import tokenize
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
+
+__all__ = [
+    "Finding", "SourceFile", "Project", "register", "RULES",
+    "load_project", "run_rules", "apply_suppressions", "Baseline",
+    "DEFAULT_PATHS", "BASELINE_PATH", "repo_root", "main",
+    "dotted", "const_str",
+]
+
+
+def dotted(node) -> Optional[str]:
+    """'jax.lax.scan' for an Attribute/Name chain, else None — the
+    shared spelling every rule uses to match dotted calls."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def const_str(node) -> Optional[str]:
+    """The value of a string-literal node, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+# Default scan scope: the serving/control-plane surface whose contracts
+# the rules encode.  tools/lint itself is excluded (rule modules carry
+# site-name and counter-name string literals as *data*).
+DEFAULT_PATHS = (
+    "paddle_tpu/inference",
+    "paddle_tpu/distributed/rpc",
+    "tools",
+)
+# path-SEGMENT prefixes to skip (never substring-matched)
+EXCLUDE_PREFIXES = (("tools", "lint"),)
+
+# Markdown/doc files scanned by rules that also read docs (failpoint
+# JSON literals in operator examples).
+DOC_FILES = ("README.md",)
+
+_SUPPRESS_RE = re.compile(
+    r"graft-lint:\s*(disable|disable-file)=([A-Za-z0-9_,-]+)")
+_GUARDED_RE = re.compile(r"guarded-by:\s*(self\.[A-Za-z_][A-Za-z0-9_]*)")
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+@dataclass
+class Finding:
+    """One lint finding, pointing at a repo-relative file:line."""
+
+    file: str
+    line: int
+    rule: str
+    message: str
+
+    def key(self) -> Tuple[str, str, str]:
+        """Baseline identity: line numbers drift, (file, rule, message)
+        survives unrelated edits above the finding."""
+        return (self.file, self.rule, self.message)
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line} {self.rule} {self.message}"
+
+    def as_dict(self) -> Dict:
+        return {"file": self.file, "line": self.line, "rule": self.rule,
+                "message": self.message}
+
+
+class SourceFile:
+    """One parsed python file: AST + comments + suppression map.
+
+    Comments come from ``tokenize`` (not regex over raw lines), so a
+    ``#`` inside a string literal can never masquerade as a marker.
+    """
+
+    def __init__(self, path: str, relpath: str, text: str):
+        self.path = path
+        self.relpath = relpath
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=relpath)
+        # line -> comment text (without the leading '#')
+        self.comments: Dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = tok.string.lstrip("#").strip()
+        except tokenize.TokenError:
+            pass
+        # suppressions: line -> {rule ids}; rule ids valid for a line if
+        # the marker sits ON it, or on an immediately preceding
+        # comment-only line (stacked comment lines chain upward).
+        self._line_disable: Dict[int, Set[str]] = {}
+        self.file_disable: Set[str] = set()
+        for ln, comment in self.comments.items():
+            m = _SUPPRESS_RE.search(comment)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(2).split(",") if r.strip()}
+            if m.group(1) == "disable-file":
+                self.file_disable |= rules
+            else:
+                self._line_disable.setdefault(ln, set()).update(rules)
+
+    def _comment_only(self, line: int) -> bool:
+        if not (1 <= line <= len(self.lines)):
+            return False
+        stripped = self.lines[line - 1].strip()
+        return stripped.startswith("#")
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        if rule in self.file_disable:
+            return True
+        if rule in self._line_disable.get(line, ()):
+            return True
+        # a marker on a comment-only line applies to the next code line;
+        # walk up through a block of comment-only lines
+        ln = line - 1
+        while ln >= 1 and self._comment_only(ln):
+            if rule in self._line_disable.get(ln, ()):
+                return True
+            ln -= 1
+        return False
+
+    def guarded_by(self, line: int) -> Optional[str]:
+        """``# guarded-by: self._lock`` annotation attached to ``line``
+        (same line or immediately preceding comment-only lines)."""
+        m = _GUARDED_RE.search(self.comments.get(line, ""))
+        if m:
+            return m.group(1)
+        ln = line - 1
+        while ln >= 1 and self._comment_only(ln):
+            m = _GUARDED_RE.search(self.comments.get(ln, ""))
+            if m:
+                return m.group(1)
+            ln -= 1
+        return None
+
+    def walk(self):
+        return ast.walk(self.tree)
+
+
+@dataclass
+class Project:
+    """Everything one lint run sees: parsed sources + raw doc texts."""
+
+    root: str
+    files: List[SourceFile] = field(default_factory=list)
+    docs: Dict[str, str] = field(default_factory=dict)  # relpath -> text
+    parse_errors: List[Finding] = field(default_factory=list)
+
+    def file(self, relpath: str) -> Optional[SourceFile]:
+        for f in self.files:
+            if f.relpath == relpath:
+                return f
+        return None
+
+    def in_dir(self, prefix: str) -> List[SourceFile]:
+        prefix = prefix.rstrip("/") + "/"
+        return [f for f in self.files if f.relpath.startswith(prefix)]
+
+
+# rule-id -> run(project) -> List[Finding]
+RULES: Dict[str, Callable[[Project], List[Finding]]] = {}
+
+
+def register(rule_id: str):
+    def deco(fn):
+        fn.rule_id = rule_id
+        RULES[rule_id] = fn
+        return fn
+    return deco
+
+
+def _iter_py(root: str, paths: Iterable[str]) -> Iterable[str]:
+    for p in paths:
+        full = os.path.join(root, p)
+        if os.path.isfile(full):
+            if full.endswith(".py"):
+                yield full
+            continue
+        for dirpath, dirnames, filenames in os.walk(full):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                fp = os.path.join(dirpath, fn)
+                rel = os.path.relpath(fp, root)
+                if not fn.endswith(".py"):
+                    continue
+                segs = tuple(rel.split(os.sep))
+                if any(segs[:len(pre)] == pre for pre in EXCLUDE_PREFIXES):
+                    continue
+                yield fp
+
+
+def load_project(paths: Optional[Iterable[str]] = None,
+                 root: Optional[str] = None,
+                 docs: Iterable[str] = DOC_FILES) -> Project:
+    root = root or repo_root()
+    proj = Project(root=root)
+    seen = set()
+    for fp in _iter_py(root, paths or DEFAULT_PATHS):
+        rel = os.path.relpath(fp, root)
+        if rel in seen:
+            continue
+        seen.add(rel)
+        with open(fp, encoding="utf-8") as f:
+            text = f.read()
+        try:
+            proj.files.append(SourceFile(fp, rel, text))
+        except SyntaxError as e:
+            proj.parse_errors.append(Finding(
+                rel, e.lineno or 1, "parse-error",
+                f"file does not parse: {e.msg}"))
+    for d in docs:
+        dp = os.path.join(root, d)
+        if os.path.isfile(dp):
+            with open(dp, encoding="utf-8") as f:
+                proj.docs[d] = f.read()
+    return proj
+
+
+def _load_rules():
+    # import order = report order; each module self-registers
+    from . import graph_hygiene      # noqa: F401
+    from . import typed_termination  # noqa: F401
+    from . import failpoint_sites    # noqa: F401
+    from . import metrics_discipline  # noqa: F401
+    from . import lock_discipline    # noqa: F401
+    from . import determinism        # noqa: F401
+
+
+def run_rules(project: Project,
+              rules: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Run (a subset of) the registered rules; returns findings with
+    inline suppressions already applied, sorted by file:line."""
+    _load_rules()
+    wanted = list(rules) if rules else list(RULES)
+    unknown = [r for r in wanted if r not in RULES]
+    if unknown:
+        raise ValueError(f"unknown rule(s) {unknown}; have {sorted(RULES)}")
+    findings = list(project.parse_errors)
+    for rid in wanted:
+        findings.extend(RULES[rid](project))
+    findings = apply_suppressions(project, findings)
+    findings.sort(key=lambda f: (f.file, f.line, f.rule, f.message))
+    return findings
+
+
+def apply_suppressions(project: Project,
+                       findings: List[Finding]) -> List[Finding]:
+    by_rel = {f.relpath: f for f in project.files}
+    out = []
+    for f in findings:
+        sf = by_rel.get(f.file)
+        if sf is not None and sf.suppressed(f.line, f.rule):
+            continue
+        out.append(f)
+    return out
+
+
+BASELINE_PATH = os.path.join("tools", "lint", "baseline.json")
+
+
+class Baseline:
+    """Grandfathered findings: counts per (file, rule, message).
+
+    A finding matches the baseline while its key has remaining budget —
+    N baselined occurrences absorb the first N findings with that key
+    (line numbers deliberately excluded, so edits above a grandfathered
+    site don't resurface it).  The CI gate is zero NON-baselined
+    findings; new code therefore meets every rule from day one.
+    """
+
+    def __init__(self, entries: Optional[Dict[Tuple[str, str, str], int]] = None):
+        self.entries = dict(entries or {})
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.isfile(path):
+            return cls()
+        with open(path, encoding="utf-8") as f:
+            raw = json.load(f)
+        entries: Dict[Tuple[str, str, str], int] = {}
+        for e in raw.get("findings", []):
+            key = (e["file"], e["rule"], e["message"])
+            entries[key] = entries.get(key, 0) + int(e.get("count", 1))
+        return cls(entries)
+
+    @classmethod
+    def from_findings(cls, findings: List[Finding]) -> "Baseline":
+        entries: Dict[Tuple[str, str, str], int] = {}
+        for f in findings:
+            entries[f.key()] = entries.get(f.key(), 0) + 1
+        return cls(entries)
+
+    def save(self, path: str):
+        rows = [{"file": k[0], "rule": k[1], "message": k[2], "count": n}
+                for k, n in sorted(self.entries.items())]
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump({"comment": "grandfathered graft-lint findings; "
+                                  "refresh with python -m tools.lint "
+                                  "--write-baseline",
+                       "findings": rows}, f, indent=1)
+            f.write("\n")
+
+    def filter(self, findings: List[Finding]
+               ) -> Tuple[List[Finding], List[Finding]]:
+        """-> (new, grandfathered)."""
+        budget = dict(self.entries)
+        new, old = [], []
+        for f in findings:
+            if budget.get(f.key(), 0) > 0:
+                budget[f.key()] -= 1
+                old.append(f)
+            else:
+                new.append(f)
+        return new, old
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="graft-lint",
+        description="AST invariant checkers for the serving contracts "
+                    "(see tools/lint/__init__.py)")
+    ap.add_argument("paths", nargs="*",
+                    help=f"files/dirs to lint (default: {DEFAULT_PATHS})")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="JSON report on stdout")
+    ap.add_argument("--rules", help="comma-separated rule subset")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: {BASELINE_PATH})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report grandfathered findings too")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write current findings as the new baseline")
+    ap.add_argument("--show-baselined", action="store_true",
+                    help="also list grandfathered findings (marked)")
+    args = ap.parse_args(argv)
+
+    root = repo_root()
+    # a gate that scans nothing must fail LOUDLY, not stay green: a
+    # typo'd/renamed path would otherwise turn the CI job into a no-op
+    for p in (args.paths or DEFAULT_PATHS):
+        if not os.path.exists(os.path.join(root, p)):
+            print(f"graft-lint: path {p!r} does not exist under {root}",
+                  file=sys.stderr)
+            return 2
+    project = load_project(args.paths or None, root=root)
+    if not project.files:
+        print("graft-lint: no python files matched "
+              f"{args.paths or list(DEFAULT_PATHS)}", file=sys.stderr)
+        return 2
+    rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
+             if args.rules else None)
+    findings = run_rules(project, rules)
+
+    bl_path = os.path.join(root, args.baseline or BASELINE_PATH)
+    if args.write_baseline:
+        if args.paths:
+            # a scoped scan sees only a subset of findings; writing it
+            # wholesale would silently drop every grandfathered entry
+            # that lives in an unscanned file and break the next full
+            # CI run on unrelated debt
+            print("graft-lint: --write-baseline refreshes the WHOLE "
+                  "baseline and must run over the full default scope; "
+                  "drop the path arguments", file=sys.stderr)
+            return 2
+        Baseline.from_findings(findings).save(bl_path)
+        print(f"wrote {len(findings)} finding(s) to {bl_path}")
+        return 0
+    baseline = Baseline() if args.no_baseline else Baseline.load(bl_path)
+    new, grandfathered = baseline.filter(findings)
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.as_dict() for f in new],
+            "baselined": len(grandfathered),
+            "files_scanned": len(project.files),
+            "rules": rules or sorted(RULES),
+            "ok": not new,
+        }, indent=1))
+    else:
+        for f in new:
+            print(f.render())
+        if args.show_baselined:
+            for f in grandfathered:
+                print(f"{f.render()}  [baselined]")
+        print(f"graft-lint: {len(new)} finding(s), "
+              f"{len(grandfathered)} baselined, "
+              f"{len(project.files)} file(s) scanned")
+    return 1 if new else 0

@@ -1,0 +1,80 @@
+"""determinism: replay-relevant code never reads the wall clock or
+rolls unseeded dice.
+
+Every chaos soak, journal recovery, and failover test in this repo
+leans on one contract: rerunning the same (seed, config, schedule)
+reproduces the same tokens, the same fault history, the same terminal
+statuses.  ``paddle_tpu/inference`` therefore takes clocks as
+injectable parameters (``clock=time.monotonic`` as a DEFAULT is fine —
+the reference to the function is the injection point; *calling*
+``time.time()`` inline is not) and derives all randomness from seeded
+``random.Random(...)`` instances or ``jax.random`` keys.
+
+Flagged calls in ``paddle_tpu/inference``:
+
+* ``time.time()`` / ``time.monotonic()`` / ``time.perf_counter()`` /
+  ``datetime.now()`` etc. — inline wall-clock reads; thread the
+  injectable clock instead.  (``time.sleep`` is allowed: it delays,
+  it does not steer control flow with a nondeterministic value.)
+* module-level ``random.*`` calls (``random.random()``,
+  ``random.randrange()``, ...) — process-global unseeded stream;
+  construct a seeded ``random.Random(seed_material)`` (allowed).
+* ``np.random.*`` — same, numpy's global stream.
+
+Genuinely wall-clock-bound paths (subprocess boot deadlines, real-time
+standby polls — things that are NOT replayed) carry inline
+suppressions stating exactly that, so every exemption is visible at
+the call site.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from . import Finding, Project, dotted as _dotted, register
+
+RULE = "determinism"
+SCOPE = "paddle_tpu/inference"
+
+_CLOCK_READS = {"time", "monotonic", "perf_counter", "time_ns",
+                "monotonic_ns", "perf_counter_ns"}
+_DATETIME_READS = {"now", "utcnow", "today"}
+_SEEDED_CTORS = {"Random", "default_rng", "SeedSequence", "PRNGKey",
+                 "seed", "fold_in", "shuffle_seeded"}
+
+
+@register(RULE)
+def run(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for sf in project.in_dir(SCOPE):
+        for node in sf.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            if not d:
+                continue
+            head, _, tail = d.rpartition(".")
+            if head == "time" and tail in _CLOCK_READS:
+                out.append(Finding(
+                    sf.relpath, node.lineno, RULE,
+                    f"inline {d}() read in replay-relevant code: thread "
+                    "the injectable clock (clock=time.monotonic default "
+                    "parameter) so tests and replays can drive it"))
+            elif head.endswith("datetime") and tail in _DATETIME_READS:
+                out.append(Finding(
+                    sf.relpath, node.lineno, RULE,
+                    f"inline {d}() wall-clock read in replay-relevant "
+                    "code: inject the clock"))
+            elif head == "random" and tail not in _SEEDED_CTORS:
+                out.append(Finding(
+                    sf.relpath, node.lineno, RULE,
+                    f"{d}() draws from the process-global unseeded "
+                    "stream: construct random.Random(seed_material) "
+                    "and draw from that"))
+            elif head in ("np.random", "numpy.random") \
+                    and tail not in _SEEDED_CTORS:
+                out.append(Finding(
+                    sf.relpath, node.lineno, RULE,
+                    f"{d}() draws from numpy's global stream: use a "
+                    "seeded Generator (np.random.default_rng(seed))"))
+    return out

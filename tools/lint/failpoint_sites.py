@@ -1,0 +1,272 @@
+"""failpoint-sites: every failpoint string cross-checked, both ways.
+
+The runtime half of this contract landed in r12/r13: ``FaultInjector``
+validates armed site names against ``KNOWN_SITES`` at arm time, because
+a typo'd site ("enigne.step") used to arm fine and never fire — a chaos
+run silently degrading to calm.  This rule is the static half, catching
+the same class at lint time and covering what arm-time validation
+cannot see:
+
+* **armed-but-unregistered** — a site name in any statically-visible
+  arming position (``FaultInjector({...})`` dicts, ``"sites": {...}``
+  spec-JSON dict literals, ``sites[...] = ...`` schedule builders,
+  ``PADDLE_TPU_FAULTS='{...}'`` JSON literals in tools/ and docs) that
+  neither appears in ``KNOWN_SITES``/``register_failpoint`` nor parses
+  as ``<namespace>.<op>`` with a replica op and a statically-registered
+  namespace (literal or f-string prefix from
+  ``register_replica_namespace`` / ``replica_namespaces=`` /
+  ``FaultyReplica(name=...)``).
+* **fired-but-unregistered** — a ``.fire("name")`` whose literal is not
+  in the registry: production code grew a site without registering it,
+  so no chaos schedule can ever arm it.
+* **registered-but-never-fired** — a ``KNOWN_SITES`` entry (or
+  ``register_failpoint`` call) that no ``.fire`` reaches, literally or
+  via an f-string with a matching constant prefix (``f"engine.{op}"``
+  covers ``engine.*``): dead registry weight that would let a schedule
+  arm a site nothing traverses — exactly the silent-calm failure the
+  registry exists to prevent.
+
+Dynamic fires with no constant prefix (``f"{self.name}.{op}"``) are
+replica-scoped by construction and skipped.  The drift test in
+``tests/test_graft_lint.py`` pins this rule's extraction against the
+LIVE registries: the static validator and ``FaultInjector``'s arm-time
+validator must agree on every site either can see.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import Finding, Project, SourceFile, const_str as _const_str, register
+
+RULE = "failpoint-sites"
+
+_ENV_JSON_RE = re.compile(r"PADDLE_TPU_FAULTS='(\{.*?\})'", re.S)
+
+
+@dataclass
+class Sites:
+    """Everything the static pass extracted, for checks and tests."""
+
+    known: Dict[str, Tuple[str, int]] = field(default_factory=dict)
+    replica_ops: Set[str] = field(default_factory=set)
+    ns_literals: Set[str] = field(default_factory=set)
+    ns_prefixes: Set[str] = field(default_factory=set)
+    constants: Dict[str, str] = field(default_factory=dict)  # NAME -> site
+    armed: List[Tuple[str, str, int]] = field(default_factory=list)
+    fired: List[Tuple[str, str, int]] = field(default_factory=list)
+    fired_prefixes: Set[str] = field(default_factory=set)
+
+    def valid(self, site: str) -> bool:
+        """Static analog of FaultInjector._validate_site: known, or a
+        replica-shaped ``<registered ns>.<op>``."""
+        if site in self.known:
+            return True
+        if "." in site:
+            ns, op = site.rsplit(".", 1)
+            if op in self.replica_ops:
+                if ns in self.ns_literals:
+                    return True
+                if any(ns.startswith(p) for p in self.ns_prefixes):
+                    return True
+        return False
+
+    def fired_covers(self, site: str) -> bool:
+        if any(s == site for s, _, _ in self.fired):
+            return True
+        return any(site.startswith(p) for p in self.fired_prefixes)
+
+
+def _fstring_prefix(node: ast.JoinedStr) -> str:
+    """Leading constant text of an f-string ('' if it opens dynamic)."""
+    if node.values and isinstance(node.values[0], ast.Constant):
+        return str(node.values[0].value)
+    return ""
+
+
+def _collect_ns_strings(node, sites: Sites):
+    """Namespace names from an expression: string literals and f-string
+    prefixes, looking through list/set/tuple literals and comprehensions
+    (``[f"r{i}" for i in ...]``) — but NOT into calls or other dynamic
+    expressions, whose inner strings are not namespace names."""
+    s = _const_str(node)
+    if s is not None:
+        sites.ns_literals.add(s)
+    elif isinstance(node, ast.JoinedStr):
+        p = _fstring_prefix(node)
+        if p:
+            sites.ns_prefixes.add(p)
+    elif isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+        for el in node.elts:
+            _collect_ns_strings(el, sites)
+    elif isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+        _collect_ns_strings(node.elt, sites)
+
+
+def _callee(node: ast.Call) -> Optional[str]:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _arm_dict(sf: SourceFile, d: ast.Dict, sites: Sites):
+    for k in d.keys:
+        s = _const_str(k)
+        if s is not None:
+            sites.armed.append((s, sf.relpath, k.lineno))
+
+
+def collect(project: Project) -> Sites:
+    sites = Sites()
+    for sf in project.files:
+        for node in sf.walk():
+            # KNOWN_SITES / _REPLICA_OPS literal registries
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                tname = t.id if isinstance(t, ast.Name) else None
+                if tname == "KNOWN_SITES" and isinstance(node.value, ast.Set):
+                    for el in node.value.elts:
+                        s = _const_str(el)
+                        if s is not None:
+                            sites.known[s] = (sf.relpath, el.lineno)
+                elif tname == "_REPLICA_OPS" \
+                        and isinstance(node.value, ast.Set):
+                    for el in node.value.elts:
+                        s = _const_str(el)
+                        if s is not None:
+                            sites.replica_ops.add(s)
+                # sites["engine.step"] = {...} schedule builders
+                elif isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "sites":
+                    s = _const_str(t.slice)
+                    if s is not None:
+                        sites.armed.append((s, sf.relpath, t.lineno))
+            if not isinstance(node, ast.Call):
+                continue
+            name = _callee(node)
+            if name == "register_failpoint" and node.args:
+                s = _const_str(node.args[0])
+                if s is not None:
+                    sites.known.setdefault(s, (sf.relpath,
+                                               node.args[0].lineno))
+            elif name == "register_replica_namespace" and node.args:
+                _collect_ns_strings(node.args[0], sites)
+            elif name == "FaultyReplica":
+                for kw in node.keywords:
+                    if kw.arg == "name":
+                        _collect_ns_strings(kw.value, sites)
+                if len(node.args) >= 3:
+                    _collect_ns_strings(node.args[2], sites)
+            elif name == "fire" and isinstance(node.func, ast.Attribute) \
+                    and node.args:
+                a = node.args[0]
+                s = _const_str(a)
+                if s is not None:
+                    sites.fired.append((s, sf.relpath, a.lineno))
+                elif isinstance(a, ast.Name):
+                    # resolved below once constants are all known
+                    sites.fired.append((f"${a.id}", sf.relpath, a.lineno))
+                elif isinstance(a, ast.JoinedStr):
+                    p = _fstring_prefix(a)
+                    if p:
+                        sites.fired_prefixes.add(p)
+            if name == "FaultInjector" or name == "from_env":
+                arg = None
+                if node.args:
+                    arg = node.args[0]
+                for kw in node.keywords:
+                    if kw.arg == "sites":
+                        arg = kw.value
+                    elif kw.arg == "replica_namespaces":
+                        _collect_ns_strings(kw.value, sites)
+                if isinstance(arg, ast.Dict):
+                    _arm_dict(sf, arg, sites)
+        # second pass: NAME = register_failpoint("x") constants, and
+        # spec-JSON-style {"sites": {...}, "replica_namespaces": [...]}
+        # dict literals anywhere (fleet spec recipes)
+        for node in sf.walk():
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call) \
+                    and _callee(node.value) == "register_failpoint" \
+                    and node.value.args:
+                s = _const_str(node.value.args[0])
+                if s is not None:
+                    sites.constants[node.targets[0].id] = s
+            elif isinstance(node, ast.Dict):
+                for k, v in zip(node.keys, node.values):
+                    ks = _const_str(k)
+                    if ks == "sites" and isinstance(v, ast.Dict):
+                        _arm_dict(sf, v, sites)
+                    elif ks == "replica_namespaces":
+                        _collect_ns_strings(v, sites)
+
+    # resolve $NAME fires through the register_failpoint constant map
+    resolved = []
+    for s, f, ln in sites.fired:
+        if s.startswith("$"):
+            target = sites.constants.get(s[1:])
+            if target is not None:
+                resolved.append((target, f, ln))
+            # unresolvable names are skipped (not flagged: a variable
+            # site is usually a passed-through parameter, e.g. the
+            # FaultInjector.fire definition itself)
+        else:
+            resolved.append((s, f, ln))
+    sites.fired = resolved
+
+    # PADDLE_TPU_FAULTS='{...}' JSON literals in docs and raw source
+    texts = dict(project.docs)
+    for sf in project.files:
+        texts[sf.relpath] = sf.text
+    for rel, text in texts.items():
+        for m in _ENV_JSON_RE.finditer(text):
+            line = text.count("\n", 0, m.start()) + 1
+            try:
+                cfg = json.loads(m.group(1))
+            except (ValueError, TypeError):
+                continue
+            for s in (cfg.get("sites") or {}):
+                sites.armed.append((s, rel, line))
+            for ns in (cfg.get("replica_namespaces") or ()):
+                if isinstance(ns, str):
+                    sites.ns_literals.add(ns)
+    return sites
+
+
+@register(RULE)
+def run(project: Project) -> List[Finding]:
+    sites = collect(project)
+    out: List[Finding] = []
+    if not sites.known:
+        return out  # no registry in scope: nothing to check against
+    for s, f, ln in sites.armed:
+        if not sites.valid(s):
+            out.append(Finding(f, ln, RULE,
+                               f"armed failpoint site '{s}' is not in "
+                               "KNOWN_SITES and is not a registered "
+                               "replica-scoped '<ns>.<op>': this spec "
+                               "would fail arm-time validation (or worse"
+                               ", silently never fire)"))
+    for s, f, ln in sites.fired:
+        if not sites.valid(s):
+            out.append(Finding(f, ln, RULE,
+                               f"fired failpoint site '{s}' is not "
+                               "registered: no chaos schedule can arm "
+                               "it; add register_failpoint next to this "
+                               "fire"))
+    for s, (f, ln) in sorted(sites.known.items()):
+        if not sites.fired_covers(s):
+            out.append(Finding(f, ln, RULE,
+                               f"registered failpoint site '{s}' is "
+                               "never fired by any code in scope: a "
+                               "schedule arming it degrades to calm; "
+                               "fire it or drop the registration"))
+    return out

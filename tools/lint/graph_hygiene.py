@@ -1,0 +1,269 @@
+"""graph-hygiene: host-sync and retrace hazards inside compiled bodies.
+
+A "compiled body" is any function that XLA traces: decorated with
+``jax.jit``/``jit``, wrapped in a ``jax.jit(fn, ...)`` call, passed as a
+branch/body to ``lax.scan``/``lax.cond``/``lax.while_loop``/
+``lax.fori_lop``-family combinators, or a member of the serving engine's
+compiled-builder family (functions defined inside ``_build_*`` methods,
+plus ``_sample_tokens`` — traced by every sampler call site).  Nested
+functions and lambdas inside a compiled body are compiled too (closures
+inline at trace time).
+
+Inside one, each of these either host-syncs a traced value (a silent
+device round trip per call), poisons determinism, or forces a retrace
+per distinct value:
+
+* ``.item()`` / ``float(x)`` / ``int(x)`` / ``bool(x)`` on a non-constant
+* ``np.*`` / ``numpy.*`` calls (numpy eagerly materializes tracer args)
+* ``print(...)`` (traces once, then silently never prints again — or
+  syncs under ``jax.debug`` misuse)
+* wall-clock reads (``time.time``/``monotonic``/``perf_counter``)
+* unseeded host RNG (``random.*``, ``np.random.*``; ``jax.random`` with
+  explicit keys is the sanctioned path)
+* a Python ``if`` on a traced parameter (concretization error at trace
+  time, or a retrace per value if the arg is weak-typed) — ``is None``/
+  ``is not None`` checks are exempt (argument-structure dispatch, static
+  under jit), as are parameters named in the wrapping ``jit``'s
+  ``static_argnames``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from . import Finding, Project, SourceFile, dotted as _dotted, register
+
+RULE = "graph-hygiene"
+
+# functions whose *inner* defs are compiled even when the jit wrap is
+# not visible in the same module (the serving engine's builder family)
+BUILDER_PREFIXES = ("_build_",)
+COMPILED_NAMES = {"_sample_tokens"}
+
+_LAX_BODY_FNS = {"scan", "cond", "while_loop", "fori_loop", "switch",
+                 "associative_scan", "map"}
+_WALLCLOCK = {"time", "monotonic", "perf_counter", "time_ns",
+              "monotonic_ns", "perf_counter_ns"}
+
+
+def _is_jit(expr: ast.AST) -> bool:
+    d = _dotted(expr)
+    return d in ("jax.jit", "jit") if d else False
+
+
+class _ParentMap(ast.NodeVisitor):
+    def __init__(self, tree):
+        self.parent: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parent[child] = node
+
+
+def _collect_compiled(sf: SourceFile):
+    """-> list of (FunctionDef/Lambda, static_argnames) to scan."""
+    tree = sf.tree
+    parents = _ParentMap(tree).parent
+    # name -> FunctionDef/Lambda for resolution of jit(fn)/scan(fn)
+    # references; `body = lambda c, x: ...` counts — a scan body written
+    # as a lambda must not dodge the rule
+    defs: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+        elif isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Lambda):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    defs.setdefault(t.id, []).append(node.value)
+
+    def _enclosing_funcs(node):
+        chain = []
+        cur = parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                chain.append(cur)
+            cur = parents.get(cur)
+        return chain
+
+    def resolve(call: ast.Call, name: str) -> List[ast.AST]:
+        """Defs ``name`` can refer to AT the call site, lexically: a def
+        local to an enclosing function wins (shadowing); otherwise only
+        module-level defs — never some same-named method elsewhere."""
+        cands = defs.get(name, ())
+        chain = _enclosing_funcs(call)
+        # local test: fn's parent chain passes through an enclosing
+        # function of the call
+        local = []
+        for fn in cands:
+            cur = parents.get(fn)
+            while cur is not None:
+                if cur in chain:
+                    local.append(fn)
+                    break
+                cur = parents.get(cur)
+        if local:
+            return local
+        return [fn for fn in cands
+                if isinstance(parents.get(fn), ast.Module)]
+
+    compiled: Dict[ast.AST, Set[str]] = {}  # fn node -> static argnames
+
+    def add(fn_node, static: Set[str]):
+        if fn_node is not None and fn_node not in compiled:
+            compiled[fn_node] = static
+
+    def static_argnames(call: ast.Call) -> Set[str]:
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                if isinstance(kw.value, (ast.Tuple, ast.List)):
+                    return {e.value for e in kw.value.elts
+                            if isinstance(e, ast.Constant)}
+                if isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, str):
+                    return {kw.value.value}
+        return set()
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # decorated with jit
+            for dec in node.decorator_list:
+                if _is_jit(dec) or (isinstance(dec, ast.Call)
+                                    and _is_jit(dec.func)):
+                    add(node, static_argnames(dec)
+                        if isinstance(dec, ast.Call) else set())
+            # builder family: inner defs of _build_* are the traced bodies
+            name = node.name
+            if name in COMPILED_NAMES:
+                add(node, set())
+            if any(name.startswith(p) for p in BUILDER_PREFIXES):
+                # every function or lambda defined inside a _build_* body
+                # is (part of) the traced program it returns
+                for inner in node.body:
+                    for sub in ast.walk(inner):
+                        if isinstance(sub, (ast.FunctionDef, ast.Lambda)):
+                            add(sub, set())
+        elif isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            if d in ("jax.jit", "jit") and node.args:
+                target = node.args[0]
+                static = static_argnames(node)
+                if isinstance(target, ast.Lambda):
+                    add(target, static)
+                elif isinstance(target, ast.Name):
+                    for fn in resolve(node, target.id):
+                        add(fn, static)
+            elif d and (d.startswith("lax.") or d.startswith("jax.lax.")):
+                tail = d.rsplit(".", 1)[1]
+                if tail in _LAX_BODY_FNS:
+                    for arg in node.args:
+                        if isinstance(arg, ast.Lambda):
+                            add(arg, set())
+                        elif isinstance(arg, ast.Name):
+                            for fn in resolve(node, arg.id):
+                                add(fn, set())
+    return compiled, parents
+
+
+def _check_body(sf: SourceFile, fn, static: Set[str],
+                out: List[Finding]):
+    """Flag hazards inside one compiled function's body."""
+    if isinstance(fn, ast.Lambda):
+        params = {a.arg for a in fn.args.args}
+        body_nodes = [fn.body]
+    else:
+        params = {a.arg for a in (fn.args.args + fn.args.kwonlyargs
+                                  + fn.args.posonlyargs)}
+        body_nodes = fn.body
+    params -= static
+    params.discard("self")
+    # parameters with literal defaults (return_probs=False, K=8) are
+    # host-side config switches by convention, static at trace time
+    pos = fn.args.posonlyargs + fn.args.args
+    for a, dflt in zip(pos[len(pos) - len(fn.args.defaults):],
+                       fn.args.defaults):
+        if isinstance(dflt, ast.Constant):
+            params.discard(a.arg)
+    for a, dflt in zip(fn.args.kwonlyargs, fn.args.kw_defaults):
+        if isinstance(dflt, ast.Constant):
+            params.discard(a.arg)
+
+    def flag(node, msg):
+        out.append(Finding(sf.relpath, node.lineno, RULE, msg))
+
+    for top in body_nodes:
+        for node in ast.walk(top):
+            if isinstance(node, ast.Call):
+                d = _dotted(node.func)
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "item" and not node.args:
+                    flag(node, ".item() host-syncs a traced value inside "
+                               "a compiled body")
+                elif isinstance(node.func, ast.Name) \
+                        and node.func.id in ("float", "int", "bool") \
+                        and node.args \
+                        and not isinstance(node.args[0], ast.Constant):
+                    flag(node, f"{node.func.id}() on a traced value "
+                               "host-syncs inside a compiled body")
+                elif isinstance(node.func, ast.Name) \
+                        and node.func.id == "print":
+                    flag(node, "print() inside a compiled body traces "
+                               "once and never runs again; use "
+                               "jax.debug.print")
+                elif d:
+                    head, _, tail = d.rpartition(".")
+                    if head in ("np", "numpy") and tail != "ndarray":
+                        flag(node, f"{d}() inside a compiled body eagerly "
+                                   "materializes tracers; use jnp")
+                    elif head == "time" and tail in _WALLCLOCK:
+                        flag(node, f"{d}() inside a compiled body bakes "
+                                   "trace-time wall clock into the graph")
+                    elif head == "random" or head.startswith("np.random") \
+                            or head.startswith("numpy.random") \
+                            or (head == "" and d == "random"):
+                        flag(node, f"{d}() inside a compiled body is "
+                                   "unseeded host RNG baked in at trace "
+                                   "time; use jax.random with a key")
+            elif isinstance(node, ast.If):
+                names = {n.id for n in ast.walk(node.test)
+                         if isinstance(n, ast.Name)}
+                hit = names & params
+                if not hit:
+                    continue
+                # `x is None` / `x is not None` dispatch on argument
+                # STRUCTURE (static under jit) — exempt
+                t = node.test
+                if isinstance(t, ast.Compare) \
+                        and all(isinstance(op, (ast.Is, ast.IsNot))
+                                for op in t.ops):
+                    continue
+                flag(node, "Python `if` on traced parameter(s) "
+                           f"{sorted(hit)} inside a compiled body: "
+                           "concretization error or per-value retrace; "
+                           "use lax.cond/jnp.where or mark static")
+
+
+@register(RULE)
+def run(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for sf in project.files:
+        compiled, _parents = _collect_compiled(sf)
+        # de-duplicate nesting: a compiled fn inside another compiled fn
+        # would double-report; keep outermost only
+        nodes = set(compiled)
+        keep = []
+        for fn in compiled:
+            inner = False
+            for other in nodes:
+                if other is fn:
+                    continue
+                for sub in ast.walk(other):
+                    if sub is fn:
+                        inner = True
+                        break
+                if inner:
+                    break
+            if not inner:
+                keep.append(fn)
+        for fn in keep:
+            _check_body(sf, fn, compiled[fn], out)
+    return out

@@ -1,0 +1,138 @@
+"""lock-discipline: annotated shared state only touched under its lock.
+
+The control plane's genuinely multi-threaded state — fleet async-spawn
+bookkeeping raced by boot threads, the ``EpochFence`` raced by RPC
+server threads (``distributed/rpc`` serves from a ThreadingHTTPServer),
+the worker-side ``ServingMetrics`` registry written by concurrent
+handlers — is declared at its birth site:
+
+    self._pending_spawns = {}   # guarded-by: self._spawn_lock
+
+From then on, every OTHER lexical access to that attribute inside the
+class (read, write, method call on it, ``del``) must sit inside a
+``with self._spawn_lock:`` block.  The statement that carries (or
+immediately follows) the annotation is the declaration and is exempt,
+as is the rest of the declaring function (constructors build state
+before the object escapes to other threads).
+
+The check is lexical, not interprocedural: a helper that is only ever
+called with the lock held still needs its own ``with`` (re-entrant
+locks make that cheap) or an inline suppression naming the invariant —
+both make the locking protocol visible at the access site, which is the
+point.  Attributes without an annotation are not checked; annotate
+state when (and only when) a second thread can genuinely reach it.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from . import Finding, Project, SourceFile, register
+
+RULE = "lock-discipline"
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'x' for ``self.x`` Attribute nodes."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _with_locks(node: ast.With) -> List[str]:
+    out = []
+    for item in node.items:
+        a = _self_attr(item.context_expr)
+        if a is not None:
+            out.append("self." + a)
+        elif isinstance(item.context_expr, ast.Call):
+            a = _self_attr(item.context_expr.func)
+            if a is not None:
+                out.append("self." + a)
+    return out
+
+
+def _check_class(sf: SourceFile, cls: ast.ClassDef, out: List[Finding]):
+    # 1) find annotated attributes: self.X assignment whose line carries
+    #    a guarded-by comment
+    guarded: Dict[str, Tuple[str, ast.AST]] = {}  # attr -> (lock, declfn)
+    funcs = [n for n in ast.walk(cls)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    for fn in funcs:
+        for node in ast.walk(fn):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                targets = [node.target]
+            for t in targets:
+                attr = _self_attr(t)
+                if attr is None:
+                    continue
+                lock = sf.guarded_by(t.lineno)
+                if lock is not None:
+                    guarded.setdefault(attr, (lock, fn))
+    if not guarded:
+        return
+
+    # 2) every access to a guarded attr (outside its declaring function)
+    #    must be lexically under `with <lock>`.  Each function — and
+    #    each CLOSURE (nested def or lambda, which runs later, on
+    #    whatever thread calls it, when the outer `with` is long
+    #    released) — is its own scan unit: the shallow walk stops at
+    #    nested units, so one access reports once and an outer lock
+    #    never wrongly satisfies a deferred body.
+    units: List[ast.AST] = list(funcs)
+    units.extend(n for n in ast.walk(cls) if isinstance(n, ast.Lambda))
+
+    def shallow(unit):
+        body = [unit.body] if isinstance(unit, ast.Lambda) else unit.body
+        stack = list(body)
+        while stack:
+            node = stack.pop()
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if not isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef,
+                                          ast.Lambda)):
+                    stack.append(child)
+
+    for fn in units:
+        # parent chain within this unit, for lexical with-nesting
+        parent: Dict[ast.AST, ast.AST] = {}
+        for node in shallow(fn):
+            for child in ast.iter_child_nodes(node):
+                parent[child] = node
+        fname = getattr(fn, "name", "<lambda>")
+        for node in shallow(fn):
+            attr = _self_attr(node)
+            if attr is None or attr not in guarded:
+                continue
+            lock, declfn = guarded[attr]
+            if fn is declfn:
+                continue
+            held = False
+            cur = node
+            while cur is not None and not held:
+                if isinstance(cur, ast.With) and lock in _with_locks(cur):
+                    held = True
+                cur = parent.get(cur)
+            if not held:
+                out.append(Finding(
+                    sf.relpath, node.lineno, RULE,
+                    f"self.{attr} is guarded-by {lock} but accessed "
+                    f"outside `with {lock}` in {cls.name}.{fname}(); "
+                    "take the lock (it is re-entrant or uncontended on "
+                    "this path) or suppress with the invariant that "
+                    "makes this safe"))
+
+
+@register(RULE)
+def run(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for sf in project.files:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                _check_class(sf, node, out)
+    return out

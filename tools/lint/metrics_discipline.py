@@ -1,0 +1,173 @@
+"""metrics-discipline: the exactly-once counter contract, as a rule.
+
+The r9/r12/r13 rounds each fixed a variant of the same bug: a counter
+folded twice (an engine-level monotone counter delta-folded by the
+gauge sampler AND inc()'d directly), or a name typo'd at an inc() site
+so the series silently never moved.  ``ServingMetrics`` declares the
+full vocabulary (``COUNTERS``/``GAUGES``/``SAMPLES``) and the fold
+tuples (``PREFIX_COUNTERS``/``MEGASTEP_COUNTERS``); this rule pins the
+discipline statically over ``paddle_tpu/inference``:
+
+* ``COUNTERS``/``GAUGES``/``SAMPLES`` declare each name exactly once,
+  counters end in ``_total``, gauges do not (Prometheus type hygiene —
+  ``merge()`` and both exporters key their fold/render path on which
+  tuple a name sits in, so a name in the wrong tuple gets the wrong
+  fold).
+* every literal name at an ``inc(``/``set_gauge(``/``set_gauge_peak(``/
+  ``observe(`` call site exists in the matching declaration tuple (the
+  typo class: an undeclared counter inc()s fine into the defaultdict-ish
+  registry and then never exports).
+* ``*_total`` names never appear at ``set_gauge`` sites and ``inc`` is
+  never called with a negative literal: counters only go up.
+* **fold-exactly-once**: names in the delta-fold tuples are engine-level
+  monotone counters mirrored into registries by ``fold_counter_deltas``
+  — a direct ``inc()`` of one of them anywhere else double-counts every
+  merge window (the r12 self-reported-counter bug shape).
+* the ordinal-gauge list inside ``merge()`` (``_maxed``) only names
+  declared gauges, so a renamed gauge cannot silently fall back to
+  additive folding.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import Finding, Project, SourceFile, const_str as _const_str, register
+
+RULE = "metrics-discipline"
+SCOPE = "paddle_tpu/inference"
+DECLS = ("COUNTERS", "GAUGES", "SAMPLES", "PREFIX_COUNTERS",
+         "MEGASTEP_COUNTERS")
+_RECORDERS = {"inc": "COUNTERS", "set_gauge": "GAUGES",
+              "set_gauge_peak": "GAUGES", "observe": "SAMPLES"}
+
+
+def _collect_decls(files) -> Tuple[Dict[str, List[Tuple[str, str, int]]],
+                                   Optional[SourceFile]]:
+    """name-tuple declarations -> [(value, file, line)]; also returns the
+    file that declared COUNTERS (the registry module)."""
+    decls: Dict[str, List[Tuple[str, str, int]]] = {k: [] for k in DECLS}
+    registry_file = None
+    for sf in files:
+        for node in sf.tree.body:  # module level only
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            name = node.targets[0].id
+            if name not in DECLS:
+                continue
+            if name == "COUNTERS":
+                registry_file = sf
+            if isinstance(node.value, (ast.Tuple, ast.List, ast.Set)):
+                for el in node.value.elts:
+                    s = _const_str(el)
+                    if s is not None:
+                        decls[name].append((s, sf.relpath, el.lineno))
+    return decls, registry_file
+
+
+@register(RULE)
+def run(project: Project) -> List[Finding]:
+    files = project.in_dir(SCOPE)
+    decls, registry_file = _collect_decls(files)
+    if registry_file is None:
+        return []
+    out: List[Finding] = []
+
+    declared: Dict[str, Set[str]] = {}
+    for tup in ("COUNTERS", "GAUGES", "SAMPLES"):
+        seen: Dict[str, int] = {}
+        for val, f, ln in decls[tup]:
+            if val in seen:
+                out.append(Finding(f, ln, RULE,
+                                   f"'{val}' declared twice in {tup}: "
+                                   "every name must have exactly one "
+                                   "fold path"))
+            seen[val] = ln
+        declared[tup] = set(seen)
+
+    for val, f, ln in decls["COUNTERS"]:
+        if not val.endswith("_total"):
+            out.append(Finding(f, ln, RULE,
+                               f"counter '{val}' must end in _total "
+                               "(Prometheus counter naming; merge() and "
+                               "the exporters assume it)"))
+    for val, f, ln in decls["GAUGES"]:
+        if val.endswith("_total"):
+            out.append(Finding(f, ln, RULE,
+                               f"gauge '{val}' ends in _total: counters "
+                               "only increment — declare it in COUNTERS "
+                               "or rename"))
+
+    fold_names = {v for v, _, _ in decls["PREFIX_COUNTERS"]} \
+        | {v for v, _, _ in decls["MEGASTEP_COUNTERS"]}
+    for val in sorted(fold_names):
+        if val not in declared["COUNTERS"]:
+            src = decls["PREFIX_COUNTERS"] + decls["MEGASTEP_COUNTERS"]
+            f, ln = next((f, ln) for v, f, ln in src if v == val)
+            out.append(Finding(f, ln, RULE,
+                               f"delta-fold tuple names '{val}' which is "
+                               "not a declared counter"))
+
+    for sf in files:
+        in_registry = sf is registry_file
+        for node in sf.walk():
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            meth = node.func.attr
+            if meth not in _RECORDERS or not node.args:
+                continue
+            name = _const_str(node.args[0])
+            if name is None:
+                continue
+            tup = _RECORDERS[meth]
+            ok = declared[tup]
+            if meth == "set_gauge_peak":
+                if name not in ok or (name + "_peak") not in ok:
+                    out.append(Finding(sf.relpath, node.lineno, RULE,
+                                       f"set_gauge_peak('{name}') needs "
+                                       f"both '{name}' and '{name}_peak' "
+                                       "declared in GAUGES"))
+                continue
+            if name not in ok:
+                out.append(Finding(sf.relpath, node.lineno, RULE,
+                                   f"{meth}('{name}') uses a name not "
+                                   f"declared in {tup}: a typo here "
+                                   "records into a series that never "
+                                   "exports"))
+            if meth == "set_gauge" and name.endswith("_total"):
+                out.append(Finding(sf.relpath, node.lineno, RULE,
+                                   f"set_gauge('{name}'): *_total is a "
+                                   "counter; counters only increment"))
+            if meth == "inc":
+                if len(node.args) > 1 \
+                        and isinstance(node.args[1], ast.UnaryOp) \
+                        and isinstance(node.args[1].op, ast.USub):
+                    out.append(Finding(sf.relpath, node.lineno, RULE,
+                                       f"inc('{name}', negative): "
+                                       "counters only increment"))
+                if name in fold_names and not in_registry:
+                    out.append(Finding(sf.relpath, node.lineno, RULE,
+                                       f"inc('{name}') double-folds an "
+                                       "engine-mirrored counter: this "
+                                       "name is delta-folded by "
+                                       "fold_counter_deltas; one fold "
+                                       "path only"))
+
+    # merge()'s ordinal (_maxed) gauge list must name declared gauges
+    for node in registry_file.walk():
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "_maxed" \
+                and isinstance(node.value, (ast.Tuple, ast.List, ast.Set)):
+            for el in node.value.elts:
+                s = _const_str(el)
+                if s is not None and s not in declared["GAUGES"]:
+                    out.append(Finding(registry_file.relpath, el.lineno,
+                                       RULE,
+                                       f"merge() ordinal gauge '{s}' is "
+                                       "not declared in GAUGES: it would "
+                                       "silently fold additively after a "
+                                       "rename"))
+    return out

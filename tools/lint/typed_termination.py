@@ -1,0 +1,88 @@
+"""typed-termination: request paths terminate typed, never swallowed.
+
+Scope: ``paddle_tpu/inference/`` (the request lifecycle).  Two checks:
+
+* **untyped raise** — ``raise RuntimeError(...)`` / ``raise
+  Exception(...)`` / ``raise BaseException(...)`` on a request path is
+  invisible to the containment machinery: the frontend's failover /
+  retry-budget / typed-terminal logic keys on the exception TYPE
+  (``StaleEpoch`` deposes, ``RpcTimeout`` fails over, ``JournalSuperseded``
+  stops journaling, ``InjectedFault`` counts as a replica death).  A
+  generic raise reaches the chaos soak as an unexplained crash instead
+  of a typed terminal.  Validation raises (``ValueError``/``TypeError``/
+  ``KeyError``/``NotImplementedError``/``TimeoutError``) are exempt:
+  they reject bad *inputs* before a request exists.  Custom exception
+  classes (anything not in the generic set) are presumed typed.
+
+* **exception swallow** — ``except Exception: pass`` (or bare
+  ``except:``, or a handler whose whole body is ``pass``/``...``/
+  ``continue``) silently converts a fault into a hang or a wrong
+  answer; the r10 containment contract is every fault either handled
+  meaningfully or re-raised typed.  Handlers that do real work (log,
+  degrade, count, re-raise) are fine — only no-op bodies are flagged.
+  Intentional best-effort swallows (shutdown paths probing possibly-dead
+  workers) carry an inline suppression with the reason.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from . import Finding, Project, register
+
+RULE = "typed-termination"
+SCOPE = "paddle_tpu/inference"
+
+GENERIC = {"RuntimeError", "Exception", "BaseException"}
+_NOOP_STMTS = (ast.Pass, ast.Continue)
+
+
+def _exc_name(node: ast.AST):
+    if isinstance(node, ast.Call):
+        node = node.func
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _body_is_noop(body) -> bool:
+    for stmt in body:
+        if isinstance(stmt, _NOOP_STMTS):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue  # docstring / ...
+        return False
+    return True
+
+
+@register(RULE)
+def run(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for sf in project.in_dir(SCOPE):
+        for node in sf.walk():
+            if isinstance(node, ast.Raise) and node.exc is not None:
+                name = _exc_name(node.exc)
+                if name in GENERIC:
+                    out.append(Finding(
+                        sf.relpath, node.lineno, RULE,
+                        f"raise {name} on a request path is invisible to "
+                        "typed-termination handling; raise a typed "
+                        "exception (StaleEpoch / JournalSuperseded / "
+                        "RpcTimeout / a module-specific subclass) or a "
+                        "validation error"))
+            elif isinstance(node, ast.ExceptHandler):
+                name = (_exc_name(node.type)
+                        if node.type is not None else None)
+                broad = node.type is None or name in ("Exception",
+                                                      "BaseException")
+                if broad and _body_is_noop(node.body):
+                    what = "bare except:" if node.type is None \
+                        else f"except {name}: pass"
+                    out.append(Finding(
+                        sf.relpath, node.lineno, RULE,
+                        f"{what} swallows faults the containment layer "
+                        "needs to see; handle it (count/degrade/failover)"
+                        ", narrow the type, or re-raise"))
+    return out

@@ -27,7 +27,8 @@ __all__ = ["Config", "create_predictor", "Predictor", "PredictorPool",
            "BrownoutPolicy", "FaultInjector", "FaultSpec",
            "RespawnCircuitBreaker", "RequestJournal", "JournalCorruption",
            "JournalSuperseded", "StaleEpoch", "EpochFence", "FencedEngine",
-           "FrontendLease", "StandbyFrontend", "HandedOff"]
+           "FrontendLease", "StandbyFrontend", "HandedOff",
+           "TraceContext", "FlightRecorder", "Tracer"]
 
 from .control_plane import (  # noqa: E402
     BrownoutPolicy,
@@ -66,6 +67,11 @@ from .serving import (  # noqa: E402
     SamplingParams,
     ServingEngine,
     ServingRequest,
+)
+from .tracing import (  # noqa: E402
+    FlightRecorder,
+    TraceContext,
+    Tracer,
 )
 
 

@@ -151,6 +151,28 @@ fleet-wide metrics aggregation on top of this class, and replicas can be
 attached/detached at runtime with ``add_replica``/``remove_replica``
 (``draining`` replicas finish in-flight work but take no new
 placements).
+
+Tracing (ISSUE 15).  Pass ``tracer=tracing.Tracer(...)`` and every
+admitted request gets a deterministic ``TraceContext`` whose id rides
+the journal admit record (a recovered request keeps its trace) and
+whose per-dispatch ``attempt-N`` child span is stamped onto the engine
+RPC like ``epoch=`` — workers record against it and ship their events
+back on the ``_w_step`` reply, so ``tracer`` assembles ONE fleet-wide
+span tree per request.  What IS recorded: admission (``admit``/
+``queue``), every dispatch (``dispatch`` on the attempt span), prefill
+completion and each megastep boundary with its token count (engine
+side), ``preempt``/``retry``/``replica_death``/``recover`` lifecycle
+edges, exactly one typed ``terminal`` per request, and trace-less
+process events for lease renew/depose/fence/takeover/handoff, brownout
+level moves, breaker transitions, and fault-injection fires.  What is
+NOT recorded: tokens, prompts (only lengths), logprobs, raw exception
+text on span events, or anything inside a compiled body — tracing is
+host-side only, bounded (flight-recorder ring + per-trace index), and
+zero-cost when ``tracer`` is None.  TTFT/ITL/e2e histogram
+observations carry the trace id as an exemplar
+(``metrics.exemplars``), so a latency outlier is one lookup from its
+tree; non-COMPLETED terminals and slow completions auto-capture their
+trees into ``tracer.captures``.
 """
 from __future__ import annotations
 
@@ -169,6 +191,7 @@ from .journal import (ADMIT, EPOCH, PROGRESS, TERMINAL, JournalSuperseded,
 from .metrics import (MEGASTEP_COUNTERS, ServingMetrics,
                       fold_counter_deltas, fold_prefix_counters)
 from .serving import SamplingParams, ServingEngine, prompt_block_hashes
+from .tracing import TraceContext, Tracer
 
 __all__ = ["Priority", "RequestStatus", "RequestResult", "ServingFrontend",
            "BrownoutPolicy", "StaleEpoch", "HandedOff"]
@@ -300,6 +323,7 @@ class _FrontendRequest:
     first_token_t: Optional[float] = None
     last_token_t: Optional[float] = None
     counted_tokens: int = 0        # held against the class token budget
+    trace: Optional[TraceContext] = None  # root span (tracer armed only)
 
     @property
     def remaining_new_tokens(self) -> int:
@@ -365,7 +389,8 @@ class ServingFrontend:
                  epoch: Optional[int] = None,
                  lease: Optional[FrontendLease] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 metrics: Optional[ServingMetrics] = None):
+                 metrics: Optional[ServingMetrics] = None,
+                 tracer: Optional[Tracer] = None):
         if isinstance(engines, ServingEngine):
             engines = [engines]
         if not engines:
@@ -394,6 +419,8 @@ class ServingFrontend:
         self._class_tokens: Dict[Priority, int] = {p: 0 for p in Priority}
         self.preemption = bool(preemption)
         self.metrics = metrics if metrics is not None else ServingMetrics(clock)
+        # per-request tracing (ISSUE 15): None = every hook is one test
+        self.tracer = tracer
         self._queue: List[_FrontendRequest] = []
         self._requests: Dict[int, _FrontendRequest] = {}
         self._results: Dict[int, RequestResult] = {}
@@ -547,6 +574,8 @@ class ServingFrontend:
             return
         self._deposed = True
         self._deposed_reason = reason
+        if self.tracer is not None:
+            self.tracer.process_event("depose", epoch=self.epoch)
         self._step_records = []
         if self.journal is not None:
             try:
@@ -570,6 +599,8 @@ class ServingFrontend:
         eng = replica.engine if replica is not None else None
         if not getattr(eng, "fences_self_reported", False):
             self.metrics.inc("fenced_rpcs_total")
+        if self.tracer is not None:
+            self.tracer.process_event("fenced", epoch=self.epoch)
         self._depose(f"fenced by a replica: {exc}")
         raise exc
 
@@ -607,6 +638,8 @@ class ServingFrontend:
         if not ok:
             self._depose_and_raise("lease lost: a newer epoch holds "
                                    f"{self.lease.key!r}")
+        if self.tracer is not None:
+            self.tracer.process_event("lease_renew", epoch=self.epoch)
 
     def remove_replica(self, replica: _Replica):
         """Detach a replica.  It must be idle (drained) or dead — removing
@@ -777,6 +810,15 @@ class ServingFrontend:
         req.admitted = True
         if idempotency_key is not None:
             self._idem_open[idempotency_key] = rid
+        if self.tracer is not None:
+            # minted BEFORE the admit record so the trace id rides it
+            # (a journal-recovered request keeps its trace)
+            req.trace = self.tracer.begin(rid)
+            self.tracer.event(req.trace, "admit",
+                              priority=int(req.priority),
+                              prompt_len=len(prompt),
+                              max_new_tokens=req.max_new_tokens)
+            self.tracer.event(req.trace, "queue", depth=len(self._queue))
         # write-ahead: the admit record is durable BEFORE the request can
         # reach a replica, so a crash after this line cannot lose it
         self._journal_append(self._admit_record(req))
@@ -885,6 +927,14 @@ class ServingFrontend:
         finally:
             self._in_step = False
             self._flush_step_records()
+        if self.tracer is not None:
+            # graft engine/worker-side span events (prefill done, megastep
+            # boundaries) onto the fleet-wide trees; a RemoteReplica's pop
+            # is a local buffer drain, so no RPC fault can fire here
+            for rep in self._replicas:
+                fn = getattr(rep.engine, "pop_trace_events", None)
+                if fn is not None:
+                    self.tracer.absorb(fn())
         self._sample_gauges()
         if (self._journaling
                 and self._records_since_compact >= self.journal_compact_every):
@@ -1020,7 +1070,9 @@ class ServingFrontend:
                 "deadline_s": rem, "eos": req.eos_token_id,
                 "sampling": req.sampling.to_wire(),
                 "key": req.idempotency_key,
-                "attempts": req.attempts, "nr": self._next_rid}
+                "attempts": req.attempts, "nr": self._next_rid,
+                "trace": (req.trace.trace_id
+                          if req.trace is not None else None)}
 
     def _snapshot_state(self) -> Dict:
         """Compaction snapshot: open admits + the bounded keyed-terminal
@@ -1120,6 +1172,8 @@ class ServingFrontend:
             except Exception:  # noqa: BLE001 — TTL expiry still hands off
                 pass
         self._handed_off = True
+        if self.tracer is not None:
+            self.tracer.process_event("handoff", epoch=self.epoch)
         self.metrics.inc("handoffs_total")
 
     @classmethod
@@ -1278,6 +1332,18 @@ class ServingFrontend:
                 idempotency_key=t.get("key"))
             fe._next_seq += 1
             fe._requests[rid] = stub
+            if fe.tracer is not None:
+                # pre-crash terminals keep their journaled trace id too:
+                # the successor's tree carries a "terminal" stub event,
+                # so EVERY typed terminal it can answer for owns a
+                # complete span tree (the pre-crash spans died with the
+                # old incarnation's recorder)
+                a = admits.get(rid) or {}
+                stub.trace = (fe.tracer.adopt(a["trace"], rid)
+                              if a.get("trace") else fe.tracer.begin(rid))
+                fe.tracer.event(stub.trace, "terminal",
+                                status=t["status"], recovered=True,
+                                attempts=int(t.get("attempts", 0)))
             fe._results[rid] = RequestResult(
                 rid=rid, status=RequestStatus(t["status"]), tokens=[],
                 detail="recovered terminal from journal (tokens are not "
@@ -1315,6 +1381,14 @@ class ServingFrontend:
             # progress records carry the live value — take the max
             req.attempts = max(int(a.get("attempts", 0)),
                                attempts.get(rid, 0))
+            if fe.tracer is not None:
+                # the trace id rode the admit record: the recovered
+                # request KEEPS its pre-crash trace (same id minted
+                # deterministically from the rid either way)
+                req.trace = (fe.tracer.adopt(a["trace"], rid)
+                             if a.get("trace") else fe.tracer.begin(rid))
+                fe.tracer.event(req.trace, "recover",
+                                attempts=req.attempts)
             req.admitted = True
             req.counted_tokens = req.total_tokens
             fe._class_tokens[req.priority] += req.counted_tokens
@@ -1371,10 +1445,16 @@ class ServingFrontend:
             self._brownout_level += 1
             self._brownout_pressure_steps = 0
             self.metrics.inc("brownout_transitions_total")
+            if self.tracer is not None:
+                self.tracer.process_event("brownout",
+                                          level=self._brownout_level)
         elif (self._brownout_clear_steps >= pol.exit_after
                 and self._brownout_level > 0):
             self._brownout_level -= 1
             self._brownout_clear_steps = 0
+            if self.tracer is not None:
+                self.tracer.process_event("brownout",
+                                          level=self._brownout_level)
         self.metrics.set_gauge("degraded_mode", self._brownout_level)
 
     def _fits_at_all(self, rep: _Replica, req: _FrontendRequest) -> bool:
@@ -1561,6 +1641,9 @@ class ServingFrontend:
         victim.replica = None
         victim.engine_rid = None
         victim.preemptions += 1
+        if self.tracer is not None and victim.trace is not None:
+            self.tracer.event(victim.trace, "preempt",
+                              tokens=len(victim.generated))
         self.metrics.inc("preempted_total")
         # re-queued with prompt+generated as the new prefill; keeps its
         # original seq so it resumes ahead of younger peers in its class
@@ -1572,6 +1655,15 @@ class ServingFrontend:
             self._finish(req, RequestStatus.COMPLETED)
             return
         prefill = req.prompt + req.generated
+        extra = {}
+        if self.tracer is not None and req.trace is not None:
+            # one child span per dispatch: engine/worker events for THIS
+            # placement land on the attempt span, so a failover or
+            # preemption re-dispatch shows up as a new attempt in the tree
+            ctx = req.trace.child(f"attempt-{req.assignments + 1}")
+            self.tracer.event(ctx, "dispatch", replica=rep.idx,
+                              attempt=req.assignments + 1)
+            extra["trace"] = ctx.to_wire()
         try:
             # sampling params travel as the dict wire form (RemoteReplica
             # ships them over RPC verbatim); sample_offset continues the
@@ -1580,7 +1672,7 @@ class ServingFrontend:
                 prefill, max_new_tokens=req.remaining_new_tokens,
                 eos_token_id=req.eos_token_id,
                 sampling=req.sampling.to_wire(),
-                sample_offset=len(req.generated))
+                sample_offset=len(req.generated), **extra)
         except ValueError as e:
             # e.g. an int8 engine whose one-shot-prefill contract a resumed
             # (grown) prefill no longer satisfies
@@ -1637,15 +1729,18 @@ class ServingFrontend:
                 continue
             if not toks:
                 continue
+            tid = req.trace.trace_id if req.trace is not None else None
             if req.first_token_t is None:
                 req.first_token_t = t
-                self.metrics.observe("ttft_seconds", t - req.submit_t)
+                self.metrics.observe("ttft_seconds", t - req.submit_t,
+                                     trace_id=tid)
             elif req.last_token_t is not None:
                 # inter-token latency: a megastep delivers its K tokens in
                 # one burst, so the per-token value is the boundary-to-
                 # boundary gap amortized over the burst
                 self.metrics.observe(
-                    "token_latency_seconds", (t - req.last_token_t) / len(toks))
+                    "token_latency_seconds",
+                    (t - req.last_token_t) / len(toks), trace_id=tid)
             req.last_token_t = t
             req.generated.extend(toks)
             if req.sampling.logprobs:
@@ -1688,6 +1783,9 @@ class ServingFrontend:
         for erid, req in list(rep.requests.items()):
             req.replica = None
             req.engine_rid = None
+            if self.tracer is not None and req.trace is not None:
+                self.tracer.event(req.trace, "replica_death",
+                                  replica=rep.idx)
             self._requeue_or_quarantine(req, rep)
         rep.requests.clear()
 
@@ -1704,6 +1802,8 @@ class ServingFrontend:
                 f"{rep.last_error}")
             return
         self._queue.append(req)
+        if self.tracer is not None and req.trace is not None:
+            self.tracer.event(req.trace, "retry", attempts=req.attempts)
         # make the bumped retry budget durable NOW (not batched) — a
         # crash before the request's next harvested token would
         # otherwise hand a poison request a fresh budget on recovery
@@ -1734,6 +1834,17 @@ class ServingFrontend:
             logprobs=(list(req.logprob_values) if req.sampling.logprobs
                       else None))
         self._results[req.rid] = res
+        if self.tracer is not None:
+            if req.trace is None:
+                # typed rejections never pass admission; mint here so
+                # EVERY typed terminal owns a complete span tree
+                req.trace = self.tracer.begin(req.rid)
+                self.tracer.event(req.trace, "submit")
+            self.tracer.event(req.trace, "terminal", status=status.value,
+                              tokens=len(req.generated),
+                              attempts=req.attempts)
+            self.tracer.note_terminal(req.trace, status.value,
+                                      e2e_s=res.e2e_s)
         if req.counted_tokens:
             self._class_tokens[req.priority] -= req.counted_tokens
             req.counted_tokens = 0
@@ -1761,7 +1872,10 @@ class ServingFrontend:
                 self._idem_done.popitem(last=False)
         self.metrics.inc(_STATUS_COUNTER[status])
         if status is RequestStatus.COMPLETED:
-            self.metrics.observe("e2e_latency_seconds", res.e2e_s)
+            self.metrics.observe("e2e_latency_seconds", res.e2e_s,
+                                 trace_id=(req.trace.trace_id
+                                           if req.trace is not None
+                                           else None))
         return res
 
     def _sample_gauges(self):
@@ -1772,10 +1886,23 @@ class ServingFrontend:
         m.set_gauge("replicas_alive", len(live))
         total = sum(r.engine.blocks.num_blocks for r in live)
         free = sum(r.engine.blocks.num_free for r in live)
-        m.set_gauge("blocks_total", total)
+        m.set_gauge("blocks_capacity", total)
         m.set_gauge("blocks_free", free)
         m.set_gauge_peak("block_pool_utilization",
                          (1.0 - free / total) if total else 0.0)
+        # per-phase step-time attribution (ISSUE 15 satellite): cumulative
+        # host seconds summed over live replicas, same aggregation shape
+        # as the block gauges above
+        sched = exe = harv = 0.0
+        for rep in live:
+            ps = getattr(rep.engine, "phase_seconds", None)
+            if ps:
+                sched += float(ps.get("schedule", 0.0))
+                exe += float(ps.get("execute", 0.0))
+                harv += float(ps.get("harvest", 0.0))
+        m.set_gauge("step_phase_schedule_seconds", sched)
+        m.set_gauge("step_phase_execute_seconds", exe)
+        m.set_gauge("step_phase_harvest_seconds", harv)
         for rep in live:
             eng = rep.engine
             if getattr(eng, "prefix_counters_self_reported", False):

@@ -210,6 +210,10 @@ class FaultInjector:
         self._traversals: Dict[str, int] = {}
         self._fires: Dict[str, int] = {}
         self.log: List[Tuple[str, str, str]] = []  # (site, kind, detail)
+        # optional tracing.FlightRecorder (ISSUE 15): armed by chaos
+        # harnesses so every injected fault lands in the flight recorder
+        # next to the lifecycle events it perturbed
+        self.recorder = None
 
     def _namespaces(self) -> set:
         """The namespace registry THIS injector validates against: its
@@ -303,6 +307,12 @@ class FaultInjector:
             return False
         self._fires[site] = self._fires.get(site, 0) + 1
         self.log.append((site, spec.kind, detail))
+        if self.recorder is not None:
+            # trace-less process event: fault fires are flight-recorder
+            # context, not request spans (the perturbed request's own
+            # retry/replica_death events carry the request linkage)
+            self.recorder.record(None, None, None, "fault",
+                                 site=site, kind=spec.kind)
         msg = (f"injected {spec.kind} at failpoint '{site}'"
                + (f" ({detail})" if detail else ""))
         if spec.kind == "delay":
@@ -355,6 +365,9 @@ class RespawnCircuitBreaker:
         self._failures: List[float] = []   # guarded-by: self._lock
         self._consecutive_opens = 0        # guarded-by: self._lock
         self._retry_at = -float("inf")     # guarded-by: self._lock
+        # optional tracing.FlightRecorder (ISSUE 15): breaker transitions
+        # land in the flight recorder as trace-less process events
+        self.recorder = None
 
     def _backoff(self) -> float:
         with self._lock:
@@ -370,6 +383,9 @@ class RespawnCircuitBreaker:
             self._consecutive_opens += 1
             self._retry_at = self._clock() + self._backoff()
             self._failures.clear()
+            if self.recorder is not None:
+                self.recorder.record(None, None, None, "breaker_open",
+                                     opens=self.open_count)
 
     def allow(self) -> bool:
         """May a spawn proceed right now?  An open breaker past its
@@ -406,10 +422,13 @@ class RespawnCircuitBreaker:
     def record_success(self):
         """A spawned worker attached and looks healthy."""
         with self._lock:
+            reopened = self.state != "closed"
             self.state = "closed"
             self._failures.clear()
             self._consecutive_opens = 0
             self._retry_at = -float("inf")
+            if reopened and self.recorder is not None:
+                self.recorder.record(None, None, None, "breaker_close")
 
     @property
     def open_gauge(self) -> float:

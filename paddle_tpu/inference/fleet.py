@@ -323,12 +323,15 @@ def _w_config() -> Dict:
 
 
 def _w_add_request(prompt, max_new_tokens, eos_token_id=None,
-                   sampling=None, sample_offset=0, epoch=None):
+                   sampling=None, sample_offset=0, epoch=None, trace=None):
     _fence(epoch, "add_request")
     eng = _engine()
+    # the trace wire context rides the RPC like epoch= (ISSUE 15): the
+    # worker engine records its span events against the frontend's
+    # attempt span, shipped back on the _w_step reply
     rid = eng.add_request(prompt, max_new_tokens=max_new_tokens,
                           eos_token_id=eos_token_id, sampling=sampling,
-                          sample_offset=sample_offset)
+                          sample_offset=sample_offset, trace=trace)
     return rid, eng.state_summary()
 
 
@@ -355,9 +358,14 @@ def _w_step(epoch=None):
     st = eng.state_summary()
     m.set_gauge_peak("queue_depth", st["queue_depth"])
     m.set_gauge("running_requests", st["num_active"])
-    m.set_gauge("blocks_total", st["blocks_total"])
+    m.set_gauge("blocks_capacity", st["blocks_total"])
     m.set_gauge("blocks_free", st["blocks_free"])
     m.set_gauge_peak("block_pool_utilization", st["pool_utilization"])
+    ps = st.get("phase_seconds") or {}
+    if ps:
+        m.set_gauge("step_phase_schedule_seconds", ps.get("schedule", 0.0))
+        m.set_gauge("step_phase_execute_seconds", ps.get("execute", 0.0))
+        m.set_gauge("step_phase_harvest_seconds", ps.get("harvest", 0.0))
     # engine-level counters are monotone; fold the per-step deltas so
     # _w_reset_metrics windows stay correct
     pc = st.get("prefix_cache") or {}
@@ -370,7 +378,23 @@ def _w_step(epoch=None):
     _WORKER["mega_seen"] = fold_counter_deltas(m, MEGASTEP_COUNTERS, mcur,
                                                _WORKER["mega_seen"])
     m.inc("completed_total", len(finished))
-    return emitted, finished, st, logprobs
+    # span events the engine recorded this step (prefill done, megastep
+    # boundaries) piggyback on the reply — the frontend grafts them onto
+    # its fleet-wide trees (tracing disabled -> always [])
+    pt_fn = getattr(eng, "pop_trace_events", None)
+    traces = pt_fn() if pt_fn is not None else []
+    return emitted, finished, st, logprobs, traces
+
+
+def _w_pop_traces(epoch=None):
+    """Drain the worker engine's buffered span events without stepping —
+    the recovery-path drain: a takeover frontend pulls the spans a dead
+    frontend never collected before it reaps.  Fenced like every control
+    RPC (a zombie draining them would hide events from the successor)."""
+    _fence(epoch, "pop_traces")
+    eng = _engine()
+    pt_fn = getattr(eng, "pop_trace_events", None)
+    return pt_fn() if pt_fn is not None else []
 
 
 def _w_evict(rid, epoch=None):
@@ -525,6 +549,7 @@ class RemoteReplica:
         self._free_slots: List[int] = list(range(self.B))
         self._finished: Dict[int, List[int]] = {}
         self._logprobs: Dict[int, List[float]] = {}
+        self._trace_events: List[Dict] = []  # worker spans off _w_step replies
         self._pending_step = None
         self._apply_state(h["state"])
 
@@ -560,6 +585,10 @@ class RemoteReplica:
         self.megastep_k = int(ms.get("k", 1))
         self.megasteps = int(ms.get("megasteps", 0))
         self.megastep_tokens = int(ms.get("tokens", 0))
+        # per-phase step-time mirror (the worker sets the gauges in its
+        # own registry too; the frontend sums mirrors like the block
+        # counts above)
+        self.phase_seconds = dict(st.get("phase_seconds") or {})
 
     def cached_block_hashes(self):
         """Last-synced mirror of the worker engine's content-addressable
@@ -573,14 +602,15 @@ class RemoteReplica:
 
     def add_request(self, prompt_ids, max_new_tokens: int = 32,
                     eos_token_id: Optional[int] = None,
-                    sampling=None, sample_offset: int = 0) -> int:
+                    sampling=None, sample_offset: int = 0,
+                    trace: Optional[Dict] = None) -> int:
         prompt = [int(t) for t in prompt_ids]
         if sampling is not None and not isinstance(sampling, dict):
             # ship the dict wire form (no class pickling across versions)
             sampling = sampling.to_wire()
         rid, st = self._call(_w_add_request, prompt, int(max_new_tokens),
                              eos_token_id, sampling, int(sample_offset),
-                             epoch=self._epoch)
+                             epoch=self._epoch, trace=trace)
         self._apply_state(st)
         return rid
 
@@ -598,15 +628,35 @@ class RemoteReplica:
         fut = self._pending_step
         self._pending_step = None
         if fut is not None:
-            emitted, finished, st, lps = fut.result()
+            emitted, finished, st, lps, traces = fut.result()
         else:
-            emitted, finished, st, lps = self._call(_w_step,
-                                                    epoch=self._epoch)
+            emitted, finished, st, lps, traces = self._call(
+                _w_step, epoch=self._epoch)
         self._apply_state(st)
         self._finished.update(finished)
         for rid, vals in lps.items():
             self._logprobs.setdefault(rid, []).extend(vals)
+        if traces:
+            self._trace_events.extend(traces)
         return emitted
+
+    def pop_trace_events(self) -> List[Dict]:
+        """Local drain of the worker span events buffered off ``_w_step``
+        replies — same shape as ``ServingEngine.pop_trace_events``, and
+        crucially NOT an RPC (the frontend drains it after stepping, so
+        a dead worker cannot fault the trace harvest)."""
+        out = self._trace_events
+        self._trace_events = []
+        return out
+
+    def pop_remote_traces(self) -> List[Dict]:
+        """``_w_pop_traces`` RPC: pull span events the worker recorded
+        but never shipped (no step happened, or the previous frontend
+        died before collecting) — the recovery/takeover drain."""
+        evs = self._call(_w_pop_traces, epoch=self._epoch)
+        if evs:
+            self._trace_events.extend(evs)
+        return self.pop_trace_events()
 
     def pop_finished(self) -> Dict[int, List[int]]:
         out = self._finished

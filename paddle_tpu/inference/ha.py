@@ -455,6 +455,9 @@ class StandbyFrontend:
         fe.metrics.inc("standby_takeovers_total")
         if was_failover:
             fe.metrics.inc("failovers_total")
+        if getattr(fe, "tracer", None) is not None:
+            fe.tracer.process_event("takeover", epoch=epoch,
+                                    failover=was_failover)
         self.frontend = fe
         return fe
 

@@ -28,7 +28,9 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, Dict, Iterable, List, Mapping, Optional, Union
+from collections import deque
+from typing import (Callable, Dict, Iterable, List, Mapping, Optional,
+                    Tuple, Union)
 
 __all__ = ["ServingMetrics", "fold_prefix_counters", "fold_counter_deltas"]
 
@@ -69,7 +71,7 @@ COUNTERS = (
 )
 GAUGES = (
     "queue_depth", "queue_depth_peak", "running_requests", "replicas_alive",
-    "blocks_total", "blocks_free", "block_pool_utilization",
+    "blocks_capacity", "blocks_free", "block_pool_utilization",
     "block_pool_utilization_peak", "prefix_cache_hit_rate",
     # 0/1/2 brownout level and 0 / 0.5 / 1 breaker state (closed/half/open)
     "degraded_mode", "respawn_breaker_open",
@@ -80,6 +82,12 @@ GAUGES = (
     # the frontend's fencing epoch (monotone across incarnations; a
     # fleet-wide scrape shows every registry agreeing on the current one)
     "lease_epoch",
+    # per-phase step-time attribution (ISSUE 15): cumulative host seconds
+    # the engine spent scheduling/admitting, executing compiled programs,
+    # and harvesting emitted tokens — gauges mirroring the engine's own
+    # monotone accumulators (merge() sums them fleet-wide)
+    "step_phase_schedule_seconds", "step_phase_execute_seconds",
+    "step_phase_harvest_seconds",
 )
 SAMPLES = ("ttft_seconds", "token_latency_seconds", "e2e_latency_seconds")
 
@@ -150,6 +158,11 @@ class ServingMetrics:
             self._samples: Dict[str, List[float]] = {k: [] for k in SAMPLES}  # guarded-by: self._lock
             self._sample_counts: Dict[str, int] = {k: 0 for k in SAMPLES}     # guarded-by: self._lock
             self._sample_sums: Dict[str, float] = {k: 0.0 for k in SAMPLES}   # guarded-by: self._lock
+            # trace-linked exemplars (ISSUE 15): the most recent
+            # (trace_id, value) pairs per latency series, so a p95
+            # outlier on the scrape page is one trace lookup away —
+            # bounded per series, zero-cost when no trace_id is passed
+            self._exemplars: Dict[str, deque] = {}                             # guarded-by: self._lock
             self._first_emit_t: Optional[float] = None
             self._last_emit_t: Optional[float] = None
             self._tokens_at_first_emit = 0
@@ -176,7 +189,8 @@ class ServingMetrics:
             self._gauges[peak] = max(self._gauges.get(peak, 0.0),
                                      float(value))
 
-    def observe(self, name: str, value: float):
+    def observe(self, name: str, value: float,
+                trace_id: Optional[str] = None):
         with self._lock:
             buf = self._samples.setdefault(name, [])
             cnt = self._sample_counts.get(name, 0)
@@ -187,6 +201,11 @@ class ServingMetrics:
             self._sample_counts[name] = cnt + 1
             self._sample_sums[name] = (self._sample_sums.get(name, 0.0)
                                        + float(value))
+            if trace_id is not None:
+                ex = self._exemplars.get(name)
+                if ex is None:
+                    ex = self._exemplars[name] = deque(maxlen=8)
+                ex.append((trace_id, float(value)))
 
     def note_tokens(self, n: int, t: Optional[float] = None):
         """Record ``n`` tokens emitted at time ``t`` (defaults to now)."""
@@ -208,6 +227,12 @@ class ServingMetrics:
     def gauge(self, name: str) -> float:
         with self._lock:
             return self._gauges.get(name, 0.0)
+
+    def exemplars(self, name: str) -> List[Tuple[str, float]]:
+        """Most recent (trace_id, value) pairs observed for ``name`` —
+        the lookup that turns a latency outlier into a span tree."""
+        with self._lock:
+            return list(self._exemplars.get(name, ()))
 
     def tokens_per_sec(self) -> float:
         """Steady-state emission rate: tokens after the first emission
@@ -302,7 +327,7 @@ class ServingMetrics:
                     gauges[k] = max(gauges.get(k, 0.0), float(v))
                 else:
                     gauges[k] = gauges.get(k, 0.0) + float(v)
-        total = gauges.get("blocks_total", 0.0)
+        total = gauges.get("blocks_capacity", 0.0)
         free = gauges.get("blocks_free", 0.0)
         if "block_pool_utilization" in gauges:
             gauges["block_pool_utilization"] = \
@@ -451,4 +476,10 @@ class ServingMetrics:
                 lines.append(f'{full}{{quantile="0.95"}} {s["p95"]:.6g}')
                 lines.append(f"{full}_count {s['count']}")
                 lines.append(f"{full}_sum {s['sum']:.6g}")
+                # trace-linked exemplars as comment lines (the 0.0.4 text
+                # format has no exemplar syntax; OpenMetrics-style braces
+                # keep them greppable without breaking strict parsers)
+                for tid, v in self._exemplars.get(name, ()):
+                    lines.append(
+                        f'# EXEMPLAR {full} {{trace_id="{tid}"}} {v:.6g}')
         return "\n".join(lines) + "\n"

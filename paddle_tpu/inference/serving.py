@@ -70,10 +70,11 @@ exactly.
 from __future__ import annotations
 
 import hashlib
+import time
 from collections import Counter, OrderedDict
 from dataclasses import dataclass, field
 from functools import partial
-from typing import Dict, List, Optional, Sequence, Set
+from typing import Callable, Dict, List, Optional, Sequence, Set
 
 import numpy as np
 
@@ -391,6 +392,10 @@ class ServingRequest:
     # of tokens already sampled here, so the seeded key stream continues
     # exactly where the evicted run stopped
     sample_offset: int = 0
+    # tracing wire context (ISSUE 15): {"trace", "span", "parent", "rid"}
+    # stamped by the frontend (rid = the FRONTEND rid); engine lifecycle
+    # events (prefill done, megastep boundaries) are recorded under it
+    trace: Optional[Dict] = None
     # runtime state
     generated: List[int] = field(default_factory=list)
     logprob_values: List[float] = field(default_factory=list)
@@ -422,7 +427,9 @@ class ServingEngine:
                  num_blocks: Optional[int] = None, cache_dtype=None,
                  cache_quant: str = "none", prefix_cache="auto",
                  megastep_k: int = 8, fault_injector=None,
-                 capture_sample_probs: bool = False):
+                 capture_sample_probs: bool = False,
+                 trace_recorder=None,
+                 clock: Callable[[], float] = time.monotonic):
         from .faults import FaultInjector
 
         # seeded failpoint registry (faults.py): the 'engine.step' site
@@ -516,6 +523,16 @@ class ServingEngine:
         self.megastep_k = int(megastep_k)
         self.megasteps = 0          # megastep program launches (monotone)
         self.megastep_tokens = 0    # tokens emitted via the megastep path
+        # per-request tracing (ISSUE 15): an optional FlightRecorder ring.
+        # None (the default) keeps every hook at a single attribute test —
+        # same zero-cost pattern as self._faults above.
+        self.trace_recorder = trace_recorder
+        self._clock = clock
+        # cumulative host-side seconds per step phase (schedule = admission
+        # + batch marshalling, execute = compiled call + device sync,
+        # harvest = token/unblocking bookkeeping); surfaced via
+        # state_summary() for megastep cost attribution
+        self.phase_seconds = {"schedule": 0.0, "execute": 0.0, "harvest": 0.0}
         self._forward = self._build_forward()
         self._step_fn = self._build_step()
         self._mega_fn = None  # lazy: compiled lax.scan megastep program
@@ -692,7 +709,8 @@ class ServingEngine:
     # ------------------------------------------------------------- serving
     def add_request(self, prompt_ids, max_new_tokens: int = 32,
                     eos_token_id: Optional[int] = None,
-                    sampling=None, sample_offset: int = 0) -> int:
+                    sampling=None, sample_offset: int = 0,
+                    trace: Optional[Dict] = None) -> int:
         """Queue one request.  ``sampling`` is a :class:`SamplingParams`
         (or its dict wire form; None = greedy argmax).  ``sample_offset``
         is the sample index of the first NEW token — a resumed request
@@ -722,7 +740,8 @@ class ServingEngine:
         self._queue.append(ServingRequest(
             rid, prompt, max_new_tokens, eos_token_id,
             sampling=SamplingParams.coerce(sampling),
-            sample_offset=int(sample_offset)))
+            sample_offset=int(sample_offset),
+            trace=dict(trace) if trace else None))
         return rid
 
     def _match_cached_prefix(self, prompt: List[int]):
@@ -895,7 +914,20 @@ class ServingEngine:
                 "megasteps": self.megasteps,
                 "tokens": self.megastep_tokens,
             },
+            # cumulative host seconds per step phase — megastep cost
+            # attribution without a profiler (ISSUE 15 satellite)
+            "phase_seconds": dict(self.phase_seconds),
         }
+
+    def pop_trace_events(self) -> List[Dict]:
+        """Drain span events recorded by this engine's flight recorder
+        since the last call (empty when tracing is off).  In-process
+        frontends drain this directly; a worker host drains it into the
+        ``_w_step`` reply so the frontend can graft engine-side spans
+        (prefill done, megastep boundaries) onto the fleet-wide tree."""
+        if self.trace_recorder is None:
+            return []
+        return self.trace_recorder.drain()
 
     def pop_finished(self) -> Dict[int, List[int]]:
         """Drain and return requests retired since the last call,
@@ -973,8 +1005,10 @@ class ServingEngine:
         compiled ``lax.scan`` (the megastep), so the returned lists carry
         up to K tokens per request and the host — admission included —
         only observes the engine at megastep boundaries."""
+        t0 = self._clock()
         self._try_admit()
         if not self._active:
+            self.phase_seconds["schedule"] += self._clock() - t0
             return {}
         if self._faults is not None:
             from .faults import prompt_signature
@@ -1010,6 +1044,7 @@ class ServingEngine:
                 sched.append((req, n, req.prefill_pos + n >= len(req.prompt)))
                 budget -= n
         if not sched:
+            self.phase_seconds["schedule"] += self._clock() - t0
             return {}
         # pure-decode steps run the tight [B]-token program (mq=1); steps
         # carrying prefill chunks run the [T]-token program (mq=T) — decide
@@ -1019,6 +1054,7 @@ class ServingEngine:
                 and self.cache_quant != "int8"
                 and max(r.max_new_tokens - len(r.generated)
                         for r, _, _ in sched) > 1):
+            self.phase_seconds["schedule"] += self._clock() - t0
             return self._megastep([s[0] for s in sched])
         tokens = np.zeros((self.B if decode_only else self.T,), np.int32)
         # stable slot order so cu_seqlens is monotone over batch rows
@@ -1054,6 +1090,8 @@ class ServingEngine:
             pos += n
             cu[slot + 1] = pos
 
+        t1 = self._clock()
+        self.phase_seconds["schedule"] += t1 - t0
         had_cache = self._step_fn._cache_size() if hasattr(self._step_fn, "_cache_size") else None
         nxt, lps, probs, self.key_caches, self.value_caches, new_scales = \
             self._step_fn(
@@ -1071,6 +1109,8 @@ class ServingEngine:
         nxt = np.asarray(nxt)
         lps = np.asarray(lps)
         probs = np.asarray(probs) if probs is not None else None
+        t2 = self._clock()
+        self.phase_seconds["execute"] += t2 - t1
 
         emitted: Dict[int, List[int]] = {}
         for req, n, finishes in sched:
@@ -1078,6 +1118,12 @@ class ServingEngine:
                 req.prefill_pos += n
                 if not finishes:
                     continue  # mid-prompt chunk: sampled token is meaningless
+                if self.trace_recorder is not None and req.trace is not None:
+                    self.trace_recorder.record(
+                        req.trace["trace"], req.trace["span"],
+                        req.trace.get("parent"), "prefill",
+                        rid=req.trace.get("rid"),
+                        prompt_len=len(req.prompt))
             tok = int(nxt[req.slot])
             req.generated.append(tok)
             if req.sampling.logprobs:
@@ -1094,6 +1140,7 @@ class ServingEngine:
             hit_eos = (req.eos_token_id is not None and tok == req.eos_token_id)
             if hit_eos or len(req.generated) >= req.max_new_tokens:
                 self._retire(req)
+        self.phase_seconds["harvest"] += self._clock() - t2
         return emitted
 
     def _megastep(self, reqs: List[ServingRequest]) -> Dict[int, List[int]]:
@@ -1111,6 +1158,7 @@ class ServingEngine:
             self._faults.fire(
                 "engine.megastep",
                 detail=" ".join(prompt_signature(r.prompt) for r in reqs))
+        t0 = self._clock()
         kmax = max(r.max_new_tokens - len(r.generated) for r in reqs)
         K = 1
         while K < min(self.megastep_k, kmax):
@@ -1149,6 +1197,8 @@ class ServingEngine:
                                     seeds, spos)
                 pos += 1
             cu[slot + 1] = pos
+        t1 = self._clock()
+        self.phase_seconds["schedule"] += t1 - t0
         if self._mega_fn is None:
             self._mega_fn = self._build_megastep()
         had = (self._mega_fn._cache_size()
@@ -1169,6 +1219,8 @@ class ServingEngine:
         lps_o = np.asarray(lps_o)
         probs_o = np.asarray(probs_o) if probs_o is not None else None
         self.megasteps += 1
+        t2 = self._clock()
+        self.phase_seconds["execute"] += t2 - t1
 
         emitted: Dict[int, List[int]] = {}
         for req in reqs:
@@ -1185,10 +1237,16 @@ class ServingEngine:
                     probs_o[:, s][col])   # [n_valid, V]
             emitted[req.rid] = new
             self.megastep_tokens += len(new)
+            if self.trace_recorder is not None and req.trace is not None:
+                self.trace_recorder.record(
+                    req.trace["trace"], req.trace["span"],
+                    req.trace.get("parent"), "megastep",
+                    rid=req.trace.get("rid"), tokens=len(new), k=K)
             hit_eos = (req.eos_token_id is not None and new
                        and new[-1] == req.eos_token_id)
             if hit_eos or len(req.generated) >= req.max_new_tokens:
                 self._retire(req)
+        self.phase_seconds["harvest"] += self._clock() - t2
         return emitted
 
     def run(self, max_steps: int = 10_000) -> Dict[int, List[int]]:

@@ -290,7 +290,7 @@ class TestMetrics:
         assert lat["token_latency_seconds"]["count"] > 0
         assert lat["e2e_latency_seconds"]["count"] == 2
         # block utilization was sampled inside the loop and ends drained
-        assert snap["gauges"]["blocks_total"] > 0
+        assert snap["gauges"]["blocks_capacity"] > 0
         assert snap["gauges"]["queue_depth"] == 0
         text = fe.metrics.prometheus_text()
         assert "# TYPE paddle_tpu_serving_admitted_total counter" in text

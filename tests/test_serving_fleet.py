@@ -161,8 +161,8 @@ class TestRemoteParity:
             # every emitted token shows up exactly once fleet-wide
             assert merged["counters"]["tokens_emitted_total"] == 4 * 4
             assert merged["num_replicas"] == 2
-            assert merged["gauges"]["blocks_total"] == sum(
-                s["gauges"]["blocks_total"] for s in snaps.values())
+            assert merged["gauges"]["blocks_capacity"] == sum(
+                s["gauges"]["blocks_capacity"] for s in snaps.values())
             text = fleet.prometheus_text()
             for name in ("worker0", "worker1", "frontend"):
                 assert f'replica="{name}"' in text
@@ -494,9 +494,9 @@ class TestMetricsMerge:
         b.inc("tokens_emitted_total", 5)
         a.set_gauge_peak("queue_depth", 3)
         b.set_gauge_peak("queue_depth", 7)
-        a.set_gauge("blocks_total", 8)
+        a.set_gauge("blocks_capacity", 8)
         a.set_gauge("blocks_free", 2)
-        b.set_gauge("blocks_total", 8)
+        b.set_gauge("blocks_capacity", 8)
         b.set_gauge("blocks_free", 6)
         a.set_gauge_peak("block_pool_utilization", 0.75)
         b.set_gauge_peak("block_pool_utilization", 0.25)

@@ -1,0 +1,291 @@
+"""Request-lifecycle tracing (ISSUE 15): span trees, flight recorder,
+and — the acceptance-critical part — TRACE CONTINUITY across every
+control-plane discontinuity the serving stack owns:
+
+* preempt/resume keeps one tree (the preempt event and the resume
+  re-dispatch land on the same root);
+* replica-death failover with a retry budget: every attempt is its own
+  child span, the typed FAILED_POISON terminal closes the tree, and the
+  typed failure auto-captures;
+* journal ``recover()`` after a crash adopts the journaled trace id —
+  the successor's tree answers for pre-crash terminals too;
+* ``StandbyFrontend`` takeover at epoch+1 re-roots every recovered
+  request under the SAME deterministic trace id and stamps the takeover
+  as a process event.
+"""
+import pytest
+
+from paddle_tpu.inference import (
+    FaultInjector,
+    FlightRecorder,
+    Priority,
+    RequestJournal,
+    RequestStatus,
+    ServingEngine,
+    ServingFrontend,
+    TraceContext,
+    Tracer,
+)
+from paddle_tpu.inference.faults import FaultyReplica
+from paddle_tpu.inference.tracing import (
+    assemble_trees,
+    events_digest,
+    tree_complete,
+)
+
+pytestmark = pytest.mark.quick
+
+
+@pytest.fixture(scope="module")
+def model(serving_model):
+    from paddle_tpu.distributed.topology import set_hybrid_communicate_group
+
+    set_hybrid_communicate_group(None)
+    return serving_model
+
+
+class Counter:
+    """Injected deterministic clock (the tracing contract: no wall
+    clock anywhere in the recorded stream)."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        self.t += 1.0
+        return self.t
+
+
+def make_engine(model, clock=None, traced=False, **kw):
+    kw.setdefault("max_batch_size", 2)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("token_budget", 16)
+    if traced:
+        kw["trace_recorder"] = FlightRecorder(clock=clock, proc="engine")
+        kw["clock"] = clock
+    return ServingEngine(model, **kw)
+
+
+def span_events(tree, name):
+    return [e for evs in tree.values() for e in evs if e["event"] == name]
+
+
+# ----------------------------------------------------------- unit surface
+class TestTraceSurface:
+    def test_mint_deterministic_and_wire_roundtrip(self):
+        a, b = TraceContext.mint(7), TraceContext.mint(7)
+        assert a.trace_id == b.trace_id != TraceContext.mint(8).trace_id
+        child = a.child("attempt-1")
+        assert child.parent == "request"
+        back = TraceContext.from_wire(child.to_wire())
+        assert (back.trace_id, back.span, back.parent) == \
+            (child.trace_id, "attempt-1", "request")
+
+    def test_tree_complete_flags_orphans_and_missing_terminal(self):
+        clk = Counter()
+        rec = FlightRecorder(clock=clk, proc="p")
+        ctx = TraceContext.mint(1)
+        rec.record(ctx.trace_id, "request", None, "admit", rid=1)
+        tree = assemble_trees(rec.snapshot())[ctx.trace_id]
+        ok, why = tree_complete(tree)
+        assert not ok and "terminal" in why
+        rec.record(ctx.trace_id, "request", None, "terminal", rid=1)
+        rec.record(ctx.trace_id, "attempt-9", "vanished", "prefill", rid=1)
+        tree = assemble_trees(rec.snapshot())[ctx.trace_id]
+        ok, why = tree_complete(tree)
+        assert not ok and "orphan" in why
+
+    def test_flight_recorder_bounded(self):
+        clk = Counter()
+        rec = FlightRecorder(capacity=4, clock=clk, proc="p")
+        for i in range(9):
+            rec.record(None, None, None, "tick", n=i)
+        assert len(rec.snapshot()) == 4 and rec.dropped == 5
+
+    def test_digest_ignores_clock_but_not_content(self):
+        def stream(offset, n=3):
+            clk = Counter()
+            clk.t = offset
+            rec = FlightRecorder(clock=clk, proc="p")
+            for i in range(n):
+                rec.record("t1", "request", None, "e", n=i)
+            return rec.snapshot()
+
+        assert events_digest(stream(0.0)) == events_digest(stream(100.0))
+        assert events_digest(stream(0.0)) != events_digest(stream(0.0, 4))
+
+
+# ---------------------------------------------------- lifecycle continuity
+class TestPreemptResumeContinuity:
+    def test_preempt_and_resume_share_one_tree(self, model):
+        """Block-pool exhaustion evicts the LOW request for the HIGH
+        one; the preempt event, the resume re-dispatch, and the
+        engine-side spans all land on the LOW request's single root."""
+        clk = Counter()
+        tracer = Tracer(clock=clk, proc="frontend")
+        eng = make_engine(model, clock=clk, traced=True,
+                          max_seq_len=32, num_blocks=4)
+        fe = ServingFrontend([eng], tracer=tracer)
+        rlo = fe.submit([3, 17, 101], max_new_tokens=8,
+                        priority=Priority.LOW)
+        fe.step()
+        rhi = fe.submit(list(range(40, 50)), max_new_tokens=8,
+                        priority=Priority.HIGH)
+        res = fe.run()
+        assert res[rlo].ok and res[rhi].ok and res[rlo].preemptions >= 1
+
+        tree = tracer.tree_for(TraceContext.mint(rlo).trace_id)
+        ok, why = tree_complete(tree)
+        assert ok, why
+        assert span_events(tree, "preempt")
+        # evict + resume re-dispatches: the tree holds BOTH attempts
+        dispatches = span_events(tree, "dispatch")
+        assert len(dispatches) >= 2
+        assert {d["span"] for d in dispatches} >= {"attempt-1", "attempt-2"}
+        # fleet-wide: engine-side spans (prefill/megastep) joined the
+        # frontend's tree through the recorder drain
+        procs = {e["proc"] for evs in tree.values() for e in evs}
+        assert procs == {"frontend", "engine"}
+        # the HIGH request's tree is complete and separate
+        ok, why = tree_complete(tracer.tree_for(
+            TraceContext.mint(rhi).trace_id))
+        assert ok, why
+
+
+class TestFailoverRetryContinuity:
+    def test_poison_attempt_spans_and_typed_terminal(self, model):
+        """A poison request burning its retry budget leaves one tree:
+        one child span per attempt, a replica_death + retry edge per
+        failover, the typed FAILED_POISON terminal, and an auto-capture
+        for the typed failure."""
+        clk = Counter()
+        tracer = Tracer(clock=clk, proc="frontend")
+        inj = FaultInjector({"engine.step": {"kind": "error",
+                                             "match": "p66-6-6-"}})
+        engines = [FaultyReplica(make_engine(model), inj, name=f"r{i}")
+                   for i in range(3)]
+        fe = ServingFrontend(engines, max_request_retries=1,
+                             tracer=tracer)
+        poison = fe.submit([66, 6, 6], max_new_tokens=4)
+        good = fe.submit([3, 17, 101], max_new_tokens=6)
+        res = fe.run()
+        assert res[poison].status is RequestStatus.FAILED_POISON
+        assert res[poison].attempts == 2
+        assert res[good].status is RequestStatus.COMPLETED
+
+        tid = TraceContext.mint(poison).trace_id
+        tree = tracer.tree_for(tid)
+        ok, why = tree_complete(tree)
+        assert ok, why
+        assert {d["span"] for d in span_events(tree, "dispatch")} \
+            == {"attempt-1", "attempt-2"}
+        assert len(span_events(tree, "replica_death")) == 2
+        assert len(span_events(tree, "retry")) == 1
+        term, = span_events(tree, "terminal")
+        assert term["attrs"]["status"] == "failed_poison"
+        # typed failures auto-capture their tree
+        assert tid in tracer.captures
+        assert "failed_poison" in tracer.captures[tid]["reason"]
+        # the collateral good request still owns a complete tree
+        ok, why = tree_complete(tracer.tree_for(
+            TraceContext.mint(good).trace_id))
+        assert ok, why
+
+
+class TestJournalRecoverContinuity:
+    def test_recover_adopts_journaled_trace_ids(self, model, tmp_path):
+        """The trace id rides the admit record: the successor frontend
+        re-roots open requests under the SAME id (deterministically
+        minted from the rid), and pre-crash terminals get a stub
+        terminal so every result it answers for owns a complete tree."""
+        clk = Counter()
+        j = RequestJournal(str(tmp_path / "req.wal"), fsync=False)
+        fe = ServingFrontend([make_engine(model)], journal=j,
+                             tracer=Tracer(clock=clk, proc="fe-a"))
+        done = fe.submit([5, 6], max_new_tokens=2, idempotency_key="d")
+        fe.run()                          # `done` closes pre-crash
+        open_rid = fe.submit([3, 17, 101], max_new_tokens=6,
+                             idempotency_key="o")
+        fe.step()                         # partial progress, then "crash"
+        assert open_rid not in fe.results()
+        j.close()
+
+        tracer_b = Tracer(clock=clk, proc="fe-b")
+        fe2 = ServingFrontend.recover(j.path, [make_engine(model)],
+                                      tracer=tracer_b)
+        tid = TraceContext.mint(open_rid).trace_id
+        assert fe2._requests[open_rid].trace.trace_id == tid
+        assert span_events(tracer_b.tree_for(tid), "recover")
+        res = fe2.run()
+        assert res[open_rid].status is RequestStatus.COMPLETED
+        ok, why = tree_complete(tracer_b.tree_for(tid))
+        assert ok, why
+        # the pre-crash terminal's stub tree is complete too
+        ok, why = tree_complete(tracer_b.tree_for(
+            TraceContext.mint(done).trace_id))
+        assert ok, why
+        term, = span_events(tracer_b.tree_for(
+            TraceContext.mint(done).trace_id), "terminal")
+        assert term["attrs"].get("recovered") is True
+
+
+class TestStandbyTakeoverContinuity:
+    def test_takeover_at_epoch_plus_one_keeps_traces(self, model,
+                                                     tmp_path):
+        from paddle_tpu.distributed.launch.master import KVServer
+        from paddle_tpu.inference.ha import FrontendLease, StandbyFrontend
+
+        clk = Counter()
+        srv = KVServer(0).start()
+        ep = f"127.0.0.1:{srv.port}"
+        jpath = str(tmp_path / "req.wal")
+        try:
+            lease_a = FrontendLease(ep, ttl_s=30.0, holder="a",
+                                    clock=clk, seed=0)
+            assert lease_a.acquire() == 1
+            fe_a = ServingFrontend(
+                [make_engine(model)],
+                journal=RequestJournal(jpath, fsync=False),
+                epoch=lease_a.epoch, clock=clk,
+                tracer=Tracer(clock=clk, proc="fe-a"))
+            rid = fe_a.submit([3, 17, 101], max_new_tokens=6,
+                              idempotency_key="k")
+            fe_a.step()                   # in flight, then the zombie
+            clk.t += lease_a.ttl_s + 1.0  # pauses through its TTL
+
+            lease_b = FrontendLease(ep, ttl_s=30.0, holder="b",
+                                    clock=clk, seed=0)
+            tracer_b = Tracer(clock=clk, proc="fe-b")
+            fe_b = StandbyFrontend(
+                lease_b, jpath, lambda: [make_engine(model)],
+                frontend_kwargs={"clock": clk,
+                                 "tracer": tracer_b}).poll()
+            assert fe_b is not None and fe_b.epoch == 2
+            # the takeover is a process event in the successor's ring
+            tk = [e for e in tracer_b.recorder.snapshot()
+                  if e["event"] == "takeover"]
+            assert tk and tk[0]["attrs"] == {"epoch": 2, "failover": True}
+            # same deterministic trace id across incarnations
+            tid = TraceContext.mint(rid).trace_id
+            assert fe_b._requests[rid].trace.trace_id == tid
+            assert fe_b.submit([3, 17, 101], max_new_tokens=6,
+                               idempotency_key="k") == rid
+            res = fe_b.run()
+            assert res[rid].status is RequestStatus.COMPLETED
+            ok, why = tree_complete(tracer_b.tree_for(tid))
+            assert ok, why
+        finally:
+            srv.stop()
+
+
+class TestZeroCostDisabled:
+    def test_untraced_frontend_and_engine_record_nothing(self, model):
+        eng = make_engine(model)
+        fe = ServingFrontend([eng])
+        rid = fe.submit([3, 17, 101], max_new_tokens=4)
+        res = fe.run()
+        assert res[rid].ok
+        assert fe.tracer is None
+        assert fe._requests[rid].trace is None
+        assert eng.pop_trace_events() == []

@@ -203,6 +203,9 @@ def run_chaos(seed=0, replicas=3, num_requests=18, max_request_retries=2,
         ServingFrontend,
     )
     from paddle_tpu.inference.faults import FaultyReplica
+    from paddle_tpu.inference.tracing import (FlightRecorder, TraceContext,
+                                              Tracer, events_digest,
+                                              tree_complete)
 
     model = _build_model()
     reqs = _request_stream(seed, num_requests, poison)
@@ -227,6 +230,15 @@ def run_chaos(seed=0, replicas=3, num_requests=18, max_request_retries=2,
     # engine pool: respawns recycle a dead replica's engine (a restarted
     # worker rebuilds the same engine; recycling skips the recompile)
     spares = []
+    step_i = 0
+
+    def tclock():
+        # the soak's only clock: STEP counts — every trace timestamp
+        # replays bit-identically under the same (seed, config)
+        return float(step_i)
+
+    tracer = Tracer(clock=tclock, proc="frontend")
+    inj.recorder = tracer.recorder   # fault fires land in the dumps too
 
     def wrap(engine, name):
         return FaultyReplica(engine, inj, name=name, timeout_exc=RpcTimeout)
@@ -236,9 +248,13 @@ def run_chaos(seed=0, replicas=3, num_requests=18, max_request_retries=2,
     # megastep launch, covering the batched K-token decode path), which
     # the FaultyReplica proxy cannot see from outside
     fe = ServingFrontend(
-        [wrap(ServingEngine(model, fault_injector=inj, **ENGINE), f"r{i}")
+        [wrap(ServingEngine(model, fault_injector=inj,
+                            trace_recorder=FlightRecorder(clock=tclock,
+                                                          proc=f"r{i}"),
+                            clock=tclock, **ENGINE), f"r{i}")
          for i in range(replicas)],
         max_request_retries=max_request_retries,
+        tracer=tracer,
         # sensitive thresholds: the 2-requests-per-step trickle over 3
         # replicas must be able to cross them while replicas are dying,
         # or the soak never exercises degradation
@@ -246,11 +262,11 @@ def run_chaos(seed=0, replicas=3, num_requests=18, max_request_retries=2,
                                 enter_after=2, exit_after=3,
                                 normal_max_new_tokens=6)
         if brownout else None)
-    step_i = 0
     breaker = RespawnCircuitBreaker(threshold=3, window_s=40.0,
                                     base_backoff_s=4.0, max_backoff_s=64.0,
                                     jitter=0.25, seed=seed,
                                     clock=lambda: float(step_i))
+    breaker.recorder = tracer.recorder
     born_at = {id(rep): 0 for rep in fe.replicas}
     next_name = replicas
     respawns = early_deaths = deaths = 0
@@ -297,6 +313,11 @@ def run_chaos(seed=0, replicas=3, num_requests=18, max_request_retries=2,
             next_name += 1
             respawns += 1
 
+    # dead-and-never-respawned engines may still hold undrained worker
+    # spans (live replicas were drained inside every fe.step())
+    for eng in spares:
+        tracer.absorb(eng.pop_trace_events())
+
     # ---- containment contract
     res = fe.results()
     assert len(res) == len(rids) and not fe.pending, (
@@ -336,6 +357,21 @@ def run_chaos(seed=0, replicas=3, num_requests=18, max_request_retries=2,
         if pr.status is RequestStatus.FAILED_POISON:
             assert pr.attempts == max_request_retries + 1
 
+    # ---- span-tree contract (ISSUE 15): every typed terminal owns a
+    # complete, orphan-free tree, and the soak as a whole produced
+    # fleet-wide trees (frontend + at least one engine proc) — a run
+    # where no worker span ever shipped back would pass completeness
+    # trivially and must not count as coverage
+    fleet_wide = 0
+    for rid in rids:
+        tree = tracer.tree_for(TraceContext.mint(rid).trace_id)
+        ok, why = tree_complete(tree)
+        assert ok, f"rid {rid} span tree incomplete: {why}"
+        tree_procs = {e["proc"] for evs in tree.values() for e in evs}
+        if len(tree_procs) > 1:
+            fleet_wide += 1
+    assert fleet_wide >= 1, "no span tree crossed frontend -> engine"
+
     m = fe.metrics
     return {
         "mode": "in-process",
@@ -357,6 +393,14 @@ def run_chaos(seed=0, replicas=3, num_requests=18, max_request_retries=2,
         "brownout_transitions": m.counter("brownout_transitions_total"),
         "shed_brownout": m.counter("shed_brownout_total"),
         "survivors_token_identical": True,
+        # trace fields are wall-clock-free (counter-clocked timestamps;
+        # the digest excludes t/seq anyway) — the same-seed full-report
+        # equality gates therefore cover tracing too
+        "trace_events": len(tracer.all_events()),
+        "trace_trees_complete": len(rids),
+        "trace_fleet_wide": fleet_wide,
+        "trace_captures": len(tracer.captures),
+        "trace_digest": events_digest(tracer.all_events()),
     }
 
 
@@ -674,6 +718,9 @@ def run_standby(seed=0, num_requests=14, pause_after=4, max_steps=3000,
     )
     from paddle_tpu.inference.ha import (EpochFence, FencedEngine,
                                          FrontendLease, StandbyFrontend)
+    from paddle_tpu.inference.tracing import (FlightRecorder, TraceContext,
+                                              Tracer, events_digest,
+                                              tree_complete)
 
     model = _build_model()
     reqs = _kill_request_stream(seed, num_requests)
@@ -688,8 +735,13 @@ def run_standby(seed=0, num_requests=14, pause_after=4, max_steps=3000,
     def clock():
         return t[0]
 
-    engines = [_CountingEngine(ServingEngine(model, **ENGINE))
-               for _ in range(2)]
+    # engines carry their own flight recorders (shared across both
+    # incarnations, like the engines themselves): spans recorded while
+    # the active drives drain to the active, post-takeover ones to the
+    # successor — both on the injected counter clock
+    engines = [_CountingEngine(ServingEngine(
+        model, trace_recorder=FlightRecorder(clock=clock, proc=f"r{i}"),
+        clock=clock, **ENGINE)) for i in range(2)]
     fences = [EpochFence() for _ in engines]
 
     def wrap():
@@ -706,7 +758,8 @@ def run_standby(seed=0, num_requests=14, pause_after=4, max_steps=3000,
         assert lease_a.acquire() == 1
         fe_a = ServingFrontend(
             wrap(), journal=RequestJournal(jpath, fsync=False),
-            epoch=lease_a.epoch, clock=clock)
+            epoch=lease_a.epoch, clock=clock,
+            tracer=Tracer(clock=clock, proc="frontend-a"))
         rids = [fe_a.submit(p, max_new_tokens=m, priority=pr,
                             idempotency_key=f"req-{i}", **sk)
                 for i, (p, m, pr, sk) in enumerate(reqs)]
@@ -729,8 +782,11 @@ def run_standby(seed=0, num_requests=14, pause_after=4, max_steps=3000,
         t[0] += lease_a.ttl_s + 1.0
         lease_b = FrontendLease(ep, ttl_s=30.0, holder="frontend-b",
                                 clock=clock, seed=seed)
-        standby = StandbyFrontend(lease_b, jpath, wrap,
-                                  frontend_kwargs={"clock": clock})
+        standby = StandbyFrontend(
+            lease_b, jpath, wrap,
+            frontend_kwargs={"clock": clock,
+                             "tracer": Tracer(clock=clock,
+                                              proc="frontend-b")})
         fe_b = standby.poll()
         assert fe_b is not None and fe_b.epoch == 2, fe_b
         assert fe_b.metrics.counter("standby_takeovers_total") == 1
@@ -795,6 +851,23 @@ def run_standby(seed=0, num_requests=14, pause_after=4, max_steps=3000,
                 mismatched.append(rid)
         assert not mismatched, (
             f"survivors diverged from crash-free run: {mismatched}")
+
+        # ---- span-tree contract (ISSUE 15): the SUCCESSOR owns a
+        # complete tree for every admit.  Recovered traces keep the
+        # journaled trace id (deterministically minted from the rid),
+        # so pre-pause engine spans that drained after takeover attach
+        # to the same tree even though frontend-a's recorder died with
+        # its incarnation
+        fleet_wide = 0
+        for rid in rids:
+            tree = fe_b.tracer.tree_for(TraceContext.mint(rid).trace_id)
+            ok, why = tree_complete(tree)
+            assert ok, f"rid {rid} post-takeover tree incomplete: {why}"
+            tree_procs = {e["proc"]
+                          for evs in tree.values() for e in evs}
+            if len(tree_procs) > 1:
+                fleet_wide += 1
+        assert fleet_wide >= 1, "no successor tree crossed into an engine"
 
         # ---- handoff leg: clean early release, zero dropped admits,
         # no StaleEpoch anywhere
@@ -872,6 +945,12 @@ def run_standby(seed=0, num_requests=14, pause_after=4, max_steps=3000,
         "handoff_fenced_rpcs": 0,
         "survivors_token_identical": True,
         "exactly_one_terminal_per_admit": True,
+        # counter-clocked + digest excludes t/seq: the standby replay
+        # equality gate covers tracing too
+        "trace_events": len(fe_b.tracer.all_events()),
+        "trace_trees_complete": len(rids),
+        "trace_fleet_wide": fleet_wide,
+        "trace_digest": events_digest(fe_b.tracer.all_events()),
     }
 
 

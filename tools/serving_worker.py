@@ -111,6 +111,15 @@ def main(argv=None):
                 if faults else None)
     engine = ServingEngine(model, fault_injector=injector,
                            **spec.get("engine", {}))
+    # tracing (ISSUE 15): {"tracing": true} in the spec arms a per-worker
+    # flight recorder; the engine's span events (prefill done, megastep
+    # boundaries) ship back on every _w_step reply / _w_pop_traces RPC
+    if spec.get("tracing"):
+        from paddle_tpu.inference.tracing import FlightRecorder
+
+        engine.trace_recorder = FlightRecorder(proc=args.name)
+        if injector is not None:
+            injector.recorder = engine.trace_recorder
 
     stop = fleet.init_worker(engine, name=args.name, fault_injector=injector)
     for sig in (signal.SIGTERM, signal.SIGINT):

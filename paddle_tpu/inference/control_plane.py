@@ -14,14 +14,22 @@ stay pure execution loops driven via ``ServingEngine.step()``:
 * **Deadlines & cancellation** — queued requests past deadline are shed
   (``DEADLINE_EXCEEDED``); running ones are evicted mid-generation and
   return their partial tokens.  ``cancel(rid)`` works in both states.
-  MEGASTEP BOUNDARY SEMANTICS (ISSUE 9): the engines decode up to
-  ``megastep_k`` (K) tokens per compiled step, and the frontend's
-  deadline/cancel checks run between steps — so a running request can
-  generate at most K-1 tokens past its deadline (it was under deadline
-  when the megastep launched and the first in-scan token was due) before
-  the next boundary sheds it.  The shed result still carries every token
-  generated, so the overshoot is extra work, never wrong output; size
-  the engines' ``megastep_k`` (default 8) against the tightest SLO.
+  MEGASTEP BOUNDARY SEMANTICS (ISSUE 9, tightened by ISSUE 16): the
+  engines decode up to ``megastep_k`` (K) tokens per compiled step and
+  the frontend's deadline/cancel checks run between steps, but the
+  deadline no longer overshoots by up to K-1 tokens: at dispatch the
+  frontend forwards the REMAINING deadline (``deadline_s``) to the
+  engine, which converts it into a per-row iteration budget carried as
+  data through the scan and decremented in-graph — a row whose budget
+  hits zero freezes mid-scan and emits nothing further, so token
+  overshoot is ZERO once the engine has a per-iteration time estimate
+  (EWMA of measured megastep time, or an injected
+  ``deadline_token_seconds``).  The frontend's boundary check is still
+  what finalizes the typed ``DEADLINE_EXCEEDED`` shed, carrying every
+  token generated before the freeze.  Before the first measured
+  megastep the engine has no estimate and the old K-1 bound is the
+  worst case; cancellation (which has no in-graph analog) still
+  resolves at the next boundary.
 * **Sampling & streaming** — ``submit`` takes per-request
   ``temperature``/``top_k``/``top_p``/``seed``/``logprobs`` (defaults =
   exact greedy argmax; see ``serving.SamplingParams``) and forwards them
@@ -359,7 +367,9 @@ class _Replica:
         # counts monotonically; the frontend incs the deltas so the
         # registry counter survives replica death/removal)
         self.prefix_seen = (0, 0, 0)  # (hit_blocks, miss_blocks, evictions)
-        self.mega_seen = (0, 0)       # (megasteps, megastep tokens)
+        # (megasteps, megastep tokens, mixed launches, prefill chunks) —
+        # the MEGASTEP_COUNTERS wire order
+        self.mega_seen = (0, 0, 0, 0)
 
 
 def _blocks_needed(engine: ServingEngine, total_tokens: int) -> int:
@@ -1668,6 +1678,12 @@ class ServingFrontend:
             # sampling params travel as the dict wire form (RemoteReplica
             # ships them over RPC verbatim); sample_offset continues the
             # seeded key stream where a preempted/failed-over run stopped
+            if req.deadline_t is not None:
+                # forward the REMAINING deadline so the engine can freeze
+                # the row in-graph at its budget (ISSUE 16) — relative
+                # seconds, same wire form the journal uses, because the
+                # engine keeps its own clock
+                extra["deadline_s"] = req.deadline_t - self._clock()
             erid = rep.engine.add_request(
                 prefill, max_new_tokens=req.remaining_new_tokens,
                 eos_token_id=req.eos_token_id,
@@ -1915,6 +1931,8 @@ class ServingFrontend:
                    int(getattr(eng, "prefix_evictions", 0)))
             rep.prefix_seen = fold_prefix_counters(m, cur, rep.prefix_seen)
             mcur = (int(getattr(eng, "megasteps", 0)),
-                    int(getattr(eng, "megastep_tokens", 0)))
+                    int(getattr(eng, "megastep_tokens", 0)),
+                    int(getattr(eng, "megasteps_mixed", 0)),
+                    int(getattr(eng, "prefill_chunks", 0)))
             rep.mega_seen = fold_counter_deltas(m, MEGASTEP_COUNTERS, mcur,
                                                 rep.mega_seen)

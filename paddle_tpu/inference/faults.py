@@ -66,6 +66,10 @@ FAULTS_ENV_VAR = "PADDLE_TPU_FAULTS"
 KNOWN_SITES = {
     "engine.step",        # ServingEngine.step scheduling boundary
     "engine.megastep",    # batched K-token decode launch
+    "engine.prefill_chunk",  # prompt-chunk feed boundary (ISSUE 16):
+    #                       fired per chunk the scheduler commits —
+    #                       single-step prefill feeds AND rows packed
+    #                       into a mixed-phase megastep launch
     "engine.add_request",  # FaultyReplica admission path
     "engine.evict",       # FaultyReplica eviction path
     "rpc.send",           # distributed/rpc._post transport

@@ -250,7 +250,7 @@ class _BoundedErrors(OrderedDict):
 # --------------------------------------------------------------------------
 _WORKER: Dict[str, Any] = {
     "engine": None, "metrics": None, "stop": None, "name": None,
-    "prefix_seen": (0, 0, 0), "mega_seen": (0, 0), "faults": None,
+    "prefix_seen": (0, 0, 0), "mega_seen": (0, 0, 0, 0), "faults": None,
     "fence": EpochFence(),
 }
 
@@ -282,7 +282,7 @@ def init_worker(engine, name: str,
     _WORKER["stop"] = stop if stop is not None else threading.Event()
     _WORKER["name"] = name
     _WORKER["prefix_seen"] = (0, 0, 0)
-    _WORKER["mega_seen"] = (0, 0)
+    _WORKER["mega_seen"] = (0, 0, 0, 0)
     _WORKER["faults"] = (fault_injector if fault_injector is not None
                          else FaultInjector.from_env())
     _WORKER["fence"] = EpochFence()
@@ -323,15 +323,20 @@ def _w_config() -> Dict:
 
 
 def _w_add_request(prompt, max_new_tokens, eos_token_id=None,
-                   sampling=None, sample_offset=0, epoch=None, trace=None):
+                   sampling=None, sample_offset=0, epoch=None, trace=None,
+                   deadline_s=None):
     _fence(epoch, "add_request")
     eng = _engine()
     # the trace wire context rides the RPC like epoch= (ISSUE 15): the
     # worker engine records its span events against the frontend's
-    # attempt span, shipped back on the _w_step reply
+    # attempt span, shipped back on the _w_step reply.  deadline_s is the
+    # REMAINING deadline in seconds (relative, like the journal wire
+    # form): the worker engine re-anchors it on its own clock and
+    # freezes the row in-graph at the budget (ISSUE 16)
     rid = eng.add_request(prompt, max_new_tokens=max_new_tokens,
                           eos_token_id=eos_token_id, sampling=sampling,
-                          sample_offset=sample_offset, trace=trace)
+                          sample_offset=sample_offset, trace=trace,
+                          deadline_s=deadline_s)
     return rid, eng.state_summary()
 
 
@@ -374,7 +379,8 @@ def _w_step(epoch=None):
     _WORKER["prefix_seen"] = fold_prefix_counters(m, cur,
                                                   _WORKER["prefix_seen"])
     ms = st.get("megastep") or {}
-    mcur = (int(ms.get("megasteps", 0)), int(ms.get("tokens", 0)))
+    mcur = (int(ms.get("megasteps", 0)), int(ms.get("tokens", 0)),
+            int(ms.get("mixed", 0)), int(ms.get("prefill_chunks", 0)))
     _WORKER["mega_seen"] = fold_counter_deltas(m, MEGASTEP_COUNTERS, mcur,
                                                _WORKER["mega_seen"])
     m.inc("completed_total", len(finished))
@@ -585,6 +591,8 @@ class RemoteReplica:
         self.megastep_k = int(ms.get("k", 1))
         self.megasteps = int(ms.get("megasteps", 0))
         self.megastep_tokens = int(ms.get("tokens", 0))
+        self.megasteps_mixed = int(ms.get("mixed", 0))
+        self.prefill_chunks = int(ms.get("prefill_chunks", 0))
         # per-phase step-time mirror (the worker sets the gauges in its
         # own registry too; the frontend sums mirrors like the block
         # counts above)
@@ -603,14 +611,16 @@ class RemoteReplica:
     def add_request(self, prompt_ids, max_new_tokens: int = 32,
                     eos_token_id: Optional[int] = None,
                     sampling=None, sample_offset: int = 0,
-                    trace: Optional[Dict] = None) -> int:
+                    trace: Optional[Dict] = None,
+                    deadline_s: Optional[float] = None) -> int:
         prompt = [int(t) for t in prompt_ids]
         if sampling is not None and not isinstance(sampling, dict):
             # ship the dict wire form (no class pickling across versions)
             sampling = sampling.to_wire()
         rid, st = self._call(_w_add_request, prompt, int(max_new_tokens),
                              eos_token_id, sampling, int(sample_offset),
-                             epoch=self._epoch, trace=trace)
+                             epoch=self._epoch, trace=trace,
+                             deadline_s=deadline_s)
         self._apply_state(st)
         return rid
 

@@ -54,6 +54,11 @@ COUNTERS = (
     # tokens they emitted (megastep_tokens/megasteps ~ the realized K),
     # plus streaming-callback faults the step loop absorbed
     "megasteps_total", "megastep_tokens_total",
+    # mixed-phase megastep (ISSUE 16): scan launches that packed prefill
+    # chunks alongside decode rows, and every prompt chunk fed (both the
+    # in-scan chunks and single-step prefill feeds — the ratio
+    # prefill_chunks/megastep_mixed shows how much prefill rides the scan)
+    "megastep_mixed_total", "prefill_chunks_total",
     "stream_callback_errors_total",
     # durable control plane (ISSUE 11): write-ahead request journal,
     # crash recovery, idempotent submission
@@ -95,9 +100,12 @@ SAMPLES = ("ttft_seconds", "token_latency_seconds", "e2e_latency_seconds")
 # expects its (hit_blocks, miss_blocks, evictions) tuples
 PREFIX_COUNTERS = ("prefix_hit_blocks_total", "prefix_miss_blocks_total",
                    "prefix_evictions_total")
-# engine-level megastep counters, in the order their (megasteps, tokens)
-# fold tuples are built (control_plane gauge sampler / fleet _w_step)
-MEGASTEP_COUNTERS = ("megasteps_total", "megastep_tokens_total")
+# engine-level megastep counters, in the order their (megasteps, tokens,
+# mixed, prefill_chunks) fold tuples are built (control_plane gauge
+# sampler / fleet _w_step) — extend at the END only: the tuple order IS
+# the wire order of every mirrored ``mega_seen`` fold tuple
+MEGASTEP_COUNTERS = ("megasteps_total", "megastep_tokens_total",
+                     "megastep_mixed_total", "prefill_chunks_total")
 
 
 def fold_counter_deltas(metrics: "ServingMetrics", names, cur, seen):

@@ -18,19 +18,32 @@ Design:
   argmax path, so greedy serving is bit-identical to the pre-sampling
   engine.  The host reads back [B] next-token ids per step (one small
   transfer, the same shape every step).
-- MEGASTEP decode (ISSUE 9): once every active sequence is past prefill,
-  ``step()`` runs K decode iterations inside ONE compiled ``lax.scan``
-  instead of K host round trips — the host syncs only at megastep
-  boundaries (finish / chunk / admission).  Rows that finish mid-scan
-  (EOS or token budget) are masked: their carry freezes, so remaining
-  iterations rewrite the same KV bits and their sampled tokens are
-  dropped on the host.  K rounds up to a power of two (bounded compile
-  count) capped at ``megastep_k``; ``megastep_k=1`` restores per-token
-  stepping, and the int8 KV cache keeps the single-step path (its scale
-  threading predates the scan).  Consequence for callers: admission and
-  any host-side control (deadlines, cancellation — control_plane.py)
-  observe the engine only at megastep boundaries, so a request can run
-  up to K-1 tokens past such an event before the host sees it.
+- MEGASTEP decode (ISSUE 9, mixed-phase since ISSUE 16): ``step()`` runs
+  K iterations inside ONE compiled ``lax.scan`` instead of K host round
+  trips — the host syncs only at megastep boundaries (finish / admission).
+  ARMING RULE: the scan arms whenever any scheduled row is DECODING
+  (``megastep_k > 1``).  A pure-decode batch runs the tight [B]-token
+  scan (mq=1); a batch mixing decode rows with prefilling rows runs the
+  MIXED scan (mq=block_size): each iteration processes, per row, either
+  one decode token or one block-size prompt chunk — prompt chunks are fed
+  as data through a ``prefill_pos`` carry against a host-staged prompt
+  window, so chunked prefill adds no shape axis and no recompile.  Under
+  open-loop admission the megastep therefore never disarms just because
+  some row is still prefilling (Sarathi/vLLM-style stall-free chunked
+  prefill).  Rows that finish mid-scan (EOS or token budget) are masked:
+  their carry freezes and their sampled tokens are dropped on the host.
+  K rounds up to a power of two (bounded compile count) capped at
+  ``megastep_k``; ``megastep_k=1`` restores per-token stepping.  The
+  int8 KV cache rides the pure-decode scan too (its per-(slot, kv-head)
+  scales travel in the scan carry; enc=0 rows pass them through
+  untouched) — only its one-shot PREFILL keeps the single-step path,
+  because dynamic scales freeze at prefill.  Per-row DEADLINE budgets
+  ride the carry as data (iterations, not wall clock — compiled bodies
+  never read a clock): a row whose budget hits zero freezes in-graph,
+  so deadline overshoot inside a megastep is ZERO tokens once a
+  per-iteration time estimate exists (``deadline_token_seconds`` or the
+  engine's measured EWMA); the host-side typed shed stays the
+  control plane's job at harvest (control_plane.py).
 - This is the vLLM-style schedule expressed the XLA way: static shapes +
   dynamic lengths as data, not as shapes.
 - Automatic prefix caching (on by default, ``prefix_cache="auto"``):
@@ -396,12 +409,17 @@ class ServingRequest:
     # stamped by the frontend (rid = the FRONTEND rid); engine lifecycle
     # events (prefill done, megastep boundaries) are recorded under it
     trace: Optional[Dict] = None
+    # absolute engine-clock deadline (None = no deadline): set from the
+    # ``deadline_s`` admission kwarg; megastep launches convert it into
+    # an in-graph iteration budget (see _deadline_budgets)
+    deadline_t: Optional[float] = None
     # runtime state
     generated: List[int] = field(default_factory=list)
     logprob_values: List[float] = field(default_factory=list)
     blocks: List[int] = field(default_factory=list)
     prefill_pos: int = 0          # prompt tokens already cached
     cached_prefix_tokens: int = 0  # of those, tokens REUSED from the cache
+    chunks_fed: int = 0           # prompt chunks fed so far (trace index)
     slot: int = -1                # batch row while active
     done: bool = False
 
@@ -412,6 +430,17 @@ class ServingRequest:
     @property
     def context_len(self) -> int:
         return self.prefill_pos + len(self.generated)
+
+
+# Process-wide cache of compiled serving programs, keyed by the static
+# configuration the _build_* closures bake into the trace (model dims +
+# engine geometry + quant/capture flags).  Weights, caches and rope are
+# call ARGUMENTS — the trace never bakes their values, and jax.jit
+# already re-specializes on argument shapes/dtypes/pytree structure —
+# so every engine built with the same geometry shares one jitted
+# program AND its XLA compile cache.  N engines over one model costs
+# one set of multi-second compiles instead of N.
+_PROGRAM_CACHE: Dict[tuple, dict] = {}
 
 
 class ServingEngine:
@@ -429,6 +458,7 @@ class ServingEngine:
                  megastep_k: int = 8, fault_injector=None,
                  capture_sample_probs: bool = False,
                  trace_recorder=None,
+                 deadline_token_seconds: Optional[float] = None,
                  clock: Callable[[], float] = time.monotonic):
         from .faults import FaultInjector
 
@@ -514,15 +544,28 @@ class ServingEngine:
         self._emitted_sample_probs: Dict[int, List[np.ndarray]] = {}
         self._next_rid = 0
         self._free_slots = list(range(self.B - 1, -1, -1))
-        # megastep decode: K compiled decode iterations per host round
-        # trip once every active row is past prefill (1 = per-token
-        # stepping; int8 KV-quant keeps the single-step path — its scale
-        # threading predates the scan)
+        # megastep decode: K compiled iterations per host round trip
+        # whenever any scheduled row is decoding (1 = per-token stepping);
+        # prefilling rows ride the same scan chunk-by-chunk (mixed phase),
+        # and int8 KV-quant rides the pure-decode scan with its scales in
+        # the carry
         if int(megastep_k) < 1:
             raise ValueError("megastep_k must be >= 1")
         self.megastep_k = int(megastep_k)
         self.megasteps = 0          # megastep program launches (monotone)
         self.megastep_tokens = 0    # tokens emitted via the megastep path
+        self.megasteps_mixed = 0    # of those launches, mixed-phase scans
+        self.prefill_chunks = 0     # prompt chunks fed inside mixed scans
+        # in-graph deadline budgets: seconds one scan iteration costs.
+        # An explicit deadline_token_seconds pins it (tests, or operators
+        # who measured their hardware); None lets the engine learn an
+        # EWMA from measured megastep execute time.  Until some estimate
+        # exists, deadline rows fall back to the K-1 boundary bound.
+        if deadline_token_seconds is not None and deadline_token_seconds <= 0:
+            raise ValueError("deadline_token_seconds must be > 0")
+        self._tau_override = deadline_token_seconds is not None
+        self._tau = (float(deadline_token_seconds)
+                     if deadline_token_seconds is not None else None)
         # per-request tracing (ISSUE 15): an optional FlightRecorder ring.
         # None (the default) keeps every hook at a single attribute test —
         # same zero-cost pattern as self._faults above.
@@ -533,11 +576,30 @@ class ServingEngine:
         # harvest = token/unblocking bookkeeping); surfaced via
         # state_summary() for megastep cost attribution
         self.phase_seconds = {"schedule": 0.0, "execute": 0.0, "harvest": 0.0}
-        self._forward = self._build_forward()
-        self._step_fn = self._build_step()
-        self._mega_fn = None  # lazy: compiled lax.scan megastep program
-        self._cow_fn = None   # lazy: compiled block-copy for COW forks
+        # Programs are shared process-wide across engines with identical
+        # trace-shaping config (see _PROGRAM_CACHE): a fresh engine over
+        # an already-served geometry starts with warm compile caches.
+        self._programs = _PROGRAM_CACHE.setdefault(self._program_key(), {})
+        if "forward" not in self._programs:
+            self._programs["forward"] = self._build_forward()
+        self._forward = self._programs["forward"]
+        if "step" not in self._programs:
+            self._programs["step"] = self._build_step()
+        self._step_fn = self._programs["step"]
+        self._mega_fn = self._programs.get("mega")    # lazy: pure-decode scan
+        self._mixed_fn = self._programs.get("mixed")  # lazy: mixed-phase scan
+        self._cow_fn = self._programs.get("cow")      # lazy: COW block copy
         self.compile_count = 0
+
+    def _program_key(self) -> tuple:
+        """Everything the compiled-program closures capture that shapes
+        the trace.  Model identity is deliberately NOT part of the key:
+        weights/caches/rope enter as arguments, so jit keys their
+        shapes/dtypes (and the layer count, via pytree structure)
+        itself — two models with the same architecture share programs."""
+        return (self.B, self.T, self.bs, self.H, self.KV, self.D, self.E,
+                float(self.cfg.rms_norm_eps), self.cache_quant,
+                bool(self.capture_sample_probs))
 
     # ------------------------------------------------------------ weights
     def _extract_weights(self, model):
@@ -662,61 +724,188 @@ class ServingEngine:
 
     def _build_megastep(self):
         """K decode iterations inside one compiled ``lax.scan``: the
-        megastep program.  Per-row masking implements early exit — a row
-        whose sequence finishes (EOS / budget) freezes its carry (token,
-        cache position, sample index), so every later iteration re-feeds
-        the same token at the same position and rewrites the SAME KV
-        bits (deterministic fn of token, position, weights), while its
-        sampled outputs are marked invalid and dropped on the host.
-        Rows with ``now=0`` (empty batch slots) never write at all."""
+        pure-decode megastep program.  Per-row masking implements early
+        exit — a row whose sequence finishes (EOS / budget) freezes its
+        carry (token, cache position, sample index), so every later
+        iteration re-feeds the same token at the same position and
+        rewrites the SAME KV bits (deterministic fn of token, position,
+        weights), while its sampled outputs are marked invalid and
+        dropped on the host.  Rows with ``now=0`` (empty batch slots)
+        never write at all.  Two ISSUE 16 carry threads: ``dl`` is the
+        per-row deadline budget in ITERATIONS (a row freezes the moment
+        it hits 0 — zero-token overshoot, checked in-graph as data, no
+        clock in the compiled body), and ``scales`` carries the int8
+        KV-quant per-(slot, kv-head) scale pytree — enc=0 decode rows
+        pass the values through blha untouched, but quantize writes /
+        dequantize reads with them, so ``cache_quant='int8'`` rides the
+        same scan instead of keeping a per-token path."""
         fwd = self._forward
         B = self.B
         with_probs = self.capture_sample_probs
 
         def mega(weights, key_caches, value_caches, rope, toks, dec, now,
-                 cu, occ_idx, bt, active, remaining, eos, temps, top_ks,
-                 top_ps, seeds, sample_pos, K):
+                 cu, occ_idx, bt, active, remaining, dl, eos, temps,
+                 top_ks, top_ps, seeds, sample_pos, scales, K):
             enc = jnp.zeros((B,), jnp.int32)
 
             def body(carry, _):
-                toks, kcs, vcs, dec, active, remaining, sample_pos = carry
+                (toks, kcs, vcs, dec, active, remaining, sample_pos, dl,
+                 scales) = carry
                 packed = toks[occ_idx]    # slot-order -> packed layout
-                logits, kcs, vcs, _ = fwd(weights, kcs, vcs, rope, packed,
-                                          enc, dec, now, cu, bt, 1, None)
+                logits, kcs, vcs, ns = fwd(weights, kcs, vcs, rope, packed,
+                                           enc, dec, now, cu, bt, 1, scales)
+                scales = ns if scales is not None else None
                 nxt, lps, probs = _sample_tokens(
                     logits, temps, top_ks, top_ps, seeds, sample_pos,
                     return_probs=with_probs)
-                valid = active
-                fin = (nxt == eos) | (remaining <= 1)
-                nxt_active = active & jnp.logical_not(fin)
-                # freeze finished rows: token/position/sample-index only
-                # advance while the row stays active
-                toks = jnp.where(nxt_active, nxt, toks)
-                dec = dec + nxt_active.astype(jnp.int32)
-                remaining = remaining - active.astype(jnp.int32)
-                sample_pos = sample_pos + active.astype(jnp.int32)
-                return ((toks, kcs, vcs, dec, nxt_active, remaining,
-                         sample_pos), (nxt, valid, lps, probs))
+                # a row is ALIVE while unfinished and inside its deadline
+                # budget; deadline-frozen rows stay active host-side (the
+                # control plane finalizes the typed shed at harvest) but
+                # emit nothing and advance nothing in-graph
+                alive = active & (dl > 0)
+                valid = alive
+                fin = alive & ((nxt == eos) | (remaining <= 1))
+                adv = alive & jnp.logical_not(fin)
+                # freeze finished/frozen rows: token/position/sample-index
+                # only advance while the row stays alive
+                toks = jnp.where(adv, nxt, toks)
+                dec = dec + adv.astype(jnp.int32)
+                remaining = remaining - alive.astype(jnp.int32)
+                sample_pos = sample_pos + alive.astype(jnp.int32)
+                dl = dl - alive.astype(jnp.int32)
+                active = active & jnp.logical_not(fin)
+                return ((toks, kcs, vcs, dec, active, remaining,
+                         sample_pos, dl, scales), (nxt, valid, lps, probs))
 
             carry0 = (toks, key_caches, value_caches, dec, active,
-                      remaining, sample_pos)
+                      remaining, sample_pos, dl, scales)
             carry, (toks_o, valid_o, lps_o, probs_o) = jax.lax.scan(
                 body, carry0, None, length=K)
-            return carry[1], carry[2], toks_o, valid_o, lps_o, probs_o
+            return (carry[1], carry[2], carry[8], toks_o, valid_o, lps_o,
+                    probs_o)
 
         return jax.jit(mega, static_argnames=("K",), donate_argnums=(1, 2))
+
+    def _build_mixed_megastep(self):
+        """K MIXED-PHASE iterations inside one compiled ``lax.scan``:
+        each iteration processes, per row, either ONE decode token or ONE
+        prompt chunk of up to ``block_size`` tokens — so the megastep
+        stays armed while prompts are still prefilling and open-loop
+        admission never degrades decode back to per-token host stepping.
+
+        Prompt chunks are pure data: the host stages a per-row prompt
+        window ``prompt_buf[b] = prompt[pp0_b : pp0_b + K*block_size]``
+        (zero-padded) and the scan slices the next chunk at offset
+        ``pp - pp0`` from the ``prefill_pos`` carry.  Each iteration the
+        per-row token counts are EXACT-packed into the [token_budget]
+        buffer with an in-graph cumsum + scatter, so the forward's
+        last-packed-token logits extraction (``cu[1:] - 1``) works
+        unchanged; the attention runs with ``mq=block_size``.  No shape
+        depends on which rows are prefilling — no recompile axes beyond
+        the existing static K.
+
+        Carry per row: next decode token, KV caches, ``cached`` (tokens
+        written to KV = the blha ``dec`` argument, identical bookkeeping
+        for both phases), ``pp`` (prefill position), active/remaining/
+        sample-index masks, and the ``dl`` deadline iteration budget
+        (same zero-overshoot freeze as the pure-decode scan — prefill
+        chunks burn budget too).  A row emits a token only on decode
+        iterations and on the iteration that FINISHES its prefill (the
+        chunk's last packed token produces the first sampled token).
+        int8 is excluded here by the scheduler: dynamic quant scales
+        freeze at one-shot prefill, which chunking would violate."""
+        fwd = self._forward
+        B, T, C = self.B, self.T, self.bs
+        with_probs = self.capture_sample_probs
+
+        def mixed(weights, key_caches, value_caches, rope, toks, cached,
+                  pp, pp0, plen, prompt_buf, bt, active, remaining, dl,
+                  eos, temps, top_ks, top_ps, seeds, sample_pos, K):
+            enc = jnp.zeros((B,), jnp.int32)
+
+            def chunk_at(row, start):
+                return jax.lax.dynamic_slice(row, (start,), (C,))
+
+            def body(carry, _):
+                (toks, kcs, vcs, cached, pp, active, remaining,
+                 sample_pos, dl) = carry
+                alive = active & (dl > 0)
+                prefilling = pp < plen
+                n_pre = jnp.minimum(plen - pp, C)
+                now_t = jnp.where(
+                    alive, jnp.where(prefilling, n_pre, 1), 0
+                ).astype(jnp.int32)
+                cu = jnp.concatenate(
+                    [jnp.zeros((1,), jnp.int32),
+                     jnp.cumsum(now_t).astype(jnp.int32)])
+                # per-row tokens this iteration [B, C]: the next prompt
+                # chunk for prefilling rows, the carried token at column
+                # 0 for decode rows
+                chunk = jax.vmap(chunk_at)(prompt_buf, pp - pp0)
+                dec_row = jnp.zeros((B, C), jnp.int32).at[:, 0].set(toks)
+                row_toks = jnp.where(prefilling[:, None], chunk, dec_row)
+                # exact-pack into the [T] buffer (scatter; OOB -> drop):
+                # slot b's tokens land at cu[b] .. cu[b]+now_t[b]-1, so
+                # the packed layout is identical to the single-step path
+                j = jnp.arange(C, dtype=jnp.int32)[None, :]
+                flat = jnp.where(j < now_t[:, None], cu[:-1][:, None] + j,
+                                 T)
+                buf = jnp.zeros((T,), jnp.int32).at[flat.reshape(-1)].set(
+                    row_toks.reshape(-1), mode="drop")
+                logits, kcs, vcs, _ = fwd(weights, kcs, vcs, rope, buf,
+                                          enc, cached, now_t, cu, bt, C,
+                                          None)
+                nxt, lps, probs = _sample_tokens(
+                    logits, temps, top_ks, top_ps, seeds, sample_pos,
+                    return_probs=with_probs)
+                # a row emits on decode iterations and on the iteration
+                # whose chunk finishes the prompt (its last packed token
+                # is the prompt's last token -> first sampled token)
+                finishing = prefilling & (pp + n_pre >= plen)
+                emits = alive & (jnp.logical_not(prefilling) | finishing)
+                fin = emits & ((nxt == eos) | (remaining <= 1))
+                adv = emits & jnp.logical_not(fin)
+                toks = jnp.where(adv, nxt, toks)
+                cached = cached + now_t
+                pp = pp + jnp.where(alive & prefilling, n_pre, 0)
+                remaining = remaining - emits.astype(jnp.int32)
+                sample_pos = sample_pos + emits.astype(jnp.int32)
+                dl = dl - alive.astype(jnp.int32)
+                active = active & jnp.logical_not(fin)
+                return ((toks, kcs, vcs, cached, pp, active, remaining,
+                         sample_pos, dl), (nxt, emits, lps, probs))
+
+            carry0 = (toks, key_caches, value_caches, cached, pp, active,
+                      remaining, sample_pos, dl)
+            carry, (toks_o, emits_o, lps_o, probs_o) = jax.lax.scan(
+                body, carry0, None, length=K)
+            return (carry[1], carry[2], carry[4], toks_o, emits_o, lps_o,
+                    probs_o)
+
+        return jax.jit(mixed, static_argnames=("K",),
+                       donate_argnums=(1, 2))
 
     # ------------------------------------------------------------- serving
     def add_request(self, prompt_ids, max_new_tokens: int = 32,
                     eos_token_id: Optional[int] = None,
                     sampling=None, sample_offset: int = 0,
-                    trace: Optional[Dict] = None) -> int:
+                    trace: Optional[Dict] = None,
+                    deadline_s: Optional[float] = None) -> int:
         """Queue one request.  ``sampling`` is a :class:`SamplingParams`
         (or its dict wire form; None = greedy argmax).  ``sample_offset``
         is the sample index of the first NEW token — a resumed request
         (prompt+generated re-prefilled after preemption/failover) passes
         the number of tokens already sampled so the seeded key stream
-        continues exactly where it stopped."""
+        continues exactly where it stopped.  ``deadline_s`` (seconds
+        from now, this engine's clock) arms the IN-GRAPH deadline
+        budget: megastep launches convert the remaining time into a scan
+        iteration budget and the row freezes in-graph the moment it is
+        spent — zero tokens of overshoot once a per-iteration estimate
+        exists.  The engine only ever FREEZES on deadline; the typed
+        shed (DEADLINE_EXCEEDED) stays the control plane's job — an
+        engine driven standalone with an expired deadline will hit
+        ``run()``'s max_steps loudly rather than silently dropping the
+        request."""
         prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -741,7 +930,9 @@ class ServingEngine:
             rid, prompt, max_new_tokens, eos_token_id,
             sampling=SamplingParams.coerce(sampling),
             sample_offset=int(sample_offset),
-            trace=dict(trace) if trace else None))
+            trace=dict(trace) if trace else None,
+            deadline_t=(self._clock() + float(deadline_s)
+                        if deadline_s is not None else None)))
         return rid
 
     def _match_cached_prefix(self, prompt: List[int]):
@@ -764,12 +955,14 @@ class ServingEngine:
         cache (the copy-on-write fork: the writer gets a private copy, the
         shared original stays read-only for its other owners)."""
         if self._cow_fn is None:
-            def cow(kcs, vcs, s, d):
-                kcs = [kc.at[d].set(kc[s]) for kc in kcs]
-                vcs = [vc.at[d].set(vc[s]) for vc in vcs]
-                return kcs, vcs
-            # s/d are data, not static: one compiled copy program total
-            self._cow_fn = jax.jit(cow, donate_argnums=(0, 1))
+            if "cow" not in self._programs:
+                def cow(kcs, vcs, s, d):
+                    kcs = [kc.at[d].set(kc[s]) for kc in kcs]
+                    vcs = [vc.at[d].set(vc[s]) for vc in vcs]
+                    return kcs, vcs
+                # s/d are data, not static: one compiled copy program total
+                self._programs["cow"] = jax.jit(cow, donate_argnums=(0, 1))
+            self._cow_fn = self._programs["cow"]
         self.key_caches, self.value_caches = self._cow_fn(
             self.key_caches, self.value_caches,
             jnp.asarray(src, jnp.int32), jnp.asarray(dst, jnp.int32))
@@ -913,6 +1106,8 @@ class ServingEngine:
                 "k": self.megastep_k,
                 "megasteps": self.megasteps,
                 "tokens": self.megastep_tokens,
+                "mixed": self.megasteps_mixed,
+                "prefill_chunks": self.prefill_chunks,
             },
             # cumulative host seconds per step phase — megastep cost
             # attribution without a profiler (ISSUE 15 satellite)
@@ -999,12 +1194,16 @@ class ServingEngine:
         """One engine iteration: schedule -> compiled step(s) -> retire.
         Returns tokens appended this step, {rid: [tok, ...]}.
 
-        Steps carrying prefill chunks run the single-step program (one
-        token per sequence emitted at most).  Once every scheduled row is
-        decoding, up to ``megastep_k`` decode iterations run inside ONE
-        compiled ``lax.scan`` (the megastep), so the returned lists carry
-        up to K tokens per request and the host — admission included —
-        only observes the engine at megastep boundaries."""
+        ARMING: whenever any scheduled row is decoding (and
+        ``megastep_k > 1``), up to ``megastep_k`` iterations run inside
+        ONE compiled ``lax.scan`` — the pure-decode scan when every row
+        is decoding (int8 included; its scales ride the carry), the
+        MIXED scan when prefilling rows share the batch (each iteration
+        feeds those rows one block-size prompt chunk as data).  The
+        returned lists then carry up to K tokens per request and the
+        host — admission included — only observes the engine at megastep
+        boundaries.  Prefill-only batches (plus int8 one-shot prefill
+        and ``megastep_k=1``) run the single-step program."""
         t0 = self._clock()
         self._try_admit()
         if not self._active:
@@ -1043,6 +1242,13 @@ class ServingEngine:
                 n = min(need, budget)
                 sched.append((req, n, req.prefill_pos + n >= len(req.prompt)))
                 budget -= n
+                if self._faults is not None:
+                    from .faults import prompt_signature
+
+                    # chunk-boundary failpoint, single-step path: fires
+                    # before any device mutation, once per prompt chunk
+                    self._faults.fire("engine.prefill_chunk",
+                                      detail=prompt_signature(req.prompt))
         if not sched:
             self.phase_seconds["schedule"] += self._clock() - t0
             return {}
@@ -1051,11 +1257,32 @@ class ServingEngine:
         # first, allocate the one token buffer the program actually takes
         decode_only = all(not r.in_prefill for r, _, _ in sched)
         if (decode_only and self.megastep_k > 1
-                and self.cache_quant != "int8"
                 and max(r.max_new_tokens - len(r.generated)
                         for r, _, _ in sched) > 1):
             self.phase_seconds["schedule"] += self._clock() - t0
             return self._megastep([s[0] for s in sched])
+        # MIXED-PHASE arming (ISSUE 16): any decoding row + any prefilling
+        # row -> run both phases inside one scan instead of falling back
+        # to per-token host stepping.  int8 keeps one-shot prefill
+        # (dynamic scales freeze at prefill, chunking would violate it);
+        # bs > T cannot exact-pack a full chunk into the token buffer.
+        if (self.megastep_k > 1 and self.cache_quant != "int8"
+                and self.bs <= self.T and not decode_only
+                and any(not r.in_prefill for r, _, _ in sched)):
+            dec_rows = [r for r, _, _ in sched if not r.in_prefill]
+            pre_rows = []
+            budget_m = self.T - len(dec_rows)
+            for r, _, _ in sched:
+                if r.in_prefill:
+                    # worst-case packed tokens this row adds to any one
+                    # iteration: its first chunk (chunks only shrink)
+                    cost = min(self.bs, len(r.prompt) - r.prefill_pos)
+                    if cost <= budget_m:
+                        pre_rows.append(r)
+                        budget_m -= cost
+            if pre_rows:
+                self.phase_seconds["schedule"] += self._clock() - t0
+                return self._megastep_mixed(dec_rows, pre_rows)
         tokens = np.zeros((self.B if decode_only else self.T,), np.int32)
         # stable slot order so cu_seqlens is monotone over batch rows
         sched.sort(key=lambda s: s[0].slot)
@@ -1116,6 +1343,14 @@ class ServingEngine:
         for req, n, finishes in sched:
             if req.in_prefill:
                 req.prefill_pos += n
+                req.chunks_fed += 1
+                self.prefill_chunks += 1
+                if self.trace_recorder is not None and req.trace is not None:
+                    self.trace_recorder.record(
+                        req.trace["trace"], req.trace["span"],
+                        req.trace.get("parent"), "prefill_chunk",
+                        rid=req.trace.get("rid"),
+                        chunk=req.chunks_fed - 1, tokens=n)
                 if not finishes:
                     continue  # mid-prompt chunk: sampled token is meaningless
                 if self.trace_recorder is not None and req.trace is not None:
@@ -1142,6 +1377,36 @@ class ServingEngine:
                 self._retire(req)
         self.phase_seconds["harvest"] += self._clock() - t2
         return emitted
+
+    def _deadline_budgets(self, by_slot: Dict[int, "ServingRequest"]
+                          ) -> np.ndarray:
+        """Per-slot deadline budgets in SCAN ITERATIONS, computed on the
+        host at megastep launch so the compiled body checks deadlines as
+        pure data (wall clock never enters a traced program).  A row with
+        no deadline — or no per-iteration time estimate yet — gets an
+        effectively infinite budget; ``floor((deadline_t - now) / tau)``
+        otherwise, so a conservative (large) tau freezes EARLY: that
+        costs throughput, never correctness, and overshoot past the
+        deadline stays zero."""
+        dl = np.full((self.B,), 2 ** 30, np.int32)
+        tau = self._tau
+        if tau is None or tau <= 0:
+            return dl
+        now = self._clock()
+        for slot, req in by_slot.items():
+            if req.deadline_t is not None:
+                dl[slot] = max(0, int((req.deadline_t - now) / tau))
+        return dl
+
+    def _update_tau(self, execute_s: float, k: int, compiled: bool):
+        """Fold one megastep's measured execute time into the EWMA
+        per-iteration estimate (skipped when deadline_token_seconds was
+        injected, and on compile launches — trace+compile time is not
+        steady-state iteration cost)."""
+        if self._tau_override or compiled or k <= 0 or execute_s <= 0:
+            return
+        x = execute_s / k
+        self._tau = x if self._tau is None else 0.8 * self._tau + 0.2 * x
 
     def _megastep(self, reqs: List[ServingRequest]) -> Dict[int, List[int]]:
         """Run up to ``megastep_k`` decode iterations in one compiled
@@ -1197,23 +1462,33 @@ class ServingEngine:
                                     seeds, spos)
                 pos += 1
             cu[slot + 1] = pos
+        dl = self._deadline_budgets(by_slot)
         t1 = self._clock()
         self.phase_seconds["schedule"] += t1 - t0
         if self._mega_fn is None:
-            self._mega_fn = self._build_megastep()
+            if "mega" not in self._programs:
+                self._programs["mega"] = self._build_megastep()
+            self._mega_fn = self._programs["mega"]
         had = (self._mega_fn._cache_size()
                if hasattr(self._mega_fn, "_cache_size") else None)
-        kcs, vcs, toks_o, valid_o, lps_o, probs_o = self._mega_fn(
-            self._weights, self.key_caches, self.value_caches, self._rope,
-            jnp.asarray(toks), jnp.asarray(dec), jnp.asarray(now),
-            jnp.asarray(cu), jnp.asarray(occ_idx),
-            jnp.asarray(self.block_tables), jnp.asarray(active),
-            jnp.asarray(remaining), jnp.asarray(eos), jnp.asarray(temps),
-            jnp.asarray(top_ks), jnp.asarray(top_ps), jnp.asarray(seeds),
-            jnp.asarray(spos), K=K)
+        kcs, vcs, new_scales, toks_o, valid_o, lps_o, probs_o = \
+            self._mega_fn(
+                self._weights, self.key_caches, self.value_caches,
+                self._rope, jnp.asarray(toks), jnp.asarray(dec),
+                jnp.asarray(now), jnp.asarray(cu), jnp.asarray(occ_idx),
+                jnp.asarray(self.block_tables), jnp.asarray(active),
+                jnp.asarray(remaining), jnp.asarray(dl), jnp.asarray(eos),
+                jnp.asarray(temps), jnp.asarray(top_ks),
+                jnp.asarray(top_ps), jnp.asarray(seeds),
+                jnp.asarray(spos), self.cache_scales, K=K)
         self.key_caches, self.value_caches = kcs, vcs
+        if self.cache_scales is not None:
+            self.cache_scales = new_scales
+        compiled = False
         if had is not None:
-            self.compile_count += self._mega_fn._cache_size() - had
+            grew = self._mega_fn._cache_size() - had
+            self.compile_count += grew
+            compiled = grew > 0
         toks_o = np.asarray(toks_o)       # [K, B]
         valid_o = np.asarray(valid_o)
         lps_o = np.asarray(lps_o)
@@ -1221,6 +1496,7 @@ class ServingEngine:
         self.megasteps += 1
         t2 = self._clock()
         self.phase_seconds["execute"] += t2 - t1
+        self._update_tau(t2 - t1, K, compiled)
 
         emitted: Dict[int, List[int]] = {}
         for req in reqs:
@@ -1232,6 +1508,159 @@ class ServingEngine:
                 row_lps = [float(v) for v in lps_o[:, s][col]]
                 req.logprob_values.extend(row_lps)
                 self._emitted_logprobs.setdefault(req.rid, []).extend(row_lps)
+            if probs_o is not None and new:
+                self._emitted_sample_probs.setdefault(req.rid, []).extend(
+                    probs_o[:, s][col])   # [n_valid, V]
+            emitted[req.rid] = new
+            self.megastep_tokens += len(new)
+            if self.trace_recorder is not None and req.trace is not None:
+                self.trace_recorder.record(
+                    req.trace["trace"], req.trace["span"],
+                    req.trace.get("parent"), "megastep",
+                    rid=req.trace.get("rid"), tokens=len(new), k=K)
+            hit_eos = (req.eos_token_id is not None and new
+                       and new[-1] == req.eos_token_id)
+            if hit_eos or len(req.generated) >= req.max_new_tokens:
+                self._retire(req)
+        self.phase_seconds["harvest"] += self._clock() - t2
+        return emitted
+
+    def _megastep_mixed(self, dec_reqs: List[ServingRequest],
+                        pre_reqs: List[ServingRequest]
+                        ) -> Dict[int, List[int]]:
+        """Run up to ``megastep_k`` MIXED-PHASE iterations in one
+        compiled scan: ``dec_reqs`` decode one token per iteration while
+        ``pre_reqs`` consume one block-size prompt chunk per iteration
+        (then decode in place once their prompt completes).  The caller
+        guarantees the worst-case packed-token total fits the [T]
+        buffer.  Unlike the pure-decode scan (power-of-two K buckets),
+        mixed launches ALWAYS run the full ``megastep_k`` bucket: one
+        compiled mixed program per engine.  Mixed arms under live
+        admission, so a tail-sized launch (every row near completion)
+        would compile a second multi-second XLA program mid-traffic —
+        far costlier than the masked tail iterations it saves."""
+        reqs = dec_reqs + pre_reqs
+        if self._faults is not None:
+            from .faults import prompt_signature
+
+            self._faults.fire(
+                "engine.megastep",
+                detail=" ".join(prompt_signature(r.prompt) for r in reqs))
+            for r in pre_reqs:
+                # chunk-boundary failpoint: fires BEFORE the compiled
+                # call (a fault never leaves half-committed tokens), once
+                # per prompt entering the scan chunked
+                self._faults.fire("engine.prefill_chunk",
+                                  detail=prompt_signature(r.prompt))
+        t0 = self._clock()
+        C = self.bs
+        K = self.megastep_k
+        B = self.B
+        toks = np.zeros((B,), np.int32)
+        cached = np.zeros((B,), np.int32)
+        pp = np.zeros((B,), np.int32)
+        pp0 = np.zeros((B,), np.int32)
+        plen = np.zeros((B,), np.int32)
+        prompt_buf = np.zeros((B, K * C), np.int32)
+        active = np.zeros((B,), bool)
+        remaining = np.zeros((B,), np.int32)
+        eos = np.full((B,), -1, np.int32)
+        temps = np.zeros((B,), np.float32)
+        top_ks = np.zeros((B,), np.int32)
+        top_ps = np.ones((B,), np.float32)
+        seeds = np.zeros((B,), np.int32)
+        spos = np.zeros((B,), np.int32)
+        by_slot = {r.slot: r for r in reqs}
+        for slot, req in by_slot.items():
+            active[slot] = True
+            remaining[slot] = req.max_new_tokens - len(req.generated)
+            if req.eos_token_id is not None:
+                eos[slot] = req.eos_token_id
+            self._fill_sampling(req, slot, temps, top_ks, top_ps, seeds,
+                                spos)
+            if req.in_prefill:
+                # the prompt window this scan can reach: K chunks of C
+                pp[slot] = pp0[slot] = cached[slot] = req.prefill_pos
+                plen[slot] = len(req.prompt)
+                window = req.prompt[req.prefill_pos:
+                                    req.prefill_pos + K * C]
+                prompt_buf[slot, :len(window)] = window
+            else:
+                toks[slot] = (req.generated[-1] if req.generated
+                              else req.prompt[-1])
+                cached[slot] = req.context_len - 1
+                # pp == plen marks the row as decoding from iteration 0
+                pp[slot] = pp0[slot] = plen[slot] = len(req.prompt)
+        dl = self._deadline_budgets(by_slot)
+        t1 = self._clock()
+        self.phase_seconds["schedule"] += t1 - t0
+        if self._mixed_fn is None:
+            if "mixed" not in self._programs:
+                self._programs["mixed"] = self._build_mixed_megastep()
+            self._mixed_fn = self._programs["mixed"]
+        had = (self._mixed_fn._cache_size()
+               if hasattr(self._mixed_fn, "_cache_size") else None)
+        kcs, vcs, pp_f, toks_o, emits_o, lps_o, probs_o = self._mixed_fn(
+            self._weights, self.key_caches, self.value_caches, self._rope,
+            jnp.asarray(toks), jnp.asarray(cached), jnp.asarray(pp),
+            jnp.asarray(pp0), jnp.asarray(plen), jnp.asarray(prompt_buf),
+            jnp.asarray(self.block_tables), jnp.asarray(active),
+            jnp.asarray(remaining), jnp.asarray(dl), jnp.asarray(eos),
+            jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
+            jnp.asarray(seeds), jnp.asarray(spos), K=K)
+        self.key_caches, self.value_caches = kcs, vcs
+        compiled = False
+        if had is not None:
+            grew = self._mixed_fn._cache_size() - had
+            self.compile_count += grew
+            compiled = grew > 0
+        pp_f = np.asarray(pp_f)           # [B] final prefill positions
+        toks_o = np.asarray(toks_o)       # [K, B]
+        emits_o = np.asarray(emits_o)
+        lps_o = np.asarray(lps_o)
+        probs_o = np.asarray(probs_o) if probs_o is not None else None
+        self.megasteps += 1
+        self.megasteps_mixed += 1
+        t2 = self._clock()
+        self.phase_seconds["execute"] += t2 - t1
+        self._update_tau(t2 - t1, K, compiled)
+
+        emitted: Dict[int, List[int]] = {}
+        for req in sorted(reqs, key=lambda r: r.slot):
+            s = req.slot
+            col = emits_o[:, s]
+            new = [int(t) for t in toks_o[:, s][col]]
+            fed = int(pp_f[s]) - req.prefill_pos
+            if fed > 0:
+                # reconstruct the chunk boundaries the scan crossed (all
+                # full C except a completing tail) for counters + spans
+                req.prefill_pos += fed
+                self.prefill_tokens_computed += fed
+                nch = -(-fed // C)
+                for i in range(nch):
+                    ntok = min(C, fed - i * C)
+                    req.chunks_fed += 1
+                    self.prefill_chunks += 1
+                    if (self.trace_recorder is not None
+                            and req.trace is not None):
+                        self.trace_recorder.record(
+                            req.trace["trace"], req.trace["span"],
+                            req.trace.get("parent"), "prefill_chunk",
+                            rid=req.trace.get("rid"),
+                            chunk=req.chunks_fed - 1, tokens=ntok)
+                if (not req.in_prefill and self.trace_recorder is not None
+                        and req.trace is not None):
+                    self.trace_recorder.record(
+                        req.trace["trace"], req.trace["span"],
+                        req.trace.get("parent"), "prefill",
+                        rid=req.trace.get("rid"),
+                        prompt_len=len(req.prompt))
+            req.generated.extend(new)
+            if req.sampling.logprobs:
+                row_lps = [float(v) for v in lps_o[:, s][col]]
+                req.logprob_values.extend(row_lps)
+                self._emitted_logprobs.setdefault(req.rid, []).extend(
+                    row_lps)
             if probs_o is not None and new:
                 self._emitted_sample_probs.setdefault(req.rid, []).extend(
                     probs_o[:, s][col])   # [n_valid, V]

@@ -1,4 +1,6 @@
-"""Megastep decode + in-graph sampling + token streaming (ISSUE 9).
+"""Megastep decode + in-graph sampling + token streaming (ISSUE 9),
+mixed-phase chunked prefill + in-graph deadlines + int8 scan carry
+(ISSUE 16).
 
 Contracts under test:
 
@@ -6,14 +8,24 @@ Contracts under test:
   to the engine-independent greedy reference (``models.generate``) —
   with the prefix cache on AND off, and across recompute preemption
   (evict at a megastep boundary, resume with prompt+generated);
+* MIXED-PHASE (ISSUE 16): under staggered open-loop admission the scan
+  packs one prompt chunk per prefilling row alongside the decode rows —
+  token-identical to per-token stepping (greedy AND seeded), with the
+  prefix cache entering prefill mid-chunk, across a preempt/resume that
+  straddles a chunk boundary, and with ``prefill_chunk`` span events
+  attributing TTFT chunk by chunk;
 * ``temperature=0`` sampling is the argmax path exactly (same tokens as
   the greedy engine), and seeded sampling is deterministic: same seed →
   same tokens across K values, across an engine rebuild (the worker-
   restart shape), and across a preempt/resume with ``sample_offset``;
 * streaming surfaces every token exactly once, in order, both through
   ``on_token`` callbacks and the ``stream()`` iterator;
-* deadline sheds fire at megastep boundaries with the overshoot bounded
-  by the engine's K (the documented small-fix semantics);
+* deadline budgets ride the scan carry as data (ISSUE 16): a row
+  freezes in-graph AT its deadline — zero token overshoot when the
+  engine has a per-iteration time estimate, K-bounded before the first
+  measurement (the superseded ISSUE 9 contract, kept as the fallback);
+* ``cache_quant='int8'`` decodes through the scan (scales in the
+  carry) with token parity vs the per-token int8 path;
 * logprobs align 1:1 with tokens and survive the result plumbing.
 """
 import numpy as np
@@ -21,12 +33,16 @@ import pytest
 
 import paddle_tpu as P
 from paddle_tpu.inference import (
+    FlightRecorder,
     Priority,
     RequestStatus,
     SamplingParams,
     ServingEngine,
     ServingFrontend,
+    TraceContext,
+    Tracer,
 )
+from paddle_tpu.inference.tracing import tree_complete
 
 pytestmark = pytest.mark.quick
 
@@ -298,10 +314,39 @@ class TestStreaming:
 
 
 class TestMegastepBoundaries:
-    def test_deadline_overshoot_bounded_by_k(self, model):
-        """The small-fix contract: shed/cancel fire at megastep
-        boundaries, so a request past deadline carries at most K extra
-        tokens from the megastep that straddled it — never unbounded."""
+    def test_deadline_shed_zero_overshoot_in_graph(self, model):
+        """ISSUE 16 (supersedes test_deadline_overshoot_bounded_by_k):
+        the deadline rides the scan carry as a per-row iteration budget
+        decremented in-graph, so the row freezes AT its deadline — zero
+        token overshoot — and the frontend's next boundary check turns
+        the frozen row into the typed shed.  ``deadline_token_seconds``
+        injects the per-iteration estimate so the budget is exact."""
+        clock = FakeClock()
+        eng = ServingEngine(model, megastep_k=4,
+                            deadline_token_seconds=1.0, clock=clock,
+                            **ENGINE)
+        fe = ServingFrontend([eng], clock=clock)
+        rid = fe.submit([3, 17, 101], max_new_tokens=30, deadline_s=100.0)
+        fe.step()                 # prefill + first token at t=0
+        clock.t = 97.0            # 3 iteration budgets remain
+        fe.step()                 # K=4 scan with in-graph budget dl=3
+        assert fe.result(rid) is None      # frozen, not yet expired
+        clock.t = 101.0
+        fe.step()                 # boundary: typed shed of the frozen row
+        res = fe.result(rid)
+        assert res is not None
+        assert res.status is RequestStatus.DEADLINE_EXCEEDED
+        # 1 prefill-step token + the in-graph budget of exactly 3: the
+        # K=4 scan stopped one short of its sweep — ZERO overshoot
+        assert len(res.tokens) == 4
+        assert res.tokens == ref_greedy(model, [3, 17, 101], 30)[:4]
+        assert eng.megasteps > 0           # the scan path really ran
+
+    def test_deadline_fallback_bounded_by_k_without_estimate(self, model):
+        """Before the engine has measured a megastep (no injected
+        ``deadline_token_seconds``, first launch is a compile), the
+        in-graph budget is unarmed and the ISSUE 9 bound is the worst
+        case: at most K extra tokens from the straddling megastep."""
         clock = FakeClock()
         eng = ServingEngine(model, megastep_k=4, **ENGINE)
         fe = ServingFrontend([eng], clock=clock)
@@ -350,8 +395,187 @@ class TestMegastepBoundaries:
         eng = ServingEngine(model, megastep_k=1, **ENGINE)
         rid = eng.add_request([3, 17, 101], max_new_tokens=8)
         assert eng.run()[rid] == out_ref
-        assert eng.megasteps == 0 and eng._mega_fn is None
+        # never armed: zero scan launches (the program object itself may
+        # be pre-warmed from the process-wide shared program cache)
+        assert eng.megasteps == 0
 
     def test_megastep_k_validation(self, model):
         with pytest.raises(ValueError, match="megastep_k"):
             ServingEngine(model, megastep_k=0, **ENGINE)
+
+
+def run_staggered(model, prompts, arrivals, k, n=8, sampling=None, **kw):
+    """Open-loop staggered admission in engine-step time: request i is
+    admitted once the step counter reaches ``arrivals[i]`` — the traffic
+    shape where the r11 arming rule (megastep only when EVERY scheduled
+    row is past prefill) degraded to per-token stepping."""
+    eng = ServingEngine(model, megastep_k=k, **{**ENGINE, **kw})
+    out, rids, nxt, steps = {}, [], 0, 0
+    while True:
+        while nxt < len(prompts) and arrivals[nxt] <= steps:
+            rid = eng.add_request(prompts[nxt], max_new_tokens=n,
+                                  sampling=sampling)
+            rids.append(rid)
+            out[rid] = []
+            nxt += 1
+        st = eng.state_summary()
+        if st["num_active"] == 0 and st["queue_depth"] == 0:
+            if nxt >= len(prompts):
+                break
+            steps = arrivals[nxt]     # idle gap: jump to the next arrival
+            continue
+        for rid, toks in eng.step().items():
+            out[rid].extend(toks)
+        steps += 1
+    return [out[r] for r in rids], eng
+
+
+class TestMixedPhaseMegastep:
+    """ISSUE 16: chunked prefill INSIDE the scan.  Each iteration
+    processes, per row, one decode token or one ≤block_size prompt
+    chunk (fed as data through ``prefill_pos`` carries), so the
+    megastep arms whenever any row is decoding and never disarms under
+    open-loop admission."""
+
+    PROMPTS = ([3, 17, 101],
+               [40, 41, 42, 43, 44, 45, 46, 47, 48, 49],
+               [7, 9],
+               [90, 91, 92, 93, 94])
+    ARRIVALS = (0, 1, 3, 5)
+
+    def test_staggered_greedy_parity_and_stays_armed(self, model):
+        """The headline contract both ways: chunked-on/off token
+        identity under staggered admission, and the scan actually
+        stayed armed (mixed launches + chunks fed happened)."""
+        on, eng = run_staggered(model, self.PROMPTS, self.ARRIVALS, 4)
+        off, _ = run_staggered(model, self.PROMPTS, self.ARRIVALS, 1)
+        assert on == off
+        for p, toks in zip(self.PROMPTS, on):
+            assert toks == ref_greedy(model, p, 8)
+        assert eng.megasteps_mixed > 0        # prefill rode the scan
+        assert eng.prefill_chunks > 0
+        ms = eng.state_summary()["megastep"]
+        assert ms["mixed"] == eng.megasteps_mixed
+        assert ms["prefill_chunks"] == eng.prefill_chunks
+
+    def test_staggered_seeded_parity(self, model):
+        """Seeded sampling through the mixed scan: the (seed, sample
+        index) key contract is phase-blind, so chunked-on/off streams
+        are identical."""
+        on, eng = run_staggered(model, self.PROMPTS, self.ARRIVALS, 4,
+                                sampling=SAMPLED)
+        off, _ = run_staggered(model, self.PROMPTS, self.ARRIVALS, 1,
+                               sampling=SAMPLED)
+        assert on == off
+        assert eng.megasteps_mixed > 0
+
+    def test_prefix_hit_enters_mid_chunk(self, model):
+        """A prefix-cache hit drops a prompt into prefill at its first
+        uncached position — mid-chunk from the scan's point of view (the
+        chunk window starts at ``prefill_pos``, not a chunk-0 boundary).
+        Cache-on and cache-off runs stay token-identical."""
+        shared = list(range(30, 46))          # 16 tokens = 2 full blocks
+        outs = {}
+        for cache in (False, "auto"):
+            eng = ServingEngine(model, prefix_cache=cache, megastep_k=4,
+                                **ENGINE)
+            r0 = eng.add_request(shared + [7, 9], max_new_tokens=8)
+            first = eng.run()[r0]             # seeds the cache
+            rd = eng.add_request([3, 17, 101], max_new_tokens=10)
+            eng.step()                        # rd past prefill: decoding
+            r1 = eng.add_request(shared + [5], max_new_tokens=8)
+            rest = eng.run()
+            outs[cache] = (first, rest[rd], rest[r1])
+            if cache == "auto":
+                assert eng.prefix_hit_blocks > 0   # the cache engaged
+                assert eng.megasteps_mixed > 0     # hit rode the scan
+        assert outs[False] == outs["auto"]
+
+    def test_preempt_resume_across_chunk_boundary(self, model):
+        """Evict a request mid-prefill — after the mixed scan fed some
+        chunks but before the prompt completed — and resume it: the
+        re-queued run and the concurrent decode row both match the
+        unpreempted greedy reference."""
+        long = list(range(40, 64))            # 24 tokens = 3 chunks of 8
+        eng = ServingEngine(model, megastep_k=2, **ENGINE)
+        r0 = eng.add_request([3, 17, 101], max_new_tokens=12)
+        eng.step()                            # prefill + first token
+        r1 = eng.add_request(long, max_new_tokens=6)
+        eng.step()                # mixed K=2 scan: 2 chunks of r1 fed
+        req = eng._active[r1]
+        assert 0 < req.prefill_pos < len(long)    # mid-prefill
+        assert req.chunks_fed >= 1            # crossed a chunk boundary
+        evicted = eng.evict(r1)
+        assert evicted.generated == []        # preempted before token 1
+        r2 = eng.add_request(long, max_new_tokens=6)
+        out = eng.run()
+        assert out[r2] == ref_greedy(model, long, 6)
+        assert out[r0] == ref_greedy(model, [3, 17, 101], 12)
+
+    def test_int8_scan_carry_parity(self, model):
+        """cache_quant='int8' rides the pure-decode scan (the quant
+        scales travel in the carry): K>1 matches the per-token int8
+        path exactly, greedy and seeded."""
+        prompt = [3, 17, 101, 7]
+        off, eoff = run_engine(model, prompt, 10, 1, cache_quant="int8")
+        on, eon = run_engine(model, prompt, 10, 4, cache_quant="int8")
+        assert on == off
+        assert eon.megasteps > 0 and eoff.megasteps == 0
+        s_off, _ = run_engine(model, prompt, 10, 1, cache_quant="int8",
+                              sampling=SAMPLED)
+        s_on, _ = run_engine(model, prompt, 10, 4, cache_quant="int8",
+                             sampling=SAMPLED)
+        assert s_on == s_off
+
+    def test_int8_staggered_excludes_mixed_but_scans_decode(self, model):
+        """int8's one-shot prefill contract (scales freeze at the full
+        prompt) keeps prefill OUT of the mixed scan — chunk feeds would
+        re-freeze scales per chunk — but decode still megasteps, and
+        parity holds under staggered admission."""
+        on, eng = run_staggered(model, self.PROMPTS, self.ARRIVALS, 4,
+                                cache_quant="int8")
+        off, _ = run_staggered(model, self.PROMPTS, self.ARRIVALS, 1,
+                               cache_quant="int8")
+        assert on == off
+        assert eng.megasteps_mixed == 0       # contract: no int8 chunks
+        assert eng.megasteps > 0              # decode rode the scan
+
+    def test_mixed_counters_fold_through_frontend(self, model):
+        eng = ServingEngine(model, megastep_k=4, **ENGINE)
+        fe = ServingFrontend([eng])
+        r0 = fe.submit([3, 17, 101], max_new_tokens=10)
+        fe.step()                             # r0 decoding
+        r1 = fe.submit(list(range(40, 50)), max_new_tokens=8)
+        res = fe.run()
+        assert res[r0].ok and res[r1].ok
+        assert eng.megasteps_mixed > 0
+        assert (fe.metrics.counter("megastep_mixed_total")
+                == eng.megasteps_mixed)
+        assert (fe.metrics.counter("prefill_chunks_total")
+                == eng.prefill_chunks > 0)
+
+    def test_prefill_chunk_trace_events(self, model):
+        """r15 span events at chunk boundaries: every chunk feed lands
+        a ``prefill_chunk`` event (index + token count) on the request's
+        attempt span, so TTFT attributes across chunks fleet-wide."""
+        clock = FakeClock()
+        tracer = Tracer(clock=clock, proc="frontend")
+        rec = FlightRecorder(clock=clock, proc="engine")
+        eng = ServingEngine(model, megastep_k=2, trace_recorder=rec,
+                            clock=clock, **ENGINE)
+        fe = ServingFrontend([eng], tracer=tracer, clock=clock)
+        r0 = fe.submit([3, 17, 101], max_new_tokens=8)
+        fe.step()
+        long = list(range(40, 60))            # 20 tokens: chunks 8, 8, 4
+        r1 = fe.submit(long, max_new_tokens=4)
+        res = fe.run()
+        assert res[r0].ok and res[r1].ok
+        tree = tracer.tree_for(TraceContext.mint(r1).trace_id)
+        ok, why = tree_complete(tree)
+        assert ok, why
+        chunks = [e["attrs"] for evs in tree.values() for e in evs
+                  if e["event"] == "prefill_chunk"]
+        assert len(chunks) >= 2               # fed across scan launches
+        assert sorted(a["chunk"] for a in chunks) == \
+            list(range(len(chunks)))
+        assert sum(a["tokens"] for a in chunks) == len(long)

@@ -120,6 +120,27 @@ class TestServingEngine:
             # step (mq=T) and the tight pure-decode step (mq=1)
             assert eng._step_fn._cache_size() <= 2
 
+    def test_engines_share_compiled_programs(self, model):
+        """Engines with identical trace-shaping config share one jitted
+        program (and so its XLA compile cache): weights/caches/rope are
+        call arguments, so nothing per-engine is baked into the trace.
+        A different geometry (here token_budget) must NOT share."""
+        kw = dict(max_batch_size=3, max_seq_len=64, block_size=8,
+                  token_budget=12)
+        e1 = ServingEngine(model, **kw)
+        e2 = ServingEngine(model, **kw)
+        assert e1._step_fn is e2._step_fn
+        assert e1._forward is e2._forward
+        e3 = ServingEngine(model, **{**kw, "token_budget": 16})
+        assert e3._step_fn is not e1._step_fn
+        # sharing must not change results: both engines serve correctly
+        p = [3, 17, 101, 7]
+        r1 = e1.add_request(p, max_new_tokens=5)
+        r2 = e2.add_request(p, max_new_tokens=5)
+        ref = ref_greedy(model, p, 5)
+        assert e1.run()[r1] == ref
+        assert e2.run()[r2] == ref
+
     def test_run_raises_on_max_steps_exhaustion(self, model):
         """ADVICE r5 low #1: a truncated run (max_steps hit with work still
         queued/active) must raise, not return a dict missing tokens."""

@@ -184,6 +184,12 @@ def _fault_schedule(seed, total_names, poison):
     sites["r0.add_request"] = {"kind": "delay", "delay_s": 0.001, "times": 2}
     sites["engine.megastep"] = {"kind": kinds[rng.randrange(3)],
                                 "after": rng.randrange(1, 5), "times": 1}
+    # mixed-phase megastep (ISSUE 16): a crash at a prompt-chunk feed
+    # boundary — mid-prefill, before the row's first token — must fail
+    # over with full replay equality like any other death
+    sites["engine.prefill_chunk"] = {"kind": kinds[rng.randrange(3)],
+                                     "after": rng.randrange(1, 6),
+                                     "times": 1}
     if poison:
         sites["engine.step"] = {"kind": "error", "match": "p66-6-6-"}
     return sites

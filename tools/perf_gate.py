@@ -3,7 +3,7 @@
 check_op_benchmark_result.py — CI fails when a benchmark regresses vs the
 recorded baseline).
 
-Two checks, both against the PREVIOUS round's recordings:
+Three checks; the first two run against the PREVIOUS round's recordings:
 
 1. Headline: the newest BENCH_r*.json's ``vs_baseline`` ratio must not drop
    more than --tolerance (default 10%), and the pinned workload must not
@@ -17,6 +17,12 @@ Two checks, both against the PREVIOUS round's recordings:
    a note. This is what keeps schedule wins (e.g. the r6 branch-free
    interleaved pipeline) and slow drifts (the ~4-7% BERT creep flagged in
    r5) from silently decaying.
+3. Cross-rung (r16, ISSUE 16): bounds declared in ``CROSS_RUNG_BOUNDS``
+   between rungs of the LATEST round — today, the saturated staggered-
+   admission megastep rung must stay within 1.5x of the closed-batch
+   megastep rung's host-round-trips-per-token (both deterministic counter
+   ratios), or chunked prefill has stopped keeping the scan armed under
+   open-loop load.
 
 Run with no arguments from the repo root.
 """
@@ -217,6 +223,42 @@ def check_ladder(ladders, tolerances: Dict) -> int:
     return rc
 
 
+# cross-rung bounds WITHIN the latest round (ISSUE 16): unlike the
+# round-over-round deltas above, these assert a relationship between two
+# rungs measured together — the saturated open-admission megastep rung
+# must stay within 1.5x of the closed-batch rung's host-round-trips-per-
+# token, or chunked prefill has stopped keeping the scan armed under
+# open-loop load.  Both rungs are deterministic counter ratios, so this
+# check has no noise allowance beyond the factor itself.
+CROSS_RUNG_BOUNDS = (
+    ("serving_megastep_saturated_steps_per_token",
+     "serving_megastep_steps_per_token", 1.5),
+)
+
+
+def check_cross_rungs(ladders) -> int:
+    if not ladders:
+        return 0
+    cn, cpath, cur = ladders[-1]
+    cur_by = {r["metric"]: r for r in cur}
+    rc = 0
+    for metric, ref, factor in CROSS_RUNG_BOUNDS:
+        mr, rr = cur_by.get(metric), cur_by.get(ref)
+        if mr is None or rr is None:
+            continue  # pair not measured this round — nothing to bound
+        mv, rv = float(mr["value"]), float(rr["value"])
+        if rv <= 0:
+            continue
+        ratio = mv / rv
+        print(f"perf-gate: cross-rung {metric} / {ref}: "
+              f"{mv:g} / {rv:g} = {ratio:.3f}x (bound {factor:g}x)")
+        if ratio > factor:
+            print(f"perf-gate: FAIL — '{metric}' is {ratio:.2f}x '{ref}' "
+                  f"in r{cn} ({cpath}), over the {factor:g}x bound")
+            rc = 1
+    return rc
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--tolerance", type=float, default=0.10,
@@ -228,8 +270,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = ap.parse_args(argv)
 
     rc = check_headline(load_rounds(args.root), args.tolerance)
-    rc = check_ladder(load_ladders(args.root),
-                      load_tolerances(args.root)) or rc
+    ladders = load_ladders(args.root)
+    rc = check_ladder(ladders, load_tolerances(args.root)) or rc
+    rc = check_cross_rungs(ladders) or rc
     print("perf-gate: pass" if rc == 0 else "perf-gate: FAIL")
     return rc
 

@@ -399,6 +399,17 @@ def bench_serving_prefix():
     print(json.dumps(_load_bench_serving().run_bench_prefix()))
 
 
+def bench_serving_disagg():
+    """Disaggregation rung (ISSUE 17): concurrent identical prompts
+    served colocated (2 decode replicas) vs split (prefill replica + the
+    same decode replicas over the KV fabric); value = the ratio of
+    fleet-wide prefill tokens actually computed (deterministic engine
+    counters, lower is better — transferred blocks are written, not
+    computed).  Greedy parity across modes is asserted inside the
+    bench."""
+    print(json.dumps(_load_bench_serving().run_bench_disagg()))
+
+
 def bench_serving_megastep():
     """Megastep rung (ISSUE 9): a closed request batch served with K-step
     in-graph decode vs per-token stepping; value = host round trips per
@@ -522,6 +533,8 @@ if __name__ == "__main__":
         bench_serving_fleet()
     if which in ("all", "prefix"):
         bench_serving_prefix()
+    if which in ("all", "disagg"):
+        bench_serving_disagg()
     if which in ("all", "megastep"):
         bench_serving_megastep()
     if which in ("all", "megastep_saturated"):

@@ -181,6 +181,33 @@ observations carry the trace id as an exemplar
 (``metrics.exemplars``), so a latency outlier is one lookup from its
 tree; non-COMPLETED terminals and slow completions auto-capture their
 trees into ``tracer.captures``.
+
+Disaggregation (ISSUE 17).  Pass ``kv_fabric=KVFabric(master)`` and
+label replicas with roles (``ServingFleet(worker_roles=...)`` or
+``engine.role = "prefill"``) to split the fleet: prefill-role replicas
+run prompts as one-token *prefill passes* (the sampled token is
+discarded; decode re-emits it token-identically because the seeded
+sample stream restarts at offset 0), publish the prompt's full-block
+chain into the fleet-wide directory, and stream the KV payloads to the
+decode replica that will own the request.  Decode admission consults
+the directory before computing any prefix: a chain published anywhere
+in the fleet is pulled instead of recomputed, and a *prefill-in-
+progress* table dedupes concurrent identical prompts down to one pass.
+What the directory GUARANTEES: every entry is stamped with its writer's
+fencing epoch (an entry IS a fenced block lease — a deposed frontend's
+entries surface as typed ``StaleEpoch`` and are dropped, never served);
+payload transfer is bit-exact (``cache_quant='int8'`` caches are a
+typed error — per-slot dynamic scales make their payloads
+writer-specific); served tokens are identical to colocated serving,
+greedy and seeded.  What it does NOT guarantee: that an entry's blocks
+still exist (the owner may have died or evicted them — every fabric
+fault, including all three ``fabric.*`` failpoints, degrades to
+recomputing the prefix locally), that a chain is transferred at most
+once, or any durability (the directory is a routing hint over the
+launch KV master, not a replicated store; losing it costs recompute,
+never correctness).  One request burns at most one prefill pass
+(``prefill_passes`` budget): a fabric sick enough to fail the pass
+falls back to classic colocated placement.
 """
 from __future__ import annotations
 
@@ -332,6 +359,12 @@ class _FrontendRequest:
     last_token_t: Optional[float] = None
     counted_tokens: int = 0        # held against the class token budget
     trace: Optional[TraceContext] = None  # root span (tracer armed only)
+    # disaggregation (kv_fabric): True while the request is running as a
+    # prefill PASS on a prefill-role replica — its sampled token is
+    # discarded, the pass exists to compute + publish the prompt's KV
+    prefill_pass: bool = False
+    prefill_passes: int = 0        # passes burned (bounds retry loops)
+    fabric_key: Optional[str] = None  # held prefill-in-progress claim
 
     @property
     def remaining_new_tokens(self) -> int:
@@ -400,7 +433,8 @@ class ServingFrontend:
                  lease: Optional[FrontendLease] = None,
                  clock: Callable[[], float] = time.monotonic,
                  metrics: Optional[ServingMetrics] = None,
-                 tracer: Optional[Tracer] = None):
+                 tracer: Optional[Tracer] = None,
+                 kv_fabric=None):
         if isinstance(engines, ServingEngine):
             engines = [engines]
         if not engines:
@@ -431,6 +465,10 @@ class ServingFrontend:
         self.metrics = metrics if metrics is not None else ServingMetrics(clock)
         # per-request tracing (ISSUE 15): None = every hook is one test
         self.tracer = tracer
+        # disaggregated prefill/decode (ISSUE 17): fleet-wide KV directory
+        # + transfer fabric.  None = classic colocated serving, zero new
+        # code on any hot path.  See the "Disaggregation" docstring section.
+        self.fabric = kv_fabric
         self._queue: List[_FrontendRequest] = []
         self._requests: Dict[int, _FrontendRequest] = {}
         self._results: Dict[int, RequestResult] = {}
@@ -464,6 +502,10 @@ class ServingFrontend:
         self._handed_off = False
         if self.epoch is not None:
             self.metrics.set_gauge("lease_epoch", float(self.epoch))
+        if self.fabric is not None and self.epoch is not None:
+            # fence the fabric at this frontend's epoch: directory entries
+            # stamped by a deposed incarnation become StaleEpoch on lookup
+            self.fabric.set_epoch(self.epoch)
         for rep in self._replicas:
             self._propagate_epoch(rep)
         self._rr = 0  # round-robin cursor for routing tie-breaks
@@ -1544,14 +1586,157 @@ class ServingFrontend:
                              f"prompt+max_new_tokens={req.total_tokens} "
                              "exceeds every live replica's capacity")
                 continue
-            rep = self._pick_replica(req, accepting)
+            # disaggregation (ISSUE 17): prefill-role replicas never take
+            # decode placements — they exist to run prefill PASSES.  With
+            # no fabric (or an all-prefill fleet) the pool is `accepting`
+            # unchanged and dispatch behaves exactly as before.
+            placing = self._decode_pool(accepting)
+            if self.fabric is not None and not req.prefill_pass:
+                action, frep = self._fabric_plan(req, accepting, placing)
+                if action == "wait":
+                    # a twin prefill is in flight elsewhere — this request
+                    # stays queued WITHOUT raising the priority barrier
+                    # (it is blocked on dedup, not on capacity)
+                    continue
+                if action == "prefill":
+                    self._queue.remove(req)
+                    self._assign(req, frep)
+                    continue
+                if frep is not None:      # "place" onto the pulled-into rep
+                    self._queue.remove(req)
+                    self._assign(req, frep)
+                    continue
+            rep = self._pick_replica(req, placing)
             if rep is None and self.preemption:
-                rep = self._preempt_for(req, accepting)
+                rep = self._preempt_for(req, placing)
             if rep is None:
                 barrier = int(req.priority)
                 continue
             self._queue.remove(req)
             self._assign(req, rep)
+
+    @staticmethod
+    def _decode_pool(reps: List[_Replica]) -> List[_Replica]:
+        """Replicas eligible for decode placement: everything not labelled
+        'prefill'.  An all-prefill fleet degrades to colocated serving
+        (better than wedging the queue on a mislabelled deployment)."""
+        pool = [r for r in reps
+                if getattr(r.engine, "role", None) != "prefill"]
+        return pool or list(reps)
+
+    @staticmethod
+    def _replica_name(rep: _Replica) -> str:
+        """Directory owner id: the fleet worker name when remote, else a
+        frontend-local synthetic one (stable across the frontend's life)."""
+        return getattr(rep.engine, "worker", None) or f"replica{rep.idx}"
+
+    def _owner_replica(self, name: str) -> Optional[_Replica]:
+        for rep in self._replicas:
+            if rep.alive and self._replica_name(rep) == name:
+                return rep
+        return None
+
+    def _fabric_plan(self, req: _FrontendRequest, accepting: List[_Replica],
+                     placing: List[_Replica]):
+        """Decide how the fabric serves this request's prefix: pull blocks
+        published elsewhere onto a decode replica ("place", rep), run a
+        prefill pass on a prefill-role replica ("prefill", rep), queue
+        behind an identical in-flight prefill ("wait", None), or fall
+        through to normal placement ("place", None).  Every fabric fault
+        degrades to recompute — the directory is a hint, never a
+        correctness dependency."""
+        if req.generated:
+            return "place", None      # resumed request: prefix is not the
+            # prompt anymore; normal prefix-cache affinity handles it
+        bs = int(placing[0].engine.bs)
+        hashes = prompt_block_hashes(req.prompt, bs)
+        if not hashes:
+            return "place", None
+        hcache = {bs: hashes}
+        local_best = max((self._prefix_affinity(r, req, hcache)
+                          for r in placing), default=0)
+        if local_best >= len(hashes):
+            return "place", None      # fully cached locally already
+        try:
+            chain = self.fabric.lookup_chain(hashes)
+        except Exception:  # noqa: BLE001 — directory unavailable ≠ outage
+            self.metrics.inc("fabric_recomputes_total")
+            return "place", None
+        if len(chain) > local_best:
+            target = self._pick_replica(req, placing)
+            if target is None:
+                return "place", None
+            if self._pull_chain(req, target, chain):
+                return "place", target
+            return "place", None      # pull failed → recompute locally
+        # nothing (better) published yet: try to claim a prefill pass
+        if req.prefill_passes > 0:
+            return "place", None      # one pass per request — a second
+            # failure means the fabric is sick; recompute guarantees
+            # forward progress
+        prefill_pool = [r for r in accepting
+                        if getattr(r.engine, "role", None) == "prefill"]
+        if not prefill_pool:
+            return "place", None
+        if not any(self._fits_at_all(r, req) for r in prefill_pool):
+            return "place", None
+        key = hashes[-1]              # chain head identifies the prompt
+        owner = self.fabric.prefill_owner(key)
+        if owner is not None:
+            self.metrics.inc("fabric_dedup_waits_total")
+            return "wait", None
+        rep = self._pick_replica(req, prefill_pool)
+        if rep is None:
+            return "wait", None       # prefill capacity busy; dedup table
+            # still guards against a twin racing in meanwhile
+        if not self.fabric.begin_prefill(key, self._replica_name(rep),
+                                         epoch=self.epoch):
+            self.metrics.inc("fabric_dedup_waits_total")
+            return "wait", None
+        req.prefill_pass = True
+        req.prefill_passes += 1
+        req.fabric_key = key
+        self.metrics.inc("fabric_prefill_passes_total")
+        return "prefill", rep
+
+    def _pull_chain(self, req: _FrontendRequest, target: _Replica,
+                    chain) -> bool:
+        """Stream directory-published blocks (a ``FabricEntry`` chain from
+        ``lookup_chain``) onto ``target``, grouped by owning replica; True
+        if anything landed.  A dead owner's leases drop out of the
+        directory and the caller recomputes."""
+        cached_fn = getattr(target.engine, "cached_block_hashes", None)
+        cached = cached_fn() if cached_fn is not None else set()
+        missing = [e for e in chain if e.hash not in cached]
+        if not missing:
+            return True
+        by_owner: Dict[str, List[str]] = {}
+        for entry in missing:
+            by_owner.setdefault(entry.owner, []).append(entry.hash)
+        pulled = nbytes = 0
+        for owner, hs in by_owner.items():
+            src = self._owner_replica(owner)
+            try:
+                if src is None:
+                    raise ConnectionError(
+                        f"directory owner {owner!r} is not a live replica")
+                n, b = self.fabric.pull(src.engine, target.engine, hs,
+                                        owner=owner)
+                pulled += n
+                nbytes += b
+            except StaleEpoch:
+                self.metrics.inc("fabric_recomputes_total")
+                return pulled > 0
+            except Exception:  # noqa: BLE001 — decode-pulls-from-dead-peer
+                # drop every entry the dead owner published so the next
+                # request doesn't retry the same corpse, then recompute
+                self.fabric.drop_owner(owner)
+                self.metrics.inc("fabric_pull_failures_total")
+                self.metrics.inc("fabric_recomputes_total")
+        if pulled and self.tracer is not None and req.trace is not None:
+            self.tracer.event(req.trace, "block_transfer", blocks=pulled,
+                              bytes=nbytes, dst=self._replica_name(target))
+        return pulled > 0
 
     def _prefix_affinity(self, rep: _Replica, req: _FrontendRequest,
                          hash_cache: Dict[int, List[str]]) -> int:
@@ -1665,6 +1850,10 @@ class ServingFrontend:
             self._finish(req, RequestStatus.COMPLETED)
             return
         prefill = req.prompt + req.generated
+        # a prefill PASS runs the prompt through attention and stops: one
+        # sampled token (discarded at harvest) is the cheapest way to make
+        # the engine compute + publish every full prompt block
+        mnt = 1 if req.prefill_pass else req.remaining_new_tokens
         extra = {}
         if self.tracer is not None and req.trace is not None:
             # one child span per dispatch: engine/worker events for THIS
@@ -1685,7 +1874,7 @@ class ServingFrontend:
                 # engine keeps its own clock
                 extra["deadline_s"] = req.deadline_t - self._clock()
             erid = rep.engine.add_request(
-                prefill, max_new_tokens=req.remaining_new_tokens,
+                prefill, max_new_tokens=mnt,
                 eos_token_id=req.eos_token_id,
                 sampling=req.sampling.to_wire(),
                 sample_offset=len(req.generated), **extra)
@@ -1745,6 +1934,11 @@ class ServingFrontend:
                 continue
             if not toks:
                 continue
+            if req.prefill_pass:
+                # the pass's sampled token is scaffolding, not output —
+                # decode re-emits it token-identically (sample_offset=0
+                # restarts the seeded stream from the same prefix)
+                continue
             tid = req.trace.trace_id if req.trace is not None else None
             if req.first_token_t is None:
                 req.first_token_t = t
@@ -1784,7 +1978,55 @@ class ServingFrontend:
                 continue
             req.replica = None
             req.engine_rid = None
+            if req.prefill_pass:
+                # not a terminal: the pass computed + cached the prompt's
+                # KV; publish the chain, stream it to a decode replica,
+                # then hand the request over for the real generation
+                self._complete_prefill_pass(req, rep)
+                continue
             self._finish(req, RequestStatus.COMPLETED)
+
+    def _complete_prefill_pass(self, req: _FrontendRequest, rep: _Replica):
+        """Prefill pass finished on ``rep``: publish the prompt's block
+        chain to the directory, push the blocks to the decode replica that
+        will own the request, release the dedup claim, and dispatch the
+        request for real.  Any fault (prefill-worker-dies-mid-stream,
+        injected fabric.publish/pull) degrades to recompute: the request
+        re-queues and decode admission simply misses the cache."""
+        req.prefill_pass = False
+        key, req.fabric_key = req.fabric_key, None
+        name = self._replica_name(rep)
+        hashes = prompt_block_hashes(req.prompt, int(rep.engine.bs))
+        live = [r for r in self._replicas if r.alive and not r.draining]
+        pool = [r for r in self._decode_pool(live) if r is not rep]
+        target = self._pick_replica(req, pool) if pool else None
+        try:
+            self.fabric.publish_chain(name, hashes, epoch=self.epoch)
+            if target is not None:
+                cached_fn = getattr(target.engine, "cached_block_hashes",
+                                    None)
+                cached = cached_fn() if cached_fn is not None else set()
+                missing = [h for h in hashes if h not in cached]
+                n, nbytes = self.fabric.pull(rep.engine, target.engine,
+                                             missing, owner=name)
+                if self.tracer is not None and req.trace is not None:
+                    self.tracer.event(req.trace, "block_transfer",
+                                      blocks=n, bytes=nbytes, src=name,
+                                      dst=self._replica_name(target))
+        except StaleEpoch:
+            self.metrics.inc("fabric_recomputes_total")
+            target = None
+        except Exception:  # noqa: BLE001 — fabric fault → recompute
+            self.metrics.inc("fabric_pull_failures_total")
+            self.metrics.inc("fabric_recomputes_total")
+            target = None
+        finally:
+            if key is not None:
+                self.fabric.finish_prefill(key)
+        if target is not None:
+            self._assign(req, target)
+        else:
+            self._queue.append(req)
 
     def _kill_replica(self, rep: _Replica, exc: BaseException):
         rep.alive = False
@@ -1808,6 +2050,15 @@ class ServingFrontend:
     def _requeue_or_quarantine(self, req: _FrontendRequest, rep: _Replica):
         """Charge one replica death against ``req``'s retry budget: back
         to the queue within budget, typed FAILED_POISON past it."""
+        if req.prefill_pass:
+            # the pass died with its replica (prefill-worker-dies-mid-
+            # stream): release the claim so a twin can proceed, and let
+            # the re-queued request recompute on a decode replica — its
+            # prefill_passes budget is already spent
+            req.prefill_pass = False
+            if req.fabric_key is not None and self.fabric is not None:
+                self.fabric.finish_prefill(req.fabric_key)
+                req.fabric_key = None
         req.attempts += 1
         if req.attempts > self.max_request_retries:
             self._finish(
@@ -1836,6 +2087,13 @@ class ServingFrontend:
         prev = self._results.get(req.rid)
         if prev is not None:
             return prev
+        if req.fabric_key is not None and self.fabric is not None:
+            # a terminal (deadline shed, cancel, quarantine) mid-prefill-
+            # pass must release the dedup claim or identical prompts wait
+            # on a corpse until the claim's epoch goes stale
+            self.fabric.finish_prefill(req.fabric_key)
+            req.fabric_key = None
+            req.prefill_pass = False
         if status is RequestStatus.COMPLETED and req.capped_from is not None:
             detail = (f"brownout: max_new_tokens capped "
                       f"{req.capped_from} -> {req.max_new_tokens}")
@@ -1919,6 +2177,11 @@ class ServingFrontend:
         m.set_gauge("step_phase_schedule_seconds", sched)
         m.set_gauge("step_phase_execute_seconds", exe)
         m.set_gauge("step_phase_harvest_seconds", harv)
+        if self.fabric is not None:
+            # directory/transfer counters, exported as gauges (they are
+            # fabric-cumulative, not frontend deltas)
+            for k, v in self.fabric.counters.items():
+                m.set_gauge(f"fabric_{k}", float(v))
         for rep in live:
             eng = rep.engine
             if getattr(eng, "prefix_counters_self_reported", False):

@@ -85,6 +85,12 @@ KNOWN_SITES = {
     "lease.acquire",      # FrontendLease.acquire (standby takeover)
     "lease.renew",        # FrontendLease.renew (active heartbeat)
     "handoff.flush",      # ServingFrontend.handoff final snapshot
+    # disaggregated KV fabric (ISSUE 17) — canonical registrations live
+    # next to the firing code in inference/kv_fabric.py; listed here too
+    # so env-armed injectors validate without importing the fabric
+    "fabric.publish",     # prefill worker dies before its chain lands
+    "fabric.pull",        # decode pulls blocks from a dead peer
+    "fabric.directory",   # directory reads, incl. stale-lease rejection
 }
 # FaultyReplica/FencedEngine also fire replica-scoped sites
 # "<replica name>.<op>" (so a schedule can doom one replica).  The

@@ -111,7 +111,7 @@ from .metrics import (MEGASTEP_COUNTERS, ServingMetrics,
 
 __all__ = ["RemoteReplica", "ServingFleet", "FleetAutoscaler",
            "AutoscalePolicy", "init_worker", "discover_workers",
-           "connect_workers"]
+           "connect_workers", "worker_roles"]
 
 
 def discover_workers(master_endpoint: str,
@@ -143,6 +143,20 @@ def discover_workers(master_endpoint: str,
     names = (k.rsplit("/", 1)[-1] for k in entries)
     drop = set(exclude)
     return sorted(n for n in names if n not in drop and "frontend" not in n)
+
+
+def worker_roles(master_endpoint: str) -> Dict[str, str]:
+    """Disaggregation role labels registered alongside the workers
+    (``/serving/roles/<name>``, written by tools/serving_worker.py right
+    after its rpc registration).  The label ALSO rides every health
+    reply (``RemoteReplica.role``), so this registry view exists for the
+    paths that must know a worker's role without probing it — takeover
+    planning, operator tooling — and as the KV-side source of truth a
+    recovered frontend can audit its rebuilt fleet against."""
+    from ..distributed.launch.master import KVClient
+
+    entries = KVClient(master_endpoint).get_prefix("/serving/roles/")
+    return {k.rsplit("/", 1)[-1]: v for k, v in entries.items()}
 
 
 # the only probe failures that PROVE nothing is listening at the
@@ -195,11 +209,21 @@ def connect_workers(master_endpoint: str,
 
     rpc.refresh_workers()
     kv = KVClient(master_endpoint)
+    # role-correct rebuild (disaggregation): the health reply carries the
+    # worker's own role label; the KV registry entry backs it up so a
+    # worker predating the label (or a probe that lost the field) still
+    # lands in the right pool — a recovered frontend must never route
+    # prefill passes to a decode-only worker or vice versa
+    roles = {k.rsplit("/", 1)[-1]: v
+             for k, v in kv.get_prefix("/serving/roles/").items()}
     out: List[RemoteReplica] = []
     for name in discover_workers(master_endpoint, exclude):
         try:
-            out.append(RemoteReplica(name, rpc_timeout=rpc_timeout,
-                                     probe_timeout=probe_timeout_s))
+            rep = RemoteReplica(name, rpc_timeout=rpc_timeout,
+                                probe_timeout=probe_timeout_s)
+            if rep.role is None:
+                rep.role = roles.get(name)
+            out.append(rep)
         except rpc.RpcTimeout:
             continue           # live-but-slow ≠ stale: skip, never prune
         except OSError as e:
@@ -251,15 +275,15 @@ class _BoundedErrors(OrderedDict):
 _WORKER: Dict[str, Any] = {
     "engine": None, "metrics": None, "stop": None, "name": None,
     "prefix_seen": (0, 0, 0), "mega_seen": (0, 0, 0, 0), "faults": None,
-    "fence": EpochFence(),
+    "fence": EpochFence(), "role": None,
 }
 
 
 def init_worker(engine, name: str,
                 stop: Optional[threading.Event] = None,
                 metrics: Optional[ServingMetrics] = None,
-                fault_injector: Optional[FaultInjector] = None
-                ) -> threading.Event:
+                fault_injector: Optional[FaultInjector] = None,
+                role: Optional[str] = None) -> threading.Event:
     """Install ``engine`` as this process's served replica (called by
     tools/serving_worker.py before ``rpc.init_rpc``).  Returns the stop
     event ``_w_shutdown`` sets.  ``fault_injector`` arms the worker-side
@@ -267,7 +291,11 @@ def init_worker(engine, name: str,
     ``engine.step`` site) for chaos runs.  A fresh ``EpochFence`` is
     armed too: it lives for the worker PROCESS — frontends come and go
     across it (that is the whole point), each bumping the highest epoch
-    seen with its first control RPC."""
+    seen with its first control RPC.  ``role`` labels the worker for
+    disaggregated serving ('prefill' = prefill passes only, 'decode' =
+    decode placement only, None = both); it rides the health reply (so
+    ``RemoteReplica``/``connect_workers`` rebuild role-correct fleets on
+    takeover) and is stamped onto the engine for in-process callers."""
     if "frontend" in name:
         # discover_workers/connect_workers drop any registration whose
         # name contains "frontend" (that's how stale frontend-generation
@@ -286,6 +314,11 @@ def init_worker(engine, name: str,
     _WORKER["faults"] = (fault_injector if fault_injector is not None
                          else FaultInjector.from_env())
     _WORKER["fence"] = EpochFence()
+    if role is not None and role not in ("prefill", "decode"):
+        raise ValueError(
+            f"worker role must be 'prefill', 'decode' or None, got {role!r}")
+    _WORKER["role"] = role
+    engine.role = role
     return _WORKER["stop"]
 
 
@@ -429,6 +462,30 @@ def _w_reap_orphans(epoch=None):
     return n, eng.state_summary()
 
 
+def _w_export_blocks(hashes, epoch=None):
+    """Bit-exact KV payload for a chain of published block hashes — the
+    source side of the disaggregated prefill→decode transfer
+    (inference/kv_fabric.py).  Fenced: a deposed frontend must not farm
+    this worker's blocks out to replicas the current incarnation is not
+    scheduling.  The payload is host numpy and ships over the pickle
+    transport like any reply."""
+    _fence(epoch, "export_blocks")
+    return _engine().export_blocks(hashes)
+
+
+def _w_import_blocks(payload, epoch=None):
+    """Install a transferred KV payload into this worker's pool (the
+    destination side of the disaggregated hop); returns the imported
+    block count plus the post-import state summary so the frontend's
+    mirror — including the prefix-hash set affinity routing reads —
+    reflects the new content-addressable blocks immediately."""
+    _fence(epoch, "import_blocks")
+    eng = _engine()
+    n = eng.import_blocks(payload)
+    _WORKER["metrics"].inc("fabric_blocks_imported_total", n)
+    return n, eng.state_summary()
+
+
 def _w_health(include_samples: bool = False):
     """The one shared probe: heartbeat liveness, autoscaler load signals,
     and metrics aggregation all read this."""
@@ -447,6 +504,7 @@ def _w_health(include_samples: bool = False):
         "draining": False,  # drain state is frontend-side; kept for probes
         "name": _WORKER["name"],
         "epoch": _WORKER["fence"].highest,   # highest epoch ever seen
+        "role": _WORKER.get("role"),         # disaggregation label
     }
 
 
@@ -542,6 +600,9 @@ class RemoteReplica:
              else self.rpc_timeout)
         h = self._rpc.rpc_sync(self.worker, _w_health, timeout=t)
         cfg = h["config"]
+        # disaggregation role label (init_worker): rides every health
+        # reply so a takeover frontend rebuilds a role-correct fleet
+        self.role = h.get("role")
         self.B = int(cfg["max_batch_size"])
         self.T = int(cfg["token_budget"])
         self.bs = int(cfg["block_size"])
@@ -692,6 +753,20 @@ class RemoteReplica:
         self._apply_state(st)
         self._finished.clear()
         self._logprobs.clear()
+        return int(n)
+
+    def export_blocks(self, hashes) -> Dict:
+        """Pull a bit-exact KV payload off the worker (source side of a
+        disaggregated block transfer, kv_fabric.py)."""
+        return self._call(_w_export_blocks, list(hashes),
+                          epoch=self._epoch)
+
+    def import_blocks(self, payload: Dict) -> int:
+        """Push a transferred KV payload into the worker's pool; the
+        reply's state summary refreshes the mirror so prefix-affinity
+        routing sees the imported hashes immediately."""
+        n, st = self._call(_w_import_blocks, payload, epoch=self._epoch)
+        self._apply_state(st)
         return int(n)
 
     # --------------------------------------------------- fleet-layer extras
@@ -849,6 +924,7 @@ class ServingFleet:
 
     def __init__(self, worker_spec: Dict, num_workers: int = 0, *,
                  master_endpoint: Optional[str] = None,
+                 worker_roles: Optional[Sequence[Optional[str]]] = None,
                  frontend_kwargs: Optional[Dict] = None,
                  rpc_timeout: float = 60.0,
                  spawn_timeout: float = 120.0,
@@ -866,6 +942,12 @@ class ServingFleet:
         from ..distributed.launch.master import KVClient, KVServer
 
         self.worker_spec = dict(worker_spec)
+        # disaggregation: role label per launch index ('prefill'/'decode'/
+        # None); workers past the list launch unlabeled.  The label is
+        # injected into each worker's spec JSON, so it rides the same
+        # wire the engine config does and survives respawns by name.
+        self.worker_roles = (list(worker_roles)
+                             if worker_roles is not None else [])
         self.rpc_timeout = float(rpc_timeout)
         self.spawn_timeout = float(spawn_timeout)
         self.heartbeat_interval_s = float(heartbeat_interval_s)
@@ -936,14 +1018,21 @@ class ServingFleet:
             os.path.abspath(__file__))))
         return os.path.join(here, "tools", "serving_worker.py")
 
-    def _launch(self, name: Optional[str] = None) -> str:
+    def _launch(self, name: Optional[str] = None,
+                role: Optional[str] = None) -> str:
         """Start a worker process (non-blocking); pair with _await_worker."""
         if name is None:
-            name = f"worker{self._next_worker}"
+            idx = self._next_worker
+            name = f"worker{idx}"
             self._next_worker += 1
+            if role is None and idx < len(self.worker_roles):
+                role = self.worker_roles[idx]
+        spec = dict(self.worker_spec)
+        if role is not None:
+            spec["role"] = role
         cmd = [sys.executable, self._worker_script(),
                "--master", self.master_endpoint, "--name", name,
-               "--spec-json", json.dumps(self.worker_spec)]
+               "--spec-json", json.dumps(spec)]
         if self.cpu_workers:
             cmd += ["--platform", "cpu"]
         # stderr to a file, not a pipe: nobody drains worker pipes and a
@@ -1062,11 +1151,15 @@ class ServingFleet:
         self._rpc.refresh_workers()
         return self._attach_replica(self._make_replica(name))
 
-    def spawn_worker(self, name: Optional[str] = None) -> str:
+    def spawn_worker(self, name: Optional[str] = None,
+                     role: Optional[str] = None) -> str:
         """Launch + register + attach one new worker.  Blocking: the
         worker is routable when this returns (initial fleet bring-up; the
         autoscaler's in-loop scale-up uses ``spawn_worker_async``)."""
-        name = self._launch(name)
+        # only forward role= when asked: tests monkeypatch _launch with
+        # role-unaware fakes, and the default path must keep working
+        name = (self._launch(name, role=role) if role is not None
+                else self._launch(name))
         try:
             self._await_worker(name)
         except Exception as e:  # noqa: BLE001 — feed the respawn breaker
@@ -1274,6 +1367,7 @@ class ServingFleet:
         # workers (no local Popen): a stale /rpc/workers entry would keep
         # a dead worker in everyone's routing table on the next refresh
         self._kv.delete(f"/rpc/workers/{name}")
+        self._kv.delete(f"/serving/roles/{name}")  # role label rides along
         proc = self._procs.pop(name, None)
         if proc is None:
             return

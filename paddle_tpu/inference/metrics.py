@@ -73,6 +73,17 @@ COUNTERS = (
     # event lands in exactly one scraped registry
     "fenced_rpcs_total", "failovers_total", "handoffs_total",
     "standby_takeovers_total",
+    # disaggregated prefill/decode (ISSUE 17): prefill passes run on
+    # prefill-role replicas, requests parked behind an identical
+    # in-flight prefill, transfer faults, and every fabric fault that
+    # degraded to recomputing the prefix locally (the recompute counter
+    # is the fabric's health signal: correctness never depends on it
+    # staying zero, throughput does).  Worker-side:
+    # fabric_blocks_imported_total counts blocks landed via
+    # _w_import_blocks in the importing worker's own registry
+    "fabric_prefill_passes_total", "fabric_dedup_waits_total",
+    "fabric_pull_failures_total", "fabric_recomputes_total",
+    "fabric_blocks_imported_total",
 )
 GAUGES = (
     "queue_depth", "queue_depth_peak", "running_requests", "replicas_alive",

@@ -589,6 +589,7 @@ class ServingEngine:
         self._mega_fn = self._programs.get("mega")    # lazy: pure-decode scan
         self._mixed_fn = self._programs.get("mixed")  # lazy: mixed-phase scan
         self._cow_fn = self._programs.get("cow")      # lazy: COW block copy
+        self._put_fn = self._programs.get("put")      # lazy: block import write
         self.compile_count = 0
 
     def _program_key(self) -> tuple:
@@ -1729,3 +1730,88 @@ class ServingEngine:
         if not self.prefix_cache_enabled:
             return set()
         return self.blocks.cached_hashes()
+
+    # ------------------------------------------------- block transfer
+    # (kv_fabric.py: disaggregated prefill/decode moves KV between
+    # engines as bit-exact payloads keyed by chain hash)
+
+    def _check_transferable(self, op: str):
+        if self.cache_quant == "int8":
+            raise ValueError(
+                f"{op} cannot be used with cache_quant='int8': the int8 "
+                "cache dequantizes through per-(slot, kv-head) DYNAMIC "
+                "scales frozen at each sequence's own prefill, so a "
+                "block's uint8 payload is only meaningful under its "
+                "writer's scales — another engine importing it would "
+                "dequantize garbage. Disaggregated transfer requires the "
+                "unquantized cache")
+
+    def export_blocks(self, hashes: Sequence[str]) -> Dict:
+        """Bit-exact KV payload for a chain of published block hashes
+        (parent-first order).  Stops at the first hash this pool no
+        longer holds — a chain is only usable up to its first gap, so
+        exporting past one would ship unmatchable blocks.  The payload
+        is host numpy (device→host copy), self-describing enough for
+        ``import_blocks`` to reject geometry mismatches loudly."""
+        self._check_transferable("export_blocks")
+        blocks: Dict[str, Dict[str, list]] = {}
+        for h in hashes:
+            b = self.blocks.lookup(h)
+            if b is None:
+                break
+            blocks[h] = {"k": [np.asarray(kc[b]) for kc in self.key_caches],
+                         "v": [np.asarray(vc[b]) for vc in self.value_caches]}
+        return {"block_size": self.bs, "layers": self.L, "kv_heads": self.KV,
+                "head_dim": self.D, "dtype": str(self.key_caches[0].dtype),
+                "blocks": blocks}
+
+    def import_blocks(self, payload: Dict) -> int:
+        """Install an ``export_blocks`` payload into this pool: allocate
+        a block, write the bits on device, ``publish`` it under its
+        chain hash while live, then ``free`` it — which parks it in the
+        reuse LRU, content-addressable exactly like a locally-prefilled
+        published block.  Already-cached hashes are skipped (first
+        publisher wins); allocation pressure stops the import early
+        (partial chains are still useful from the root).  Returns the
+        number of blocks imported."""
+        self._check_transferable("import_blocks")
+        geom = (payload.get("block_size"), payload.get("layers"),
+                payload.get("kv_heads"), payload.get("head_dim"),
+                payload.get("dtype"))
+        want = (self.bs, self.L, self.KV, self.D,
+                str(self.key_caches[0].dtype))
+        if geom != want:
+            raise ValueError(
+                f"import_blocks: payload geometry {geom} does not match "
+                f"this engine's cache geometry {want} (block_size, layers, "
+                "kv_heads, head_dim, dtype) — transfers require identical "
+                "cache layouts")
+        imported = 0
+        for h, kv in payload.get("blocks", {}).items():
+            if self.blocks.lookup(h) is not None:
+                continue
+            if not self.blocks.can_allocate(1):
+                break
+            (b,) = self.blocks.allocate(1)
+            self._write_block(b, kv["k"], kv["v"])
+            self.blocks.publish(b, h)
+            self.blocks.free([b])   # park published: reusable, evictable
+            imported += 1
+        return imported
+
+    def _write_block(self, dst: int, ks: Sequence[np.ndarray],
+                     vs: Sequence[np.ndarray]):
+        """Device-side write of one imported block across every layer's
+        K and V cache (same shape of program as the COW copy: the block
+        id is data, so one compiled write program serves every import)."""
+        if self._put_fn is None:
+            if "put" not in self._programs:
+                def put(kcs, vcs, d, ks, vs):
+                    kcs = [kc.at[d].set(k) for kc, k in zip(kcs, ks)]
+                    vcs = [vc.at[d].set(v) for vc, v in zip(vcs, vs)]
+                    return kcs, vcs
+                self._programs["put"] = jax.jit(put, donate_argnums=(0, 1))
+            self._put_fn = self._programs["put"]
+        self.key_caches, self.value_caches = self._put_fn(
+            self.key_caches, self.value_caches, jnp.asarray(dst, jnp.int32),
+            [jnp.asarray(k) for k in ks], [jnp.asarray(v) for v in vs])

@@ -1,0 +1,306 @@
+"""Disaggregated prefill/decode over the fleet-wide KV fabric
+(ISSUE 17): directory + fenced block leases, bit-exact engine-level
+block export/import, prefill-pass routing, prefill-in-progress dedup,
+and every fault path degrading to recompute with token parity intact.
+
+Fast in-process tests ride tier-1 (the shared session ``serving_model``
+keeps build cost flat); the real-worker fleet test (role labels riding
+launch-KV registration + export/import over RPC) spawns subprocesses at
+~10 s apiece and is marked ``slow`` like the rest of the fleet suite —
+the CI 'parallel' shard runs it with no marker filter.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu.inference import (
+    RequestStatus,
+    ServingEngine,
+    ServingFrontend,
+    StaleEpoch,
+)
+from paddle_tpu.inference.kv_fabric import FabricEntry, KVFabric, MemoryKV
+from paddle_tpu.inference.serving import prompt_block_hashes
+
+pytestmark = pytest.mark.quick
+
+ENGINE = dict(max_batch_size=2, max_seq_len=96, block_size=8,
+              num_blocks=48)
+PROMPT = list(range(2, 34))          # 4 full blocks
+PROMPT_B = list(range(40, 72))
+SEEDED = dict(temperature=0.8, top_p=0.9, seed=7)
+
+
+@pytest.fixture()
+def model(serving_model):
+    from paddle_tpu.distributed.topology import set_hybrid_communicate_group
+
+    set_hybrid_communicate_group(None)
+    return serving_model
+
+
+def _engine(model, role=None, **over):
+    eng = ServingEngine(model, **{**ENGINE, **over})
+    if role is not None:
+        eng.role = role
+    return eng
+
+
+def _serve(fe, prompt, n, **kw):
+    rid = fe.submit(prompt, max_new_tokens=n, **kw)
+    res = fe.run()[rid]
+    assert res.status is RequestStatus.COMPLETED, res
+    return res.tokens
+
+
+class TestExportImport:
+    def test_roundtrip_bit_exact_and_token_parity(self, model):
+        """Blocks exported from the computing engine and imported into a
+        fresh one are byte-identical on re-export, and serving from the
+        imported cache is greedy token-identical while computing only
+        the one uncached tail token."""
+        a, b = _engine(model), _engine(model)
+        ref = _serve(ServingFrontend(a), PROMPT, 8)
+        hashes = prompt_block_hashes(PROMPT, ENGINE["block_size"])
+        payload = a.export_blocks(hashes)
+        assert set(payload["blocks"]) == set(hashes)
+        assert b.import_blocks(payload) == len(hashes)
+        # bit-exact: the imported cache re-exports the same bytes
+        back = b.export_blocks(hashes)
+        for h in hashes:
+            for k1, k2 in zip(payload["blocks"][h]["k"],
+                              back["blocks"][h]["k"]):
+                np.testing.assert_array_equal(k1, k2)
+            for v1, v2 in zip(payload["blocks"][h]["v"],
+                              back["blocks"][h]["v"]):
+                np.testing.assert_array_equal(v1, v2)
+        got = _serve(ServingFrontend(b), PROMPT, 8)
+        assert got == ref
+        # the whole prompt minus its cached full blocks, plus the +1
+        # logits recompute, is all the importing engine ever computed
+        assert b.prefill_tokens_computed <= (
+            len(PROMPT) - len(hashes) * ENGINE["block_size"] + 1)
+
+    def test_seeded_sampling_parity_from_imported_cache(self, model):
+        a, b = _engine(model), _engine(model)
+        ref = _serve(ServingFrontend(a), PROMPT, 8, **SEEDED)
+        payload = a.export_blocks(prompt_block_hashes(
+            PROMPT, ENGINE["block_size"]))
+        b.import_blocks(payload)
+        assert _serve(ServingFrontend(b), PROMPT, 8, **SEEDED) == ref
+
+    def test_int8_cache_is_typed_error_both_directions(self, model):
+        eng = _engine(model, cache_quant="int8")
+        with pytest.raises(ValueError, match="int8"):
+            eng.export_blocks(["deadbeef"])
+        with pytest.raises(ValueError, match="int8"):
+            eng.import_blocks({"block_size": 8, "blocks": {}})
+
+    def test_geometry_mismatch_is_typed_error(self, model):
+        a = _engine(model)
+        _serve(ServingFrontend(a), PROMPT, 2)
+        payload = a.export_blocks(prompt_block_hashes(
+            PROMPT, ENGINE["block_size"]))
+        b = _engine(model, block_size=16)
+        with pytest.raises(ValueError, match="geometry"):
+            b.import_blocks(payload)
+
+    def test_export_stops_at_chain_gap(self, model):
+        a = _engine(model)
+        _serve(ServingFrontend(a), PROMPT, 2)
+        hashes = prompt_block_hashes(PROMPT, ENGINE["block_size"])
+        payload = a.export_blocks([hashes[0], "missing", hashes[1]])
+        assert set(payload["blocks"]) == {hashes[0]}
+
+
+class TestDirectory:
+    def test_memorykv_cas_semantics(self):
+        kv = MemoryKV()
+        assert kv.cas("k", None, "a")          # absent -> set
+        assert not kv.cas("k", None, "b")      # now present
+        assert kv.cas("k", "a", "b")
+        assert kv.get("k") == "b"
+        kv.put("p/x", "1")
+        assert kv.get_prefix("p/") == {"p/x": "1"}
+
+    def test_stale_epoch_entry_rejected_and_dropped(self):
+        fab = KVFabric(MemoryKV())
+        fab.publish_chain("old-life", ["h1", "h2"], epoch=1)
+        fab.set_epoch(2)
+        with pytest.raises(StaleEpoch):
+            fab.lookup("h1")
+        assert fab.counters["stale_entries_total"] == 1
+        assert "h1" not in fab.entries()       # the row is gone, not served
+        # lookup_chain treats the stale lease as the end of the chain
+        assert fab.lookup_chain(["h2", "h1"]) == []
+
+    def test_lookup_chain_longest_live_prefix(self):
+        fab = KVFabric(MemoryKV())
+        fab.publish_chain("w0", ["a", "b"])
+        chain = fab.lookup_chain(["a", "b", "c"])
+        assert [e.hash for e in chain] == ["a", "b"]
+        assert all(isinstance(e, FabricEntry) and e.owner == "w0"
+                   for e in chain)
+
+    def test_depth_is_eviction_cost_signal(self):
+        fab = KVFabric(MemoryKV(), max_entries=3)
+        fab.publish_chain("w0", ["a", "b", "c"])   # depths 1, 2, 3
+        fab.publish_chain("w1", ["x", "y"])        # depths 1, 2
+        # capacity 3: the shallowest (cheapest-to-recompute) leases go
+        left = fab.entries()
+        assert len(left) == 3
+        assert fab.eviction_cost("c") == 3
+        assert "c" in left                      # deepest chain tail kept
+
+    def test_prefill_claim_dedup_and_release(self):
+        fab = KVFabric(MemoryKV())
+        assert fab.begin_prefill("key1", "w0")
+        assert not fab.begin_prefill("key1", "w1")   # twin loses the CAS
+        assert fab.counters["prefill_dedup_hits_total"] == 1
+        assert fab.prefill_owner("key1") == "w0"
+        fab.finish_prefill("key1")
+        assert fab.prefill_owner("key1") is None
+        assert fab.begin_prefill("key1", "w1")
+
+    def test_drop_owner_removes_all_leases(self):
+        fab = KVFabric(MemoryKV())
+        fab.publish_chain("dead", ["a", "b"])
+        fab.publish_chain("live", ["c"])
+        assert fab.drop_owner("dead") == 2
+        assert set(fab.entries()) == {"c"}
+
+
+class TestDisaggFrontend:
+    def _colocated(self, model, prompt, n, **kw):
+        return _serve(ServingFrontend(_engine(model)), prompt, n, **kw)
+
+    def test_greedy_and_seeded_parity(self, model):
+        ref_g = self._colocated(model, PROMPT, 8)
+        ref_s = self._colocated(model, PROMPT_B, 8, **SEEDED)
+        fab = KVFabric(MemoryKV())
+        fe = ServingFrontend([_engine(model, "prefill"),
+                              _engine(model, "decode")], kv_fabric=fab)
+        assert _serve(fe, PROMPT, 8) == ref_g
+        assert _serve(fe, PROMPT_B, 8, **SEEDED) == ref_s
+        assert fe.metrics.counter("fabric_prefill_passes_total") >= 1
+        assert fab.counters["pulls_total"] >= 1
+
+    def test_identical_prompts_dedupe_to_one_prefill(self, model):
+        ref = self._colocated(model, PROMPT, 8)
+        fab = KVFabric(MemoryKV())
+        fe = ServingFrontend([_engine(model, "prefill"),
+                              _engine(model, "decode")], kv_fabric=fab)
+        r1 = fe.submit(PROMPT, max_new_tokens=8)
+        r2 = fe.submit(PROMPT, max_new_tokens=8)
+        res = fe.run()
+        assert res[r1].tokens == ref and res[r2].tokens == ref
+        assert fe.metrics.counter("fabric_prefill_passes_total") == 1
+        assert fe.metrics.counter("fabric_dedup_waits_total") >= 1
+        assert fab.counters["prefill_claims_total"] == 1
+
+    def test_dead_owner_pull_fails_over_to_recompute(self, model):
+        ref = self._colocated(model, PROMPT, 8)
+        fab = KVFabric(MemoryKV())
+        fab.publish_chain("ghost-worker", prompt_block_hashes(
+            PROMPT, ENGINE["block_size"]))
+        fe = ServingFrontend([_engine(model, "prefill"),
+                              _engine(model, "decode")], kv_fabric=fab)
+        assert _serve(fe, PROMPT, 8) == ref
+        assert fe.metrics.counter("fabric_pull_failures_total") >= 1
+        assert fe.metrics.counter("fabric_recomputes_total") >= 1
+        assert not any(e.owner == "ghost-worker"
+                       for e in fab.entries().values())
+
+    def test_stale_directory_entry_recomputes_with_parity(self, model):
+        ref = self._colocated(model, PROMPT, 8)
+        kv = MemoryKV()
+        KVFabric(kv).publish_chain("old-life", prompt_block_hashes(
+            PROMPT, ENGINE["block_size"]), epoch=1)
+        fab = KVFabric(kv)
+        fe = ServingFrontend([_engine(model, "prefill"),
+                              _engine(model, "decode")],
+                             kv_fabric=fab, epoch=2)
+        assert _serve(fe, PROMPT, 8) == ref
+        assert fab.counters["stale_entries_total"] >= 1
+
+    def test_block_transfer_span_event(self, model):
+        from paddle_tpu.inference.tracing import Tracer
+
+        tracer = Tracer()
+        fe = ServingFrontend([_engine(model, "prefill"),
+                              _engine(model, "decode")],
+                             kv_fabric=KVFabric(MemoryKV()), tracer=tracer)
+        rid = fe.submit(PROMPT, max_new_tokens=4)
+        fe.run()
+        evs = [e for e in tracer.all_events()
+               if e.get("event") == "block_transfer"]
+        assert evs, "no block_transfer event on the prefill->decode hop"
+        assert evs[0]["attrs"]["blocks"] >= 1
+        assert evs[0]["attrs"]["bytes"] > 0
+        assert evs[0]["rid"] == rid
+
+    def test_all_prefill_fleet_degrades_to_colocated(self, model):
+        """A mislabelled deployment (every replica 'prefill') must serve,
+        not wedge: the decode pool falls back to the whole fleet."""
+        ref = self._colocated(model, PROMPT, 6)
+        fe = ServingFrontend([_engine(model, "prefill"),
+                              _engine(model, "prefill")],
+                             kv_fabric=KVFabric(MemoryKV()))
+        assert _serve(fe, PROMPT, 6) == ref
+
+
+@pytest.mark.slow
+class TestFleetRoles:
+    def test_roles_ride_launch_kv_and_rpc_transfer(self):
+        """Worker role labels ride the spec JSON + launch-KV registration
+        (``fleet.worker_roles``), ``connect_workers`` rebuilds a
+        role-correct fleet (the StandbyFrontend takeover path), and
+        export/import over the fenced ``_w_export_blocks`` /
+        ``_w_import_blocks`` RPCs is bit-exact across real worker
+        processes."""
+        from paddle_tpu.inference import ServingFleet
+        from paddle_tpu.inference.fleet import connect_workers, worker_roles
+
+        model_cfg = dict(vocab_size=256, hidden_size=64,
+                         intermediate_size=160, num_hidden_layers=1,
+                         num_attention_heads=2,
+                         max_position_embeddings=256)
+        engine_cfg = dict(max_batch_size=2, max_seq_len=64, block_size=8,
+                          token_budget=16)
+        spec = {"seed": 11, "model": model_cfg, "engine": engine_cfg}
+        prompt = list(range(2, 26))            # 3 full blocks at bs=8
+        with ServingFleet(spec, num_workers=2,
+                          worker_roles=["prefill", "decode"],
+                          heartbeat_interval_s=0.5,
+                          spawn_timeout=180.0) as fleet:
+            ep = fleet.master_endpoint
+            assert worker_roles(ep) == {"worker0": "prefill",
+                                        "worker1": "decode"}
+            reps = {getattr(r.engine, "worker", None): r
+                    for r in fleet.frontend.replicas}
+            assert reps["worker0"].engine.role == "prefill"
+            assert reps["worker1"].engine.role == "decode"
+
+            # compute the prompt's KV on the prefill worker, then move it
+            pre, dec = reps["worker0"].engine, reps["worker1"].engine
+            rid = pre.add_request(prompt, max_new_tokens=1)
+            for _ in range(64):
+                pre.step()
+                if pre.pop_finished():
+                    break
+            hashes = prompt_block_hashes(prompt, engine_cfg["block_size"])
+            payload = pre.export_blocks(hashes)
+            assert set(payload["blocks"]) == set(hashes)
+            assert dec.import_blocks(payload) == len(hashes)
+            back = dec.export_blocks(hashes)
+            for h in hashes:
+                for k1, k2 in zip(payload["blocks"][h]["k"],
+                                  back["blocks"][h]["k"]):
+                    np.testing.assert_array_equal(np.asarray(k1),
+                                                  np.asarray(k2))
+
+            # the takeover path: a fresh connect_workers() (what a
+            # StandbyFrontend's replica factory runs) sees the same roles
+            rebuilt = connect_workers(ep)
+            got = {r.worker: r.role for r in rebuilt}
+            assert got == {"worker0": "prefill", "worker1": "decode"}

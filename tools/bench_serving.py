@@ -343,6 +343,135 @@ def run_bench_prefix(num_requests=None, shared_prefix_len=None, seed=0):
     }
 
 
+def run_bench_disagg(num_groups=None, group_size=None, seed=0):
+    """Disaggregated prefill/decode workload (ISSUE 17): G distinct
+    full-block prompts, each submitted C times CONCURRENTLY (a popular
+    prompt hitting the whole fleet at once), served by two plain decode
+    replicas (off) vs a prefill-role replica + the same two decode
+    replicas over a KV fabric (on).  The gated ``value`` is the ratio of
+    fleet-wide ``prefill_tokens_computed`` with disagg on / off —
+    transferred blocks count as NOT computed (the import path writes KV
+    without running attention), so the ratio falls exactly when the
+    prefill-in-progress table dedupes the concurrent twins down to one
+    pass and the directory moves the result instead of recomputing it
+    per replica.  Deterministic counters, wall-clock-free; asserts
+    greedy outputs token-identical across modes."""
+    import jax
+    import numpy as np
+
+    import bench_ladder  # repo root is on sys.path (top of this file)
+    import paddle_tpu as P
+    from paddle_tpu.inference import ServingEngine, ServingFrontend
+    from paddle_tpu.inference.kv_fabric import KVFabric, MemoryKV
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    backend = jax.default_backend()
+    on_accel = backend in ("tpu", "axon")
+    if on_accel:
+        model_cfg = dict(vocab_size=32000, hidden_size=2560,
+                         intermediate_size=8192, num_hidden_layers=9,
+                         num_attention_heads=10,
+                         max_position_embeddings=2048, dtype="bfloat16")
+        engine_cfg = dict(max_batch_size=8, max_seq_len=448, block_size=64,
+                          token_budget=128, num_blocks=56)
+        prompt_blocks, max_new = 3, 16
+        num_groups = num_groups or 3
+        group_size = group_size or 6
+    else:
+        model_cfg = dict(vocab_size=512, hidden_size=128,
+                         intermediate_size=352, num_hidden_layers=2,
+                         num_attention_heads=4, max_position_embeddings=256)
+        engine_cfg = dict(max_batch_size=4, max_seq_len=64, block_size=8,
+                          token_budget=16, num_blocks=24)
+        prompt_blocks, max_new = 3, 8
+        num_groups = num_groups or 3
+        group_size = group_size or 4
+    bs = engine_cfg["block_size"]
+    rng = np.random.RandomState(seed)
+    groups = [rng.randint(0, model_cfg["vocab_size"],
+                          (prompt_blocks * bs,)).tolist()
+              for _ in range(num_groups)]
+    # interleaved so every dispatch round sees twins from several groups
+    prompts = [groups[g] for _ in range(group_size)
+               for g in range(num_groups)]
+
+    P.seed(0)
+    model = LlamaForCausalLM(LlamaConfig(**model_cfg))
+    if on_accel:
+        model.bfloat16()
+    model.eval()
+
+    def serve(disagg):
+        engines = [ServingEngine(model, **engine_cfg) for _ in range(2)]
+        fab = None
+        if disagg:
+            for e in engines:
+                e.role = "decode"
+            pre = ServingEngine(model, **engine_cfg)
+            pre.role = "prefill"
+            engines = [pre] + engines
+            fab = KVFabric(MemoryKV())
+        fe = ServingFrontend(engines, kv_fabric=fab)
+        t0 = time.monotonic()
+        rids = [fe.submit(p, max_new_tokens=max_new) for p in prompts]
+        fe.run()
+        wall = time.monotonic() - t0
+        res = fe.results()
+        snap = fe.metrics.snapshot()
+        return {
+            "tokens": [res[r].tokens for r in rids],
+            "computed": sum(int(e.prefill_tokens_computed)
+                            for e in engines),
+            "decode_computed": sum(
+                int(e.prefill_tokens_computed) for e in engines
+                if getattr(e, "role", None) != "prefill"),
+            "prefill_passes": snap["counters"].get(
+                "fabric_prefill_passes_total", 0),
+            "dedup_waits": snap["counters"].get(
+                "fabric_dedup_waits_total", 0),
+            "fabric": dict(fab.counters) if fab is not None else None,
+            "wall_s": round(wall, 3),
+        }
+
+    off = serve(False)
+    on = serve(True)
+    assert on["tokens"] == off["tokens"], \
+        "disaggregation changed greedy outputs — parity violation"
+    frac = on["computed"] / max(off["computed"], 1)
+    total_prefill = sum(len(p) for p in prompts)
+    return {
+        "metric": "serving_disagg_prefill_fraction",
+        "value": round(frac, 4),
+        "unit": "computed disagg/colocated (lower=better)",
+        "extra": {
+            "host": bench_ladder.host_fingerprint(),
+            "backend": backend,
+            "num_groups": num_groups,
+            "group_size": group_size,
+            "prompt_blocks": prompt_blocks,
+            "block_size": bs,
+            "max_new_tokens": max_new,
+            "total_prompt_tokens": total_prefill,
+            "prefill_tokens_computed_off": off["computed"],
+            "prefill_tokens_computed_on": on["computed"],
+            "decode_side_computed_on": on["decode_computed"],
+            "prefill_passes": on["prefill_passes"],
+            "dedup_waits": on["dedup_waits"],
+            "blocks_transferred": on["fabric"]["pulled_blocks_total"],
+            "bytes_transferred": on["fabric"]["pulled_bytes_total"],
+            "wall_s_off": off["wall_s"],
+            "wall_s_on": on["wall_s"],
+            "outputs_token_identical": True,
+            "method": "same concurrent identical-prompt stream served by "
+                      "2 decode replicas (off) vs prefill+2 decode over "
+                      "the KV fabric (on); value = ratio of fleet-summed "
+                      "engine prefill_tokens_computed counters — "
+                      "transferred blocks are written, not computed "
+                      "(deterministic, wall-clock-free)",
+        },
+    }
+
+
 def run_bench_megastep(num_requests=None, megastep_k=8, seed=0):
     """Megastep rung (ISSUE 9): a closed batch of requests served to
     completion with in-graph K-step decode vs per-token stepping.  The
@@ -622,6 +751,12 @@ def main(argv=None):
                          "with the same S-token system prompt (>= 2 full "
                          "blocks); reports hit rate + prefill tokens "
                          "computed cache-on vs cache-off")
+    ap.add_argument("--disagg", action="store_true",
+                    help="disaggregation workload (ISSUE 17) — concurrent "
+                         "identical prompts served colocated vs prefill/"
+                         "decode split over the KV fabric; reports the "
+                         "fleet-wide computed-prefill-token ratio "
+                         "(transferred blocks count as not-computed)")
     ap.add_argument("--megastep", action="store_true",
                     help="megastep workload — a closed batch served with "
                          "in-graph K-step decode vs per-token stepping; "
@@ -633,7 +768,9 @@ def main(argv=None):
                          "reports host round trips per token with the "
                          "mixed-phase megastep on + greedy/seeded parity")
     args = ap.parse_args(argv)
-    if args.staggered_admission:
+    if args.disagg:
+        line = run_bench_disagg(seed=args.seed)
+    elif args.staggered_admission:
         line = run_bench_staggered(num_requests=args.num_requests,
                                    megastep_k=args.megastep_k,
                                    seed=args.seed)

@@ -410,6 +410,174 @@ def run_chaos(seed=0, replicas=3, num_requests=18, max_request_retries=2,
     }
 
 
+def _disagg_request_stream(seed, num_requests):
+    """Seeded stream for the disaggregation soak: LONG prompts (the
+    fabric only moves FULL blocks — the base stream's 2-5 token prompts
+    never publish anything) with identical-prompt pairs riding along to
+    drive the prefill-in-progress dedup table.  Priorities/max-new reuse
+    the base stream's seeded cadence so the reference stays shared."""
+    import random
+
+    base = _request_stream(seed, num_requests, poison=False)
+    rng = random.Random(f"disagg-reqs:{seed}")
+    out = []
+    for _, m, pr in base:
+        prompt = [rng.randrange(1, MODEL["vocab_size"])
+                  for _ in range(rng.randrange(17, 30))]
+        out.append((prompt, m, pr))
+    for i in range(0, len(out) - 1, 4):
+        # the twin keeps its own max_new/priority — only the PROMPT (and
+        # so the block chain + prefill claim key) is shared
+        out[i + 1] = (list(out[i][0]), out[i + 1][1], out[i + 1][2])
+    return out
+
+
+def run_chaos_disagg(seed=0, num_requests=16, max_steps=3000):
+    """Disaggregated-serving chaos soak (ISSUE 17): a prefill-role
+    replica + two decode replicas over a fenced KV fabric, with all
+    three ``fabric.*`` failpoints armed, a deterministically pre-seeded
+    STALE directory entry (written at epoch 1, frontend fenced at 2),
+    and the prefill replica dying mid-run.  Asserts the disaggregation
+    contract: every request reaches a typed terminal, every COMPLETED
+    request is token-identical to colocated fault-free serving (greedy
+    AND the dedup twins), every fabric fault degraded to recompute, and
+    the prefill/pull/dedup machinery actually ran (a soak where the
+    fabric quietly idled must not count as coverage)."""
+    from paddle_tpu.distributed.rpc import RpcTimeout
+    from paddle_tpu.inference import (FaultInjector, RequestStatus,
+                                      ServingEngine, ServingFrontend)
+    from paddle_tpu.inference.faults import FaultyReplica
+    from paddle_tpu.inference.kv_fabric import KVFabric, MemoryKV
+    from paddle_tpu.inference.serving import prompt_block_hashes
+    from paddle_tpu.inference.tracing import (FlightRecorder, TraceContext,
+                                              Tracer, events_digest,
+                                              tree_complete)
+
+    model = _build_model()
+    reqs = _disagg_request_stream(seed, num_requests)
+    ref_tokens = _reference_tokens(model, reqs)
+
+    step_i = 0
+
+    def tclock():
+        return float(step_i)
+
+    # all three fabric sites armed: publish = prefill worker dies before
+    # its chain lands; pull = decode pulls from a dead peer; directory =
+    # a directory read blows up mid-lookup.  Every one must degrade to
+    # recompute with token parity intact.  r0.step additionally kills the
+    # prefill replica itself mid-soak (the process-death variant).
+    inj = FaultInjector({
+        "fabric.publish": {"kind": "error", "after": 1, "times": 1},
+        "fabric.pull": {"kind": "error", "after": 1, "times": 1},
+        "fabric.directory": {"kind": "error", "after": 4, "times": 1},
+        "r0.step": {"kind": "error", "after": 8, "times": 1},
+    }, seed=seed, replica_namespaces=["r0", "r1", "r2"])
+    tracer = Tracer(clock=tclock, proc="frontend")
+    inj.recorder = tracer.recorder
+
+    kv = MemoryKV()
+    # the stale lease, planted by a PREVIOUS incarnation (epoch 1, owner
+    # long gone) over the first request's real chain: the epoch-2
+    # frontend's first lookup must reject it typed and recompute
+    KVFabric(kv).publish_chain(
+        "ghost-prefill", prompt_block_hashes(reqs[0][0],
+                                             ENGINE["block_size"]),
+        epoch=1)
+    fab = KVFabric(kv, fault_injector=inj)
+
+    def mk(i, role):
+        eng = ServingEngine(model, fault_injector=inj,
+                            trace_recorder=FlightRecorder(clock=tclock,
+                                                          proc=f"r{i}"),
+                            clock=tclock, **ENGINE)
+        eng.role = role
+        return FaultyReplica(eng, inj, name=f"r{i}",
+                             timeout_exc=RpcTimeout)
+
+    fe = ServingFrontend(
+        [mk(0, "prefill"), mk(1, "decode"), mk(2, "decode")],
+        kv_fabric=fab, epoch=2, tracer=tracer)
+
+    rids = []
+    submitted = 0
+    while (fe.pending or submitted < len(reqs)) and step_i < max_steps:
+        for _ in range(2):
+            if submitted < len(reqs):
+                p, m, pr = reqs[submitted]
+                rids.append(fe.submit(p, max_new_tokens=m, priority=pr))
+                submitted += 1
+        fe.step()
+        step_i += 1
+    for rep in list(fe.replicas):
+        if not rep.alive:
+            fe.remove_replica(rep)
+            tracer.absorb(rep.engine._eng.pop_trace_events())
+
+    # ---- disaggregation contract
+    res = fe.results()
+    assert len(res) == len(rids) and not fe.pending, (
+        f"disagg soak stalled: {fe.pending} request(s) never reached a "
+        f"terminal status in {max_steps} steps")
+    statuses = {}
+    mismatched = []
+    for i, rid in enumerate(rids):
+        r = res[rid]
+        statuses[r.status.value] = statuses.get(r.status.value, 0) + 1
+        if r.status is RequestStatus.COMPLETED \
+                and r.tokens != ref_tokens[i]:
+            mismatched.append(rid)
+    assert not mismatched, (
+        f"disagg survivors diverged from colocated serving: {mismatched}")
+    for site in ("fabric.publish", "fabric.pull", "fabric.directory"):
+        assert inj.fires(site) >= 1, f"failpoint {site} never fired"
+    m = fe.metrics
+    assert m.counter("fabric_prefill_passes_total") >= 1, (
+        "no prefill pass ever ran — the fleet degraded to colocated")
+    assert fab.counters["pulls_total"] >= 1, "no chain was ever pulled"
+    assert fab.counters["stale_entries_total"] >= 1, (
+        "the pre-seeded epoch-1 lease was never rejected")
+    assert m.counter("fabric_dedup_waits_total") >= 1, (
+        "identical twin prompts never hit the prefill-in-progress table")
+    assert m.counter("fabric_recomputes_total") >= 1, (
+        "no fabric fault degraded to recompute — the schedule missed")
+
+    # ---- span-tree contract: complete trees, and at least one request
+    # carries the prefill -> transfer -> decode hop as a block_transfer
+    # event (the TTFT-attribution signal this soak exists to protect)
+    transfers = 0
+    for rid in rids:
+        tree = tracer.tree_for(TraceContext.mint(rid).trace_id)
+        ok, why = tree_complete(tree)
+        assert ok, f"rid {rid} span tree incomplete: {why}"
+        if any(e.get("event") == "block_transfer"
+               for evs in tree.values() for e in evs):
+            transfers += 1
+    assert transfers >= 1, "no block_transfer span event was recorded"
+
+    return {
+        "mode": "disagg",
+        "seed": seed,
+        "requests": len(rids),
+        "steps": step_i,
+        "statuses": statuses,
+        "fault_kinds_fired": inj.kinds_fired(),
+        "fabric_fires": {s: inj.fires(s) for s in
+                         ("fabric.publish", "fabric.pull",
+                          "fabric.directory")},
+        "prefill_passes": m.counter("fabric_prefill_passes_total"),
+        "dedup_waits": m.counter("fabric_dedup_waits_total"),
+        "recomputes": m.counter("fabric_recomputes_total"),
+        "pull_failures": m.counter("fabric_pull_failures_total"),
+        "replica_deaths": m.counter("replica_deaths_total"),
+        "fabric_counters": dict(fab.counters),
+        "requests_with_block_transfer": transfers,
+        "survivors_token_identical": True,
+        "trace_events": len(tracer.all_events()),
+        "trace_digest": events_digest(tracer.all_events()),
+    }
+
+
 def _kill_request_stream(seed, num_requests):
     """The shared seeded stream with per-request sampling attached:
     every third request is a seeded NON-GREEDY stream, so recovery has
@@ -1283,6 +1451,11 @@ def main(argv=None):
                     help="HA phase (ISSUE 12): lease-based standby "
                          "failover + zombie fencing; in-process by "
                          "default, real processes with --workers N")
+    ap.add_argument("--disagg", action="store_true",
+                    help="disaggregation phase (ISSUE 17): prefill/decode "
+                         "split over a fenced KV fabric with all three "
+                         "fabric.* failpoints armed + a stale directory "
+                         "lease + prefill-replica death")
     ap.add_argument("--pause-after", type=int, default=None,
                     help="standby: pause/kill the active frontend once "
                          "this many requests are terminal (with work "
@@ -1307,6 +1480,8 @@ def main(argv=None):
             args.requests = 10
         elif args.standby:
             args.requests = 14
+        elif args.disagg:
+            args.requests = 16
         else:
             args.requests = 18
     if args.pause_after is None:
@@ -1329,6 +1504,9 @@ def main(argv=None):
         report = run_standby(seed=args.seed,
                              num_requests=args.requests,
                              pause_after=args.pause_after)
+    elif args.disagg:
+        report = run_chaos_disagg(seed=args.seed,
+                                  num_requests=args.requests)
     elif args.kill_frontend:
         report = run_kill_frontend(seed=args.seed,
                                    num_requests=args.requests,

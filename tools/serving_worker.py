@@ -39,7 +39,8 @@ Spec JSON (everything the worker needs to be a bit-identical replica):
     {"seed": 11,
      "model": {"vocab_size": 256, "hidden_size": 64, ...},   # LlamaConfig
      "engine": {"max_batch_size": 2, "max_seq_len": 64, ...},
-     "bfloat16": false}
+     "bfloat16": false,
+     "role": "prefill"}    # optional disaggregation label (or "decode")
 
 Run standalone (an operator adding capacity from another host):
 
@@ -121,11 +122,21 @@ def main(argv=None):
         if injector is not None:
             injector.recorder = engine.trace_recorder
 
-    stop = fleet.init_worker(engine, name=args.name, fault_injector=injector)
+    role = spec.get("role")
+    stop = fleet.init_worker(engine, name=args.name, fault_injector=injector,
+                             role=role)
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_: stop.set())
     rpc.init_rpc(args.name, rank=args.rank, world_size=1,
                  master_endpoint=args.master)
+    if role is not None:
+        # the role label rides the launch-KV registration next to the rpc
+        # entry, so discovery (fleet.worker_roles / connect_workers) can
+        # rebuild a role-correct fleet on StandbyFrontend takeover even
+        # without probing every worker first
+        from paddle_tpu.distributed.launch.master import KVClient
+
+        KVClient(args.master).put(f"/serving/roles/{args.name}", role)
     print(f"WORKER_READY {args.name} pid={os.getpid()}", flush=True)
     stop.wait()
     rpc.shutdown()

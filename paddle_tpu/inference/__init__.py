@@ -28,7 +28,8 @@ __all__ = ["Config", "create_predictor", "Predictor", "PredictorPool",
            "RespawnCircuitBreaker", "RequestJournal", "JournalCorruption",
            "JournalSuperseded", "StaleEpoch", "EpochFence", "FencedEngine",
            "FrontendLease", "StandbyFrontend", "HandedOff",
-           "TraceContext", "FlightRecorder", "Tracer"]
+           "TraceContext", "FlightRecorder", "Tracer",
+           "TenantRegistry", "TenantSpec", "WarmPool"]
 
 from .control_plane import (  # noqa: E402
     BrownoutPolicy,
@@ -48,6 +49,7 @@ from .fleet import (  # noqa: E402
     FleetAutoscaler,
     RemoteReplica,
     ServingFleet,
+    WarmPool,
 )
 from .ha import (  # noqa: E402
     EpochFence,
@@ -62,6 +64,10 @@ from .journal import (  # noqa: E402
     RequestJournal,
 )
 from .metrics import ServingMetrics  # noqa: E402
+from .tenancy import (  # noqa: E402
+    TenantRegistry,
+    TenantSpec,
+)
 from .serving import (  # noqa: E402
     BlockManager,
     SamplingParams,

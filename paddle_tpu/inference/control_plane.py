@@ -208,6 +208,48 @@ launch KV master, not a replicated store; losing it costs recompute,
 never correctness).  One request burns at most one prefill pass
 (``prefill_passes`` budget): a fabric sick enough to fail the pass
 falls back to classic colocated placement.
+
+Tenancy (ISSUE 18).  Pass ``tenants=TenantRegistry([...])`` and the one
+fleet serves N tenants — named traffic classes each owning a model (or
+adapter) id, an admission token budget, a priority ceiling, and a
+fairness weight.  Admission: a tenant's requests are clamped to its
+priority ceiling and typed-rejected (OVERLOADED,
+``tenant_rejected_budget_total``) once its OUTSTANDING admitted tokens
+(prompt + max_new, released at terminal) exceed its budget — a bursty
+tenant cannot starve a steady one past its contract.  Fairness
+contract: dispatch runs deficit round-robin ACROSS tenants above the
+priority classes — each round credits every backlogged tenant
+``quantum * weight`` deficit tokens and places its (priority-sorted)
+requests while their remaining-token cost fits the credit, so over any
+window where two tenants stay backlogged their served-token shares
+converge to the ratio of their weights, independent of request sizes;
+priorities still order work WITHIN a tenant, and a tenant whose queue
+drains forfeits unused credit (no banking bursts).  Routing: a
+tenant's requests prefer replicas whose ``engine.model_id`` matches
+its model; with ``TenantRegistry.model_provider`` armed, a mismatched
+fleet swaps a replica on demand (an idle one immediately, else the
+least-loaded one is drained for the swap) — without a provider the
+model id is a preference, never a wedge.
+
+Rolling weight swaps.  ``rolling_swap(new_weights, version)`` upgrades
+the fleet one replica at a time: drain → ``engine.load_weights`` →
+re-admit.  What a swap GUARANTEES: zero dropped admitted requests
+(draining replicas finish their in-flight work; queued work routes to
+the rest of the fleet), and greedy+seeded token parity for every
+request completing entirely on ONE weights version — a drained replica
+has no in-flight sequence when its weights change, and the swap
+invalidates the replica's prefix cache and fabric directory entries,
+so no new-version request decodes against old-version KV.  What it
+does NOT guarantee: which version a mid-roll request lands on
+(``RequestResult.weights_version`` reports the version that generated
+its final tokens), fleet-wide atomicity (mid-roll the fleet is
+mixed-version by design), or admission continuity on a ONE-replica
+fleet (while its only replica drains, new submits take the typed
+draining rejection).  A swap fault (the ``weights.swap`` failpoint)
+leaves the replica serving its OLD version — counted in
+``weight_swap_failures_total``, never a drop.  Per-tenant counters and
+the ``weights_version`` trace/result labels ride the existing metric
+and trace machinery.
 """
 from __future__ import annotations
 
@@ -226,6 +268,7 @@ from .journal import (ADMIT, EPOCH, PROGRESS, TERMINAL, JournalSuperseded,
 from .metrics import (MEGASTEP_COUNTERS, ServingMetrics,
                       fold_counter_deltas, fold_prefix_counters)
 from .serving import SamplingParams, ServingEngine, prompt_block_hashes
+from .tenancy import TenantRegistry
 from .tracing import TraceContext, Tracer
 
 __all__ = ["Priority", "RequestStatus", "RequestResult", "ServingFrontend",
@@ -327,6 +370,10 @@ class RequestResult:
     ttft_s: Optional[float] = None
     e2e_s: Optional[float] = None
     logprobs: Optional[List[float]] = None
+    # weights version that generated the FINAL harvested tokens (None =
+    # version-less engine); single-version requests report that version
+    weights_version: Optional[str] = None
+    tenant: Optional[str] = None   # tenant attribution (registry armed)
 
     @property
     def ok(self) -> bool:
@@ -365,6 +412,11 @@ class _FrontendRequest:
     prefill_pass: bool = False
     prefill_passes: int = 0        # passes burned (bounds retry loops)
     fabric_key: Optional[str] = None  # held prefill-in-progress claim
+    # tenancy (ISSUE 18): resolved tenant name (None = registry off) and
+    # the weights version stamped at each harvest — last writer wins, so
+    # a single-version request reports exactly its version
+    tenant: Optional[str] = None
+    weights_version: Optional[str] = None
 
     @property
     def remaining_new_tokens(self) -> int:
@@ -394,6 +446,10 @@ class _Replica:
         self.engine = engine
         self.alive = True
         self.draining = False
+        # True while draining FOR A WEIGHT SWAP (rolling_swap or tenant
+        # swap-on-demand): the fleet's scale-down reaper must leave a
+        # swap-draining replica alone — it re-admits after the swap
+        self.swapping = False
         self.last_error: Optional[str] = None
         self.requests: Dict[int, _FrontendRequest] = {}  # engine_rid -> req
         # engine-level counters last folded into the registry (the engine
@@ -434,7 +490,8 @@ class ServingFrontend:
                  clock: Callable[[], float] = time.monotonic,
                  metrics: Optional[ServingMetrics] = None,
                  tracer: Optional[Tracer] = None,
-                 kv_fabric=None):
+                 kv_fabric=None,
+                 tenants: Optional[TenantRegistry] = None):
         if isinstance(engines, ServingEngine):
             engines = [engines]
         if not engines:
@@ -469,6 +526,12 @@ class ServingFrontend:
         # + transfer fabric.  None = classic colocated serving, zero new
         # code on any hot path.  See the "Disaggregation" docstring section.
         self.fabric = kv_fabric
+        # multi-tenant platform (ISSUE 18): None = single-tenant serving,
+        # zero new code on any hot path.  See the "Tenancy" docstring.
+        self.tenants = tenants
+        # replica idx -> model_id: drain-for-swap in progress (a replica
+        # being emptied so swap-on-demand routing can re-weight it)
+        self._pending_swaps: Dict[int, str] = {}
         self._queue: List[_FrontendRequest] = []
         self._requests: Dict[int, _FrontendRequest] = {}
         self._results: Dict[int, RequestResult] = {}
@@ -714,6 +777,75 @@ class ServingFrontend:
         if replica.alive:
             self._kill_replica(replica, exc)
 
+    def rolling_swap(self, new_weights, version: str, *,
+                     model_id: Optional[str] = None,
+                     step: Optional[Callable[[], None]] = None,
+                     max_steps: int = 10_000) -> int:
+        """Zero-downtime rolling weight swap (ISSUE 18): one replica at
+        a time, drain → load version-labelled weights → re-admit.  See
+        the "Rolling weight swaps" docstring section for the exact
+        guarantee (zero dropped admitted requests; greedy+seeded token
+        parity for requests completing on one version; a swap fault
+        keeps the replica on its OLD version).
+
+        ``new_weights`` is whatever each replica's ``load_weights``
+        accepts — a model for in-process engines, a worker spec dict for
+        ``fleet.RemoteReplica``.  ``step`` drives the control loop while
+        replicas drain (defaults to ``self.step``;
+        ``ServingFleet.rolling_swap`` passes the fleet step so
+        heartbeats and autoscaling keep running).  Returns the number of
+        replicas now serving ``version``."""
+        step_fn = step if step is not None else self.step
+        swapped = 0
+        for rep in list(self._replicas):
+            if not rep.alive:
+                continue
+            fn = getattr(rep.engine, "load_weights", None)
+            if fn is None:
+                self.metrics.inc("weight_swap_failures_total")
+                continue
+            rep.draining = True
+            rep.swapping = True    # scale-down must not reap a swapper
+            try:
+                waited = 0
+                while rep.alive and (rep.requests or rep.engine._queue
+                                     or rep.engine.num_active):
+                    step_fn()
+                    waited += 1
+                    if waited > max_steps:
+                        raise TimeoutError(
+                            f"rolling_swap: replica {rep.idx} did not "
+                            f"drain within {max_steps} steps — inspect "
+                            "its in-flight requests before retrying")
+                if not rep.alive:
+                    continue      # died mid-drain; failover already ran
+                try:
+                    fn(new_weights, version=version, model_id=model_id)
+                except StaleEpoch as e:
+                    self._fenced(e, rep)
+                except Exception:  # noqa: BLE001 — swap fault: the
+                    # replica keeps serving its OLD weights version
+                    self.metrics.inc("weight_swap_failures_total")
+                    if self.tracer is not None:
+                        self.tracer.process_event("weights_swap_failed",
+                                                  replica=rep.idx,
+                                                  version=version)
+                    continue
+                if self.fabric is not None:
+                    # old-version directory entries must never serve a
+                    # new-version pull
+                    self.fabric.drop_owner(self._replica_name(rep))
+                swapped += 1
+                self.metrics.inc("weight_swaps_total")
+                if self.tracer is not None:
+                    self.tracer.process_event("weights_swap",
+                                              replica=rep.idx,
+                                              version=version)
+            finally:
+                rep.draining = False
+                rep.swapping = False
+        return swapped
+
     @property
     def pending(self) -> int:
         """Requests submitted but not yet resolved to a RequestResult."""
@@ -732,6 +864,7 @@ class ServingFrontend:
                temperature: float = 0.0, top_k: int = 0,
                top_p: float = 1.0, seed: int = 0, logprobs: bool = False,
                idempotency_key: Optional[str] = None,
+               tenant: Optional[str] = None,
                on_token: Optional[Callable[[int, int], None]] = None) -> int:
         """Enqueue a request; never blocks. Returns a rid whose outcome is
         readable via ``result(rid)`` — immediately for typed rejections
@@ -789,6 +922,13 @@ class ServingFrontend:
         sampling = SamplingParams(temperature=float(temperature),
                                   top_k=int(top_k), top_p=float(top_p),
                                   seed=int(seed), logprobs=bool(logprobs))
+        tenant_name = tenant
+        if self.tenants is not None:
+            # tenancy (ISSUE 18): unknown tenants fold into "default";
+            # the ceiling clamps the class BEFORE any class-budget math
+            spec = self.tenants.get(tenant)
+            tenant_name = spec.name
+            priority = Priority(spec.clamp_priority(int(priority)))
         now = self._clock()
         # the durable rid is only CLAIMED on admission below; a rejected
         # request is re-homed into the negative space by _reject
@@ -800,6 +940,7 @@ class ServingFrontend:
             eos_token_id=eos_token_id, submit_t=now, seq=self._next_seq,
             sampling=sampling, on_token=on_token,
             idempotency_key=idempotency_key)
+        req.tenant = tenant_name
         self._next_seq += 1
 
         live = [r for r in self._replicas if r.alive]
@@ -853,11 +994,25 @@ class ServingFrontend:
                     f"class {req.priority.name} token budget "
                     f"exhausted ({held}+{req.total_tokens} > {cap} "
                     "fleet-wide)")
+        if (self.tenants is not None
+                and not self.tenants.budget_allows(req.tenant,
+                                                   req.total_tokens)):
+            spec = self.tenants.get(req.tenant)
+            self.metrics.inc("tenant_rejected_budget_total")
+            return self._reject(
+                req, RequestStatus.OVERLOADED,
+                f"tenant {spec.name!r} token budget exhausted "
+                f"({self.tenants.outstanding(spec.name)}"
+                f"+{req.total_tokens} > {spec.token_budget} outstanding "
+                "fleet-wide) — the per-tenant admission contract, not "
+                "fleet capacity")
         rid = req.rid
         self._next_rid += 1
         self._requests[rid] = req
         req.counted_tokens = req.total_tokens
         self._class_tokens[req.priority] += req.counted_tokens
+        if self.tenants is not None:
+            self.tenants.charge(req.tenant, req.counted_tokens)
         self._queue.append(req)
         req.admitted = True
         if idempotency_key is not None:
@@ -866,10 +1021,13 @@ class ServingFrontend:
             # minted BEFORE the admit record so the trace id rides it
             # (a journal-recovered request keeps its trace)
             req.trace = self.tracer.begin(rid)
+            admit_extra = ({"tenant": req.tenant}
+                           if req.tenant is not None else {})
             self.tracer.event(req.trace, "admit",
                               priority=int(req.priority),
                               prompt_len=len(prompt),
-                              max_new_tokens=req.max_new_tokens)
+                              max_new_tokens=req.max_new_tokens,
+                              **admit_extra)
             self.tracer.event(req.trace, "queue", depth=len(self._queue))
         # write-ahead: the admit record is durable BEFORE the request can
         # reach a replica, so a crash after this line cannot lose it
@@ -1123,6 +1281,7 @@ class ServingFrontend:
                 "sampling": req.sampling.to_wire(),
                 "key": req.idempotency_key,
                 "attempts": req.attempts, "nr": self._next_rid,
+                "tenant": req.tenant,
                 "trace": (req.trace.trace_id
                           if req.trace is not None else None)}
 
@@ -1442,8 +1601,13 @@ class ServingFrontend:
                 fe.tracer.event(req.trace, "recover",
                                 attempts=req.attempts)
             req.admitted = True
+            req.tenant = a.get("tenant")
             req.counted_tokens = req.total_tokens
             fe._class_tokens[req.priority] += req.counted_tokens
+            if fe.tenants is not None and req.tenant is not None:
+                # tenant budgets survive the restart: the re-admitted
+                # request holds its outstanding tokens again
+                fe.tenants.charge(req.tenant, req.counted_tokens)
             fe._requests[rid] = req
             fe._queue.append(req)
             if req.idempotency_key is not None:
@@ -1563,6 +1727,10 @@ class ServingFrontend:
                         break
 
     def _dispatch(self):
+        if self.tenants is not None:
+            self._maintain_tenant_swaps()
+            self._dispatch_tenant_drr()
+            return
         # priority order; equal-priority backfill is allowed past a blocked
         # request, strictly-lower is not (it would eat the blocks the
         # blocked class is waiting for, then get preempted right back)
@@ -1572,48 +1740,189 @@ class ServingFrontend:
                 continue
             if barrier is not None and int(req.priority) > barrier:
                 continue
-            live = [r for r in self._replicas if r.alive]
-            if not live:
+            out = self._place_one(req)
+            if out == "stop":
                 break
-            # draining replicas take no NEW placements (they finish what
-            # they have); queued work waits for accepting capacity
-            accepting = [r for r in live if not r.draining]
-            if not accepting:
-                break
-            if not any(self._fits_at_all(r, req) for r in accepting):
-                self._queue.remove(req)
-                self._finish(req, RequestStatus.OVERLOADED,
-                             f"prompt+max_new_tokens={req.total_tokens} "
-                             "exceeds every live replica's capacity")
-                continue
-            # disaggregation (ISSUE 17): prefill-role replicas never take
-            # decode placements — they exist to run prefill PASSES.  With
-            # no fabric (or an all-prefill fleet) the pool is `accepting`
-            # unchanged and dispatch behaves exactly as before.
-            placing = self._decode_pool(accepting)
-            if self.fabric is not None and not req.prefill_pass:
-                action, frep = self._fabric_plan(req, accepting, placing)
-                if action == "wait":
-                    # a twin prefill is in flight elsewhere — this request
-                    # stays queued WITHOUT raising the priority barrier
-                    # (it is blocked on dedup, not on capacity)
-                    continue
-                if action == "prefill":
-                    self._queue.remove(req)
-                    self._assign(req, frep)
-                    continue
-                if frep is not None:      # "place" onto the pulled-into rep
-                    self._queue.remove(req)
-                    self._assign(req, frep)
-                    continue
-            rep = self._pick_replica(req, placing)
-            if rep is None and self.preemption:
-                rep = self._preempt_for(req, placing)
-            if rep is None:
+            if out == "blocked":
                 barrier = int(req.priority)
-                continue
+
+    def _dispatch_tenant_drr(self):
+        """Deficit round-robin ACROSS tenants, above the priority
+        classes: each dispatch round credits every backlogged tenant
+        ``quantum * weight`` deficit tokens, then places its requests
+        (priority-sorted, with the same intra-class barrier as classic
+        dispatch) while their remaining-token cost fits the accumulated
+        credit.  A tenant whose queue drains forfeits leftover credit
+        (classic DRR — idle tenants cannot bank deficit and burst)."""
+        reg = self.tenants
+        backlog: Dict[str, List[_FrontendRequest]] = {}
+        for q in self._queue:
+            backlog.setdefault(reg.resolve(q.tenant), []).append(q)
+        if not backlog:
+            return
+        for name in reg.rotation(list(backlog)):
+            reg.add_deficit(name)
+            barrier: Optional[int] = None
+            for req in sorted(backlog[name], key=_FrontendRequest.sort_key):
+                if req not in self._queue:
+                    continue
+                if barrier is not None and int(req.priority) > barrier:
+                    continue
+                cost = req.remaining_new_tokens
+                if cost > reg.deficit(name):
+                    break          # out of credit — next round tops it up
+                out = self._place_one(req)
+                if out == "stop":
+                    return
+                if out == "blocked":
+                    barrier = int(req.priority)
+                elif out == "placed":
+                    reg.charge_deficit(name, cost)
+            if not any(q in self._queue for q in backlog[name]):
+                reg.reset_deficit(name)
+
+    def _place_one(self, req: _FrontendRequest) -> str:
+        """Try to place ONE queued request (the shared body of classic
+        and DRR dispatch).  Returns ``"placed"`` (assigned), ``"gone"``
+        (resolved without placement), ``"skip"`` (stays queued without
+        raising the priority barrier — fabric dedup wait or a tenant
+        swap in flight), ``"blocked"`` (no capacity for its class), or
+        ``"stop"`` (no accepting replicas at all)."""
+        live = [r for r in self._replicas if r.alive]
+        if not live:
+            return "stop"
+        # draining replicas take no NEW placements (they finish what
+        # they have); queued work waits for accepting capacity
+        accepting = [r for r in live if not r.draining]
+        if not accepting:
+            return "stop"
+        if not any(self._fits_at_all(r, req) for r in accepting):
             self._queue.remove(req)
-            self._assign(req, rep)
+            self._finish(req, RequestStatus.OVERLOADED,
+                         f"prompt+max_new_tokens={req.total_tokens} "
+                         "exceeds every live replica's capacity")
+            return "gone"
+        # disaggregation (ISSUE 17): prefill-role replicas never take
+        # decode placements — they exist to run prefill PASSES.  With
+        # no fabric (or an all-prefill fleet) the pool is `accepting`
+        # unchanged and dispatch behaves exactly as before.
+        placing = self._decode_pool(accepting)
+        # tenancy (ISSUE 18): route onto replicas serving the tenant's
+        # model (or trigger a swap); the narrowed pool also scopes the
+        # fabric plan so cross-model pulls cannot happen
+        placing = self._tenant_pool(req, placing)
+        if not placing:
+            return "skip"      # a swap is draining; blocked on the model,
+            # not on capacity — never raises the priority barrier
+        if self.fabric is not None and not req.prefill_pass:
+            action, frep = self._fabric_plan(req, accepting, placing)
+            if action == "wait":
+                # a twin prefill is in flight elsewhere — this request
+                # stays queued WITHOUT raising the priority barrier
+                # (it is blocked on dedup, not on capacity)
+                return "skip"
+            if action == "prefill":
+                self._queue.remove(req)
+                self._assign(req, frep)
+                return "placed"
+            if frep is not None:      # "place" onto the pulled-into rep
+                self._queue.remove(req)
+                self._assign(req, frep)
+                return "placed"
+        rep = self._pick_replica(req, placing)
+        if rep is None and self.preemption:
+            rep = self._preempt_for(req, placing)
+        if rep is None:
+            return "blocked"
+        self._queue.remove(req)
+        self._assign(req, rep)
+        return "placed"
+
+    # ------------------------------------------------- tenancy (ISSUE 18)
+    def _tenant_pool(self, req: _FrontendRequest,
+                     pool: List[_Replica]) -> List[_Replica]:
+        """Tenant-aware routing, ABOVE prefix affinity: prefer replicas
+        already serving the request's tenant's model.  With a
+        ``model_provider`` armed, a fleet holding no matching replica
+        swaps one on demand — an idle fitting replica immediately, else
+        the least-loaded one starts draining for the swap (the request
+        stays queued meanwhile).  Without a provider the model id is a
+        routing preference, never a wedge."""
+        if self.tenants is None:
+            return pool
+        spec = self.tenants.get(req.tenant)
+        mid = spec.model_id
+        matching = [r for r in pool
+                    if getattr(r.engine, "model_id", "default") == mid]
+        if matching:
+            if mid != "default":
+                self.metrics.inc("tenant_routing_hits_total")
+            return matching
+        if self.tenants.model_provider is None:
+            return pool
+        fits = [r for r in pool if self._fits_at_all(r, req)]
+        idle = [r for r in fits
+                if not r.requests and not r.engine._queue
+                and not r.engine.num_active]
+        for rep in idle:
+            if self._swap_replica(rep, mid):
+                self.metrics.inc("tenant_routing_hits_total")
+                return [rep]
+        self.metrics.inc("tenant_swap_waits_total")
+        if fits and not self._pending_swaps:
+            # start draining ONE replica for the swap; the request waits
+            # queued and _maintain_tenant_swaps completes the swap the
+            # moment the replica goes idle
+            target = min(fits, key=lambda r: (len(r.requests)
+                                              + len(r.engine._queue)))
+            target.draining = True
+            target.swapping = True
+            self._pending_swaps[target.idx] = mid
+        return []
+
+    def _maintain_tenant_swaps(self):
+        """Complete drain-for-swap transitions: a replica drained on
+        behalf of a tenant whose model was not resident is swapped and
+        re-admitted the moment it goes idle (dead replicas drop out)."""
+        if not self._pending_swaps:
+            return
+        for rep in self._replicas:
+            mid = self._pending_swaps.get(rep.idx)
+            if mid is None:
+                continue
+            if not rep.alive:
+                del self._pending_swaps[rep.idx]
+                continue
+            if rep.requests or rep.engine._queue or rep.engine.num_active:
+                continue          # still draining
+            del self._pending_swaps[rep.idx]
+            self._swap_replica(rep, mid)
+            rep.draining = False
+            rep.swapping = False
+
+    def _swap_replica(self, rep: _Replica, model_id: str) -> bool:
+        """Load ``model_id``'s weights onto an (idle) replica via the
+        registry's ``model_provider``.  A fault keeps the old weights
+        serving (counted, never a drop); success drops the replica's
+        fabric directory entries — old-model KV must not be pulled."""
+        provider = self.tenants.model_provider
+        fn = getattr(rep.engine, "load_weights", None)
+        if provider is None or fn is None:
+            return False
+        try:
+            fn(provider(model_id), model_id=model_id)
+        except StaleEpoch as e:
+            self._fenced(e, rep)   # deposed: raises, never a failover
+        except Exception:  # noqa: BLE001 — swap fault: keep old weights
+            self.metrics.inc("weight_swap_failures_total")
+            return False
+        if self.fabric is not None:
+            self.fabric.drop_owner(self._replica_name(rep))
+        self.metrics.inc("weight_swaps_total")
+        if self.tracer is not None:
+            self.tracer.process_event("weights_swap", replica=rep.idx,
+                                      model_id=model_id)
+        return True
 
     @staticmethod
     def _decode_pool(reps: List[_Replica]) -> List[_Replica]:
@@ -1663,11 +1972,20 @@ class ServingFrontend:
             self.metrics.inc("fabric_recomputes_total")
             return "place", None
         if len(chain) > local_best:
-            target = self._pick_replica(req, placing)
-            if target is None:
-                return "place", None
-            if self._pull_chain(req, target, chain):
-                return "place", target
+            # re-plan on pull failure (ISSUE 18 satellite, r17 remain):
+            # the chosen decode replica can die between the directory
+            # lookup and the transfer — fall back to another live decode
+            # replica before giving up on the chain (parity is untouched;
+            # pulled blocks are bit-exact wherever they land)
+            pool = list(placing)
+            while pool:
+                target = self._pick_replica(req, pool)
+                if target is None:
+                    return "place", None
+                if self._pull_chain(req, target, chain):
+                    return "place", target
+                self.metrics.inc("fabric_replans_total")
+                pool = [r for r in pool if r is not target and r.alive]
             return "place", None      # pull failed → recompute locally
         # nothing (better) published yet: try to claim a prefill pass
         if req.prefill_passes > 0:
@@ -1939,6 +2257,11 @@ class ServingFrontend:
                 # decode re-emits it token-identically (sample_offset=0
                 # restarts the seeded stream from the same prefix)
                 continue
+            # weights-version attribution (ISSUE 18): stamp the version
+            # that generated THIS burst — last writer wins, so a request
+            # completing entirely on one version reports exactly it
+            req.weights_version = getattr(rep.engine, "weights_version",
+                                          None)
             tid = req.trace.trace_id if req.trace is not None else None
             if req.first_token_t is None:
                 req.first_token_t = t
@@ -1990,29 +2313,50 @@ class ServingFrontend:
         """Prefill pass finished on ``rep``: publish the prompt's block
         chain to the directory, push the blocks to the decode replica that
         will own the request, release the dedup claim, and dispatch the
-        request for real.  Any fault (prefill-worker-dies-mid-stream,
-        injected fabric.publish/pull) degrades to recompute: the request
-        re-queues and decode admission simply misses the cache."""
+        request for real.  The pull target is RE-PLANNED when the chosen
+        decode replica dies between prefill completion and admission
+        (ISSUE 18 satellite, r17 remain): drop the corpse from the
+        candidate pool and pick another live decode replica — parity is
+        untouched because pulled blocks are bit-exact wherever they
+        land.  Any remaining fault (injected fabric.publish/pull, every
+        candidate dead) degrades to recompute: the request re-queues and
+        decode admission simply misses the cache."""
         req.prefill_pass = False
         key, req.fabric_key = req.fabric_key, None
         name = self._replica_name(rep)
         hashes = prompt_block_hashes(req.prompt, int(rep.engine.bs))
         live = [r for r in self._replicas if r.alive and not r.draining]
         pool = [r for r in self._decode_pool(live) if r is not rep]
-        target = self._pick_replica(req, pool) if pool else None
+        target: Optional[_Replica] = None
         try:
             self.fabric.publish_chain(name, hashes, epoch=self.epoch)
-            if target is not None:
-                cached_fn = getattr(target.engine, "cached_block_hashes",
-                                    None)
-                cached = cached_fn() if cached_fn is not None else set()
-                missing = [h for h in hashes if h not in cached]
-                n, nbytes = self.fabric.pull(rep.engine, target.engine,
-                                             missing, owner=name)
-                if self.tracer is not None and req.trace is not None:
-                    self.tracer.event(req.trace, "block_transfer",
-                                      blocks=n, bytes=nbytes, src=name,
-                                      dst=self._replica_name(target))
+            while pool:
+                target = self._pick_replica(req, pool)
+                if target is None:
+                    break         # nothing fits right now → queue + recompute
+                try:
+                    cached_fn = getattr(target.engine,
+                                        "cached_block_hashes", None)
+                    cached = cached_fn() if cached_fn is not None else set()
+                    missing = [h for h in hashes if h not in cached]
+                    n, nbytes = self.fabric.pull(rep.engine, target.engine,
+                                                 missing, owner=name)
+                    if self.tracer is not None and req.trace is not None:
+                        self.tracer.event(req.trace, "block_transfer",
+                                          blocks=n, bytes=nbytes, src=name,
+                                          dst=self._replica_name(target))
+                    break
+                except StaleEpoch:
+                    raise         # outer handler: deposed-path recompute
+                except Exception:  # noqa: BLE001 — chosen target died
+                    self.metrics.inc("fabric_pull_failures_total")
+                    self.metrics.inc("fabric_replans_total")
+                    pool = [r for r in pool
+                            if r is not target and r.alive]
+                    target = None
+            if target is None and not pool:
+                # every candidate failed (or none existed): recompute
+                self.metrics.inc("fabric_recomputes_total")
         except StaleEpoch:
             self.metrics.inc("fabric_recomputes_total")
             target = None
@@ -2106,7 +2450,8 @@ class ServingFrontend:
             if req.first_token_t is not None else None,
             e2e_s=now - req.submit_t,
             logprobs=(list(req.logprob_values) if req.sampling.logprobs
-                      else None))
+                      else None),
+            weights_version=req.weights_version, tenant=req.tenant)
         self._results[req.rid] = res
         if self.tracer is not None:
             if req.trace is None:
@@ -2114,14 +2459,31 @@ class ServingFrontend:
                 # EVERY typed terminal owns a complete span tree
                 req.trace = self.tracer.begin(req.rid)
                 self.tracer.event(req.trace, "submit")
+            term_extra = {}
+            if req.weights_version is not None:
+                term_extra["weights_version"] = req.weights_version
+            if req.tenant is not None:
+                term_extra["tenant"] = req.tenant
             self.tracer.event(req.trace, "terminal", status=status.value,
                               tokens=len(req.generated),
-                              attempts=req.attempts)
+                              attempts=req.attempts, **term_extra)
             self.tracer.note_terminal(req.trace, status.value,
                                       e2e_s=res.e2e_s)
         if req.counted_tokens:
             self._class_tokens[req.priority] -= req.counted_tokens
+            if self.tenants is not None and req.tenant is not None:
+                self.tenants.release(req.tenant, req.counted_tokens)
             req.counted_tokens = 0
+        if self.tenants is not None and req.tenant is not None:
+            # per-tenant served-token attribution: dynamic counter names
+            # ride the open runtime registry (tenant_<name>_served_
+            # tokens_total) — the tenant_isolation bench rung reads the
+            # registry's ratio, not wall-clock
+            self.tenants.note_served(req.tenant, len(req.generated))
+            if req.generated:
+                self.metrics.inc(
+                    f"tenant_{self.tenants.resolve(req.tenant)}"
+                    f"_served_tokens_total", len(req.generated))
         if req.admitted:
             # exactly one typed terminal record per admitted rid (the
             # first-terminal-wins guard above makes this exact); tokens
@@ -2182,6 +2544,12 @@ class ServingFrontend:
             # fabric-cumulative, not frontend deltas)
             for k, v in self.fabric.counters.items():
                 m.set_gauge(f"fabric_{k}", float(v))
+        if self.tenants is not None:
+            # per-tenant outstanding-token gauges (budget observability);
+            # dynamic names ride the open runtime registry
+            for tname, st in self.tenants.snapshot().items():
+                m.set_gauge(f"tenant_{tname}_outstanding_tokens",
+                            st["outstanding"])
         for rep in live:
             eng = rep.engine
             if getattr(eng, "prefix_counters_self_reported", False):

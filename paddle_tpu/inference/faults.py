@@ -91,6 +91,12 @@ KNOWN_SITES = {
     "fabric.publish",     # prefill worker dies before its chain lands
     "fabric.pull",        # decode pulls blocks from a dead peer
     "fabric.directory",   # directory reads, incl. stale-lease rejection
+    # multi-tenant elastic platform (ISSUE 18) — canonical registrations
+    # live next to the firing code (serving.load_weights, fleet.WarmPool);
+    # listed here too so env-armed injectors validate everywhere
+    "weights.swap",       # engine swaps in a new weights version
+    "pool.attach",        # warm worker claimed + attached to the fleet
+    "pool.refill",        # warm pool spawns a replacement worker
 }
 # FaultyReplica/FencedEngine also fire replica-scoped sites
 # "<replica name>.<op>" (so a schedule can doom one replica).  The

@@ -104,14 +104,19 @@ from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from .control_plane import ServingFrontend
-from .faults import FaultInjector, RespawnCircuitBreaker
+from .faults import FaultInjector, RespawnCircuitBreaker, register_failpoint
 from .ha import EpochFence, StaleEpoch
 from .metrics import (MEGASTEP_COUNTERS, ServingMetrics,
                       fold_counter_deltas, fold_prefix_counters)
 
 __all__ = ["RemoteReplica", "ServingFleet", "FleetAutoscaler",
-           "AutoscalePolicy", "init_worker", "discover_workers",
+           "AutoscalePolicy", "WarmPool", "init_worker", "discover_workers",
            "connect_workers", "worker_roles"]
+
+# warm-worker pool lifecycle edges (ISSUE 18): an attach pulled from the
+# pool, and a refill launched to top it back up — both chaos-drivable
+POOL_ATTACH = register_failpoint("pool.attach")
+POOL_REFILL = register_failpoint("pool.refill")
 
 
 def discover_workers(master_endpoint: str,
@@ -139,9 +144,16 @@ def discover_workers(master_endpoint: str,
     it does not match the convention."""
     from ..distributed.launch.master import KVClient
 
-    entries = KVClient(master_endpoint).get_prefix("/rpc/workers/")
+    kv = KVClient(master_endpoint)
+    entries = kv.get_prefix("/rpc/workers/")
     names = (k.rsplit("/", 1)[-1] for k in entries)
     drop = set(exclude)
+    # warm-pool workers (ISSUE 18) are registered and serving-ready but
+    # deliberately UNATTACHED — a recovering frontend must not adopt them
+    # as serving replicas (the owning fleet's pool claims them); the
+    # ``/serving/warm/<name>`` marker is deleted at claim time, so a
+    # claimed-and-attached warm worker IS discoverable like any other
+    drop |= {k.rsplit("/", 1)[-1] for k in kv.get_prefix("/serving/warm/")}
     return sorted(n for n in names if n not in drop and "frontend" not in n)
 
 
@@ -519,6 +531,33 @@ def _w_reset_metrics(epoch=None):
     return True
 
 
+def _w_swap_weights(model_kwargs, seed, version=None, model_id=None,
+                    bfloat16=False, epoch=None):
+    """Rebuild a seeded model from spec kwargs in THIS process and load
+    it into the serving engine (ISSUE 18 rolling weight swap).  The wire
+    form is the worker-spec recipe, not weight tensors: every replica of
+    a version builds bit-identical weights from (seed, config), exactly
+    like boot, so a fleet-wide swap ships a few hundred bytes of JSON
+    per worker instead of the checkpoint.  Fenced — a deposed frontend
+    must not roll weights under the current incarnation — and the
+    engine's own ``load_weights`` fires the ``weights.swap`` failpoint
+    and validates geometry BEFORE mutating, so a faulted swap leaves the
+    old version serving.  Returns (installed version, state summary)."""
+    _fence(epoch, "swap_weights")
+    eng = _engine()
+    import paddle_tpu as P
+    from ..models import LlamaConfig, LlamaForCausalLM
+
+    P.seed(int(seed))
+    model = LlamaForCausalLM(LlamaConfig(**(model_kwargs or {})))
+    if bfloat16:
+        model.bfloat16()
+    model.eval()
+    v = eng.load_weights(model, version=version, model_id=model_id)
+    _WORKER["metrics"].inc("weight_swaps_total")
+    return v, eng.state_summary()
+
+
 def _w_shutdown(epoch=None):
     # fenced: a deposed frontend must not shut down workers the current
     # incarnation is serving with
@@ -658,6 +697,11 @@ class RemoteReplica:
         # own registry too; the frontend sums mirrors like the block
         # counts above)
         self.phase_seconds = dict(st.get("phase_seconds") or {})
+        # weights identity mirror (ISSUE 18): version label for metrics/
+        # trace attribution and model id for tenant-affine routing — the
+        # frontend reads these exactly like an in-process engine's attrs
+        self.weights_version = st.get("weights_version", "v0")
+        self.model_id = st.get("model_id", "default")
 
     def cached_block_hashes(self):
         """Last-synced mirror of the worker engine's content-addressable
@@ -768,6 +812,25 @@ class RemoteReplica:
         n, st = self._call(_w_import_blocks, payload, epoch=self._epoch)
         self._apply_state(st)
         return int(n)
+
+    def load_weights(self, spec: Dict, version: Optional[str] = None,
+                     model_id: Optional[str] = None) -> str:
+        """Rolling-swap this worker to new version-labelled weights
+        (ISSUE 18).  Duck-types ``ServingEngine.load_weights`` for the
+        frontend's swap drivers, but takes the worker-spec RECIPE —
+        ``{"seed": .., "model": {LlamaConfig kwargs}, "bfloat16": ..}``
+        — not a model instance: the worker rebuilds the seeded weights
+        itself (``_w_swap_weights``), so nothing tensor-sized crosses
+        the wire and every replica of a version is bit-identical by
+        construction.  Raises whatever the worker-side swap raised (an
+        armed ``weights.swap`` failpoint, a geometry ValueError); the
+        worker keeps its old version on any fault."""
+        v, st = self._call(_w_swap_weights, dict(spec.get("model") or {}),
+                           int(spec.get("seed", 0)), version, model_id,
+                           bool(spec.get("bfloat16", False)),
+                           epoch=self._epoch)
+        self._apply_state(st)
+        return v
 
     # --------------------------------------------------- fleet-layer extras
     def health(self, include_samples: bool = False,
@@ -903,6 +966,167 @@ class FleetAutoscaler:
         return "hold"
 
 
+class WarmPool:
+    """Pre-booted worker pool (ISSUE 18): scale-up as attach, not boot.
+
+    A *warm* worker has already paid the ~10 s boot — jax import, seeded
+    weight build, and step/megastep program compilation (driven by a
+    throwaway sub-block request, so nothing lands in the prefix cache) —
+    and parks registered-but-unattached behind a ``/serving/warm/<name>``
+    KV marker.  ``FleetAutoscaler`` scale-up then claims one (a single
+    health probe, ~ms) instead of spawning cold; the pool refills
+    asynchronously behind the claim.
+
+    The pool is deliberately host-mechanism-agnostic: ``spawn_fn(name)``
+    launches one warm worker and either returns a ready handle
+    immediately (synchronous fakes in tests) or returns ``None`` and
+    arranges for ``note_ready(name, handle)`` / ``note_failed(name)``
+    when the boot resolves (``ServingFleet`` does this on a daemon
+    thread).  The spawn ``breaker`` is consulted before every refill —
+    a crash-looping warm config backs off exactly like cold respawns —
+    and both lifecycle edges fire chaos-drivable failpoints:
+    ``pool.refill`` when a refill launches, ``pool.attach`` when a claim
+    hands a worker out (a faulted claim re-pools the worker and the
+    caller falls back to a cold spawn).
+
+    Weight-swap coherence: the pool carries a ``generation``; a rolling
+    weight swap drains the ready set and bumps it, so a warm worker that
+    finished booting with pre-swap weights is refused by ``note_ready``
+    and reaped by its owner instead of ever serving stale weights.
+
+    Counters: ``pool_refills_total`` / ``pool_attaches_total`` /
+    ``pool_attach_failures_total``; depth (ready + booting) is the
+    ``warm_pool_depth`` gauge."""
+
+    def __init__(self, size: int, spawn_fn: Callable[[str], Any], *,
+                 breaker: Optional[RespawnCircuitBreaker] = None,
+                 fault_injector: Optional[FaultInjector] = None,
+                 metrics: Optional[ServingMetrics] = None,
+                 name_prefix: str = "warm"):
+        self.size = int(size)
+        self.spawn_fn = spawn_fn
+        self.breaker = breaker
+        self.faults = fault_injector
+        self.metrics = metrics
+        self.name_prefix = name_prefix
+        self.generation = 0
+        self._lock = threading.Lock()
+        self._ready: List = []                 # guarded-by: self._lock
+        self._pending: Dict[str, int] = {}     # guarded-by: self._lock
+        self._next = 0
+
+    def _inc(self, name: str, n: int = 1):
+        if self.metrics is not None:
+            self.metrics.inc(name, n)
+
+    def _sample_depth(self):
+        if self.metrics is not None:
+            self.metrics.set_gauge("warm_pool_depth", self.depth())
+
+    def depth(self) -> int:
+        """Ready + booting warm workers (the scale-up headroom gauge)."""
+        with self._lock:
+            return len(self._ready) + len(self._pending)
+
+    def ready_names(self) -> List[str]:
+        with self._lock:
+            return [name for name, _ in self._ready]
+
+    def refill(self) -> int:
+        """Launch warm boots until depth reaches ``size``; returns how
+        many were launched.  Consults the spawn breaker first (a pool
+        must not crash-loop past containment just because it is a pool)
+        and stops at the first spawn fault — the breaker holds the next
+        attempt, and the periodic maintain retries after backoff."""
+        launched = 0
+        while self.depth() < self.size:
+            if self.breaker is not None and not self.breaker.allow():
+                break
+            with self._lock:
+                name = f"{self.name_prefix}{self._next}"
+                self._next += 1
+                self._pending[name] = self.generation
+            try:
+                if self.faults is not None:
+                    self.faults.fire(POOL_REFILL, detail=name)
+                handle = self.spawn_fn(name)
+            # graft-lint: disable=typed-termination — refill containment:
+            # any spawn fault (armed pool.refill, Popen failure) feeds the
+            # breaker and the next maintain retries after its backoff
+            except Exception:  # noqa: BLE001
+                with self._lock:
+                    self._pending.pop(name, None)
+                if self.breaker is not None:
+                    self.breaker.record_failure()
+                self._inc("spawn_failures_total")
+                self._sample_depth()
+                break
+            self._inc("pool_refills_total")
+            launched += 1
+            if handle is not None:     # synchronous spawn: ready now
+                self.note_ready(name, handle)
+        self._sample_depth()
+        return launched
+
+    def note_ready(self, name: str, handle: Any = None) -> bool:
+        """A warm boot finished; pool it — unless the generation moved
+        on (weights were swapped mid-boot), in which case the worker
+        holds stale weights: refuse it (returns False) so the owner
+        reaps it instead of ever attaching it."""
+        with self._lock:
+            gen = self._pending.pop(name, None)
+            if gen is not None and gen != self.generation:
+                self._sample_depth()
+                return False
+            self._ready.append((name, handle))
+        self._sample_depth()
+        return True
+
+    def note_failed(self, name: str, record: bool = True):
+        """A warm boot died; release its seat.  ``record=False`` when
+        the caller's own spawn machinery already fed the breaker."""
+        with self._lock:
+            self._pending.pop(name, None)
+        if record and self.breaker is not None:
+            self.breaker.record_failure()
+        self._sample_depth()
+
+    def claim(self):
+        """Pop the oldest ready warm worker as ``(name, handle)``, or
+        ``None`` when the pool is empty (caller falls back to a cold
+        spawn).  Fires ``pool.attach``; a faulted attach re-pools the
+        worker (it is still warm and healthy — the fault was the attach
+        edge) and returns ``None``."""
+        with self._lock:
+            if not self._ready:
+                return None
+            item = self._ready.pop(0)
+        try:
+            if self.faults is not None:
+                self.faults.fire(POOL_ATTACH, detail=item[0])
+        # graft-lint: disable=typed-termination — attach containment: the
+        # worker goes back in the pool and the caller cold-spawns instead
+        except Exception:  # noqa: BLE001
+            self._inc("pool_attach_failures_total")
+            with self._lock:
+                self._ready.insert(0, item)
+            return None
+        self._inc("pool_attaches_total")
+        self._sample_depth()
+        return item
+
+    def drain_ready(self, bump_generation: bool = True) -> List:
+        """Remove and return every ready worker (rolling swap / shutdown
+        — the caller owns reaping them).  Bumping the generation makes
+        still-booting workers stale: their ``note_ready`` is refused."""
+        with self._lock:
+            ready, self._ready = self._ready, []
+            if bump_generation:
+                self.generation += 1
+        self._sample_depth()
+        return ready
+
+
 class ServingFleet:
     """Remote-replica data plane: worker processes + frontend + heartbeat.
 
@@ -937,6 +1161,7 @@ class ServingFleet:
                  early_death_s: float = 20.0,
                  max_spawn_errors: int = 32,
                  fault_injector: Optional[FaultInjector] = None,
+                 warm_pool_size: int = 0,
                  clock: Callable[[], float] = time.monotonic):
         from ..distributed import rpc
         from ..distributed.launch.master import KVClient, KVServer
@@ -995,6 +1220,7 @@ class ServingFleet:
         self._frontend_kwargs = dict(frontend_kwargs or {})
         self.frontend: Optional[ServingFrontend] = None
         self.autoscaler: Optional[FleetAutoscaler] = None
+        self.warm_pool: Optional[WarmPool] = None
         self._rpc_inited = False
         # from here on every failure funnels through shutdown() so the
         # just-started KVServer (thread + port) cannot leak — init_rpc
@@ -1011,6 +1237,13 @@ class ServingFleet:
             raise
         if autoscaler_policy is not None:
             self.autoscaler = FleetAutoscaler(self, autoscaler_policy)
+        if warm_pool_size > 0:
+            # warm-worker pool (ISSUE 18): start the first refill now so
+            # the boots overlap initial serving; step() keeps it topped up
+            self.warm_pool = WarmPool(warm_pool_size, self._spawn_warm,
+                                      breaker=self.spawn_breaker,
+                                      fault_injector=self._faults)
+            self.warm_pool.refill()
 
     # ------------------------------------------------------- worker launch
     def _worker_script(self) -> str:
@@ -1019,8 +1252,11 @@ class ServingFleet:
         return os.path.join(here, "tools", "serving_worker.py")
 
     def _launch(self, name: Optional[str] = None,
-                role: Optional[str] = None) -> str:
-        """Start a worker process (non-blocking); pair with _await_worker."""
+                role: Optional[str] = None, warm: bool = False) -> str:
+        """Start a worker process (non-blocking); pair with _await_worker.
+        ``warm=True`` boots a pool worker: it pre-compiles its programs
+        BEFORE registering and parks behind a ``/serving/warm/`` marker
+        (claimed by ``WarmPool``, invisible to discovery until then)."""
         if name is None:
             idx = self._next_worker
             name = f"worker{idx}"
@@ -1033,6 +1269,8 @@ class ServingFleet:
         cmd = [sys.executable, self._worker_script(),
                "--master", self.master_endpoint, "--name", name,
                "--spec-json", json.dumps(spec)]
+        if warm:
+            cmd += ["--warm"]
         if self.cpu_workers:
             cmd += ["--platform", "cpu"]
         # stderr to a file, not a pipe: nobody drains worker pipes and a
@@ -1174,7 +1412,32 @@ class ServingFleet:
         used to stall the step loop), then parks the ready RemoteReplica;
         the next ``step()`` attaches it on the control thread.  Spawn
         failures are recorded in ``spawn_errors`` (the autoscaler's
-        pending count drops either way, so it can try again)."""
+        pending count drops either way, so it can try again).
+
+        With a warm pool armed (ISSUE 18), a ready warm worker is claimed
+        INSTEAD of launching cold: the worker already booted and compiled,
+        so "spawn" collapses to one health probe and the replica attaches
+        on the next step — near-zero time-to-capacity.  The pool refills
+        asynchronously behind the claim; an empty pool (or a faulted
+        ``pool.attach``) falls through to the cold path unchanged."""
+        if name is None and self.warm_pool is not None:
+            if self.warm_pool.metrics is None and self.frontend is not None:
+                # a claim can precede the first control-loop step — bind
+                # the pool's counters now so the attach is not invisible
+                self.warm_pool.metrics = self.frontend.metrics
+            claimed = self.warm_pool.claim()
+            if claimed is not None:
+                wname = claimed[0]
+                # claimed: drop the warm marker so discovery treats it as
+                # a normal worker from here on (recovery must see it)
+                self._kv.delete(f"/serving/warm/{wname}")
+                t = threading.Thread(target=self._adopt_warm, args=(wname,),
+                                     name=f"fleet-adopt-{wname}", daemon=True)
+                with self._spawn_lock:
+                    self._pending_spawns[wname] = t
+                t.start()
+                self.warm_pool.refill()
+                return wname
         name = self._launch(name)
         t = threading.Thread(target=self._spawn_wait, args=(name,),
                              name=f"fleet-spawn-{name}", daemon=True)
@@ -1211,6 +1474,66 @@ class ServingFleet:
             # could observe in the ready-but-unattached window and spawn
             # past max_workers
             self._ready_replicas.append((name, replica))
+
+    # ----------------------------------------------------- warm pool hooks
+    def _spawn_warm(self, name: str):
+        """``WarmPool`` spawn hook: launch a ``--warm`` worker and wait
+        out its (pre-compiling) boot on a daemon thread; the pool's
+        pending seat holds until ``note_ready``/``note_failed``.
+        Returns None — the async contract of ``WarmPool.spawn_fn``."""
+        self._launch(name, warm=True)
+        t = threading.Thread(target=self._warm_wait, args=(name,),
+                             name=f"fleet-warm-{name}", daemon=True)
+        t.start()
+        return None
+
+    def _warm_wait(self, name: str):
+        try:
+            self._await_registration(name)
+        except Exception as e:  # noqa: BLE001 — warm boot fault: record
+            # + release the pool seat (registration already reaped the
+            # process); record=False — _note_spawn_failure feeds the
+            # breaker, the pool must not count the same death twice
+            self._note_spawn_failure(name, repr(e))
+            if self.warm_pool is not None:
+                self.warm_pool.note_failed(name, record=False)
+            return
+        if self.warm_pool is None or not self.warm_pool.note_ready(name):
+            # pool generation moved on while this worker booted (weights
+            # were swapped / shutdown): it holds stale state — reap it
+            # rather than ever pooling or attaching it
+            self._reap_proc(name, kill=True)
+
+    def _adopt_warm(self, name: str):
+        """Attach side of a warm claim: the worker already booted and
+        compiled, so all that remains is one health probe (the
+        RemoteReplica constructor) — the near-zero-latency attach the
+        pool exists for.  Runs on a daemon thread like ``_spawn_wait``;
+        the next ``step()`` attaches the parked replica."""
+        try:
+            self._rpc.refresh_workers()
+            replica = self._make_replica(name)
+        except Exception as e:  # noqa: BLE001 — probe fault on a claimed
+            # warm worker: same containment as a failed cold boot
+            self._note_spawn_failure(name, repr(e))
+            self._inc_metric("pool_attach_failures_total")
+            with self._spawn_lock:
+                self._pending_spawns.pop(name, None)
+            self._reap_proc(name, kill=True)
+            return
+        with self._spawn_lock:
+            self._ready_replicas.append((name, replica))
+
+    def _flush_warm_pool(self):
+        """Reap every READY warm worker and refill (rolling swap: pooled
+        workers hold pre-swap weights and must never attach; the
+        generation bump makes still-booting ones refuse pooling too)."""
+        if self.warm_pool is None:
+            return
+        for wname, _ in self.warm_pool.drain_ready():
+            self._kv.delete(f"/serving/warm/{wname}")
+            self._reap_proc(wname, kill=True)
+        self.warm_pool.refill()
 
     @property
     def num_pending_spawns(self) -> int:
@@ -1279,6 +1602,14 @@ class ServingFleet:
             self.autoscaler.observe()
         fe.metrics.set_gauge("respawn_breaker_open",
                              self.spawn_breaker.open_gauge)
+        if self.warm_pool is not None:
+            # bind the pool's counters to the frontend registry (it may
+            # not have existed at pool creation) and keep it topped up —
+            # refill is a no-op depth check when the pool is full
+            if self.warm_pool.metrics is None:
+                self.warm_pool.metrics = fe.metrics
+            self.warm_pool.refill()
+            fe.metrics.set_gauge("warm_pool_depth", self.warm_pool.depth())
         fe.step()
         self._reap()
 
@@ -1321,6 +1652,34 @@ class ServingFleet:
             except Exception as e:  # noqa: BLE001 — any probe fault = dead
                 self.frontend.fail_replica(rep, e)
 
+    # ------------------------------------------------------------- swapping
+    def rolling_swap(self, spec: Dict, version: str, *,
+                     model_id: Optional[str] = None,
+                     max_steps: int = 10_000) -> int:
+        """Fleet-wide zero-downtime weight swap (ISSUE 18): one replica
+        at a time, drain → ``_w_swap_weights`` (the worker rebuilds the
+        seeded weights from ``spec`` — the worker-spec recipe, nothing
+        tensor-sized on the wire) → re-admit.  Drives ``self.step`` while
+        draining so heartbeats, autoscaling, and warm-pool maintenance
+        keep running.  On success the fleet's own ``worker_spec`` is
+        updated too, so respawned workers and future warm boots come up
+        on the NEW version instead of silently rolling back; the warm
+        pool's pre-swap workers are reaped and the pool refilled.
+        Returns the number of replicas now serving ``version``."""
+        fe = self._require_frontend()
+        n = fe.rolling_swap(spec, version, model_id=model_id,
+                            step=self.step, max_steps=max_steps)
+        if n:
+            for key in ("seed", "model", "bfloat16"):
+                if key in spec:
+                    self.worker_spec[key] = spec[key]
+            # respawns must come up LABELLED as the new version, not v0
+            self.worker_spec["weights_version"] = version
+            if model_id is not None:
+                self.worker_spec["model_id"] = model_id
+            self._flush_warm_pool()
+        return n
+
     # ------------------------------------------------------------ draining
     def drain_replica(self, rep):
         """Begin scale-down of one replica: stop admitting to it; once its
@@ -1333,6 +1692,11 @@ class ServingFleet:
             if not isinstance(rep.engine, RemoteReplica):
                 continue
             name = rep.engine.worker
+            if getattr(rep, "swapping", False):
+                # drained-for-swap, not scale-down (ISSUE 18): the swap
+                # driver re-admits this replica — reaping it here would
+                # turn every rolling swap into a worker funeral
+                continue
             if rep.alive and rep.draining and not rep.requests \
                     and not rep.engine._queue and not rep.engine._active:
                 try:
@@ -1443,6 +1807,18 @@ class ServingFleet:
     def shutdown(self):
         """Stop every worker (polite RPC first, then kill), the RPC state,
         and the KV master.  Idempotent."""
+        if self.warm_pool is not None:
+            # stop refills first, then drop the warm markers (best
+            # effort: the KV master may already be gone); the pooled
+            # processes are in self._procs and die with everyone below
+            self.warm_pool.size = 0
+            for wname, _ in self.warm_pool.drain_ready():
+                try:
+                    self._kv.delete(f"/serving/warm/{wname}")
+                # graft-lint: disable=typed-termination — best-effort
+                # marker cleanup during teardown
+                except Exception:  # noqa: BLE001
+                    pass
         if self.frontend is not None:
             for rep in self.frontend.replicas:
                 if rep.alive and isinstance(rep.engine, RemoteReplica):

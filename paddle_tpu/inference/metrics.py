@@ -84,6 +84,19 @@ COUNTERS = (
     "fabric_prefill_passes_total", "fabric_dedup_waits_total",
     "fabric_pull_failures_total", "fabric_recomputes_total",
     "fabric_blocks_imported_total",
+    # multi-tenant elastic platform (ISSUE 18): rolling weight swaps
+    # (attempted/failed), fabric pull-target re-plans after a decode
+    # replica death, warm-pool lifecycle (attach/refill/attach-failure),
+    # and the tenant control plane (budget rejections, model-affine
+    # routing hits, dispatches parked behind a pending model swap).
+    # Per-tenant served/outstanding series use dynamic names
+    # ("tenant_<name>_served_tokens_total") through the open registry.
+    "weight_swaps_total", "weight_swap_failures_total",
+    "fabric_replans_total",
+    "pool_attaches_total", "pool_refills_total",
+    "pool_attach_failures_total",
+    "tenant_rejected_budget_total", "tenant_routing_hits_total",
+    "tenant_swap_waits_total",
 )
 GAUGES = (
     "queue_depth", "queue_depth_peak", "running_requests", "replicas_alive",
@@ -104,6 +117,10 @@ GAUGES = (
     # monotone accumulators (merge() sums them fleet-wide)
     "step_phase_schedule_seconds", "step_phase_execute_seconds",
     "step_phase_harvest_seconds",
+    # warm-worker pool (ISSUE 18): pre-booted workers ready to attach
+    # (ready + refills in flight) — the autoscaler's near-zero-latency
+    # scale-up headroom
+    "warm_pool_depth",
 )
 SAMPLES = ("ttft_seconds", "token_latency_seconds", "e2e_latency_seconds")
 

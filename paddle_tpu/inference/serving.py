@@ -95,11 +95,18 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.paged_attention import blha_attention
+from .faults import register_failpoint
 
 __all__ = ["BlockManager", "ServingRequest", "ServingEngine",
            "SamplingParams", "prefix_block_hash", "prompt_block_hashes"]
 # the policy layer above this engine lives in control_plane.py
 # (ServingFrontend) and metrics.py (ServingMetrics)
+
+# rolling weight swaps (ISSUE 18): fired at the top of load_weights,
+# BEFORE any state is touched, so an injected swap fault leaves the old
+# weights fully serving — the rolling_swap driver keeps the replica on
+# its previous version and counts weight_swap_failures_total
+WEIGHTS_SWAP = register_failpoint("weights.swap")
 
 
 @dataclass
@@ -377,6 +384,22 @@ class BlockManager:
         the engine's prefix-affinity summary shipped to the frontend."""
         return set(self._block_of)
 
+    def drop_cached(self) -> int:
+        """Invalidate the content-addressed cache: evictable (refcount-0)
+        published blocks return to the free list and EVERY hash mapping
+        is dropped (a live publisher keeps its block but loses the hash,
+        so a later ``free`` hard-frees instead of parking).  The weight-
+        swap path calls this — KV computed under the old weights must
+        never be matched by a new-version prompt.  Returns the number of
+        hashes invalidated."""
+        n = len(self._block_of)
+        for b in self._lru:
+            self._free.append(b)
+        self._lru.clear()
+        self._block_of.clear()
+        self._hash_of.clear()
+        return n
+
     @property
     def num_free(self) -> int:
         """Blocks allocatable right now: truly free plus cached-evictable.
@@ -518,6 +541,13 @@ class ServingEngine:
                                else jnp.float32)
 
         self._weights = self._extract_weights(model)
+        # rolling weight swaps / tenancy (ISSUE 18): a version label that
+        # rides metric + trace attribution, and the model id tenant
+        # routing keys on.  Both are plain host state — load_weights
+        # replaces the weight pytree without touching the compiled
+        # programs (model identity is NOT in _program_key).
+        self.weights_version = "v0"
+        self.model_id = "default"
         self._rope = self._build_rope(cfg)
         self.key_caches = [jnp.zeros((nb, self.KV, self.bs, self.D), cache_dtype)
                            for _ in range(self.L)]
@@ -628,6 +658,49 @@ class ServingEngine:
                 "wd": v(m.down_proj.weight),
             })
         return w
+
+    def load_weights(self, model, version: Optional[str] = None,
+                     model_id: Optional[str] = None) -> str:
+        """Swap in ``model``'s weights WITHOUT recompiling: weights enter
+        the compiled programs as call arguments, so same-architecture
+        models reuse every cached program (``_program_key`` excludes
+        model identity on purpose).  The caller (``rolling_swap`` or
+        tenant swap-on-demand routing) is responsible for draining the
+        engine first — active sequences would otherwise continue under
+        the new weights mid-stream.
+
+        The prefix cache is invalidated: cached KV was computed under
+        the old weights and must never be matched by a new-version
+        prompt.  Any fault (the ``weights.swap`` failpoint, a geometry
+        mismatch) raises BEFORE state changes — the engine keeps serving
+        the old version intact.  Returns the new version label."""
+        if self._faults is not None:
+            self._faults.fire(WEIGHTS_SWAP,
+                              detail=str(version or model_id or ""))
+        cfg = model.config
+        if (cfg.num_attention_heads != self.H
+                or cfg.num_key_value_heads != self.KV
+                or cfg.head_dim != self.D
+                or cfg.hidden_size != self.E
+                or cfg.num_hidden_layers != self.L):
+            raise ValueError(
+                "load_weights: new model's geometry (heads/kv/head_dim/"
+                "hidden/layers) must match the engine's — the compiled "
+                "step programs bake the attention geometry; boot a fresh "
+                "engine for a different architecture")
+        new = self._extract_weights(model)   # raises before any mutation
+        self._weights = new
+        self.blocks.drop_cached()
+        if model_id is not None:
+            self.model_id = str(model_id)
+        if version is not None:
+            self.weights_version = str(version)
+        elif model_id is not None:
+            # a model swap without an explicit version still must not
+            # keep the old label (metrics/parity would lie about what
+            # generated the tokens)
+            self.weights_version = str(model_id)
+        return self.weights_version
 
     def _build_rope(self, cfg):
         d = cfg.head_dim
@@ -1087,6 +1160,10 @@ class ServingEngine:
             "queue_depth": len(self._queue),
             "num_active": len(self._active),
             "pool_utilization": (1.0 - self.blocks.num_free / nb) if nb else 0.0,
+            # weight-swap attribution (ISSUE 18): the fleet mirror and
+            # tenant routing read these off the same state reply
+            "weights_version": self.weights_version,
+            "model_id": self.model_id,
             # prefix-cache summary: the hash list is bounded by the pool
             # size (tens of entries), cheap enough to piggyback on every
             # RPC reply — the frontend's prefix-affinity routing matches

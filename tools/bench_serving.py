@@ -736,6 +736,172 @@ def run_bench_staggered(num_requests=None, megastep_k=8, mean_gap=None,
     }
 
 
+def run_bench_tenant_isolation(num_requests=None, seed=0):
+    """Tenant-fairness rung (ISSUE 18): a BURSTY tenant dumps its whole
+    backlog before the STEADY tenant's arrives, then both drain through
+    per-tenant DRR dispatch.  ``value`` is the steady tenant's share of
+    served tokens at the halfway point — 0.5 is perfect isolation, and
+    plain FIFO admission (the no-registry contrast measured into
+    ``extra``) hands the window to whoever burst first.  Deterministic
+    counter ratio: seeded prompts, fixed decode lengths, no wall clock
+    anywhere — perf_gate additionally bounds the share absolutely
+    (ABS_RUNG_BOUNDS), because drift in EITHER direction is a fairness
+    bug, not an improvement."""
+    import jax
+    import numpy as np
+
+    import bench_ladder
+    import paddle_tpu as P
+    from paddle_tpu.inference import (ServingEngine, ServingFrontend,
+                                      TenantRegistry, TenantSpec)
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    backend = jax.default_backend()
+    model_cfg = dict(vocab_size=256, hidden_size=64, intermediate_size=160,
+                     num_hidden_layers=1, num_attention_heads=2,
+                     max_position_embeddings=256)
+    engine_cfg = dict(max_batch_size=2, max_seq_len=64, block_size=8,
+                      token_budget=16)
+    per_tenant = (num_requests or 16) // 2
+    max_new = 6
+    rng = np.random.RandomState(seed)
+    mk_prompts = lambda: [rng.randint(1, model_cfg["vocab_size"],  # noqa: E731
+                                      (int(rng.choice((3, 4, 5))),)).tolist()
+                          for _ in range(per_tenant)]
+    bursty_prompts, steady_prompts = mk_prompts(), mk_prompts()
+    total_tokens = 2 * per_tenant * max_new
+    half = total_tokens // 2
+
+    P.seed(0)
+    model = LlamaForCausalLM(LlamaConfig(**model_cfg))
+    model.eval()
+
+    def serve(drr):
+        # quantum = one request's decode cost: each DRR round credits
+        # every backlogged tenant exactly one placement, so the engine
+        # queues interleave at request granularity (the default 64 would
+        # cover a whole burst in one round and measure nothing)
+        reg = TenantRegistry([TenantSpec("steady"), TenantSpec("bursty")],
+                             quantum=max_new) if drr else None
+        fe = ServingFrontend([ServingEngine(model, **engine_cfg)
+                              for _ in range(2)], tenants=reg)
+        tenant_of = {}
+        for p in bursty_prompts:            # the burst lands first...
+            tenant_of[fe.submit(p, max_new_tokens=max_new,
+                                **({"tenant": "bursty"} if drr else {}))] \
+                = "bursty"
+        for p in steady_prompts:            # ...then steady's backlog
+            tenant_of[fe.submit(p, max_new_tokens=max_new,
+                                **({"tenant": "steady"} if drr else {}))] \
+                = "steady"
+        served = {"steady": 0, "bursty": 0}
+        seen = set()
+        steps = 0
+        while sum(served.values()) < half and steps < 10_000:
+            fe.step()
+            steps += 1
+            for rid, r in fe.results().items():
+                if rid not in seen and r.tokens is not None:
+                    seen.add(rid)
+                    served[tenant_of[rid]] += len(r.tokens)
+        share = served["steady"] / max(sum(served.values()), 1)
+        fe.run()                            # drain the rest
+        if drr:
+            snap = reg.snapshot()
+            assert snap["steady"]["served"] + snap["bursty"]["served"] \
+                == total_tokens
+        return share, served, steps
+
+    drr_share, drr_served, drr_steps = serve(drr=True)
+    fifo_share, fifo_served, fifo_steps = serve(drr=False)
+    return {
+        "metric": "serving_tenant_isolation_served_share",
+        "value": round(drr_share, 4),
+        "unit": "steady share at half-served (0.5=fair)",
+        "extra": {
+            "host": bench_ladder.host_fingerprint(),
+            "backend": backend,
+            "num_requests": 2 * per_tenant,
+            "max_new_tokens": max_new,
+            "drr_served_at_half": drr_served,
+            "fifo_share": round(fifo_share, 4),
+            "fifo_served_at_half": fifo_served,
+            "steps_to_half": drr_steps,
+            "method": "bursty backlog submitted before steady's; share of "
+                      "served tokens credited to steady when half the "
+                      "total has served — deterministic counters, DRR vs "
+                      "the no-registry FIFO contrast",
+        },
+    }
+
+
+def run_bench_warm_pool(seed=0):
+    """Warm-pool time-to-capacity rung (ISSUE 18): one fleet measures a
+    COLD scale-up (process launch + jax import + model build + compile)
+    and a WARM claim (pre-booted pool worker: marker delete + health
+    probe + attach) back to back.  ``value`` = warm_s / cold_s — lower
+    is better and must stay under 1.0 (perf_gate bounds it absolutely;
+    a pool that does not beat a cold boot is pure overhead)."""
+    import jax
+
+    import bench_ladder
+    from paddle_tpu.inference import ServingFleet
+
+    backend = jax.default_backend()
+    on_accel = backend in ("tpu", "axon")
+    model_cfg = dict(vocab_size=256, hidden_size=64, intermediate_size=160,
+                     num_hidden_layers=1, num_attention_heads=2,
+                     max_position_embeddings=256)
+    engine_cfg = dict(max_batch_size=2, max_seq_len=64, block_size=8,
+                      token_budget=16)
+    spec = {"seed": seed, "model": model_cfg, "engine": engine_cfg}
+
+    def attach_time(fleet, spawn):
+        t0 = time.monotonic()
+        spawn()
+        while fleet.num_pending_spawns and time.monotonic() - t0 < 300:
+            fleet.step()
+            time.sleep(0.02)
+        assert fleet.num_pending_spawns == 0 and not fleet.spawn_errors, \
+            f"scale-up failed: {fleet.spawn_errors}"
+        return time.monotonic() - t0
+
+    with ServingFleet(spec, num_workers=1, warm_pool_size=1,
+                      cpu_workers=not on_accel,
+                      spawn_timeout=240.0) as fleet:
+        # cold first (named spawns bypass the pool), so the warm worker
+        # finishes booting in parallel with the measurement
+        cold_s = attach_time(
+            fleet, lambda: fleet.spawn_worker_async(name="cold1"))
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            with fleet.warm_pool._lock:
+                if fleet.warm_pool._ready:
+                    break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("warm worker never became ready")
+        warm_s = attach_time(fleet, fleet.spawn_worker_async)
+        n_replicas = len(fleet.frontend.replicas)
+        attaches = fleet.frontend.metrics.counter("pool_attaches_total")
+    assert n_replicas == 3 and attaches == 1
+    return {
+        "metric": "serving_warm_pool_attach_ratio",
+        "value": round(warm_s / cold_s, 4),
+        "unit": "warm/cold time-to-capacity (lower=better)",
+        "extra": {
+            "host": bench_ladder.host_fingerprint(),
+            "backend": backend,
+            "cold_spawn_s": round(cold_s, 3),
+            "warm_attach_s": round(warm_s, 3),
+            "method": "same fleet, back-to-back scale-ups: cold = named "
+                      "spawn (full worker boot), warm = pool claim "
+                      "(marker delete + probe + attach); ratio of "
+                      "time-to-attached wall clocks",
+        },
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--num-requests", type=int, default=None)
@@ -762,13 +928,29 @@ def main(argv=None):
                          "in-graph K-step decode vs per-token stepping; "
                          "reports host round trips per token + parity")
     ap.add_argument("--megastep-k", type=int, default=8)
+    ap.add_argument("--tenant-isolation", action="store_true",
+                    help="tenant-fairness workload (ISSUE 18) — bursty "
+                         "backlog vs steady backlog through per-tenant "
+                         "DRR dispatch; reports the steady tenant's "
+                         "served-token share at half-served (0.5=fair), "
+                         "a deterministic counter ratio")
+    ap.add_argument("--warm-pool", action="store_true",
+                    help="warm-pool workload (ISSUE 18) — cold worker "
+                         "spawn vs warm pool claim on one fleet; reports "
+                         "warm/cold time-to-capacity ratio (< 1.0 or the "
+                         "pool is overhead)")
     ap.add_argument("--staggered-admission", action="store_true",
                     help="saturated megastep workload — open-loop Poisson "
                          "staggered admission in virtual engine-step time; "
                          "reports host round trips per token with the "
                          "mixed-phase megastep on + greedy/seeded parity")
     args = ap.parse_args(argv)
-    if args.disagg:
+    if args.tenant_isolation:
+        line = run_bench_tenant_isolation(num_requests=args.num_requests,
+                                          seed=args.seed)
+    elif args.warm_pool:
+        line = run_bench_warm_pool(seed=args.seed)
+    elif args.disagg:
         line = run_bench_disagg(seed=args.seed)
     elif args.staggered_admission:
         line = run_bench_staggered(num_requests=args.num_requests,

@@ -578,6 +578,275 @@ def run_chaos_disagg(seed=0, num_requests=16, max_steps=3000):
     }
 
 
+def _mt_request_stream(seed, num_requests):
+    """Seeded (prompt, max_new_tokens, tenant) stream for the
+    multi-tenant soak: a STEADY tenant dripping one request per step and
+    a BURSTY tenant arriving in bursts (the submission plan bursts the
+    ``bursty`` indices).  All NORMAL priority — the soak's parity
+    contract is per-weights-version, so nothing may preempt a request
+    across versions mid-decode."""
+    import random
+
+    rng = random.Random(f"chaos-mt:{seed}")
+    reqs = []
+    for i in range(num_requests):
+        prompt = [rng.randrange(1, MODEL["vocab_size"])
+                  for _ in range(rng.randrange(2, 6))]
+        tenant = "bursty" if i % 3 == 2 else "steady"
+        reqs.append((prompt, rng.randrange(3, 7), tenant))
+    return reqs
+
+
+def run_chaos_multitenant(seed=0, num_requests=18, max_steps=3000):
+    """Multi-tenant elastic-platform chaos soak (ISSUE 18): three
+    replicas + a warm pool + a mid-traffic rolling weight swap under a
+    bursty-vs-steady tenant mix, with all three new failpoint sites
+    (``pool.refill``, ``pool.attach``, ``weights.swap``) armed and
+    fired.  Asserts the platform contract:
+
+    * zero dropped admitted requests — every non-negative rid reaches
+      COMPLETED through the warm attach AND the rolling swap;
+    * the swap fault leaves exactly one replica on the old version
+      (mixed-version fleet), and every COMPLETED request's tokens match
+      the fault-free reference FOR ITS OWN ``weights_version`` — the
+      single-version parity guarantee, greedy end to end;
+    * budget isolation: the bursty tenant takes >= 1 typed OVERLOADED
+      budget rejection while the steady tenant completes everything;
+    * the warm attach actually served traffic (a pool that attached an
+      idle spectator must not count), and per-tenant served counters /
+      complete per-request trace trees rode along.
+
+    Everything is step-count clocked and seeded: same (seed, config)
+    replays byte-identical reports (``trace_digest`` included)."""
+    from paddle_tpu.distributed.rpc import RpcTimeout
+    from paddle_tpu.inference import (FaultInjector, Priority, RequestStatus,
+                                      ServingEngine, ServingFrontend,
+                                      TenantRegistry, TenantSpec, WarmPool)
+    from paddle_tpu.inference.faults import FaultyReplica
+    from paddle_tpu.inference.tracing import (FlightRecorder, TraceContext,
+                                              Tracer, events_digest,
+                                              tree_complete)
+
+    model_v0 = _build_model()
+    import paddle_tpu as P
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    P.seed(13)
+    model_v2 = LlamaForCausalLM(LlamaConfig(**MODEL))
+    model_v2.eval()
+
+    reqs = _mt_request_stream(seed, num_requests)
+    base = [(p, m, Priority.NORMAL) for p, m, _ in reqs]
+    ref_v0 = _reference_tokens(model_v0, base)
+    ref_v2 = _reference_tokens(model_v2, base)
+
+    step_i = 0
+
+    def tclock():
+        return float(step_i)
+
+    inj = FaultInjector({
+        "pool.refill": {"kind": "error", "times": 1},
+        "pool.attach": {"kind": "error", "times": 1},
+        "weights.swap": {"kind": "error", "times": 1},
+    }, seed=seed, replica_namespaces=["r0", "r1", "r2", "r3"])
+    tracer = Tracer(clock=tclock, proc="frontend")
+    inj.recorder = tracer.recorder
+
+    def mk(i, model):
+        eng = ServingEngine(model, fault_injector=inj,
+                            trace_recorder=FlightRecorder(clock=tclock,
+                                                          proc=f"r{i}"),
+                            clock=tclock, **ENGINE)
+        return FaultyReplica(eng, inj, name=f"r{i}",
+                             timeout_exc=RpcTimeout)
+
+    # bursty budget 12: a 3-request burst (each 5-11 tokens) always
+    # admits its first and always rejects its third while the first two
+    # are still outstanding — >= 1 typed rejection AND >= 1 completion
+    # per burst, deterministically, for every seed
+    reg = TenantRegistry([TenantSpec("steady"),
+                          TenantSpec("bursty", token_budget=12)])
+    fe = ServingFrontend([mk(0, model_v0), mk(1, model_v0),
+                          mk(2, model_v0)],
+                         tenants=reg, tracer=tracer)
+
+    # warm pool with an in-process spawn: builds the engine AND pre-pays
+    # its compile with the same throwaway sub-block request a real
+    # ``--warm`` worker drives (nothing lands in the prefix cache, so
+    # warm-attach parity is cold-boot parity by construction)
+    def spawn_warm(name):
+        rep = mk(3, model_v0)
+        rep._eng.add_request([1], max_new_tokens=2)
+        while rep._eng.num_active or rep._eng._queue:
+            rep._eng.step()
+        rep._eng.pop_finished()
+        rep._eng.pop_trace_events()   # discard the warm-up's spans
+        return rep
+
+    pool = WarmPool(1, spawn_warm, fault_injector=inj, metrics=fe.metrics)
+
+    # submission plan: steady drips one per step, bursty arrives in
+    # bursts of three.  The tail of BOTH tenants is held back until the
+    # rolling swap returns — the swap drives the control loop itself
+    # while replicas drain, so without a reserved tail every request
+    # would retire on v0 replicas mid-swap and the soak would never
+    # prove v2 actually serves
+    steady = [i for i, r in enumerate(reqs) if r[2] == "steady"]
+    bursty = [i for i, r in enumerate(reqs) if r[2] == "bursty"]
+    pre_steady, post_steady = steady[:-3], steady[-3:]
+    pre_bursty, post_bursty = bursty[:3], bursty[3:]
+    plan = {}
+    for k, i in enumerate(pre_steady):
+        plan.setdefault(k, []).append(i)
+    for i in pre_bursty:
+        plan.setdefault(4, []).append(i)
+    warm_step, swap_step = 6, 9
+    total = len(reqs)
+
+    rids = {}
+    rejected_budget = []
+    submitted = 0
+
+    def advance():
+        # one soak step: due submissions + a frontend step.  The rolling
+        # swap drives THIS (not bare fe.step), so traffic keeps arriving
+        # mid-swap — the zero-drop guarantee is tested under load
+        nonlocal step_i, submitted
+        for i in plan.get(step_i, ()):
+            p, m, tenant = reqs[i]
+            rid = fe.submit(p, max_new_tokens=m, tenant=tenant)
+            rids[i] = rid
+            if rid < 0:
+                rejected_budget.append(i)
+            submitted += 1
+        fe.step()
+        step_i += 1
+
+    warm_name = None
+    swapped = None
+    warm_eng = None
+    warm_tokens_at_attach = 0
+    while (fe.pending or submitted < total) and step_i < max_steps:
+        if step_i == warm_step and warm_name is None:
+            # warm attach mid-burst: the first refill AND the first
+            # claim each eat an armed fault, then succeed — scale-up
+            # still lands, one deterministic retry later
+            pool.refill()              # armed pool.refill error fires
+            pool.refill()              # retry fills the pool
+            assert pool.claim() is None, (
+                "armed pool.attach fault did not fire on first claim")
+            claimed = pool.claim()     # re-pooled worker, second claim
+            assert claimed is not None, "warm pool empty after refill"
+            warm_name, warm_rep = claimed
+            warm_eng = warm_rep._eng
+            warm_tokens_at_attach = warm_eng.megastep_tokens
+            fe.add_replica(warm_rep)
+        if step_i == swap_step and swapped is None:
+            swapped = fe.rolling_swap(model_v2, "v2", step=advance)
+            # post-swap tail: the held-back steadies drip onto the
+            # mixed-version fleet and the second bursty burst retests
+            # the budget on it
+            for k, i in enumerate(post_steady):
+                plan.setdefault(step_i + k, []).append(i)
+            for i in post_bursty:
+                plan.setdefault(step_i + 1, []).append(i)
+        advance()
+
+    # ---- platform contract
+    res = fe.results()
+    admitted = [i for i, rid in rids.items() if rid >= 0]
+    assert submitted == total and not fe.pending, (
+        f"multitenant soak stalled: {fe.pending} request(s) never "
+        f"terminal in {max_steps} steps")
+    dropped = [i for i in admitted
+               if res[rids[i]].status is not RequestStatus.COMPLETED]
+    assert not dropped, (
+        f"admitted requests dropped through warm attach/rolling swap: "
+        f"{dropped}")
+
+    # mixed-version fleet: the armed weights.swap fault pinned exactly
+    # one replica to v0; everything else serves v2
+    versions = sorted(getattr(r.engine, "weights_version", "?")
+                      for r in fe.replicas)
+    assert versions.count("v0") == 1 and versions.count("v2") == 3, (
+        f"expected exactly one swap-faulted v0 replica, got {versions}")
+    assert swapped == 3, f"rolling_swap reported {swapped}, expected 3"
+
+    # single-version token parity: each survivor matches the reference
+    # for the version it actually completed on
+    mismatched = []
+    version_hist = {}
+    for i in admitted:
+        r = res[rids[i]]
+        version_hist[r.weights_version] = \
+            version_hist.get(r.weights_version, 0) + 1
+        ref = ref_v0 if r.weights_version == "v0" else ref_v2
+        if r.tokens != ref[i]:
+            mismatched.append((i, r.weights_version))
+    assert not mismatched, (
+        f"survivors diverged from their version's reference: {mismatched}")
+    assert len(version_hist) == 2, (
+        f"soak never served both weight versions: {version_hist}")
+
+    # budget isolation: bursty took >= 1 typed rejection, steady took none
+    assert rejected_budget, "bursty tenant never hit its token budget"
+    assert all(reqs[i][2] == "bursty" for i in rejected_budget), (
+        "a steady request was budget-rejected — isolation leaked")
+    for i in rejected_budget:
+        assert res[rids[i]].status is RequestStatus.OVERLOADED
+    assert fe.metrics.counter("tenant_rejected_budget_total") \
+        == len(rejected_budget)
+    snap = reg.snapshot()
+    assert snap["steady"]["served"] > 0 and snap["bursty"]["served"] > 0
+
+    # the three new lifecycle failpoints all actually fired
+    for site in ("pool.refill", "pool.attach", "weights.swap"):
+        assert inj.fires(site) >= 1, f"failpoint {site} never fired"
+    assert fe.metrics.counter("weight_swap_failures_total") == 1
+    assert warm_eng is not None \
+        and warm_eng.megastep_tokens > warm_tokens_at_attach, (
+            "warm-attached replica never served a token")
+
+    # span-tree contract: every admitted request's tree is orphan-free
+    for i in admitted:
+        tree = tracer.tree_for(TraceContext.mint(rids[i]).trace_id)
+        ok, why = tree_complete(tree)
+        assert ok, f"rid {rids[i]} span tree incomplete: {why}"
+
+    statuses = {}
+    for i, rid in rids.items():
+        s = res[rid].status.value
+        statuses[s] = statuses.get(s, 0) + 1
+    return {
+        "mode": "multitenant",
+        "seed": seed,
+        "requests": total,
+        "admitted": len(admitted),
+        "rejected_budget": len(rejected_budget),
+        "steps": step_i,
+        "statuses": statuses,
+        "replica_versions": versions,
+        "result_versions": dict(sorted(version_hist.items())),
+        "swapped_replicas": swapped,
+        "swap_failures": fe.metrics.counter("weight_swap_failures_total"),
+        "warm_attached": warm_name,
+        "pool_fires": {s: inj.fires(s) for s in
+                       ("pool.refill", "pool.attach", "weights.swap")},
+        "pool_counters": {
+            "refills": fe.metrics.counter("pool_refills_total"),
+            "attaches": fe.metrics.counter("pool_attaches_total"),
+            "attach_failures":
+                fe.metrics.counter("pool_attach_failures_total"),
+        },
+        "served_tokens": {t: int(snap[t]["served"])
+                          for t in ("steady", "bursty")},
+        "fault_kinds_fired": inj.kinds_fired(),
+        "survivors_token_identical": True,
+        "trace_events": len(tracer.all_events()),
+        "trace_digest": events_digest(tracer.all_events()),
+    }
+
+
 def _kill_request_stream(seed, num_requests):
     """The shared seeded stream with per-request sampling attached:
     every third request is a seeded NON-GREEDY stream, so recovery has
@@ -1456,6 +1725,12 @@ def main(argv=None):
                          "split over a fenced KV fabric with all three "
                          "fabric.* failpoints armed + a stale directory "
                          "lease + prefill-replica death")
+    ap.add_argument("--multitenant", action="store_true",
+                    help="multi-tenant elastic-platform phase (ISSUE 18): "
+                         "steady-vs-bursty tenants over three replicas, a "
+                         "warm-pool attach mid-burst, a rolling weight "
+                         "swap mid-traffic, and the pool.refill / "
+                         "pool.attach / weights.swap failpoints all armed")
     ap.add_argument("--pause-after", type=int, default=None,
                     help="standby: pause/kill the active frontend once "
                          "this many requests are terminal (with work "
@@ -1482,6 +1757,8 @@ def main(argv=None):
             args.requests = 14
         elif args.disagg:
             args.requests = 16
+        elif args.multitenant:
+            args.requests = 18
         else:
             args.requests = 18
     if args.pause_after is None:
@@ -1507,6 +1784,9 @@ def main(argv=None):
     elif args.disagg:
         report = run_chaos_disagg(seed=args.seed,
                                   num_requests=args.requests)
+    elif args.multitenant:
+        report = run_chaos_multitenant(seed=args.seed,
+                                       num_requests=args.requests)
     elif args.kill_frontend:
         report = run_kill_frontend(seed=args.seed,
                                    num_requests=args.requests,

@@ -3,7 +3,7 @@
 check_op_benchmark_result.py — CI fails when a benchmark regresses vs the
 recorded baseline).
 
-Three checks; the first two run against the PREVIOUS round's recordings:
+Four checks; the first two run against the PREVIOUS round's recordings:
 
 1. Headline: the newest BENCH_r*.json's ``vs_baseline`` ratio must not drop
    more than --tolerance (default 10%), and the pinned workload must not
@@ -23,6 +23,12 @@ Three checks; the first two run against the PREVIOUS round's recordings:
    megastep rung's host-round-trips-per-token (both deterministic counter
    ratios), or chunked prefill has stopped keeping the scan armed under
    open-loop load.
+4. Absolute (r18, ISSUE 18): bounds declared in ``ABS_RUNG_BOUNDS`` on
+   single rungs of the LATEST round — the tenant-isolation served share
+   must stay in [0.40, 0.60] (0.5 is fair; drift in either direction is
+   a fairness bug the one-sided delta check cannot catch), and the
+   warm-pool attach ratio must stay below 1.0 (a warm attach slower
+   than a cold spawn means the pool is pure overhead).
 
 Run with no arguments from the repo root.
 """
@@ -235,6 +241,18 @@ CROSS_RUNG_BOUNDS = (
      "serving_megastep_steps_per_token", 1.5),
 )
 
+# absolute bounds WITHIN the latest round (ISSUE 18): some rungs have a
+# contract the round-over-round delta cannot express.  The tenant-
+# isolation share is a two-sided band — 0.5 is fair, and drift TOWARD
+# 1.0 (steady starving bursty) is as much a bug as drift toward 0.0, but
+# the directional tolerance check only fails one way.  The warm-pool
+# ratio must stay under 1.0 outright: a warm attach slower than a cold
+# spawn means the pool is pure overhead no matter how stable the number.
+ABS_RUNG_BOUNDS = (
+    ("serving_tenant_isolation_served_share", 0.40, 0.60),
+    ("serving_warm_pool_attach_ratio", None, 1.0),
+)
+
 
 def check_cross_rungs(ladders) -> int:
     if not ladders:
@@ -259,6 +277,27 @@ def check_cross_rungs(ladders) -> int:
     return rc
 
 
+def check_abs_rungs(ladders) -> int:
+    if not ladders:
+        return 0
+    cn, cpath, cur = ladders[-1]
+    cur_by = {r["metric"]: r for r in cur}
+    rc = 0
+    for metric, lo, hi in ABS_RUNG_BOUNDS:
+        r = cur_by.get(metric)
+        if r is None:
+            continue  # rung not measured this round — nothing to bound
+        v = float(r["value"])
+        band = (f"[{lo:g}, {hi:g}]" if lo is not None
+                else f"(-inf, {hi:g}]")
+        print(f"perf-gate: abs-bound {metric}: {v:g} in {band}")
+        if (lo is not None and v < lo) or v > hi:
+            print(f"perf-gate: FAIL — '{metric}' = {v:g} in r{cn} "
+                  f"({cpath}) is outside its absolute bound {band}")
+            rc = 1
+    return rc
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--tolerance", type=float, default=0.10,
@@ -273,6 +312,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     ladders = load_ladders(args.root)
     rc = check_ladder(ladders, load_tolerances(args.root)) or rc
     rc = check_cross_rungs(ladders) or rc
+    rc = check_abs_rungs(ladders) or rc
     print("perf-gate: pass" if rc == 0 else "perf-gate: FAIL")
     return rc
 

@@ -66,6 +66,13 @@ def main(argv=None):
                     help="'cpu' pins JAX_PLATFORMS=cpu (CI / fleet default "
                          "via ServingFleet(cpu_workers=True)); omit to "
                          "inherit the host's jax config")
+    ap.add_argument("--warm", action="store_true",
+                    help="warm-pool boot (ISSUE 18): pre-compile the "
+                         "step/megastep programs with a throwaway request "
+                         "BEFORE registering, then park behind a "
+                         "/serving/warm/<name> KV marker until a fleet "
+                         "claims this worker — scale-up becomes a health "
+                         "probe instead of a ~10 s boot")
     args = ap.parse_args(argv)
 
     if args.platform == "cpu":
@@ -112,6 +119,13 @@ def main(argv=None):
                 if faults else None)
     engine = ServingEngine(model, fault_injector=injector,
                            **spec.get("engine", {}))
+    # weights identity labels (ISSUE 18): a worker respawned AFTER a
+    # rolling swap boots the new recipe — the spec carries the version
+    # label so it reports the version it actually serves, not "v0"
+    if "weights_version" in spec:
+        engine.weights_version = str(spec["weights_version"])
+    if "model_id" in spec:
+        engine.model_id = str(spec["model_id"])
     # tracing (ISSUE 15): {"tracing": true} in the spec arms a per-worker
     # flight recorder; the engine's span events (prefill done, megastep
     # boundaries) ship back on every _w_step reply / _w_pop_traces RPC
@@ -127,6 +141,23 @@ def main(argv=None):
                              role=role)
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_: stop.set())
+    if args.warm:
+        # pre-pay the compile bill BEFORE registering (registration is
+        # the pool's ready signal): one throwaway sub-block request
+        # drives the prefill program and one decode megastep through
+        # XLA.  The prompt is shorter than a block, so no FULL block is
+        # ever published — the prefix cache stays empty and a warm
+        # attach is token/cache-identical to a cold boot.
+        engine.add_request([1], max_new_tokens=2)
+        while engine.num_active or engine._queue:
+            engine.step()
+        engine.pop_finished()
+        lp = getattr(engine, "pop_token_logprobs", None)
+        if lp is not None:
+            lp()
+        pt = getattr(engine, "pop_trace_events", None)
+        if pt is not None:
+            pt()
     rpc.init_rpc(args.name, rank=args.rank, world_size=1,
                  master_endpoint=args.master)
     if role is not None:
@@ -137,6 +168,13 @@ def main(argv=None):
         from paddle_tpu.distributed.launch.master import KVClient
 
         KVClient(args.master).put(f"/serving/roles/{args.name}", role)
+    if args.warm:
+        # the warm marker keeps this worker out of discovery (a
+        # recovering frontend must not adopt pool inventory); the
+        # claiming fleet deletes it at attach time
+        from paddle_tpu.distributed.launch.master import KVClient
+
+        KVClient(args.master).put(f"/serving/warm/{args.name}", "1")
     print(f"WORKER_READY {args.name} pid={os.getpid()}", flush=True)
     stop.wait()
     rpc.shutdown()

@@ -265,7 +265,7 @@ import numpy as np
 from .ha import HANDOFF_FLUSH, FrontendLease, StaleEpoch
 from .journal import (ADMIT, EPOCH, PROGRESS, TERMINAL, JournalSuperseded,
                       RequestJournal)
-from .metrics import (MEGASTEP_COUNTERS, ServingMetrics,
+from .metrics import (MEGASTEP_COUNTERS, SPEC_COUNTERS, ServingMetrics,
                       fold_counter_deltas, fold_prefix_counters)
 from .serving import SamplingParams, ServingEngine, prompt_block_hashes
 from .tenancy import TenantRegistry
@@ -459,6 +459,9 @@ class _Replica:
         # (megasteps, megastep tokens, mixed launches, prefill chunks) —
         # the MEGASTEP_COUNTERS wire order
         self.mega_seen = (0, 0, 0, 0)
+        # (accepted, drafted, verify forwards) — the SPEC_COUNTERS wire
+        # order (ISSUE 19)
+        self.spec_seen = (0, 0, 0)
 
 
 def _blocks_needed(engine: ServingEngine, total_tokens: int) -> int:
@@ -863,6 +866,7 @@ class ServingFrontend:
                eos_token_id: Optional[int] = None,
                temperature: float = 0.0, top_k: int = 0,
                top_p: float = 1.0, seed: int = 0, logprobs: bool = False,
+               spec: bool = True,
                idempotency_key: Optional[str] = None,
                tenant: Optional[str] = None,
                on_token: Optional[Callable[[int, int], None]] = None) -> int:
@@ -921,7 +925,8 @@ class ServingFrontend:
             raise ValueError("max_new_tokens must be positive")
         sampling = SamplingParams(temperature=float(temperature),
                                   top_k=int(top_k), top_p=float(top_p),
-                                  seed=int(seed), logprobs=bool(logprobs))
+                                  seed=int(seed), logprobs=bool(logprobs),
+                                  spec=bool(spec))
         tenant_name = tenant
         if self.tenants is not None:
             # tenancy (ISSUE 18): unknown tenants fold into "default";
@@ -2567,3 +2572,8 @@ class ServingFrontend:
                     int(getattr(eng, "prefill_chunks", 0)))
             rep.mega_seen = fold_counter_deltas(m, MEGASTEP_COUNTERS, mcur,
                                                 rep.mega_seen)
+            scur = (int(getattr(eng, "spec_accepted_tokens", 0)),
+                    int(getattr(eng, "spec_draft_tokens", 0)),
+                    int(getattr(eng, "spec_verify_forwards", 0)))
+            rep.spec_seen = fold_counter_deltas(m, SPEC_COUNTERS, scur,
+                                                rep.spec_seen)

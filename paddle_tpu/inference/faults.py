@@ -97,6 +97,11 @@ KNOWN_SITES = {
     "weights.swap",       # engine swaps in a new weights version
     "pool.attach",        # warm worker claimed + attached to the fleet
     "pool.refill",        # warm pool spawns a replacement worker
+    # speculative decoding (ISSUE 19) — canonical registrations live
+    # next to the firing code in inference/serving.py; a fault at either
+    # site degrades to the non-spec path, never a wrong token
+    "engine.spec_draft",  # host-side n-gram drafter, fired per drafted row
+    "engine.spec_verify",  # batched multi-token verify launch
 }
 # FaultyReplica/FencedEngine also fire replica-scoped sites
 # "<replica name>.<op>" (so a schedule can doom one replica).  The

@@ -106,7 +106,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 from .control_plane import ServingFrontend
 from .faults import FaultInjector, RespawnCircuitBreaker, register_failpoint
 from .ha import EpochFence, StaleEpoch
-from .metrics import (MEGASTEP_COUNTERS, ServingMetrics,
+from .metrics import (MEGASTEP_COUNTERS, SPEC_COUNTERS, ServingMetrics,
                       fold_counter_deltas, fold_prefix_counters)
 
 __all__ = ["RemoteReplica", "ServingFleet", "FleetAutoscaler",
@@ -286,7 +286,8 @@ class _BoundedErrors(OrderedDict):
 # --------------------------------------------------------------------------
 _WORKER: Dict[str, Any] = {
     "engine": None, "metrics": None, "stop": None, "name": None,
-    "prefix_seen": (0, 0, 0), "mega_seen": (0, 0, 0, 0), "faults": None,
+    "prefix_seen": (0, 0, 0), "mega_seen": (0, 0, 0, 0),
+    "spec_seen": (0, 0, 0), "faults": None,
     "fence": EpochFence(), "role": None,
 }
 
@@ -323,6 +324,7 @@ def init_worker(engine, name: str,
     _WORKER["name"] = name
     _WORKER["prefix_seen"] = (0, 0, 0)
     _WORKER["mega_seen"] = (0, 0, 0, 0)
+    _WORKER["spec_seen"] = (0, 0, 0)
     _WORKER["faults"] = (fault_injector if fault_injector is not None
                          else FaultInjector.from_env())
     _WORKER["fence"] = EpochFence()
@@ -428,6 +430,11 @@ def _w_step(epoch=None):
             int(ms.get("mixed", 0)), int(ms.get("prefill_chunks", 0)))
     _WORKER["mega_seen"] = fold_counter_deltas(m, MEGASTEP_COUNTERS, mcur,
                                                _WORKER["mega_seen"])
+    sp = st.get("spec") or {}
+    scur = (int(sp.get("accepted", 0)), int(sp.get("drafted", 0)),
+            int(sp.get("verify_forwards", 0)))
+    _WORKER["spec_seen"] = fold_counter_deltas(m, SPEC_COUNTERS, scur,
+                                               _WORKER["spec_seen"])
     m.inc("completed_total", len(finished))
     # span events the engine recorded this step (prefill done, megastep
     # boundaries) piggyback on the reply — the frontend grafts them onto
@@ -693,6 +700,13 @@ class RemoteReplica:
         self.megastep_tokens = int(ms.get("tokens", 0))
         self.megasteps_mixed = int(ms.get("mixed", 0))
         self.prefill_chunks = int(ms.get("prefill_chunks", 0))
+        # speculative-decode mirror (ISSUE 19): same self-reported fold
+        # contract as the megastep counters above
+        sp = st.get("spec") or {}
+        self.spec_k = int(sp.get("k", 0))
+        self.spec_accepted_tokens = int(sp.get("accepted", 0))
+        self.spec_draft_tokens = int(sp.get("drafted", 0))
+        self.spec_verify_forwards = int(sp.get("verify_forwards", 0))
         # per-phase step-time mirror (the worker sets the gauges in its
         # own registry too; the frontend sums mirrors like the block
         # counts above)

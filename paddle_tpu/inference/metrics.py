@@ -97,6 +97,14 @@ COUNTERS = (
     "pool_attach_failures_total",
     "tenant_rejected_budget_total", "tenant_routing_hits_total",
     "tenant_swap_waits_total",
+    # speculative decoding (ISSUE 19): draft tokens committed by the
+    # verify (beyond the one token a forward always emits), draft tokens
+    # proposed by the host n-gram drafter, and rows scored by verify
+    # launches (a per-token forward-equivalent: verify_forwards ÷
+    # (accepted + verify_forwards) is the forwards-per-committed-token
+    # ratio the bench ladder gates < 1.0)
+    "accepted_tokens_total", "spec_draft_tokens_total",
+    "spec_verify_forwards_total",
 )
 GAUGES = (
     "queue_depth", "queue_depth_peak", "running_requests", "replicas_alive",
@@ -134,6 +142,12 @@ PREFIX_COUNTERS = ("prefix_hit_blocks_total", "prefix_miss_blocks_total",
 # the wire order of every mirrored ``mega_seen`` fold tuple
 MEGASTEP_COUNTERS = ("megasteps_total", "megastep_tokens_total",
                      "megastep_mixed_total", "prefill_chunks_total")
+# engine-level speculative-decode counters (ISSUE 19), in the order
+# their (accepted, drafted, verify_forwards) fold tuples are built —
+# same end-extend-only rule as MEGASTEP_COUNTERS: the tuple order IS
+# the wire order of every mirrored ``spec_seen`` fold tuple
+SPEC_COUNTERS = ("accepted_tokens_total", "spec_draft_tokens_total",
+                 "spec_verify_forwards_total")
 
 
 def fold_counter_deltas(metrics: "ServingMetrics", names, cur, seen):

@@ -98,7 +98,8 @@ from ..ops.paged_attention import blha_attention
 from .faults import register_failpoint
 
 __all__ = ["BlockManager", "ServingRequest", "ServingEngine",
-           "SamplingParams", "prefix_block_hash", "prompt_block_hashes"]
+           "SamplingParams", "prefix_block_hash", "prompt_block_hashes",
+           "ngram_draft"]
 # the policy layer above this engine lives in control_plane.py
 # (ServingFrontend) and metrics.py (ServingMetrics)
 
@@ -107,6 +108,14 @@ __all__ = ["BlockManager", "ServingRequest", "ServingEngine",
 # weights fully serving — the rolling_swap driver keeps the replica on
 # its previous version and counts weight_swap_failures_total
 WEIGHTS_SWAP = register_failpoint("weights.swap")
+
+# speculative decoding (ISSUE 19): both sites DEGRADE, never corrupt —
+# a drafting fault empties that row's draft (the verify still commits
+# its one non-spec token), a verify fault falls the whole step back to
+# the megastep/single-step path.  Either way the emitted token stream
+# is bit-identical to spec-off; chaos asserts exactly that.
+SPEC_DRAFT = register_failpoint("engine.spec_draft")
+SPEC_VERIFY = register_failpoint("engine.spec_verify")
 
 
 @dataclass
@@ -131,6 +140,12 @@ class SamplingParams:
     top_p: float = 1.0      # 1.0 = no nucleus filter
     seed: int = 0
     logprobs: bool = False
+    # opt OUT of speculative decoding for this request (ISSUE 19).  Only
+    # effective on engines built with spec_k > 0; spec-on is token-
+    # identical to spec-off by contract, so the toggle exists for
+    # latency-shape control (verify batches commit tokens in bursts),
+    # not correctness.
+    spec: bool = True
 
     def __post_init__(self):
         if self.temperature < 0:
@@ -160,7 +175,7 @@ class SamplingParams:
         knob cannot be silently dropped at a transport boundary."""
         return {"temperature": self.temperature, "top_k": self.top_k,
                 "top_p": self.top_p, "seed": self.seed,
-                "logprobs": self.logprobs}
+                "logprobs": self.logprobs, "spec": self.spec}
 
 
 def _sample_tokens(logits, temps, top_ks, top_ps, seeds, sample_pos,
@@ -228,6 +243,30 @@ def _sample_tokens(logits, temps, top_ks, top_ps, seeds, sample_pos,
     logprob = jnp.take_along_axis(jax.nn.log_softmax(lg, axis=-1),
                                   nxt[:, None], axis=-1)[:, 0]
     return nxt, logprob, None
+
+
+def ngram_draft(history: Sequence[int], k: int,
+                max_ngram: int = 3) -> List[int]:
+    """Model-free n-gram / prompt-lookup drafting (Saxena 2023): find
+    the most recent EARLIER occurrence of the history's longest matching
+    tail n-gram (n = ``max_ngram`` down to 1) and propose up to ``k``
+    tokens of its continuation.  Pure Python over ints — deterministic,
+    seed-free, and identical across processes, so replica failover and
+    journal replay re-draft (and hence re-verify) the exact same
+    proposals.  Operates on ONE request's ``prompt + generated`` history
+    only; no cross-request state exists to contaminate.  Returns ``[]``
+    when the history is empty/too short or no tail n-gram recurs —
+    drafting is best-effort, the verify commits >= 1 token either way."""
+    h = [int(t) for t in history]
+    n_hist = len(h)
+    if k <= 0 or n_hist < 2:
+        return []
+    for n in range(min(int(max_ngram), n_hist - 1), 0, -1):
+        pat = h[-n:]
+        for i in range(n_hist - n - 1, -1, -1):
+            if h[i:i + n] == pat:
+                return h[i + n:i + n + k]
+    return []
 
 
 def prefix_block_hash(parent: Optional[str], tokens: Sequence[int]) -> str:
@@ -482,6 +521,8 @@ class ServingEngine:
                  capture_sample_probs: bool = False,
                  trace_recorder=None,
                  deadline_token_seconds: Optional[float] = None,
+                 prefill_chunk_tokens: Optional[int] = None,
+                 spec_k: int = 0,
                  clock: Callable[[], float] = time.monotonic):
         from .faults import FaultInjector
 
@@ -586,6 +627,27 @@ class ServingEngine:
         self.megastep_tokens = 0    # tokens emitted via the megastep path
         self.megasteps_mixed = 0    # of those launches, mixed-phase scans
         self.prefill_chunks = 0     # prompt chunks fed inside mixed scans
+        # prefill chunk size (ISSUE 19 satellite, first rung toward
+        # Sarathi-style budget-adaptive chunking): tokens per prompt
+        # chunk inside the mixed-phase scan.  Default = block_size (the
+        # historical behavior); <= block_size keeps one chunk inside one
+        # KV block's worth of writes.  Trace-shaping (the scan's packed
+        # chunk width), hence part of _program_key.
+        pc = self.bs if prefill_chunk_tokens is None else int(prefill_chunk_tokens)
+        if not 1 <= pc <= self.bs:
+            raise ValueError(
+                f"prefill_chunk_tokens={pc} must be in [1, block_size="
+                f"{self.bs}]")
+        self.pc = pc
+        # speculative decoding (ISSUE 19): n-gram drafts of up to spec_k
+        # tokens per pure-decode row, verified (and committed) by ONE
+        # batched forward.  0 (default) disarms the path entirely.
+        if int(spec_k) < 0:
+            raise ValueError("spec_k must be >= 0")
+        self.spec_k = int(spec_k)
+        self.spec_accepted_tokens = 0   # draft tokens committed (monotone)
+        self.spec_draft_tokens = 0      # draft tokens proposed (monotone)
+        self.spec_verify_forwards = 0   # rows scored by verify launches
         # in-graph deadline budgets: seconds one scan iteration costs.
         # An explicit deadline_token_seconds pins it (tests, or operators
         # who measured their hardware); None lets the engine learn an
@@ -611,13 +673,17 @@ class ServingEngine:
         # an already-served geometry starts with warm compile caches.
         self._programs = _PROGRAM_CACHE.setdefault(self._program_key(), {})
         if "forward" not in self._programs:
-            self._programs["forward"] = self._build_forward()
+            fwd, trunk = self._build_forward()
+            self._programs["forward"] = fwd
+            self._programs["trunk"] = trunk
         self._forward = self._programs["forward"]
+        self._trunk = self._programs["trunk"]
         if "step" not in self._programs:
             self._programs["step"] = self._build_step()
         self._step_fn = self._programs["step"]
         self._mega_fn = self._programs.get("mega")    # lazy: pure-decode scan
         self._mixed_fn = self._programs.get("mixed")  # lazy: mixed-phase scan
+        self._spec_fn = self._programs.get("spec")    # lazy: spec verify
         self._cow_fn = self._programs.get("cow")      # lazy: COW block copy
         self._put_fn = self._programs.get("put")      # lazy: block import write
         self.compile_count = 0
@@ -630,7 +696,7 @@ class ServingEngine:
         itself — two models with the same architecture share programs."""
         return (self.B, self.T, self.bs, self.H, self.KV, self.D, self.E,
                 float(self.cfg.rms_norm_eps), self.cache_quant,
-                bool(self.capture_sample_probs))
+                bool(self.capture_sample_probs), self.pc, self.spec_k)
 
     # ------------------------------------------------------------ weights
     def _extract_weights(self, model):
@@ -727,12 +793,15 @@ class ServingEngine:
 
         quant = self.cache_quant
 
-        def forward(weights, key_caches, value_caches, rope, token_ids,
-                    enc, dec, now, cu, bt, mq, scales=None):
+        def trunk(weights, key_caches, value_caches, rope, token_ids,
+                  enc, dec, now, cu, bt, mq, scales=None):
             # mq (static): padded per-sequence query length for the attention
             # compute — T for steps carrying prefill chunks, 1 for pure
-            # decode steps (avoids T× padded-query attention waste). Two
-            # compiled programs total, still shape-stable across requests.
+            # decode steps (avoids T× padded-query attention waste).  The
+            # trunk runs embed -> layers -> final rms and returns the FULL
+            # hidden sequence: ``forward`` heads only each slot's last
+            # packed token, the spec-verify program (ISSUE 19) heads every
+            # draft position — one set of layer math, two consumers.
             hidden = weights["embed"][token_ids]  # [T, E]
             new_scales = []
             for li, lw in enumerate(weights["layers"]):
@@ -762,12 +831,19 @@ class ServingEngine:
                 u = h2 @ lw["wu"]
                 hidden = hidden + (jax.nn.silu(g) * u) @ lw["wd"]
             hidden = rms(hidden, weights["norm"])
+            return hidden, key_caches, value_caches, new_scales
+
+        def forward(weights, key_caches, value_caches, rope, token_ids,
+                    enc, dec, now, cu, bt, mq, scales=None):
+            hidden, kcs, vcs, new_scales = trunk(
+                weights, key_caches, value_caches, rope, token_ids, enc,
+                dec, now, cu, bt, mq, scales)
             # one logits row per batch slot: its LAST packed token
             rows = jnp.clip(cu[1:] - 1, 0, token_ids.shape[0] - 1)
             logits = hidden[rows] @ weights["head"]  # [B, V]
-            return logits, key_caches, value_caches, new_scales
+            return logits, kcs, vcs, new_scales
 
-        return forward
+        return forward, trunk
 
     def _step_raw(self, weights, key_caches, value_caches, rope, token_ids,
                   enc, dec, now, cu, bt, mq, scales=None):
@@ -889,7 +965,7 @@ class ServingEngine:
         int8 is excluded here by the scheduler: dynamic quant scales
         freeze at one-shot prefill, which chunking would violate."""
         fwd = self._forward
-        B, T, C = self.B, self.T, self.bs
+        B, T, C = self.B, self.T, self.pc
         with_probs = self.capture_sample_probs
 
         def mixed(weights, key_caches, value_caches, rope, toks, cached,
@@ -958,6 +1034,81 @@ class ServingEngine:
 
         return jax.jit(mixed, static_argnames=("K",),
                        donate_argnums=(1, 2))
+
+    def _build_spec_verify(self):
+        """Score all ``spec_k + 1`` positions of every row's
+        ``[last_token, draft_0 .. draft_{d-1}]`` feed in ONE batched
+        forward and redraw each position with the EXACT key stream the
+        non-spec path would use (greedy rows argmax; sampled rows
+        ``categorical(fold_in(PRNGKey(seed), spos + j))`` over the same
+        renormalized post-top-k/top-p q(x)).  Because the engine's redraw
+        is deterministic, the Leviathan accept rule collapses to prefix
+        matching: position j accepts iff its redraw EQUALS the draft, so
+        the committed tokens are simply the redraw matrix's first
+        ``accepted + 1`` columns — spec-on is token-identical to spec-off
+        by construction, greedy and seeded.
+
+        KV rewind is free, by the same argument the megastep scan uses
+        to freeze finished rows: draft tokens write KV speculatively at
+        ``dec .. dec+d``, the host advances ``dec`` only by the COMMITTED
+        count, and a cache write is a deterministic function of (token,
+        position, weights) — so accepted positions hold exactly the bits
+        a non-spec feed would write, while rejected positions are
+        overwritten by the next feed before any attention read reaches
+        them (blha attends only up to the declared ``dec + now``).
+        Prefix publishing never exposes stale bits either: it covers
+        only committed-history-minus-last-token full blocks.
+
+        The packed buffer is its OWN shape, [B * (spec_k+1)] — the trunk
+        does not bake a packed length, and ``mq = spec_k + 1`` is the
+        multi-token decode-extend case the mixed scan already exercises.
+        int8 KV-quant is excluded by the scheduler (same dynamic-scale
+        one-shot contract that excludes it from chunked prefill)."""
+        trunk = self._trunk
+        B, sk = self.B, self.spec_k
+        Kp1 = sk + 1
+        with_probs = self.capture_sample_probs
+
+        def spec_verify(weights, key_caches, value_caches, rope,
+                        token_ids, dec, now, cu, bt, dlen, draft, temps,
+                        top_ks, top_ps, seeds, spos):
+            enc = jnp.zeros((B,), jnp.int32)
+            hidden, kcs, vcs, _ = trunk(
+                weights, key_caches, value_caches, rope, token_ids, enc,
+                dec, now, cu, bt, Kp1, None)
+            # per-slot per-position logits rows: position j of slot b is
+            # packed token cu[b] + j; rows whose draft is shorter than
+            # spec_k clamp to their last fed token (masked out of the
+            # accept below, so the garbage never commits)
+            j = jnp.arange(Kp1, dtype=jnp.int32)[None, :]
+            idx = jnp.clip(cu[:-1][:, None] + jnp.minimum(j, dlen[:, None]),
+                           0, token_ids.shape[0] - 1)
+            lg = (hidden[idx.reshape(-1)] @ weights["head"]).reshape(
+                B, Kp1, -1)
+            # redraw every position under the non-spec key stream (the
+            # sample index advances by exactly one per position; Kp1 is
+            # a small static constant, so a host loop over positions
+            # keeps _sample_tokens' all-greedy cond a real cond)
+            nxts, lpss, prbs = [], [], []
+            for jj in range(Kp1):
+                n_j, l_j, p_j = _sample_tokens(
+                    lg[:, jj], temps, top_ks, top_ps, seeds, spos + jj,
+                    return_probs=with_probs)
+                nxts.append(n_j)
+                lpss.append(l_j)
+                if p_j is not None:
+                    prbs.append(p_j)
+            nxt = jnp.stack(nxts, axis=1)                    # [B, Kp1]
+            lps = jnp.stack(lpss, axis=1)                    # [B, Kp1]
+            probs = jnp.stack(prbs, axis=1) if prbs else None
+            # accepted = longest draft prefix the redraw reproduces
+            jk = jnp.arange(sk, dtype=jnp.int32)[None, :]
+            match = (nxt[:, :sk] == draft) & (jk < dlen[:, None])
+            acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
+                          axis=1).astype(jnp.int32)
+            return kcs, vcs, nxt, lps, probs, acc
+
+        return jax.jit(spec_verify, donate_argnums=(1, 2))
 
     # ------------------------------------------------------------- serving
     def add_request(self, prompt_ids, max_new_tokens: int = 32,
@@ -1106,7 +1257,12 @@ class ServingEngine:
         """Return a running request's blocks and batch slot to the pools
         (shared by retirement and mid-flight eviction).  With the prefix
         cache on, full blocks are published first: ``free`` then parks
-        them reusable in the LRU instead of hard-freeing."""
+        them reusable in the LRU instead of hard-freeing.  Idempotent:
+        a deadline-frozen row is released at megastep harvest (ISSUE 19
+        satellite) while staying in ``_active`` for the control plane's
+        typed shed, so the later ``evict``/retire re-releases it."""
+        if req.slot < 0:
+            return
         if self.prefix_cache_enabled and req.blocks:
             self._publish_prefix(req)
         self.blocks.free(req.blocks)
@@ -1186,6 +1342,14 @@ class ServingEngine:
                 "tokens": self.megastep_tokens,
                 "mixed": self.megasteps_mixed,
                 "prefill_chunks": self.prefill_chunks,
+            },
+            # speculative-decode counters (ISSUE 19; same monotone
+            # delta-fold contract as the megastep block above)
+            "spec": {
+                "k": self.spec_k,
+                "accepted": self.spec_accepted_tokens,
+                "drafted": self.spec_draft_tokens,
+                "verify_forwards": self.spec_verify_forwards,
             },
             # cumulative host seconds per step phase — megastep cost
             # attribution without a profiler (ISSUE 15 satellite)
@@ -1304,12 +1468,19 @@ class ServingEngine:
         now = np.zeros((self.B,), np.int32)
         budget = self.T
         sched: List[tuple] = []  # (req, n_tokens, finishes_prefill)
-        # decode first (latency), then fill with prefill chunks
+        # decode first (latency), then fill with prefill chunks.  Rows
+        # with slot < 0 are deadline-frozen and already released at a
+        # megastep harvest — they stay in _active only until the control
+        # plane finalizes the typed shed, and must never re-schedule.
         for req in self._active.values():
+            if req.slot < 0:
+                continue
             if not req.in_prefill and budget > 0:
                 sched.append((req, 1, False))
                 budget -= 1
         for req in self._active.values():
+            if req.slot < 0:
+                continue
             if req.in_prefill and budget > 0:
                 need = len(req.prompt) - req.prefill_pos
                 if self.cache_quant == "int8" and need > budget:
@@ -1334,6 +1505,33 @@ class ServingEngine:
         # carrying prefill chunks run the [T]-token program (mq=T) — decide
         # first, allocate the one token buffer the program actually takes
         decode_only = all(not r.in_prefill for r, _, _ in sched)
+        # SPECULATIVE arming (ISSUE 19): pure-decode batches on a
+        # spec_k > 0 engine try n-gram drafting first; one verify
+        # forward then commits accepted+1 tokens per row.  int8 is
+        # excluded (speculative rewind would need scale rewind), and a
+        # launch with NO non-empty draft falls through — the megastep
+        # is strictly better when there is nothing to verify.
+        if (decode_only and self.spec_k > 0 and self.cache_quant != "int8"
+                and any(r.sampling.spec for r, _, _ in sched)):
+            spec_rows = [r for r, _, _ in sched]
+            drafts = self._draft(spec_rows)
+            if any(drafts.values()):
+                armed = True
+                if self._faults is not None:
+                    from .faults import prompt_signature
+                    try:
+                        self._faults.fire(
+                            SPEC_VERIFY,
+                            detail=" ".join(prompt_signature(r.prompt)
+                                            for r in spec_rows))
+                    except Exception:
+                        # degrade contract: a verify fault falls this
+                        # step back to the non-spec megastep/single-step
+                        # path — token-identical, never a wrong token
+                        armed = False
+                if armed:
+                    self.phase_seconds["schedule"] += self._clock() - t0
+                    return self._spec_step(spec_rows, drafts)
         if (decode_only and self.megastep_k > 1
                 and max(r.max_new_tokens - len(r.generated)
                         for r, _, _ in sched) > 1):
@@ -1345,7 +1543,7 @@ class ServingEngine:
         # (dynamic scales freeze at prefill, chunking would violate it);
         # bs > T cannot exact-pack a full chunk into the token buffer.
         if (self.megastep_k > 1 and self.cache_quant != "int8"
-                and self.bs <= self.T and not decode_only
+                and self.pc <= self.T and not decode_only
                 and any(not r.in_prefill for r, _, _ in sched)):
             dec_rows = [r for r, _, _ in sched if not r.in_prefill]
             pre_rows = []
@@ -1354,7 +1552,7 @@ class ServingEngine:
                 if r.in_prefill:
                     # worst-case packed tokens this row adds to any one
                     # iteration: its first chunk (chunks only shrink)
-                    cost = min(self.bs, len(r.prompt) - r.prefill_pos)
+                    cost = min(self.pc, len(r.prompt) - r.prefill_pos)
                     if cost <= budget_m:
                         pre_rows.append(r)
                         budget_m -= cost
@@ -1486,6 +1684,159 @@ class ServingEngine:
         x = execute_s / k
         self._tau = x if self._tau is None else 0.8 * self._tau + 0.2 * x
 
+    def _free_frozen(self, reqs: List[ServingRequest], dl: np.ndarray,
+                     k: int):
+        """ISSUE 19 satellite (the r16 remain): a row whose in-graph
+        deadline budget ran out inside this scan is FROZEN — it will
+        never emit again, but it used to park its slot and blocks until
+        the control plane's typed shed at some later boundary.  Free
+        them at harvest instead: the request stays in ``_active`` (slot
+        -1, never re-scheduled) so the DEADLINE_EXCEEDED shed still
+        happens at the control plane, while the queue head admits into
+        the freed slot THIS control step.  A launch budget ``dl <= k``
+        means the scan drove it to 0; ``_release`` is idempotent, so
+        the shed's ``evict`` re-release is safe."""
+        freed = False
+        for req in reqs:
+            if not req.done and req.slot >= 0 and dl[req.slot] <= k:
+                self._release(req)
+                freed = True
+        if freed:
+            self._try_admit()
+
+    def _draft(self, reqs: List[ServingRequest]) -> Dict[int, List[int]]:
+        """Host-side n-gram drafts for one spec launch, {rid: [tok, ..]}.
+        Per request: drafting reads ONLY its own ``prompt + generated``
+        history, and the length is capped at ``min(spec_k, remaining-1)``
+        so (a) speculative KV writes stay inside the allocated blocks
+        and (b) a full accept commits at most ``remaining`` tokens — no
+        budget overshoot to truncate.  A ``engine.spec_draft`` fault
+        degrades that ROW to an empty draft: it rides the verify and
+        commits exactly its one non-spec token."""
+        drafts: Dict[int, List[int]] = {}
+        for r in reqs:
+            d: List[int] = []
+            cap = min(self.spec_k, r.max_new_tokens - len(r.generated) - 1)
+            if r.sampling.spec and cap > 0:
+                try:
+                    if self._faults is not None:
+                        from .faults import prompt_signature
+                        self._faults.fire(SPEC_DRAFT,
+                                          detail=prompt_signature(r.prompt))
+                    d = ngram_draft(r.prompt + r.generated, cap)
+                except Exception:
+                    d = []   # degrade: this row rides undrafted
+            drafts[r.rid] = d
+        return drafts
+
+    def _spec_step(self, reqs: List[ServingRequest],
+                   drafts: Dict[int, List[int]]) -> Dict[int, List[int]]:
+        """ONE batched verify forward over ``[last_token] + draft`` per
+        row: the compiled program (``_build_spec_verify``) redraws every
+        position with the exact non-spec key stream and reports the
+        accepted draft-prefix length; the host commits the redraw
+        matrix's first ``accepted + 1`` columns (the redraw IS the
+        committed token at every accepted position — see the program's
+        docstring), truncating at EOS exactly like the non-spec harvest.
+        Counters: ``spec_verify_forwards`` counts ROWS scored (a
+        per-token forward-equivalent, so forwards ÷ committed tokens is
+        exactly 1.0 when nothing accepts and < 1.0 iff speculation
+        pays), ``spec_draft_tokens`` counts proposals,
+        ``spec_accepted_tokens`` counts committed draft tokens."""
+        t0 = self._clock()
+        B, sk = self.B, self.spec_k
+        Kp1 = sk + 1
+        tokens = np.zeros((B * Kp1,), np.int32)
+        dec = np.zeros((B,), np.int32)
+        now = np.zeros((B,), np.int32)
+        cu = np.zeros((B + 1,), np.int32)
+        dlen = np.zeros((B,), np.int32)
+        draft_a = np.zeros((B, sk), np.int32)
+        temps = np.zeros((B,), np.float32)
+        top_ks = np.zeros((B,), np.int32)
+        top_ps = np.ones((B,), np.float32)
+        seeds = np.zeros((B,), np.int32)
+        spos = np.zeros((B,), np.int32)
+        reqs = sorted(reqs, key=lambda r: r.slot)
+        by_slot = {r.slot: r for r in reqs}
+        pos = 0
+        for slot in range(B):
+            cu[slot + 1] = pos
+            req = by_slot.get(slot)
+            if req is None:
+                continue
+            d = drafts.get(req.rid, [])
+            row = [req.generated[-1] if req.generated else req.prompt[-1]]
+            row.extend(int(t) for t in d)
+            tokens[pos:pos + len(row)] = row
+            dec[slot] = req.context_len - 1
+            now[slot] = len(row)
+            dlen[slot] = len(d)
+            draft_a[slot, :len(d)] = d
+            self._fill_sampling(req, slot, temps, top_ks, top_ps, seeds,
+                                spos)
+            pos += len(row)
+            cu[slot + 1] = pos
+        t1 = self._clock()
+        self.phase_seconds["schedule"] += t1 - t0
+        if self._spec_fn is None:
+            if "spec" not in self._programs:
+                self._programs["spec"] = self._build_spec_verify()
+            self._spec_fn = self._programs["spec"]
+        had = (self._spec_fn._cache_size()
+               if hasattr(self._spec_fn, "_cache_size") else None)
+        kcs, vcs, nxt, lps, probs, acc = self._spec_fn(
+            self._weights, self.key_caches, self.value_caches, self._rope,
+            jnp.asarray(tokens), jnp.asarray(dec), jnp.asarray(now),
+            jnp.asarray(cu), jnp.asarray(self.block_tables),
+            jnp.asarray(dlen), jnp.asarray(draft_a), jnp.asarray(temps),
+            jnp.asarray(top_ks), jnp.asarray(top_ps), jnp.asarray(seeds),
+            jnp.asarray(spos))
+        self.key_caches, self.value_caches = kcs, vcs
+        if had is not None:
+            self.compile_count += self._spec_fn._cache_size() - had
+        nxt = np.asarray(nxt)       # [B, spec_k+1] redraws
+        lps = np.asarray(lps)
+        probs = np.asarray(probs) if probs is not None else None
+        acc = np.asarray(acc)       # [B] accepted draft-prefix lengths
+        t2 = self._clock()
+        self.phase_seconds["execute"] += t2 - t1
+
+        emitted: Dict[int, List[int]] = {}
+        for req in reqs:
+            s = req.slot
+            new = [int(t) for t in nxt[s, :int(acc[s]) + 1]]
+            if req.eos_token_id is not None and req.eos_token_id in new:
+                # the non-spec engine stops AT the EOS: accepted draft
+                # tokens past it were never going to be generated
+                new = new[:new.index(req.eos_token_id) + 1]
+            d = int(dlen[s])
+            req.generated.extend(new)
+            if req.sampling.logprobs:
+                row_lps = [float(v) for v in lps[s, :len(new)]]
+                req.logprob_values.extend(row_lps)
+                self._emitted_logprobs.setdefault(req.rid, []).extend(
+                    row_lps)
+            if probs is not None:
+                self._emitted_sample_probs.setdefault(req.rid, []).extend(
+                    probs[s, j].copy() for j in range(len(new)))
+            emitted[req.rid] = new
+            self.spec_verify_forwards += 1
+            self.spec_draft_tokens += d
+            self.spec_accepted_tokens += len(new) - 1
+            if self.trace_recorder is not None and req.trace is not None:
+                self.trace_recorder.record(
+                    req.trace["trace"], req.trace["span"],
+                    req.trace.get("parent"), "spec_verify",
+                    rid=req.trace.get("rid"), drafted=d,
+                    accepted=len(new) - 1, tokens=len(new))
+            hit_eos = (req.eos_token_id is not None
+                       and new[-1] == req.eos_token_id)
+            if hit_eos or len(req.generated) >= req.max_new_tokens:
+                self._retire(req)
+        self.phase_seconds["harvest"] += self._clock() - t2
+        return emitted
+
     def _megastep(self, reqs: List[ServingRequest]) -> Dict[int, List[int]]:
         """Run up to ``megastep_k`` decode iterations in one compiled
         scan over the scheduled (all-decoding) requests.  K rounds up to
@@ -1600,6 +1951,7 @@ class ServingEngine:
                        and new[-1] == req.eos_token_id)
             if hit_eos or len(req.generated) >= req.max_new_tokens:
                 self._retire(req)
+        self._free_frozen(reqs, dl, K)
         self.phase_seconds["harvest"] += self._clock() - t2
         return emitted
 
@@ -1631,7 +1983,7 @@ class ServingEngine:
                 self._faults.fire("engine.prefill_chunk",
                                   detail=prompt_signature(r.prompt))
         t0 = self._clock()
-        C = self.bs
+        C = self.pc
         K = self.megastep_k
         B = self.B
         toks = np.zeros((B,), np.int32)
@@ -1753,6 +2105,7 @@ class ServingEngine:
                        and new[-1] == req.eos_token_id)
             if hit_eos or len(req.generated) >= req.max_new_tokens:
                 self._retire(req)
+        self._free_frozen(reqs, dl, K)
         self.phase_seconds["harvest"] += self._clock() - t2
         return emitted
 

@@ -736,6 +736,132 @@ def run_bench_staggered(num_requests=None, megastep_k=8, mean_gap=None,
     }
 
 
+def run_bench_spec(num_requests=None, spec_k=8, seed=0):
+    """Speculative-decoding rung (ISSUE 19): a closed batch of REPETITIVE
+    prompts (the tiny greedy model falls into token cycles — the n-gram
+    drafter's showcase) served spec-on vs spec-off.  The gated ``value``
+    is verify forwards per spec-committed token,
+    ``spec_verify_forwards_total / (accepted_tokens_total +
+    spec_verify_forwards_total)`` — each verify launch scores one
+    forward-equivalent PER ROW and commits ``accepted + 1`` tokens, so
+    the ratio is exactly 1.0 when nothing accepts and < 1.0 iff
+    speculation pays.  Deterministic scheduling counters, no wall clock
+    (ROADMAP carried note (a)).  Token parity spec-on vs spec-off is
+    asserted in-bench for greedy AND seeded streams."""
+    import jax
+
+    import bench_ladder  # repo root is on sys.path (top of this file)
+    import paddle_tpu as P
+    from paddle_tpu.inference import ServingEngine, ServingFrontend
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    backend = jax.default_backend()
+    on_accel = backend in ("tpu", "axon")
+    if on_accel:
+        model_cfg = dict(vocab_size=32000, hidden_size=2560,
+                         intermediate_size=8192, num_hidden_layers=9,
+                         num_attention_heads=10,
+                         max_position_embeddings=2048, dtype="bfloat16")
+        engine_cfg = dict(max_batch_size=8, max_seq_len=448, block_size=64,
+                          token_budget=64, num_blocks=56)
+        max_new = 64
+        num_requests = num_requests or 16
+    else:
+        model_cfg = dict(vocab_size=512, hidden_size=128,
+                         intermediate_size=352, num_hidden_layers=2,
+                         num_attention_heads=4, max_position_embeddings=256)
+        engine_cfg = dict(max_batch_size=4, max_seq_len=128, block_size=8,
+                          token_budget=32, num_blocks=64)
+        max_new = 48
+        num_requests = num_requests or 8
+    # repetitive workload: short cyclic patterns repeated to a fixed
+    # prompt — deterministic (seeded pattern choice only), and long
+    # generations so the greedy stream has room to fall into cycles
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    patterns = [[1, 2, 3], [10, 20, 30], [100, 200], [5, 6, 7]]
+    prompts = []
+    for i in range(num_requests):
+        pat = patterns[int(rng.randint(len(patterns)))]
+        rep = (pat * 8)[:8]
+        prompts.append(rep)
+    P.seed(0)
+    model = LlamaForCausalLM(LlamaConfig(**model_cfg))
+    if on_accel:
+        model.bfloat16()
+    model.eval()
+
+    def serve(k, sampling=None):
+        eng = ServingEngine(model, megastep_k=4, spec_k=k, **engine_cfg)
+        fe = ServingFrontend(eng)
+        warm = fe.submit(prompts[0], max_new_tokens=max_new,
+                         **(sampling or {}))
+        fe.run()
+        assert fe.result(warm).ok
+        fe.metrics.reset()
+        t0 = time.monotonic()
+        rids = [fe.submit(p, max_new_tokens=max_new, **(sampling or {}))
+                for p in prompts]
+        fe.run()
+        wall = time.monotonic() - t0
+        res = fe.results()
+        snap = fe.metrics.snapshot()
+        c = snap["counters"]
+        return {
+            "tokens": [res[r].tokens for r in rids],
+            "emitted": c["tokens_emitted_total"],
+            "verify_forwards": c.get("spec_verify_forwards_total", 0),
+            "accepted": c.get("accepted_tokens_total", 0),
+            "drafted": c.get("spec_draft_tokens_total", 0),
+            "tokens_per_sec": round(snap["tokens_per_sec"], 1),
+            "wall_s": round(wall, 3),
+        }
+
+    off = serve(0)
+    on = serve(spec_k)
+    assert on["tokens"] == off["tokens"], \
+        "speculative decoding changed greedy outputs — parity violation"
+    seeded = dict(temperature=0.8, top_k=40, top_p=0.95, seed=7)
+    s_off = serve(0, sampling=seeded)
+    s_on = serve(spec_k, sampling=seeded)
+    assert s_on["tokens"] == s_off["tokens"], \
+        "speculative decoding changed SEEDED outputs — parity violation"
+    assert on["verify_forwards"] > 0, "spec never armed — no verify ran"
+    assert on["accepted"] > 0, \
+        "nothing accepted on the repetitive workload — the rung would " \
+        "read 1.0 and the drafter is dead weight"
+    value = on["verify_forwards"] / max(on["accepted"]
+                                        + on["verify_forwards"], 1)
+    return {
+        "metric": "serving_spec_forwards_per_token",
+        "value": round(value, 4),
+        "unit": "verify forwards/spec-committed token (lower=better)",
+        "extra": {
+            "host": bench_ladder.host_fingerprint(),
+            "backend": backend,
+            "spec_k": spec_k,
+            "num_requests": num_requests,
+            "max_new_tokens": max_new,
+            "verify_forwards": on["verify_forwards"],
+            "accepted_tokens": on["accepted"],
+            "draft_tokens": on["drafted"],
+            "emitted_on": on["emitted"], "emitted_off": off["emitted"],
+            "tokens_per_sec_on": on["tokens_per_sec"],
+            "tokens_per_sec_off": off["tokens_per_sec"],
+            "wall_s_on": on["wall_s"], "wall_s_off": off["wall_s"],
+            "outputs_token_identical": True,
+            "seeded_outputs_token_identical": True,
+            "method": "closed repetitive batch served spec-on vs "
+                      "spec-off; each verify launch counts ONE forward "
+                      "per scored row, value = verify forwards / "
+                      "(accepted + verify forwards) = forwards per "
+                      "spec-committed token (deterministic counters, "
+                      "wall-clock-free)",
+        },
+    }
+
+
 def run_bench_tenant_isolation(num_requests=None, seed=0):
     """Tenant-fairness rung (ISSUE 18): a BURSTY tenant dumps its whole
     backlog before the STEADY tenant's arrives, then both drain through
@@ -939,13 +1065,23 @@ def main(argv=None):
                          "spawn vs warm pool claim on one fleet; reports "
                          "warm/cold time-to-capacity ratio (< 1.0 or the "
                          "pool is overhead)")
+    ap.add_argument("--spec", action="store_true",
+                    help="speculative-decoding workload (ISSUE 19) — a "
+                         "closed repetitive batch served spec-on vs "
+                         "spec-off; reports verify forwards per "
+                         "spec-committed token (< 1.0 iff the n-gram "
+                         "drafter pays) + greedy/seeded parity")
+    ap.add_argument("--spec-k", type=int, default=8)
     ap.add_argument("--staggered-admission", action="store_true",
                     help="saturated megastep workload — open-loop Poisson "
                          "staggered admission in virtual engine-step time; "
                          "reports host round trips per token with the "
                          "mixed-phase megastep on + greedy/seeded parity")
     args = ap.parse_args(argv)
-    if args.tenant_isolation:
+    if args.spec:
+        line = run_bench_spec(num_requests=args.num_requests,
+                              spec_k=args.spec_k, seed=args.seed)
+    elif args.tenant_isolation:
         line = run_bench_tenant_isolation(num_requests=args.num_requests,
                                           seed=args.seed)
     elif args.warm_pool:

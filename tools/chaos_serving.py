@@ -410,6 +410,160 @@ def run_chaos(seed=0, replicas=3, num_requests=18, max_request_retries=2,
     }
 
 
+def _spec_request_stream(seed, num_requests):
+    """Seeded stream for the speculative-decoding soak: REPETITIVE
+    prompts (short cyclic patterns — the n-gram drafter's showcase) with
+    LONG generations so the greedy streams have room to fall into
+    cycles, plus a seeded-sampling minority (4th tuple element) so the
+    soak covers the sampled verify path too."""
+    import random
+
+    from paddle_tpu.inference import Priority
+
+    rng = random.Random(f"spec-reqs:{seed}")
+    patterns = [[1, 2, 3], [10, 20, 30], [9, 4], [5, 6, 7]]
+    reqs = []
+    for i in range(num_requests):
+        prompt = (rng.choice(patterns) * 8)[:8]
+        m = rng.randrange(24, 41)
+        prio = Priority.HIGH if i % 5 == 0 else Priority.NORMAL
+        if i % 4 == 3:
+            reqs.append((prompt, m, prio,
+                         dict(temperature=0.8, top_k=40, top_p=0.95,
+                              seed=100 + i)))
+        else:
+            reqs.append((prompt, m, prio))
+    return reqs
+
+
+def run_chaos_spec(seed=0, num_requests=12, max_steps=3000):
+    """Speculative-decoding chaos soak (ISSUE 19): two spec-armed
+    replicas serve the repetitive stream with BOTH spec failpoints
+    firing mid-run — ``engine.spec_draft`` (a drafter fault degrades
+    that row to an empty draft: it rides the verify and commits its one
+    non-spec token) and ``engine.spec_verify`` (a verify-launch fault
+    degrades the whole step to the megastep path).  The contract: a
+    spec fault NEVER yields a wrong token — every completed request is
+    token-identical to fault-free spec-OFF serving (greedy AND seeded)
+    — speculation genuinely ran (accepted tokens > 0, ``spec_verify``
+    span events recorded), and the soak is replay-equal: the same seed
+    is run TWICE and the trace digests must match bit-for-bit."""
+    from paddle_tpu.inference import (FaultInjector, RequestStatus,
+                                      ServingEngine, ServingFrontend)
+    from paddle_tpu.inference.tracing import (FlightRecorder, TraceContext,
+                                              Tracer, events_digest,
+                                              tree_complete)
+
+    model = _build_model()
+    reqs = _spec_request_stream(seed, num_requests)
+    ref_tokens = _reference_tokens(model, reqs, replicas=2)
+    spec_engine = {**ENGINE, "spec_k": 4}
+
+    def once():
+        step_i = 0
+
+        def tclock():
+            return float(step_i)
+
+        inj = FaultInjector({
+            "engine.spec_draft": {"kind": "error", "after": 2,
+                                  "times": 2},
+            "engine.spec_verify": {"kind": "error", "after": 1,
+                                   "times": 2},
+        }, seed=seed)
+        tracer = Tracer(clock=tclock, proc="frontend")
+        inj.recorder = tracer.recorder
+        fe = ServingFrontend(
+            [ServingEngine(model, fault_injector=inj,
+                           trace_recorder=FlightRecorder(clock=tclock,
+                                                         proc=f"r{i}"),
+                           clock=tclock, **spec_engine)
+             for i in range(2)],
+            tracer=tracer)
+        rids = []
+        submitted = 0
+        while (fe.pending or submitted < len(reqs)) and step_i < max_steps:
+            for _ in range(2):
+                if submitted < len(reqs):
+                    p, m, pr, *rest = reqs[submitted]
+                    rids.append(fe.submit(p, max_new_tokens=m,
+                                          priority=pr,
+                                          **(rest[0] if rest else {})))
+                    submitted += 1
+            fe.step()
+            step_i += 1
+        return fe, inj, tracer, rids, step_i
+
+    fe, inj, tracer, rids, steps = once()
+
+    # ---- degrade contract: faults never produce a wrong token
+    res = fe.results()
+    assert len(res) == len(rids) and not fe.pending, (
+        f"spec soak stalled: {fe.pending} request(s) never reached a "
+        f"terminal status in {max_steps} steps")
+    statuses = {}
+    mismatched = []
+    for i, rid in enumerate(rids):
+        r = res[rid]
+        statuses[r.status.value] = statuses.get(r.status.value, 0) + 1
+        assert r.status is RequestStatus.COMPLETED, (
+            f"rid {rid} ended {r.status} — a spec fault must degrade, "
+            "never fail the request")
+        if r.tokens != ref_tokens[i]:
+            mismatched.append(rid)
+    assert not mismatched, (
+        f"spec survivors diverged from fault-free spec-off serving: "
+        f"rids {mismatched}")
+    for site in ("engine.spec_draft", "engine.spec_verify"):
+        assert inj.fires(site) >= 1, f"failpoint {site} never fired"
+
+    # ---- speculation genuinely ran (a soak that silently degraded to
+    # the megastep path for every step must not count as coverage)
+    m = fe.metrics
+    accepted = m.counter("accepted_tokens_total")
+    verify_fwds = m.counter("spec_verify_forwards_total")
+    assert verify_fwds >= 1, "no verify launch ever ran"
+    assert accepted >= 1, "nothing accepted on the repetitive stream"
+    spec_events = [e for e in tracer.all_events()
+                   if e.get("event") == "spec_verify"]
+    assert spec_events, "no spec_verify span event was recorded"
+
+    # ---- span-tree completeness rides along
+    for rid in rids:
+        tree = tracer.tree_for(TraceContext.mint(rid).trace_id)
+        ok, why = tree_complete(tree)
+        assert ok, f"rid {rid} span tree incomplete: {why}"
+
+    # ---- replay equality: the whole soak again under the same seed —
+    # step-count clocks, seeded streams, and the deterministic drafter
+    # must reproduce the trace stream bit-for-bit
+    digest = events_digest(tracer.all_events())
+    fe2, _, tracer2, _, _ = once()
+    digest2 = events_digest(tracer2.all_events())
+    assert digest == digest2, (
+        "same-seed replay produced a different trace digest — the spec "
+        "path leaked nondeterminism")
+
+    return {
+        "mode": "spec",
+        "seed": seed,
+        "requests": len(rids),
+        "steps": steps,
+        "statuses": statuses,
+        "fault_kinds_fired": inj.kinds_fired(),
+        "spec_fires": {s: inj.fires(s) for s in
+                       ("engine.spec_draft", "engine.spec_verify")},
+        "accepted_tokens": accepted,
+        "draft_tokens": m.counter("spec_draft_tokens_total"),
+        "verify_forwards": verify_fwds,
+        "spec_verify_span_events": len(spec_events),
+        "survivors_token_identical": True,
+        "replay_digest_equal": True,
+        "trace_events": len(tracer.all_events()),
+        "trace_digest": digest,
+    }
+
+
 def _disagg_request_stream(seed, num_requests):
     """Seeded stream for the disaggregation soak: LONG prompts (the
     fabric only moves FULL blocks — the base stream's 2-5 token prompts
@@ -1725,6 +1879,15 @@ def main(argv=None):
                          "split over a fenced KV fabric with all three "
                          "fabric.* failpoints armed + a stale directory "
                          "lease + prefill-replica death")
+    ap.add_argument("--spec", action="store_true",
+                    help="speculative-decoding phase (ISSUE 19): a "
+                         "repetitive stream over spec-armed replicas "
+                         "with the engine.spec_draft and "
+                         "engine.spec_verify failpoints both firing; "
+                         "asserts degrade-never-wrong-token survivors "
+                         "(greedy AND seeded), live speculation "
+                         "(accepted > 0 + spec_verify span events), and "
+                         "same-seed replay-equal trace digests")
     ap.add_argument("--multitenant", action="store_true",
                     help="multi-tenant elastic-platform phase (ISSUE 18): "
                          "steady-vs-bursty tenants over three replicas, a "
@@ -1759,6 +1922,8 @@ def main(argv=None):
             args.requests = 16
         elif args.multitenant:
             args.requests = 18
+        elif args.spec:
+            args.requests = 12
         else:
             args.requests = 18
     if args.pause_after is None:
@@ -1787,6 +1952,9 @@ def main(argv=None):
     elif args.multitenant:
         report = run_chaos_multitenant(seed=args.seed,
                                        num_requests=args.requests)
+    elif args.spec:
+        report = run_chaos_spec(seed=args.seed,
+                                num_requests=args.requests)
     elif args.kill_frontend:
         report = run_kill_frontend(seed=args.seed,
                                    num_requests=args.requests,

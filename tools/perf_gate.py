@@ -26,9 +26,12 @@ Four checks; the first two run against the PREVIOUS round's recordings:
 4. Absolute (r18, ISSUE 18): bounds declared in ``ABS_RUNG_BOUNDS`` on
    single rungs of the LATEST round — the tenant-isolation served share
    must stay in [0.40, 0.60] (0.5 is fair; drift in either direction is
-   a fairness bug the one-sided delta check cannot catch), and the
+   a fairness bug the one-sided delta check cannot catch), the
    warm-pool attach ratio must stay below 1.0 (a warm attach slower
-   than a cold spawn means the pool is pure overhead).
+   than a cold spawn means the pool is pure overhead), and the
+   speculative-decoding forwards-per-token ratio (r19, ISSUE 19) must
+   stay below 1.0 (at 1.0 no draft token was ever accepted and every
+   verify launch was wasted work).
 
 Run with no arguments from the repo root.
 """
@@ -251,6 +254,12 @@ CROSS_RUNG_BOUNDS = (
 ABS_RUNG_BOUNDS = (
     ("serving_tenant_isolation_served_share", 0.40, 0.60),
     ("serving_warm_pool_attach_ratio", None, 1.0),
+    # spec rung (ISSUE 19): forwards per spec-committed token is exactly
+    # 1.0 when no draft token is ever accepted — a rung at or above 1.0
+    # means every verify launch was pure overhead on a workload built to
+    # accept, which the round-over-round delta check alone cannot catch
+    # on the first round the rung appears
+    ("serving_spec_forwards_per_token", None, 1.0),
 )
 
 

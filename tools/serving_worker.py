@@ -42,6 +42,14 @@ Spec JSON (everything the worker needs to be a bit-identical replica):
      "bfloat16": false,
      "role": "prefill"}    # optional disaggregation label (or "decode")
 
+Every ``ServingEngine`` kwarg rides ``"engine"`` verbatim — including
+the speculative-decoding tier (ISSUE 19): ``{"engine": {"spec_k": 4}}``
+arms n-gram draft + multi-token verify on this replica, and
+``"prefill_chunk_tokens"`` sets the mixed-phase chunk size (ISSUE 16/19).
+Spec-on workers stay token-identical to spec-off ones, so a fleet may
+mix them freely; the worker's ``spec_*`` counters fold through
+``_w_step`` deltas like the megastep counters.
+
 Run standalone (an operator adding capacity from another host):
 
     python tools/serving_worker.py --master 10.0.0.1:8765 \

@@ -2043,8 +2043,11 @@ class ServingFrontend:
                 if src is None:
                     raise ConnectionError(
                         f"directory owner {owner!r} is not a live replica")
-                n, b = self.fabric.pull(src.engine, target.engine, hs,
-                                        owner=owner)
+                n, b, transport = self.fabric.pull(
+                    src.engine, target.engine, hs, owner=owner,
+                    epoch=self.epoch)
+                self._note_transport(req, transport, n, b,
+                                     self._replica_name(target))
                 pulled += n
                 nbytes += b
             except StaleEpoch:
@@ -2060,6 +2063,21 @@ class ServingFrontend:
             self.tracer.event(req.trace, "block_transfer", blocks=pulled,
                               bytes=nbytes, dst=self._replica_name(target))
         return pulled > 0
+
+    def _note_transport(self, req: _FrontendRequest, transport: str,
+                        blocks: int, nbytes: int, dst: str):
+        """Per-transfer transport accounting (ISSUE 20): count the
+        transport rung the fabric ladder landed on, and record a
+        ``block_wire`` span event whose bytes/hops fold into the
+        replay-equality digest — relayed payloads cross the wire twice
+        (prefill→frontend→decode), direct ones once."""
+        hops = 1 if transport == "wire" else 2
+        self.metrics.inc("fabric_wire_pulls_total" if transport == "wire"
+                         else "fabric_relay_pulls_total")
+        if self.tracer is not None and req.trace is not None:
+            self.tracer.event(req.trace, "block_wire", blocks=int(blocks),
+                              bytes=int(nbytes), hops=hops,
+                              transport=transport, dst=dst)
 
     def _prefix_affinity(self, rep: _Replica, req: _FrontendRequest,
                          hash_cache: Dict[int, List[str]]) -> int:
@@ -2344,8 +2362,11 @@ class ServingFrontend:
                                         "cached_block_hashes", None)
                     cached = cached_fn() if cached_fn is not None else set()
                     missing = [h for h in hashes if h not in cached]
-                    n, nbytes = self.fabric.pull(rep.engine, target.engine,
-                                                 missing, owner=name)
+                    n, nbytes, transport = self.fabric.pull(
+                        rep.engine, target.engine, missing, owner=name,
+                        epoch=self.epoch)
+                    self._note_transport(req, transport, n, nbytes,
+                                         self._replica_name(target))
                     if self.tracer is not None and req.trace is not None:
                         self.tracer.event(req.trace, "block_transfer",
                                           blocks=n, bytes=nbytes, src=name,

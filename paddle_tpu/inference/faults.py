@@ -91,6 +91,11 @@ KNOWN_SITES = {
     "fabric.publish",     # prefill worker dies before its chain lands
     "fabric.pull",        # decode pulls blocks from a dead peer
     "fabric.directory",   # directory reads, incl. stale-lease rejection
+    # binary KV data plane (ISSUE 20) — canonical registration lives
+    # next to the firing code in inference/blockwire.py: the listener
+    # faults a pull mid-handshake (typed error frame back; the puller
+    # degrades to the frontend relay, then recompute)
+    "fabric.wire",        # data-plane pull request on the serving side
     # multi-tenant elastic platform (ISSUE 18) — canonical registrations
     # live next to the firing code (serving.load_weights, fleet.WarmPool);
     # listed here too so env-armed injectors validate everywhere

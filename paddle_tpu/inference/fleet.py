@@ -101,7 +101,7 @@ import threading
 import time
 from collections import OrderedDict
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from .control_plane import ServingFrontend
 from .faults import FaultInjector, RespawnCircuitBreaker, register_failpoint
@@ -168,6 +168,19 @@ def worker_roles(master_endpoint: str) -> Dict[str, str]:
     from ..distributed.launch.master import KVClient
 
     entries = KVClient(master_endpoint).get_prefix("/serving/roles/")
+    return {k.rsplit("/", 1)[-1]: v for k, v in entries.items()}
+
+
+def worker_wires(master_endpoint: str) -> Dict[str, str]:
+    """Data-plane listener endpoints registered alongside the workers
+    (``/serving/wire/<name>``, written by tools/serving_worker.py right
+    next to its role label; ISSUE 20).  Like the role label, the
+    endpoint ALSO rides every health reply
+    (``RemoteReplica.wire_endpoint``) — this registry view is for
+    operator tooling and KV-side audits."""
+    from ..distributed.launch.master import KVClient
+
+    entries = KVClient(master_endpoint).get_prefix("/serving/wire/")
     return {k.rsplit("/", 1)[-1]: v for k, v in entries.items()}
 
 
@@ -505,6 +518,26 @@ def _w_import_blocks(payload, epoch=None):
     return n, eng.state_summary()
 
 
+def _w_pull_blocks(peer_endpoint, hashes, epoch=None):
+    """Direct-wire transfer (ISSUE 20): THIS worker (the decode side)
+    pulls a packed chain segment straight off ``peer_endpoint`` — the
+    prefill worker's blockwire data-plane listener — and imports it.
+    The frontend orchestrates with this directory-sized control RPC
+    only; payload bytes take one hop instead of riding the pickle
+    control channel through the frontend twice.  Fenced on BOTH ends:
+    this RPC here, and the peer's listener fences the same epoch in
+    the wire handshake before any payload bytes move.  Raises what the
+    wire raised (typed WireError / StaleEpoch) — the frontend's fabric
+    ladder owns the relay/recompute fallback."""
+    _fence(epoch, "pull_blocks")
+    eng = _engine()
+    n, nbytes = eng.pull_blocks(str(peer_endpoint), list(hashes),
+                                epoch=epoch)
+    _WORKER["metrics"].inc("fabric_blocks_imported_total", n)
+    _WORKER["metrics"].inc("fabric_wire_pulls_total")
+    return n, int(nbytes), eng.state_summary()
+
+
 def _w_health(include_samples: bool = False):
     """The one shared probe: heartbeat liveness, autoscaler load signals,
     and metrics aggregation all read this."""
@@ -524,6 +557,10 @@ def _w_health(include_samples: bool = False):
         "name": _WORKER["name"],
         "epoch": _WORKER["fence"].highest,   # highest epoch ever seen
         "role": _WORKER.get("role"),         # disaggregation label
+        # data-plane listener endpoint (ISSUE 20): rides the probe like
+        # the role label so RemoteReplica/connect_workers rebuild
+        # wire-capable fleets on takeover without a KV read
+        "wire": getattr(eng, "wire_endpoint", None),
     }
 
 
@@ -649,6 +686,9 @@ class RemoteReplica:
         # disaggregation role label (init_worker): rides every health
         # reply so a takeover frontend rebuilds a role-correct fleet
         self.role = h.get("role")
+        # data-plane listener endpoint (ISSUE 20): the fabric ladder
+        # reads this off the SOURCE replica to decide the wire rung
+        self.wire_endpoint = h.get("wire")
         self.B = int(cfg["max_batch_size"])
         self.T = int(cfg["token_budget"])
         self.bs = int(cfg["block_size"])
@@ -826,6 +866,22 @@ class RemoteReplica:
         n, st = self._call(_w_import_blocks, payload, epoch=self._epoch)
         self._apply_state(st)
         return int(n)
+
+    def pull_blocks(self, peer_endpoint: str, hashes,
+                    epoch: Optional[int] = None) -> Tuple[int, int]:
+        """Make the worker pull a chain segment DIRECTLY off a peer's
+        data-plane listener (``_w_pull_blocks``, ISSUE 20): the payload
+        never touches this frontend — only this directory-sized control
+        RPC does.  The worker's stamped epoch rides both the RPC and
+        the wire handshake; the ``epoch`` parameter exists for engine-
+        surface compatibility and is superseded by the stamp.  Returns
+        ``(blocks_imported, payload_bytes)``."""
+        n, nbytes, st = self._call(_w_pull_blocks, str(peer_endpoint),
+                                   list(hashes),
+                                   epoch=self._epoch if self._epoch
+                                   is not None else epoch)
+        self._apply_state(st)
+        return int(n), int(nbytes)
 
     def load_weights(self, spec: Dict, version: Optional[str] = None,
                      model_id: Optional[str] = None) -> str:
@@ -1746,6 +1802,7 @@ class ServingFleet:
         # a dead worker in everyone's routing table on the next refresh
         self._kv.delete(f"/rpc/workers/{name}")
         self._kv.delete(f"/serving/roles/{name}")  # role label rides along
+        self._kv.delete(f"/serving/wire/{name}")   # data-plane endpoint too
         proc = self._procs.pop(name, None)
         if proc is None:
             return

@@ -39,11 +39,43 @@ raises); callers MUST be able to fall back to recomputing the prefix —
 read-repair are out of scope; losing the whole directory costs
 recompute time, never correctness.
 
+Transport (ISSUE 20)
+--------------------
+:meth:`KVFabric.pull` is a degrade ladder; every rung preserves the
+greedy+seeded token-parity contract because imported blocks are
+bit-exact wherever (and however) they land:
+
+1. **Direct wire** — when the source exposes a ``wire_endpoint`` (a
+   ``blockwire.BlockWireServer`` data-plane listener) and the
+   destination has ``pull_blocks``, the DESTINATION pulls the chain
+   straight off the source over a persistent binary socket: one
+   length+CRC32-framed message carrying one contiguous packed buffer
+   (self-describing geometry header + raw cache bytes, no pickle).
+   Payload bytes cross the wire ONCE; the frontend only orchestrates
+   with directory-sized control messages (``_w_pull_blocks``).
+2. **Frontend relay** — the r17 path and the compatibility fallback:
+   ``src.export_blocks`` → ``dst.import_blocks`` dict payloads over
+   the pickle control channel, relayed through the frontend (payload
+   crosses the wire twice).  Entered when there is no wire endpoint or
+   when the wire rung fails (``wire_fallbacks_total``).
+3. **Recompute** — both transports failed; ``pull`` raises and the
+   CALLER recomputes the prefix (``ServingFrontend`` does).
+
+What is and is NOT fenced on the wire: the pull *handshake* carries
+the caller's epoch and the serving side checks it against the same
+``EpochFence`` its control RPCs use — a stale puller gets a typed
+``StaleEpoch`` error frame before any payload bytes move (and
+``StaleEpoch`` never falls back to relay: the caller is deposed, not
+unlucky).  The payload bytes themselves are NOT fenced mid-flight;
+that is safe because blocks are content-addressed (equal hash ⇒ equal
+bits) and re-publication into the directory re-checks the fence.
+
 Failpoint sites (chaos-schedulable, see faults.py / tools/chaos_serving.py):
 ``fabric.publish`` (prefill worker dies mid-stream, before its chain
 reaches the directory), ``fabric.pull`` (decode pulls from a dead
 peer), ``fabric.directory`` (directory reads, incl. the
-stale-entry rejection path).
+stale-entry rejection path), ``fabric.wire`` (the data-plane listener
+faults mid-handshake; registered in blockwire.py, degrades to relay).
 """
 from __future__ import annotations
 
@@ -143,9 +175,17 @@ class KVFabric:
             "stale_entries_total": 0,  # entries rejected via StaleEpoch
             "pulls_total": 0,          # transfer hops attempted
             "pulled_blocks_total": 0,  # blocks imported on the dst side
-            "pulled_bytes_total": 0,
+            "pulled_bytes_total": 0,   # raw KV bytes moved (any transport)
             "prefill_claims_total": 0,
             "prefill_dedup_hits_total": 0,  # claim found held by a peer
+            # transport ladder (ISSUE 20): wire bytes cross once,
+            # relayed bytes cross twice — payload_hop_bytes ratio =
+            # (wire*1 + relay*2) / pulled_bytes_total
+            "wire_pulls_total": 0,     # pulls served by the direct rung
+            "wire_bytes_total": 0,     # raw bytes over the data plane
+            "wire_fallbacks_total": 0,  # wire rung failed → relay rung
+            "relay_pulls_total": 0,    # pulls served by the relay rung
+            "relay_bytes_total": 0,    # raw bytes relayed via frontend
         }
 
     # ------------------------------------------------------------------
@@ -330,19 +370,45 @@ class KVFabric:
     # ------------------------------------------------------------------
     # transfer hop
 
-    def pull(self, src, dst, hashes: Sequence[str], *,
-             owner: str = "") -> Tuple[int, int]:
-        """Move blocks ``src`` → ``dst`` (anything with
-        ``export_blocks``/``import_blocks``: a local ``ServingEngine``
-        or a ``RemoteReplica``).  Returns ``(blocks_imported,
-        payload_bytes)``.  Raises whatever the dead/faulted peer raises —
-        the caller owns the recompute fallback."""
+    def pull(self, src, dst, hashes: Sequence[str], *, owner: str = "",
+             epoch: Optional[int] = None) -> Tuple[int, int, str]:
+        """Move blocks ``src`` → ``dst`` down the transport degrade
+        ladder (module docstring): direct wire when the source exposes
+        a ``wire_endpoint`` and the destination can ``pull_blocks``,
+        else (or on a wire fault) the frontend-relay
+        ``export_blocks``/``import_blocks`` dict path.  Returns
+        ``(blocks_imported, payload_bytes, transport)`` with transport
+        ``"wire"`` or ``"relay"``.  ``StaleEpoch`` from the wire
+        handshake propagates — a deposed caller must not retry via
+        relay.  Any other failure of the LAST rung raises too: the
+        caller owns the recompute fallback."""
         if self._faults is not None:
             self._faults.fire(FABRIC_PULL, detail=owner)
         self.counters["pulls_total"] += 1
-        payload = src.export_blocks(list(hashes))
+        hashes = list(hashes)
+        if epoch is None:
+            epoch = self.fence.highest
+        endpoint = getattr(src, "wire_endpoint", None)
+        if endpoint and hasattr(dst, "pull_blocks"):
+            try:
+                imported, nbytes = dst.pull_blocks(endpoint, hashes,
+                                                   epoch=epoch)
+            except StaleEpoch:
+                raise
+            except Exception:  # noqa: BLE001 — torn frame, dead listener,
+                # injected fabric.wire: degrade to the relay rung below
+                self.counters["wire_fallbacks_total"] += 1
+            else:
+                self.counters["wire_pulls_total"] += 1
+                self.counters["wire_bytes_total"] += int(nbytes)
+                self.counters["pulled_blocks_total"] += int(imported)
+                self.counters["pulled_bytes_total"] += int(nbytes)
+                return int(imported), int(nbytes), "wire"
+        payload = src.export_blocks(hashes)
         nbytes = payload_nbytes(payload)
         imported = dst.import_blocks(payload)
+        self.counters["relay_pulls_total"] += 1
+        self.counters["relay_bytes_total"] += nbytes
         self.counters["pulled_blocks_total"] += int(imported)
         self.counters["pulled_bytes_total"] += nbytes
-        return int(imported), nbytes
+        return int(imported), nbytes, "relay"

@@ -84,6 +84,12 @@ COUNTERS = (
     "fabric_prefill_passes_total", "fabric_dedup_waits_total",
     "fabric_pull_failures_total", "fabric_recomputes_total",
     "fabric_blocks_imported_total",
+    # binary KV data plane (ISSUE 20): which rung of KVFabric.pull's
+    # transport ladder each transfer landed on — wire = one payload hop
+    # straight between workers, relay = the r17 two-hop control-channel
+    # fallback.  Frontend-side per pull; _w_pull_blocks also counts
+    # fabric_wire_pulls_total in the pulling worker's own registry
+    "fabric_wire_pulls_total", "fabric_relay_pulls_total",
     # multi-tenant elastic platform (ISSUE 18): rolling weight swaps
     # (attempted/failed), fabric pull-target re-plans after a decode
     # replica death, warm-pool lifecycle (attach/refill/attach-failure),

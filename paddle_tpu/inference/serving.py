@@ -87,7 +87,7 @@ import time
 from collections import Counter, OrderedDict
 from dataclasses import dataclass, field
 from functools import partial
-from typing import Callable, Dict, List, Optional, Sequence, Set
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
@@ -505,6 +505,18 @@ class ServingRequest:
 _PROGRAM_CACHE: Dict[tuple, dict] = {}
 
 
+def _np_dtype(name: str) -> np.dtype:
+    """Numpy dtype for a cache dtype's string form.  ``bfloat16`` (and
+    friends) only resolve once ml_dtypes' registrations are imported —
+    jax depends on it, so the lazy import never fails in practice."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
 class ServingEngine:
     """Continuous batching for a LlamaForCausalLM (single process).
 
@@ -512,6 +524,12 @@ class ServingEngine:
     >>> rid = eng.add_request([1, 5, 7], max_new_tokens=16)
     >>> outputs = eng.run()   # {rid: [token, ...]}
     """
+
+    # data-plane listener endpoint ("host:port"), stamped by
+    # blockwire.BlockWireServer when this engine serves direct
+    # worker-to-worker block pulls; None = relay-only (KVFabric.pull's
+    # degrade ladder skips the wire rung)
+    wire_endpoint: Optional[str] = None
 
     def __init__(self, model, max_batch_size: int = 4, max_seq_len: int = 256,
                  block_size: int = 16, token_budget: int = 32,
@@ -2176,23 +2194,65 @@ class ServingEngine:
                 "dequantize garbage. Disaggregated transfer requires the "
                 "unquantized cache")
 
-    def export_blocks(self, hashes: Sequence[str]) -> Dict:
+    def export_blocks_packed(self, hashes: Sequence[str]) -> Tuple[Dict,
+                                                                   bytes]:
         """Bit-exact KV payload for a chain of published block hashes
-        (parent-first order).  Stops at the first hash this pool no
-        longer holds — a chain is only usable up to its first gap, so
-        exporting past one would ship unmatchable blocks.  The payload
-        is host numpy (device→host copy), self-describing enough for
-        ``import_blocks`` to reject geometry mismatches loudly."""
-        self._check_transferable("export_blocks")
-        blocks: Dict[str, Dict[str, list]] = {}
+        (parent-first order) as ONE contiguous packed buffer — the
+        binary data-plane form (inference/blockwire.py, ISSUE 20).
+        Stops at the first hash this pool no longer holds — a chain is
+        only usable up to its first gap, so exporting past one would
+        ship unmatchable blocks.  Returns ``(header, raw)``: a
+        self-describing geometry header (``shape`` = ``[2, layers,
+        nblocks, kv_heads, block_size, head_dim]``, K/V stacked over
+        the engine's native per-block cache slice) plus the raw bytes
+        of one batched device→host gather — a single jitted stacked
+        gather + ONE ``np.asarray`` for the whole chain, not
+        ``2 × layers × nblocks`` individual copies."""
+        self._check_transferable("export_blocks_packed")
+        held: List[str] = []
+        ids: List[int] = []
         for h in hashes:
             b = self.blocks.lookup(h)
             if b is None:
                 break
-            blocks[h] = {"k": [np.asarray(kc[b]) for kc in self.key_caches],
-                         "v": [np.asarray(vc[b]) for vc in self.value_caches]}
+            held.append(h)
+            ids.append(int(b))
+        header = {"block_size": self.bs, "layers": self.L,
+                  "kv_heads": self.KV, "head_dim": self.D,
+                  "dtype": str(self.key_caches[0].dtype), "hashes": held,
+                  "shape": [2, self.L, len(held), self.KV, self.bs, self.D]}
+        if not held:
+            return header, b""
+        if "gather" not in self._programs:
+            def gather(kcs, vcs, bids):
+                k = jnp.stack([kc[bids] for kc in kcs])
+                v = jnp.stack([vc[bids] for vc in vcs])
+                return jnp.stack([k, v])   # [2, L, n, KV, bs, D]
+            self._programs["gather"] = jax.jit(gather)
+        packed = self._programs["gather"](self.key_caches,
+                                          self.value_caches,
+                                          jnp.asarray(ids, jnp.int32))
+        return header, np.asarray(packed).tobytes()
+
+    def export_blocks(self, hashes: Sequence[str]) -> Dict:
+        """Bit-exact KV payload for a chain of published block hashes
+        (parent-first order) in the dict form — the compatibility /
+        frontend-relay fallback; ``export_blocks_packed`` is the data
+        plane.  Both run the same single batched device→host gather
+        (the per-block-per-layer ``np.asarray`` loop this replaced cost
+        ``2 × layers × nblocks`` host round trips); the dict's arrays
+        are host-side views into that one buffer."""
+        header, raw = self.export_blocks_packed(hashes)
+        blocks: Dict[str, Dict[str, list]] = {}
+        held = header["hashes"]
+        if held:
+            arr = np.frombuffer(raw, dtype=_np_dtype(header["dtype"]))
+            arr = arr.reshape(header["shape"])
+            for i, h in enumerate(held):
+                blocks[h] = {"k": [arr[0, li, i] for li in range(self.L)],
+                             "v": [arr[1, li, i] for li in range(self.L)]}
         return {"block_size": self.bs, "layers": self.L, "kv_heads": self.KV,
-                "head_dim": self.D, "dtype": str(self.key_caches[0].dtype),
+                "head_dim": self.D, "dtype": header["dtype"],
                 "blocks": blocks}
 
     def import_blocks(self, payload: Dict) -> int:
@@ -2228,6 +2288,73 @@ class ServingEngine:
             self.blocks.free([b])   # park published: reusable, evictable
             imported += 1
         return imported
+
+    def import_blocks_packed(self, header: Dict, raw: bytes) -> int:
+        """Install an ``export_blocks_packed`` chain segment: validate
+        the self-describing geometry header AND that the raw byte count
+        matches what the geometry implies BEFORE touching the cache — a
+        torn/truncated buffer is a typed ValueError, never a wrong or
+        half-imported block — then allocate/write/publish/free exactly
+        like :meth:`import_blocks`.  Returns the imported count."""
+        self._check_transferable("import_blocks_packed")
+        geom = (header.get("block_size"), header.get("layers"),
+                header.get("kv_heads"), header.get("head_dim"),
+                header.get("dtype"))
+        want = (self.bs, self.L, self.KV, self.D,
+                str(self.key_caches[0].dtype))
+        if geom != want:
+            raise ValueError(
+                f"import_blocks_packed: payload geometry {geom} does not "
+                f"match this engine's cache geometry {want} (block_size, "
+                "layers, kv_heads, head_dim, dtype) — transfers require "
+                "identical cache layouts")
+        hashes = [str(h) for h in header.get("hashes") or ()]
+        shape = [2, self.L, len(hashes), self.KV, self.bs, self.D]
+        if list(header.get("shape") or ()) != shape:
+            raise ValueError(
+                f"import_blocks_packed: header shape "
+                f"{header.get('shape')} does not match the geometry-"
+                f"implied {shape}")
+        dt = _np_dtype(str(header["dtype"]))
+        expect = 1
+        for dim in shape:
+            expect *= int(dim)
+        expect *= dt.itemsize
+        if len(raw) != expect:
+            raise ValueError(
+                f"import_blocks_packed: payload is {len(raw)} bytes but "
+                f"the geometry implies {expect} — truncated or padded "
+                "buffer rejected whole")
+        arr = np.frombuffer(raw, dtype=dt).reshape(shape)
+        imported = 0
+        for i, h in enumerate(hashes):
+            if self.blocks.lookup(h) is not None:
+                continue
+            if not self.blocks.can_allocate(1):
+                break
+            (b,) = self.blocks.allocate(1)
+            self._write_block(b, [arr[0, li, i] for li in range(self.L)],
+                              [arr[1, li, i] for li in range(self.L)])
+            self.blocks.publish(b, h)
+            self.blocks.free([b])
+            imported += 1
+        return imported
+
+    def pull_blocks(self, peer_endpoint: str, hashes: Sequence[str], *,
+                    epoch: Optional[int] = None,
+                    timeout: float = 60.0) -> Tuple[int, int]:
+        """Pull a chain segment DIRECTLY off a peer's data-plane
+        listener (inference/blockwire.py) and import it — the
+        destination side of the one-hop transfer; the frontend only
+        ever orchestrates this with directory-sized control messages.
+        Returns ``(blocks_imported, payload_bytes)``.  Raises
+        ``StaleEpoch`` when the peer fenced the handshake, ``WireError``
+        for transport faults — callers degrade to the frontend relay."""
+        from .blockwire import default_pool
+
+        header, raw = default_pool().pull(peer_endpoint, list(hashes),
+                                          epoch=epoch, timeout=timeout)
+        return self.import_blocks_packed(header, raw), len(raw)
 
     def _write_block(self, dst: int, ks: Sequence[np.ndarray],
                      vs: Sequence[np.ndarray]):

@@ -111,6 +111,27 @@ class TestKillFrontend:
         assert report["journal_fault_degrades_not_crashes"]
 
 
+class TestChaosDisagg:
+    def test_disagg_soak_wire_fault_replay_equal(self):
+        """The ``--disagg`` soak (ISSUE 17 + the ISSUE 20 data plane):
+        the harness itself asserts termination, token parity with
+        colocated serving, complete span trees, and that every
+        ``fabric.*`` failpoint — including the armed ``fabric.wire``
+        handshake error against the REAL blockwire listener — fired and
+        degraded down the transport ladder.  Pin the headline numbers
+        and the replay contract: same seed, same trace digest."""
+        import chaos_serving
+
+        a = chaos_serving.run_chaos_disagg(seed=0)
+        assert a["statuses"] == {"completed": 16}
+        assert a["wire_pulls"] >= 1 and a["wire_fallbacks"] >= 1
+        assert a["fabric_fires"]["fabric.wire"] == 1
+        assert a["recomputes"] >= 1
+        assert a["survivors_token_identical"]
+        b = chaos_serving.run_chaos_disagg(seed=0)
+        assert a["trace_digest"] == b["trace_digest"]
+
+
 class TestChaosFleet:
     def test_fleet_chaos_with_real_workers(self):
         """Fleet-level variant: real worker processes, failpoints armed

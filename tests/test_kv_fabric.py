@@ -257,9 +257,15 @@ class TestFleetRoles:
         role-correct fleet (the StandbyFrontend takeover path), and
         export/import over the fenced ``_w_export_blocks`` /
         ``_w_import_blocks`` RPCs is bit-exact across real worker
-        processes."""
+        processes.  With ``"wire": true`` in the spec (ISSUE 20) each
+        worker also opens a blockwire listener whose endpoint rides the
+        launch-KV registration (``fleet.worker_wires``) and every
+        health reply, and the decode worker pulls the chain DIRECTLY
+        off the prefill worker over the fenced ``_w_pull_blocks`` RPC —
+        one payload hop, no frontend relay."""
         from paddle_tpu.inference import ServingFleet
-        from paddle_tpu.inference.fleet import connect_workers, worker_roles
+        from paddle_tpu.inference.fleet import (connect_workers,
+                                                worker_roles, worker_wires)
 
         model_cfg = dict(vocab_size=256, hidden_size=64,
                          intermediate_size=160, num_hidden_layers=1,
@@ -267,7 +273,8 @@ class TestFleetRoles:
                          max_position_embeddings=256)
         engine_cfg = dict(max_batch_size=2, max_seq_len=64, block_size=8,
                           token_budget=16)
-        spec = {"seed": 11, "model": model_cfg, "engine": engine_cfg}
+        spec = {"seed": 11, "model": model_cfg, "engine": engine_cfg,
+                "wire": True}
         prompt = list(range(2, 26))            # 3 full blocks at bs=8
         with ServingFleet(spec, num_workers=2,
                           worker_roles=["prefill", "decode"],
@@ -291,7 +298,22 @@ class TestFleetRoles:
             hashes = prompt_block_hashes(prompt, engine_cfg["block_size"])
             payload = pre.export_blocks(hashes)
             assert set(payload["blocks"]) == set(hashes)
-            assert dec.import_blocks(payload) == len(hashes)
+
+            # direct data plane (ISSUE 20): both workers registered a
+            # wire endpoint, and the decode worker pulls the chain
+            # straight off the prefill worker's listener — the frontend
+            # never touches the payload
+            wires = worker_wires(ep)
+            assert set(wires) == {"worker0", "worker1"}
+            assert pre.wire_endpoint == wires["worker0"]
+            assert dec.wire_endpoint == wires["worker1"]
+            n, nbytes = dec.pull_blocks(wires["worker0"], hashes)
+            assert n == len(hashes) and nbytes > 0
+
+            # the relay RPC still works and skips the pulled chain
+            # (first publisher wins), and the wire-imported blocks
+            # re-export bit-identically to the relay payload
+            assert dec.import_blocks(payload) == 0
             back = dec.export_blocks(hashes)
             for h in hashes:
                 for k1, k2 in zip(payload["blocks"][h]["k"],

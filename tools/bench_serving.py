@@ -472,6 +472,192 @@ def run_bench_disagg(num_groups=None, group_size=None, seed=0):
     }
 
 
+def run_bench_disagg_wire(num_groups=None, group_size=None, seed=0,
+                          transport="wire"):
+    """Transport A/B for the disaggregated workload (ISSUE 20): the SAME
+    prefill+2-decode fabric stream served over the frontend relay (dict
+    export/import, every payload byte crosses the frontend twice) vs the
+    binary data plane (a blockwire listener on the prefill replica, the
+    decode replica pulls the packed buffer directly — one hop).  The
+    gated ``value`` is payload hop-bytes per pulled byte:
+
+        (wire_bytes * 1 + relay_bytes * 2) / pulled_bytes
+
+    exactly 1.0 when every block rides the wire, exactly 2.0 when
+    everything relays — a deterministic byte-counter ratio, no wall
+    clock.  In-bench asserts: greedy outputs token-identical across
+    transports, the decode-side imported blocks BYTE-identical across
+    transports (packed re-export compared raw), and on the direct path
+    the frontend relayed ZERO payload bytes (the counter the second
+    rung records).  Returns BOTH rungs:
+    ``serving_disagg_payload_hop_bytes`` (measured on ``transport``)
+    and ``serving_disagg_frontend_relay_bytes`` (always the direct
+    path's relayed bytes — 0)."""
+    import jax
+    import numpy as np
+
+    import bench_ladder  # repo root is on sys.path (top of this file)
+    import paddle_tpu as P
+    from paddle_tpu.inference import ServingEngine, ServingFrontend
+    from paddle_tpu.inference.blockwire import BlockWireServer
+    from paddle_tpu.inference.kv_fabric import KVFabric, MemoryKV
+    from paddle_tpu.inference.serving import prompt_block_hashes
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    backend = jax.default_backend()
+    on_accel = backend in ("tpu", "axon")
+    if on_accel:
+        model_cfg = dict(vocab_size=32000, hidden_size=2560,
+                         intermediate_size=8192, num_hidden_layers=9,
+                         num_attention_heads=10,
+                         max_position_embeddings=2048, dtype="bfloat16")
+        engine_cfg = dict(max_batch_size=8, max_seq_len=448, block_size=64,
+                          token_budget=128, num_blocks=56)
+        prompt_blocks, max_new = 3, 16
+        num_groups = num_groups or 3
+        group_size = group_size or 6
+    else:
+        model_cfg = dict(vocab_size=512, hidden_size=128,
+                         intermediate_size=352, num_hidden_layers=2,
+                         num_attention_heads=4, max_position_embeddings=256)
+        engine_cfg = dict(max_batch_size=4, max_seq_len=64, block_size=8,
+                          token_budget=16, num_blocks=24)
+        prompt_blocks, max_new = 3, 8
+        num_groups = num_groups or 3
+        group_size = group_size or 4
+    bs = engine_cfg["block_size"]
+    rng = np.random.RandomState(seed)
+    groups = [rng.randint(0, model_cfg["vocab_size"],
+                          (prompt_blocks * bs,)).tolist()
+              for _ in range(num_groups)]
+    prompts = [groups[g] for _ in range(group_size)
+               for g in range(num_groups)]
+    chains = [prompt_block_hashes(g, bs) for g in groups]
+
+    P.seed(0)
+    model = LlamaForCausalLM(LlamaConfig(**model_cfg))
+    if on_accel:
+        model.bfloat16()
+    model.eval()
+
+    def serve(wire):
+        pre = ServingEngine(model, **engine_cfg)
+        pre.role = "prefill"
+        decs = [ServingEngine(model, **engine_cfg) for _ in range(2)]
+        for e in decs:
+            e.role = "decode"
+        fab = KVFabric(MemoryKV())
+        srv = BlockWireServer(pre) if wire else None
+        try:
+            fe = ServingFrontend([pre] + decs, kv_fabric=fab)
+            t0 = time.monotonic()
+            rids = [fe.submit(p, max_new_tokens=max_new) for p in prompts]
+            fe.run()
+            wall = time.monotonic() - t0
+        finally:
+            if srv is not None:
+                srv.close()
+        res = fe.results()
+        c = fab.counters
+        # decode-side imported payloads, packed re-export: the raw bytes
+        # the transports must agree on bit-for-bit
+        payloads = {}
+        for gi, hs in enumerate(chains):
+            for e in decs:
+                header, raw = e.export_blocks_packed(hs)
+                if header["hashes"] == hs:
+                    payloads[gi] = raw
+                    break
+        assert len(payloads) == len(chains), (
+            "a prompt group's chain never landed whole on a decode "
+            "replica — the transfer machinery idled")
+        hop = (c["wire_bytes_total"] + 2 * c["relay_bytes_total"]) \
+            / max(c["pulled_bytes_total"], 1)
+        snap = fe.metrics.snapshot()["counters"]
+        return {
+            "tokens": [res[r].tokens for r in rids],
+            "payloads": payloads,
+            "hop_bytes": round(hop, 4),
+            "fabric": dict(c),
+            "wire_pulls_metric": snap.get("fabric_wire_pulls_total", 0),
+            "relay_pulls_metric": snap.get("fabric_relay_pulls_total", 0),
+            "wall_s": round(wall, 3),
+        }
+
+    relay = serve(wire=False)
+    direct = serve(wire=True)
+    assert direct["tokens"] == relay["tokens"], \
+        "transport changed greedy outputs — parity violation"
+    for gi in range(len(chains)):
+        assert direct["payloads"][gi] == relay["payloads"][gi], (
+            f"group {gi}: wire-imported blocks differ byte-wise from "
+            "relay-imported blocks")
+    # the headline contract, counter-asserted: zero payload bytes
+    # through the frontend on the direct path, everything one-hop
+    assert direct["fabric"]["relay_bytes_total"] == 0
+    assert direct["fabric"]["relay_pulls_total"] == 0
+    assert direct["fabric"]["wire_pulls_total"] >= 1
+    assert direct["relay_pulls_metric"] == 0
+    assert direct["wire_pulls_metric"] >= 1
+    assert direct["fabric"]["wire_bytes_total"] == \
+        direct["fabric"]["pulled_bytes_total"] > 0
+    # and the relay leg really pays double: every byte crosses twice
+    assert relay["fabric"]["wire_pulls_total"] == 0
+    assert relay["hop_bytes"] >= 2.0
+    assert direct["hop_bytes"] == 1.0
+    run = direct if transport == "wire" else relay
+    extra = {
+        "host": bench_ladder.host_fingerprint(),
+        "backend": backend,
+        "transport": transport,
+        "num_groups": num_groups,
+        "group_size": group_size,
+        "prompt_blocks": prompt_blocks,
+        "block_size": bs,
+        "max_new_tokens": max_new,
+        "hop_bytes_wire": direct["hop_bytes"],
+        "hop_bytes_relay": relay["hop_bytes"],
+        "wire_bytes": direct["fabric"]["wire_bytes_total"],
+        "relay_bytes": relay["fabric"]["relay_bytes_total"],
+        "pulled_bytes_wire": direct["fabric"]["pulled_bytes_total"],
+        "pulled_bytes_relay": relay["fabric"]["pulled_bytes_total"],
+        "wire_pulls": direct["fabric"]["wire_pulls_total"],
+        "relay_pulls": relay["fabric"]["relay_pulls_total"],
+        "wall_s_wire": direct["wall_s"],
+        "wall_s_relay": relay["wall_s"],
+        "outputs_token_identical": True,
+        "imported_blocks_byte_identical": True,
+        "method": "same concurrent identical-prompt fabric stream served "
+                  "relay-only vs with a blockwire listener on the prefill "
+                  "replica; value = (wire_bytes*1 + relay_bytes*2) / "
+                  "pulled_bytes — payload-crossing hops per transferred "
+                  "byte (deterministic byte counters, wall-clock-free)",
+    }
+    return [
+        {
+            "metric": "serving_disagg_payload_hop_bytes",
+            "value": run["hop_bytes"],
+            "unit": "payload hops per pulled byte (1.0=direct, 2.0=relay)",
+            "extra": extra,
+        },
+        {
+            "metric": "serving_disagg_frontend_relay_bytes",
+            "value": float(direct["fabric"]["relay_bytes_total"]),
+            "unit": "payload bytes relayed through the frontend on the "
+                    "direct path (must be 0)",
+            "extra": {
+                "host": bench_ladder.host_fingerprint(),
+                "backend": backend,
+                "wire_bytes": direct["fabric"]["wire_bytes_total"],
+                "pulled_bytes": direct["fabric"]["pulled_bytes_total"],
+                "method": "fabric relay_bytes_total after the direct-wire "
+                          "leg of the transport A/B — asserted 0 in-bench "
+                          "(every payload byte rode the data plane)",
+            },
+        },
+    ]
+
+
 def run_bench_megastep(num_requests=None, megastep_k=8, seed=0):
     """Megastep rung (ISSUE 9): a closed batch of requests served to
     completion with in-graph K-step decode vs per-token stepping.  The
@@ -1049,6 +1235,16 @@ def main(argv=None):
                          "decode split over the KV fabric; reports the "
                          "fleet-wide computed-prefill-token ratio "
                          "(transferred blocks count as not-computed)")
+    ap.add_argument("--wire", action="store_true",
+                    help="with --disagg: transport A/B (ISSUE 20) — the "
+                         "fabric stream over the frontend relay vs the "
+                         "binary blockwire data plane; reports payload "
+                         "hop-bytes per pulled byte on the DIRECT path "
+                         "(1.0) plus the frontend-relayed-bytes rung (0)")
+    ap.add_argument("--relay", action="store_true",
+                    help="with --disagg: the same transport A/B but the "
+                         "hop-bytes rung records the RELAY leg (2.0) — "
+                         "the operator-facing worst-case view")
     ap.add_argument("--megastep", action="store_true",
                     help="megastep workload — a closed batch served with "
                          "in-graph K-step decode vs per-token stepping; "
@@ -1086,6 +1282,9 @@ def main(argv=None):
                                           seed=args.seed)
     elif args.warm_pool:
         line = run_bench_warm_pool(seed=args.seed)
+    elif args.disagg and (args.wire or args.relay):
+        line = run_bench_disagg_wire(
+            seed=args.seed, transport="relay" if args.relay else "wire")
     elif args.disagg:
         line = run_bench_disagg(seed=args.seed)
     elif args.staggered_admission:
@@ -1108,7 +1307,8 @@ def main(argv=None):
         line = run_bench(num_requests=args.num_requests,
                          rate_rps=args.rate_rps,
                          replicas=args.replicas, seed=args.seed)
-    print(json.dumps(line))
+    for rung in (line if isinstance(line, list) else [line]):
+        print(json.dumps(rung))
 
 
 if __name__ == "__main__":

@@ -596,10 +596,18 @@ def run_chaos_disagg(seed=0, num_requests=16, max_steps=3000):
     request is token-identical to colocated fault-free serving (greedy
     AND the dedup twins), every fabric fault degraded to recompute, and
     the prefill/pull/dedup machinery actually ran (a soak where the
-    fabric quietly idled must not count as coverage)."""
+    fabric quietly idled must not count as coverage).
+
+    The prefill replica additionally serves a REAL blockwire listener
+    (ISSUE 20) with the ``fabric.wire`` failpoint armed: the first
+    direct pull's handshake errors server-side and must degrade to the
+    frontend relay, later pulls ride the wire — both transports under
+    the same parity/replay gates (the wire handshake is synchronous
+    with the pull, so the soak stays step-deterministic)."""
     from paddle_tpu.distributed.rpc import RpcTimeout
     from paddle_tpu.inference import (FaultInjector, RequestStatus,
                                       ServingEngine, ServingFrontend)
+    from paddle_tpu.inference.blockwire import BlockWireServer
     from paddle_tpu.inference.faults import FaultyReplica
     from paddle_tpu.inference.kv_fabric import KVFabric, MemoryKV
     from paddle_tpu.inference.serving import prompt_block_hashes
@@ -625,6 +633,7 @@ def run_chaos_disagg(seed=0, num_requests=16, max_steps=3000):
         "fabric.publish": {"kind": "error", "after": 1, "times": 1},
         "fabric.pull": {"kind": "error", "after": 1, "times": 1},
         "fabric.directory": {"kind": "error", "after": 4, "times": 1},
+        "fabric.wire": {"kind": "error", "times": 1},
         "r0.step": {"kind": "error", "after": 8, "times": 1},
     }, seed=seed, replica_namespaces=["r0", "r1", "r2"])
     tracer = Tracer(clock=tclock, proc="frontend")
@@ -649,24 +658,33 @@ def run_chaos_disagg(seed=0, num_requests=16, max_steps=3000):
         return FaultyReplica(eng, inj, name=f"r{i}",
                              timeout_exc=RpcTimeout)
 
-    fe = ServingFrontend(
-        [mk(0, "prefill"), mk(1, "decode"), mk(2, "decode")],
-        kv_fabric=fab, epoch=2, tracer=tracer)
+    r0 = mk(0, "prefill")
+    # the data plane under chaos: a real loopback listener on the
+    # prefill engine (FaultyReplica passes wire_endpoint through), its
+    # handshake fenced by the fabric's own epoch fence and carrying the
+    # armed fabric.wire failpoint
+    wire = BlockWireServer(r0._eng, fence=fab.fence, fault_injector=inj)
+    try:
+        fe = ServingFrontend(
+            [r0, mk(1, "decode"), mk(2, "decode")],
+            kv_fabric=fab, epoch=2, tracer=tracer)
 
-    rids = []
-    submitted = 0
-    while (fe.pending or submitted < len(reqs)) and step_i < max_steps:
-        for _ in range(2):
-            if submitted < len(reqs):
-                p, m, pr = reqs[submitted]
-                rids.append(fe.submit(p, max_new_tokens=m, priority=pr))
-                submitted += 1
-        fe.step()
-        step_i += 1
-    for rep in list(fe.replicas):
-        if not rep.alive:
-            fe.remove_replica(rep)
-            tracer.absorb(rep.engine._eng.pop_trace_events())
+        rids = []
+        submitted = 0
+        while (fe.pending or submitted < len(reqs)) and step_i < max_steps:
+            for _ in range(2):
+                if submitted < len(reqs):
+                    p, m, pr = reqs[submitted]
+                    rids.append(fe.submit(p, max_new_tokens=m, priority=pr))
+                    submitted += 1
+            fe.step()
+            step_i += 1
+        for rep in list(fe.replicas):
+            if not rep.alive:
+                fe.remove_replica(rep)
+                tracer.absorb(rep.engine._eng.pop_trace_events())
+    finally:
+        wire.close()
 
     # ---- disaggregation contract
     res = fe.results()
@@ -683,8 +701,17 @@ def run_chaos_disagg(seed=0, num_requests=16, max_steps=3000):
             mismatched.append(rid)
     assert not mismatched, (
         f"disagg survivors diverged from colocated serving: {mismatched}")
-    for site in ("fabric.publish", "fabric.pull", "fabric.directory"):
+    for site in ("fabric.publish", "fabric.pull", "fabric.directory",
+                 "fabric.wire"):
         assert inj.fires(site) >= 1, f"failpoint {site} never fired"
+    # the wire both failed AND served under the same soak: the armed
+    # fabric.wire error degraded one pull to the frontend relay, and at
+    # least one later pull crossed the binary data plane directly
+    assert fab.counters["wire_fallbacks_total"] >= 1, (
+        "the fabric.wire fault never degraded a pull to the relay")
+    assert fab.counters["wire_pulls_total"] >= 1, (
+        "no pull ever rode the binary data plane")
+    assert fab.counters["wire_bytes_total"] >= 1
     m = fe.metrics
     assert m.counter("fabric_prefill_passes_total") >= 1, (
         "no prefill pass ever ran — the fleet degraded to colocated")
@@ -718,7 +745,9 @@ def run_chaos_disagg(seed=0, num_requests=16, max_steps=3000):
         "fault_kinds_fired": inj.kinds_fired(),
         "fabric_fires": {s: inj.fires(s) for s in
                          ("fabric.publish", "fabric.pull",
-                          "fabric.directory")},
+                          "fabric.directory", "fabric.wire")},
+        "wire_pulls": fab.counters["wire_pulls_total"],
+        "wire_fallbacks": fab.counters["wire_fallbacks_total"],
         "prefill_passes": m.counter("fabric_prefill_passes_total"),
         "dedup_waits": m.counter("fabric_dedup_waits_total"),
         "recomputes": m.counter("fabric_recomputes_total"),

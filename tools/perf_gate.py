@@ -260,6 +260,16 @@ ABS_RUNG_BOUNDS = (
     # accept, which the round-over-round delta check alone cannot catch
     # on the first round the rung appears
     ("serving_spec_forwards_per_token", None, 1.0),
+    # data-plane rungs (r20, ISSUE 20): payload hop-bytes per pulled
+    # byte is exactly 1.0 when every transferred block rides the direct
+    # wire and exactly 2.0 when everything relays through the frontend;
+    # anything at or above 1.5 means at least half the payload bytes
+    # fell back off the data plane.  The frontend-relay-bytes rung is
+    # 0.0 by contract (its round-over-round delta check auto-skips a
+    # zero baseline, so the absolute bound IS the gate): a single
+    # relayed byte on the direct path fails the round.
+    ("serving_disagg_payload_hop_bytes", None, 1.4999),
+    ("serving_disagg_frontend_relay_bytes", None, 0.0),
 )
 
 

@@ -40,7 +40,11 @@ Spec JSON (everything the worker needs to be a bit-identical replica):
      "model": {"vocab_size": 256, "hidden_size": 64, ...},   # LlamaConfig
      "engine": {"max_batch_size": 2, "max_seq_len": 64, ...},
      "bfloat16": false,
-     "role": "prefill"}    # optional disaggregation label (or "decode")
+     "role": "prefill",    # optional disaggregation label (or "decode")
+     "wire": true}         # optional binary KV data-plane listener
+                           # (ISSUE 20): the port rides the launch-KV
+                           # registration (/serving/wire/<name>) + every
+                           # health reply, next to the role label
 
 Every ``ServingEngine`` kwarg rides ``"engine"`` verbatim — including
 the speculative-decoding tier (ISSUE 19): ``{"engine": {"spec_k": 4}}``
@@ -166,6 +170,26 @@ def main(argv=None):
         pt = getattr(engine, "pop_trace_events", None)
         if pt is not None:
             pt()
+    wire_server = None
+    if spec.get("wire"):
+        # binary KV data plane (ISSUE 20): open the worker's blockwire
+        # listener before registering, sharing the SAME EpochFence the
+        # control RPCs fence through — a deposed frontend's pull is
+        # rejected typed on both planes.  Bind all interfaces and
+        # advertise the rpc stack's peer-reachable address.
+        import socket as _socket
+
+        from paddle_tpu.inference.blockwire import BlockWireServer
+
+        adv = os.environ.get("PADDLE_LOCAL_IP")
+        if not adv:
+            try:
+                adv = _socket.gethostbyname(_socket.gethostname())
+            except OSError:
+                adv = "127.0.0.1"
+        wire_server = BlockWireServer(engine, fence=fleet._WORKER["fence"],
+                                      fault_injector=injector,
+                                      host="0.0.0.0", advertise_host=adv)
     rpc.init_rpc(args.name, rank=args.rank, world_size=1,
                  master_endpoint=args.master)
     if role is not None:
@@ -176,6 +200,13 @@ def main(argv=None):
         from paddle_tpu.distributed.launch.master import KVClient
 
         KVClient(args.master).put(f"/serving/roles/{args.name}", role)
+    if wire_server is not None:
+        # the data-plane endpoint registers next to the role label (and
+        # rides every health reply), so peers can pull blocks directly
+        from paddle_tpu.distributed.launch.master import KVClient
+
+        KVClient(args.master).put(f"/serving/wire/{args.name}",
+                                  wire_server.endpoint)
     if args.warm:
         # the warm marker keeps this worker out of discovery (a
         # recovering frontend must not adopt pool inventory); the
@@ -185,6 +216,8 @@ def main(argv=None):
         KVClient(args.master).put(f"/serving/warm/{args.name}", "1")
     print(f"WORKER_READY {args.name} pid={os.getpid()}", flush=True)
     stop.wait()
+    if wire_server is not None:
+        wire_server.close()
     rpc.shutdown()
     print(f"WORKER_EXIT {args.name}", flush=True)
 
